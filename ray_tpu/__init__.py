@@ -1,0 +1,112 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+Tasks, actors, and a distributed object store (the Ray-equivalent core),
+plus `xla`-backend collectives over ICI, mesh-axis parallelism
+(DP/FSDP/TP/PP/SP/EP), and ML libraries: train, tune, data, serve, rllib —
+all designed TPU-first on JAX/XLA/Pallas.
+
+Public API parity target: python/ray/__init__.py of the reference
+(ray.init/remote/get/put/wait/kill, actors, placement groups, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private import worker as _worker
+from ray_tpu._private.worker import (
+    available_resources,
+    cluster_resources,
+    get,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    timeline,
+    wait,
+)
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+_DEFAULT_OPTION_KEYS = {
+    "num_cpus", "num_tpus", "num_returns", "resources", "max_retries",
+    "retry_exceptions", "runtime_env", "scheduling_strategy", "name",
+    "namespace", "lifetime", "max_restarts", "max_task_retries",
+    "max_concurrency", "memory",
+}
+
+
+def remote(*args, **options):
+    """@ray_tpu.remote — turn a function into a task or a class into an actor.
+
+    Usage (same shapes as the reference's @ray.remote):
+        @ray_tpu.remote
+        def f(x): ...
+
+        @ray_tpu.remote(num_cpus=2, num_tpus=1)
+        class A: ...
+    """
+    if len(args) == 1 and not options and (callable(args[0]) or isinstance(args[0], type)):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    bad = set(options) - _DEFAULT_OPTION_KEYS
+    if bad:
+        raise TypeError(f"unknown @remote options: {sorted(bad)}")
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, **{
+                k: v for k, v in options.items()
+                if k not in ("num_returns", "max_retries", "retry_exceptions", "memory")
+            })
+        return RemoteFunction(target, **{
+            k: v for k, v in options.items()
+            if k in ("num_returns", "num_cpus", "num_tpus", "resources",
+                     "max_retries", "retry_exceptions", "runtime_env",
+                     "scheduling_strategy")
+        })
+
+    return decorator
+
+
+def get_runtime_context() -> dict:
+    ctx = _worker.get_global_context()
+    return {
+        "job_id": ctx.job_id,
+        "node_id": ctx.node_id,
+        "worker_id": ctx.worker_id,
+        "is_driver": ctx.is_driver,
+    }
+
+
+__all__ = [
+    "ObjectRef",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "nodes",
+    "timeline",
+    "cluster_resources",
+    "available_resources",
+    "get_actor",
+    "get_runtime_context",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "exceptions",
+]

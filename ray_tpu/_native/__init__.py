@@ -1,0 +1,67 @@
+"""Build + load the native runtime library (libraytpu.so).
+
+The C++ sources live in ``src/`` at the repo root. We compile them on first
+import (cached by source mtime) — the environment guarantees g++. This keeps
+the native components buildable without a packaging step, like the
+reference's bazel-built core but without requiring bazel at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SRC_DIRS = [os.path.join(_REPO, "src", "object_store")]
+_LIB_PATH = os.path.join(_HERE, "libraytpu.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def _sources() -> list[str]:
+    out: list[str] = []
+    for d in _SRC_DIRS:
+        if os.path.isdir(d):
+            out.extend(
+                os.path.join(d, f) for f in sorted(os.listdir(d)) if f.endswith(".cc")
+            )
+    return out
+
+
+def _needs_build(sources: list[str]) -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > lib_mtime for s in sources)
+
+
+def build(force: bool = False) -> str:
+    sources = _sources()
+    if not sources:
+        raise RuntimeError(f"no native sources found under {_SRC_DIRS}")
+    if force or _needs_build(sources):
+        cmd = [
+            "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+            "-o", _LIB_PATH, *sources,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            path = build()
+            lib = ctypes.CDLL(path)
+            lib.raytpu_store_start.restype = ctypes.c_void_p
+            lib.raytpu_store_start.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+            ]
+            lib.raytpu_store_stop.argtypes = [ctypes.c_void_p]
+            _lib = lib
+    return _lib

@@ -1,0 +1,136 @@
+"""Runtime flag system.
+
+TPU-native equivalent of the reference's native flag file
+(src/ray/common/ray_config_def.h :: RAY_CONFIG macros): one place defining
+every runtime knob, each overridable per-process via the environment as
+``RAY_TPU_<name>``.  Library-level configs (ScalingConfig etc.) live with
+their libraries; this file is the *runtime* tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+
+def _env(name: str, default: Any) -> Any:
+    raw = os.environ.get(f"RAY_TPU_{name}")
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+@dataclasses.dataclass
+class RayTpuConfig:
+    """All core-runtime knobs. Mirrors ray_config_def.h's role."""
+
+    # --- object plane ---
+    # Objects below this size are inlined in RPC replies and live in the
+    # owner's in-process memory store (reference:
+    # ray_config_def.h :: max_direct_call_object_size ~100KiB).
+    max_direct_call_object_size: int = _env("max_direct_call_object_size", 100 * 1024)
+    # Shared-memory arena capacity. 0 = auto (30% of system memory, capped).
+    object_store_memory: int = _env("object_store_memory", 0)
+    object_store_fallback_directory: str = _env(
+        "object_store_fallback_directory", ""
+    )
+    # Chunk size for inter-node object transfer (reference ~5MiB chunks).
+    object_transfer_chunk_bytes: int = _env(
+        "object_transfer_chunk_bytes", 5 * 1024 * 1024
+    )
+
+    # --- health / liveness (reference: health_check_* in ray_config_def.h) ---
+    health_check_period_ms: int = _env("health_check_period_ms", 1000)
+    health_check_timeout_ms: int = _env("health_check_timeout_ms", 5000)
+    health_check_failure_threshold: int = _env("health_check_failure_threshold", 5)
+
+    # --- scheduling ---
+    # Above this utilization fraction the hybrid policy stops packing and
+    # spreads (reference: scheduler_spread_threshold 0.5).
+    scheduler_spread_threshold: float = _env("scheduler_spread_threshold", 0.5)
+    # Max number of workers a node agent keeps warm per (runtime_env, lang).
+    worker_pool_prestart: int = _env("worker_pool_prestart", 0)
+    worker_register_timeout_s: float = _env("worker_register_timeout_s", 30.0)
+    worker_startup_batch: int = _env("worker_startup_batch", 4)
+
+    # --- tasks / fault tolerance ---
+    task_max_retries_default: int = _env("task_max_retries_default", 3)
+    actor_max_restarts_default: int = _env("actor_max_restarts_default", 0)
+    lineage_pinning_enabled: bool = _env("lineage_pinning_enabled", True)
+
+    # --- task events / state API (reference: RAY_task_events_max_num_*) ---
+    task_events_max_buffer: int = _env("task_events_max_buffer", 10000)
+
+    # --- pubsub / rpc ---
+    rpc_connect_timeout_s: float = _env("rpc_connect_timeout_s", 30.0)
+    rpc_retry_initial_backoff_s: float = _env("rpc_retry_initial_backoff_s", 0.1)
+    rpc_retry_max_backoff_s: float = _env("rpc_retry_max_backoff_s", 5.0)
+    rpc_retry_max_attempts: int = _env("rpc_retry_max_attempts", 10)
+
+    # --- testing / chaos (reference: RAY_testing_asio_delay_us) ---
+    testing_rpc_delay_ms: int = _env("testing_rpc_delay_ms", 0)
+
+    # --- metrics ---
+    metrics_report_interval_ms: int = _env("metrics_report_interval_ms", 2000)
+
+    # --- TPU topology ---
+    # Override autodetected slice topology, e.g. "v4-32". Empty = detect.
+    tpu_slice_override: str = _env("tpu_slice_override", "")
+
+    def apply_system_config(self, system_config: dict[str, Any] | None) -> None:
+        """Apply a ``_system_config`` dict (reference: ray.init(_system_config=...)).
+
+        The applied dict is remembered so cluster subprocesses can inherit it
+        (the reference head propagates _system_config cluster-wide the same
+        way)."""
+        global _applied_system_config
+        if not system_config:
+            return
+        for key, value in system_config.items():
+            if not hasattr(self, key):
+                raise ValueError(f"Unknown system config key: {key!r}")
+            setattr(self, key, value)
+        _applied_system_config.update(system_config)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, raw: str) -> "RayTpuConfig":
+        cfg = cls()
+        for key, value in json.loads(raw).items():
+            setattr(cfg, key, value)
+        return cfg
+
+
+_config: RayTpuConfig | None = None
+_applied_system_config: dict[str, Any] = {}
+
+
+def global_config() -> RayTpuConfig:
+    global _config
+    if _config is None:
+        _config = RayTpuConfig()
+        # Subprocesses inherit the driver's _system_config via env.
+        inherited = os.environ.get("RAYTPU_SYSTEM_CONFIG")
+        if inherited:
+            _config.apply_system_config(json.loads(inherited))
+    return _config
+
+
+def applied_system_config() -> dict[str, Any]:
+    return dict(_applied_system_config)
+
+
+def reset_config() -> None:
+    global _config
+    _config = None
+    _applied_system_config.clear()

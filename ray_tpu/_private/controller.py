@@ -1,0 +1,812 @@
+"""The cluster controller — control plane of the framework.
+
+Role-equivalent of the reference GCS server
+(src/ray/gcs/gcs_server/gcs_server.cc [N1]) and its managers:
+  * NodeManager      — gcs_node_manager.cc / gcs_health_check_manager.cc [N4]
+  * Scheduler        — node selection for leases (HybridSchedulingPolicy,
+                       src/ray/raylet/scheduling/scheduling_policy.cc [N10];
+                       centralized here rather than per-raylet for v0)
+  * ActorManager     — gcs_actor_manager.cc / gcs_actor_scheduler.cc [N2]
+  * PlacementGroups  — gcs_placement_group_manager.cc (2-phase commit) [N3]
+  * KV               — gcs_kv_manager.cc :: GcsInternalKVManager [N6]
+  * PubSub           — src/ray/pubsub/ + gcs_publisher.cc [N8]
+  * JobManager       — gcs_job_manager.cc [N5]
+  * TaskEvents       — gcs_task_manager.cc (state API feed) [N5]
+
+Runs as its own process (``python -m ray_tpu._private.controller``).
+State is in-memory with optional JSON snapshot persistence (the reference's
+in_memory_store_client vs redis_store_client distinction [N7]).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import collections
+import itertools
+import json
+import os
+import time
+from typing import Any
+
+from ray_tpu._private.config import global_config
+from ray_tpu._private.ids import ActorID, PlacementGroupID
+from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection
+
+ACTOR_STATES = ("PENDING", "ALIVE", "RESTARTING", "DEAD")
+PG_STATES = ("PENDING", "CREATED", "REMOVED", "RESCHEDULING")
+
+
+class NodeInfo:
+    def __init__(self, payload: dict):
+        self.node_id: str = payload["node_id"]
+        self.agent_addr: tuple = tuple(payload["agent_addr"])
+        self.resources_total: dict = dict(payload["resources"])
+        self.resources_available: dict = dict(payload["resources"])
+        self.store_info: dict = payload["store_info"]
+        self.labels: dict = payload.get("labels", {})
+        self.last_heartbeat = time.monotonic()
+        self.alive = True
+        self.client: RpcClient | None = None
+
+    def snapshot(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "agent_addr": list(self.agent_addr),
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "labels": self.labels,
+            "alive": self.alive,
+            "store_info": self.store_info,
+        }
+
+
+class ActorInfo:
+    def __init__(self, spec: dict):
+        self.actor_id: str = spec["actor_id"]
+        self.spec = spec
+        self.state = "PENDING"
+        self.address: tuple | None = None
+        self.node_id: str | None = None
+        self.worker_id: str | None = None
+        self.restarts_remaining: int = spec.get("max_restarts", 0)
+        self.name: str | None = spec.get("name") or None
+        self.detached: bool = spec.get("lifetime") == "detached"
+        self.job_id: str = spec.get("job_id", "")
+        self.death_cause: str | None = None
+        self.ready_event = asyncio.Event()
+
+    def snapshot(self) -> dict:
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "name": self.name,
+            "node_id": self.node_id,
+            "pid": self.spec.get("pid"),
+            "class_name": self.spec.get("class_name"),
+            "job_id": self.job_id,
+            "detached": self.detached,
+            "restarts_remaining": self.restarts_remaining,
+            "death_cause": self.death_cause,
+        }
+
+
+class PlacementGroupInfo:
+    def __init__(self, pg_id: str, bundles: list[dict], strategy: str, name: str, job_id: str):
+        self.pg_id = pg_id
+        self.bundles = bundles              # list of resource dicts
+        self.strategy = strategy
+        self.name = name
+        self.job_id = job_id
+        self.state = "PENDING"
+        self.bundle_nodes: list[str | None] = [None] * len(bundles)
+        self.ready_event = asyncio.Event()
+
+    def snapshot(self) -> dict:
+        return {
+            "pg_id": self.pg_id,
+            "state": self.state,
+            "strategy": self.strategy,
+            "name": self.name,
+            "bundles": self.bundles,
+            "bundle_nodes": self.bundle_nodes,
+            "job_id": self.job_id,
+        }
+
+
+class Controller:
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        self.server = RpcServer(name="controller")
+        self.server.on_disconnect = self._on_disconnect
+        self.nodes: dict[str, NodeInfo] = {}
+        self.actors: dict[str, ActorInfo] = {}
+        self.named_actors: dict[tuple, str] = {}  # (namespace, name) -> actor_id
+        self.pgs: dict[str, PlacementGroupInfo] = {}
+        self.kv: dict[str, dict[str, bytes]] = collections.defaultdict(dict)
+        self.jobs: dict[str, dict] = {}
+        self.clients: dict[str, dict] = {}  # worker/driver registry
+        self.subscribers: dict[str, set[ServerConnection]] = collections.defaultdict(set)
+        self.task_events: collections.deque = collections.deque(
+            maxlen=global_config().task_events_max_buffer
+        )
+        self._rr = itertools.count()
+
+    # ------------------------------------------------------------------
+    async def start(self, host: str, port: int) -> int:
+        self.server.route_object(self)
+        bound = await self.server.start(host, port)
+        asyncio.get_running_loop().create_task(self._health_check_loop())
+        return bound
+
+    async def _node_client(self, node: NodeInfo) -> RpcClient:
+        if node.client is None or not node.client.connected:
+            node.client = RpcClient(node.agent_addr, name=f"to-agent-{node.node_id[:10]}")
+            await node.client.connect()
+        return node.client
+
+    # ------------------------------------------------------------------
+    # pubsub [N8]
+    # ------------------------------------------------------------------
+    async def rpc_subscribe(self, conn: ServerConnection, payload) -> dict:
+        for channel in payload["channels"]:
+            self.subscribers[channel].add(conn)
+        conn.context.setdefault("subscriptions", set()).update(payload["channels"])
+        return {"status": "ok"}
+
+    async def publish(self, channel: str, message: Any) -> None:
+        dead = []
+        for conn in self.subscribers.get(channel, set()):
+            if conn.closed.is_set():
+                dead.append(conn)
+                continue
+            await conn.push(channel, message)
+        for conn in dead:
+            self.subscribers[channel].discard(conn)
+
+    async def rpc_publish(self, conn, payload) -> dict:
+        await self.publish(payload["channel"], payload["message"])
+        return {"status": "ok"}
+
+    # ------------------------------------------------------------------
+    # node management [N4] + health checks
+    # ------------------------------------------------------------------
+    async def rpc_register_node(self, conn: ServerConnection, payload) -> dict:
+        node = NodeInfo(payload)
+        self.nodes[node.node_id] = node
+        conn.context["node_id"] = node.node_id
+        await self.publish("node_added", node.snapshot())
+        await self._retry_pending()
+        return {"status": "ok"}
+
+    async def rpc_heartbeat(self, conn, payload) -> dict:
+        node = self.nodes.get(payload["node_id"])
+        if node is None:
+            return {"status": "unknown_node"}
+        node.last_heartbeat = time.monotonic()
+        node.resources_available = payload["resources_available"]
+        if not node.alive:
+            node.alive = True
+        return {"status": "ok"}
+
+    async def _health_check_loop(self) -> None:
+        cfg = global_config()
+        period = cfg.health_check_period_ms / 1000.0
+        timeout = (
+            cfg.health_check_timeout_ms * cfg.health_check_failure_threshold / 1000.0
+        )
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_heartbeat > timeout:
+                    await self._on_node_death(node)
+
+    async def _on_node_death(self, node: NodeInfo) -> None:
+        node.alive = False
+        await self.publish("node_removed", {"node_id": node.node_id})
+        # Fail actors on the node; restart the restartable ones.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node.node_id and actor.state in ("ALIVE", "PENDING"):
+                await self._handle_actor_failure(actor, f"node {node.node_id} died")
+        # Reschedule placement-group bundles that lived there.
+        for pg in self.pgs.values():
+            if pg.state == "CREATED" and node.node_id in pg.bundle_nodes:
+                pg.state = "RESCHEDULING"
+                pg.ready_event.clear()
+                for i, nid in enumerate(pg.bundle_nodes):
+                    if nid == node.node_id:
+                        pg.bundle_nodes[i] = None
+                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+
+    async def _on_disconnect(self, conn: ServerConnection) -> None:
+        node_id = conn.context.get("node_id")
+        if node_id and node_id in self.nodes:
+            node = self.nodes[node_id]
+            if node.alive:
+                await self._on_node_death(node)
+        client_id = conn.context.get("client_id")
+        if client_id:
+            info = self.clients.pop(client_id, None)
+            if info and info.get("is_driver"):
+                await self._on_driver_exit(info["job_id"])
+        for channel in conn.context.get("subscriptions", ()):
+            self.subscribers[channel].discard(conn)
+
+    # ------------------------------------------------------------------
+    # clients / jobs [N5]
+    # ------------------------------------------------------------------
+    async def rpc_register_client(self, conn: ServerConnection, payload) -> dict:
+        self.clients[payload["worker_id"]] = payload
+        conn.context["client_id"] = payload["worker_id"]
+        if payload.get("is_driver"):
+            job_id = payload["job_id"]
+            self.jobs.setdefault(
+                job_id,
+                {
+                    "job_id": job_id,
+                    "driver_id": payload["worker_id"],
+                    "start_time": time.time(),
+                    "state": "RUNNING",
+                },
+            )
+        return {"status": "ok"}
+
+    async def _on_driver_exit(self, job_id: str) -> None:
+        job = self.jobs.get(job_id)
+        if job:
+            job["state"] = "FINISHED"
+            job["end_time"] = time.time()
+        # Kill non-detached actors of the job.
+        for actor in list(self.actors.values()):
+            if actor.job_id == job_id and not actor.detached and actor.state != "DEAD":
+                await self._kill_actor(actor, "driver exited", no_restart=True)
+        # Remove the job's placement groups.
+        for pg in list(self.pgs.values()):
+            if pg.job_id == job_id and pg.state != "REMOVED":
+                await self._remove_pg(pg)
+        await self.publish("job_finished", {"job_id": job_id})
+
+    async def rpc_list_jobs(self, conn, payload) -> list:
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------
+    # KV [N6]
+    # ------------------------------------------------------------------
+    async def rpc_kv_put(self, conn, payload) -> dict:
+        ns = payload.get("namespace", "default")
+        overwrite = payload.get("overwrite", True)
+        if not overwrite and payload["key"] in self.kv[ns]:
+            return {"status": "exists"}
+        self.kv[ns][payload["key"]] = payload["value"]
+        return {"status": "ok"}
+
+    async def rpc_kv_get(self, conn, payload) -> dict:
+        ns = payload.get("namespace", "default")
+        value = self.kv[ns].get(payload["key"])
+        return {"status": "ok" if value is not None else "missing", "value": value}
+
+    async def rpc_kv_del(self, conn, payload) -> dict:
+        ns = payload.get("namespace", "default")
+        existed = self.kv[ns].pop(payload["key"], None) is not None
+        return {"status": "ok", "existed": existed}
+
+    async def rpc_kv_keys(self, conn, payload) -> list:
+        ns = payload.get("namespace", "default")
+        prefix = payload.get("prefix", "")
+        return [k for k in self.kv[ns] if k.startswith(prefix)]
+
+    # ------------------------------------------------------------------
+    # lease scheduling (HybridSchedulingPolicy-flavored) [N10]
+    # ------------------------------------------------------------------
+    def _fits(self, node: NodeInfo, resources: dict) -> bool:
+        for key, need in resources.items():
+            if need <= 0:
+                continue
+            if node.resources_available.get(key, 0.0) + 1e-9 < need:
+                return False
+        return True
+
+    def _fits_total(self, node: NodeInfo, resources: dict) -> bool:
+        return all(
+            node.resources_total.get(k, 0.0) + 1e-9 >= v
+            for k, v in resources.items()
+            if v > 0
+        )
+
+    def _utilization(self, node: NodeInfo) -> float:
+        fractions = []
+        for key, total in node.resources_total.items():
+            if total > 0:
+                used = total - node.resources_available.get(key, 0.0)
+                fractions.append(used / total)
+        return max(fractions) if fractions else 0.0
+
+    def _pick_node(self, resources: dict, submitter_node: str | None, strategy: dict) -> NodeInfo | None:
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        kind = strategy.get("kind", "")
+        if kind == "pg":
+            pg = self.pgs.get(strategy["pg_id"])
+            if pg is None or pg.state != "CREATED":
+                return None
+            index = strategy.get("bundle_index", -1)
+            candidates = (
+                [pg.bundle_nodes[index]]
+                if index >= 0
+                else [n for n in pg.bundle_nodes]
+            )
+            for node_id in candidates:
+                node = self.nodes.get(node_id or "")
+                if node and node.alive:
+                    return node
+            return None
+        if kind == "node_affinity":
+            node = self.nodes.get(strategy["node_id"])
+            if node and node.alive and self._fits(node, resources):
+                return node
+            if strategy.get("soft"):
+                pass  # fall through to default policy
+            else:
+                return None
+        if kind == "SPREAD":
+            feasible = [n for n in alive if self._fits(n, resources)]
+            if not feasible:
+                feasible = [n for n in alive if self._fits_total(n, resources)]
+            if not feasible:
+                return None
+            return feasible[next(self._rr) % len(feasible)]
+        # Hybrid policy: prefer the submitter's node while its utilization is
+        # below the spread threshold, else best-fit across the cluster
+        # (scheduling_policy.cc :: HybridSchedulingPolicy).
+        threshold = global_config().scheduler_spread_threshold
+        local = self.nodes.get(submitter_node or "")
+        if (
+            local is not None
+            and local.alive
+            and self._fits(local, resources)
+            and self._utilization(local) < threshold
+        ):
+            return local
+        feasible = [n for n in alive if self._fits(n, resources)]
+        if feasible:
+            return min(feasible, key=self._utilization)
+        feasible_total = [n for n in alive if self._fits_total(n, resources)]
+        if feasible_total:
+            return min(feasible_total, key=self._utilization)
+        return None
+
+    async def rpc_request_lease(self, conn, payload) -> dict:
+        resources = payload["resources"]
+        strategy = payload.get("scheduling_strategy") or {}
+        deadline = time.monotonic() + 60.0
+        while True:
+            node = self._pick_node(resources, payload.get("submitter_node"), strategy)
+            if node is not None:
+                bundle = None
+                if strategy.get("kind") == "pg":
+                    bundle = {
+                        "pg_id": strategy["pg_id"],
+                        "bundle_index": strategy.get("bundle_index", -1),
+                    }
+                return {
+                    "status": "ok",
+                    "node_id": node.node_id,
+                    "agent_addr": list(node.agent_addr),
+                    "bundle": bundle,
+                }
+            if time.monotonic() > deadline:
+                return {"status": "infeasible"}
+            # Wait for capacity/new nodes (the reference queues in raylets;
+            # we queue here).
+            await asyncio.sleep(0.2)
+
+    async def _retry_pending(self) -> None:
+        for pg in list(self.pgs.values()):
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+
+    # ------------------------------------------------------------------
+    # actors [N2]
+    # ------------------------------------------------------------------
+    async def rpc_create_actor(self, conn, payload) -> dict:
+        spec = payload
+        actor = ActorInfo(spec)
+        if actor.name:
+            key = (spec.get("namespace", "default"), actor.name)
+            if key in self.named_actors:
+                return {"status": "name_exists", "actor_id": self.named_actors[key]}
+            self.named_actors[key] = actor.actor_id
+        self.actors[actor.actor_id] = actor
+        asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        return {"status": "ok", "actor_id": actor.actor_id}
+
+    async def _schedule_actor(self, actor: ActorInfo) -> None:
+        spec = actor.spec
+        deadline = time.monotonic() + 120.0
+        while True:
+            node = self._pick_node(
+                spec.get("resources", {"CPU": 1}),
+                spec.get("submitter_node"),
+                spec.get("scheduling_strategy") or {},
+            )
+            if node is not None:
+                try:
+                    client = await self._node_client(node)
+                    resp = await client.call(
+                        "start_actor",
+                        {
+                            "actor_id": actor.actor_id,
+                            "spec": {
+                                k: v
+                                for k, v in spec.items()
+                                if k not in ("creation_args",)
+                            },
+                            "creation_args": spec.get("creation_args"),
+                        },
+                    )
+                    if resp["status"] == "ok":
+                        actor.node_id = node.node_id
+                        actor.worker_id = resp["worker_id"]
+                        actor.spec["pid"] = resp.get("pid")
+                        actor.address = tuple(resp["worker_addr"])
+                        actor.state = "ALIVE"
+                        actor.ready_event.set()
+                        await self.publish("actor_state", actor.snapshot())
+                        return
+                except Exception:
+                    pass
+            if time.monotonic() > deadline:
+                actor.state = "DEAD"
+                actor.death_cause = "unschedulable: no feasible node"
+                actor.ready_event.set()
+                await self.publish("actor_state", actor.snapshot())
+                return
+            await asyncio.sleep(0.2)
+
+    async def _handle_actor_failure(self, actor: ActorInfo, cause: str) -> None:
+        if actor.state == "DEAD":
+            return
+        if actor.restarts_remaining != 0:
+            if actor.restarts_remaining > 0:
+                actor.restarts_remaining -= 1
+            actor.state = "RESTARTING"
+            actor.address = None
+            actor.ready_event.clear()
+            await self.publish("actor_state", actor.snapshot())
+            asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        else:
+            actor.state = "DEAD"
+            actor.death_cause = cause
+            actor.ready_event.set()
+            if actor.name:
+                self.named_actors.pop(
+                    (actor.spec.get("namespace", "default"), actor.name), None
+                )
+            await self.publish("actor_state", actor.snapshot())
+
+    async def rpc_worker_died(self, conn, payload) -> dict:
+        """Reported by a node agent when a worker process exits."""
+        actor_id = payload.get("actor_id")
+        if actor_id and actor_id in self.actors:
+            actor = self.actors[actor_id]
+            if payload.get("intended") or actor.state == "DEAD":
+                pass
+            else:
+                await self._handle_actor_failure(
+                    actor, f"worker process died (exit={payload.get('exit_code')})"
+                )
+        return {"status": "ok"}
+
+    async def rpc_get_actor_info(self, conn, payload) -> dict:
+        actor = self.actors.get(payload["actor_id"])
+        if actor is None:
+            return {"state": "UNKNOWN"}
+        if payload.get("wait_ready"):
+            await actor.ready_event.wait()
+        return {
+            "state": actor.state,
+            "address": list(actor.address) if actor.address else None,
+            "node_id": actor.node_id,
+            "death_cause": actor.death_cause,
+        }
+
+    async def rpc_get_named_actor(self, conn, payload) -> dict:
+        key = (payload.get("namespace", "default"), payload["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            return {"status": "missing"}
+        actor = self.actors[actor_id]
+        return {
+            "status": "ok",
+            "actor_id": actor_id,
+            "spec_meta": {
+                "class_name": actor.spec.get("class_name"),
+                "methods": actor.spec.get("methods", []),
+                "max_task_retries": actor.spec.get("max_task_retries", 0),
+            },
+        }
+
+    async def rpc_kill_actor(self, conn, payload) -> dict:
+        actor = self.actors.get(payload["actor_id"])
+        if actor is None:
+            return {"status": "missing"}
+        await self._kill_actor(
+            actor, "ray_tpu.kill", no_restart=payload.get("no_restart", True)
+        )
+        return {"status": "ok"}
+
+    async def _kill_actor(self, actor: ActorInfo, cause: str, no_restart: bool) -> None:
+        if no_restart:
+            actor.restarts_remaining = 0
+        node = self.nodes.get(actor.node_id or "")
+        if node is not None and node.alive and actor.worker_id:
+            try:
+                client = await self._node_client(node)
+                await client.call(
+                    "kill_worker",
+                    {"worker_id": actor.worker_id, "actor_id": actor.actor_id,
+                     "intended": no_restart},
+                )
+            except Exception:
+                pass
+        if no_restart:
+            actor.state = "DEAD"
+            actor.death_cause = cause
+            actor.ready_event.set()
+            if actor.name:
+                self.named_actors.pop(
+                    (actor.spec.get("namespace", "default"), actor.name), None
+                )
+            await self.publish("actor_state", actor.snapshot())
+
+    async def rpc_list_actors(self, conn, payload) -> list:
+        return [a.snapshot() for a in self.actors.values()]
+
+    # ------------------------------------------------------------------
+    # placement groups (2-phase commit across agents) [N3]
+    # ------------------------------------------------------------------
+    async def rpc_create_placement_group(self, conn, payload) -> dict:
+        pg = PlacementGroupInfo(
+            payload["pg_id"],
+            payload["bundles"],
+            payload.get("strategy", "PACK"),
+            payload.get("name", ""),
+            payload.get("job_id", ""),
+        )
+        self.pgs[pg.pg_id] = pg
+        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        return {"status": "ok", "pg_id": pg.pg_id}
+
+    def _plan_bundles(self, pg: PlacementGroupInfo) -> list[NodeInfo] | None:
+        """Pick a node per bundle honoring the strategy. Pure function of the
+        current availability snapshot (gcs_placement_group_scheduler.cc)."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        needed = [
+            (i, pg.bundles[i])
+            for i in range(len(pg.bundles))
+            if pg.bundle_nodes[i] is None
+        ]
+        avail = {n.node_id: dict(n.resources_available) for n in alive}
+
+        def can_host(node_id: str, bundle: dict) -> bool:
+            return all(
+                avail[node_id].get(k, 0.0) + 1e-9 >= v for k, v in bundle.items() if v > 0
+            )
+
+        def consume(node_id: str, bundle: dict) -> None:
+            for k, v in bundle.items():
+                avail[node_id][k] = avail[node_id].get(k, 0.0) - v
+
+        plan: dict[int, NodeInfo] = {}
+        strategy = pg.strategy
+        if strategy in ("STRICT_PACK", "PACK"):
+            # Try to land everything on one node first.
+            for node in sorted(alive, key=self._utilization):
+                trial = {n.node_id: dict(n.resources_available) for n in alive}
+                ok = True
+                for _, bundle in needed:
+                    if all(trial[node.node_id].get(k, 0) + 1e-9 >= v for k, v in bundle.items() if v > 0):
+                        for k, v in bundle.items():
+                            trial[node.node_id][k] = trial[node.node_id].get(k, 0) - v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return [
+                        node if pg.bundle_nodes[i] is None else self.nodes[pg.bundle_nodes[i]]
+                        for i in range(len(pg.bundles))
+                    ]
+            if strategy == "STRICT_PACK":
+                return None
+        if strategy in ("SPREAD", "STRICT_SPREAD"):
+            used_nodes: set[str] = {n for n in pg.bundle_nodes if n}
+            for index, bundle in needed:
+                choice = None
+                for node in sorted(alive, key=self._utilization):
+                    if strategy == "STRICT_SPREAD" and (
+                        node.node_id in used_nodes
+                        or any(p.node_id == node.node_id for p in plan.values())
+                    ):
+                        continue
+                    if can_host(node.node_id, bundle):
+                        choice = node
+                        break
+                if choice is None:
+                    return None
+                plan[index] = choice
+                consume(choice.node_id, bundle)
+        else:  # PACK fallback / DEFAULT: bin-pack greedily
+            for index, bundle in needed:
+                choice = None
+                for node in sorted(alive, key=lambda n: -self._utilization(n)):
+                    if can_host(node.node_id, bundle):
+                        choice = node
+                        break
+                if choice is None:
+                    return None
+                plan[index] = choice
+                consume(choice.node_id, bundle)
+        return [
+            plan[i] if pg.bundle_nodes[i] is None else self.nodes[pg.bundle_nodes[i]]
+            for i in range(len(pg.bundles))
+        ]
+
+    async def _schedule_pg(self, pg: PlacementGroupInfo) -> None:
+        deadline = time.monotonic() + 120.0
+        while pg.state in ("PENDING", "RESCHEDULING"):
+            placement = self._plan_bundles(pg)
+            if placement is not None:
+                # Phase 1: prepare (reserve) every missing bundle.
+                prepared: list[tuple[int, NodeInfo]] = []
+                ok = True
+                for index, node in enumerate(placement):
+                    if pg.bundle_nodes[index] is not None:
+                        continue
+                    try:
+                        client = await self._node_client(node)
+                        resp = await client.call(
+                            "prepare_bundle",
+                            {
+                                "pg_id": pg.pg_id,
+                                "bundle_index": index,
+                                "resources": pg.bundles[index],
+                            },
+                        )
+                        if resp["status"] != "ok":
+                            ok = False
+                            break
+                        prepared.append((index, node))
+                    except Exception:
+                        ok = False
+                        break
+                if ok:
+                    # Phase 2: commit. A node dying between prepare and
+                    # commit aborts this round: roll back and retry.
+                    committed: list[int] = []
+                    try:
+                        for index, node in prepared:
+                            client = await self._node_client(node)
+                            await client.call(
+                                "commit_bundle",
+                                {"pg_id": pg.pg_id, "bundle_index": index},
+                            )
+                            pg.bundle_nodes[index] = node.node_id
+                            committed.append(index)
+                    except Exception:
+                        ok = False
+                        for index in committed:
+                            pg.bundle_nodes[index] = None
+                if ok:
+                    pg.state = "CREATED"
+                    pg.ready_event.set()
+                    await self.publish("pg_state", pg.snapshot())
+                    return
+                # Rollback phase-1 reservations (committed ones included).
+                for index, node in prepared:
+                    try:
+                        client = await self._node_client(node)
+                        await client.call(
+                            "release_bundle",
+                            {"pg_id": pg.pg_id, "bundle_index": index},
+                        )
+                    except Exception:
+                        pass
+            if time.monotonic() > deadline:
+                await self.publish("pg_state", pg.snapshot())
+                return  # stays PENDING (autoscaler hint); creator may time out
+            await asyncio.sleep(0.2)
+
+    async def rpc_pg_ready(self, conn, payload) -> dict:
+        pg = self.pgs.get(payload["pg_id"])
+        if pg is None:
+            return {"status": "missing"}
+        await pg.ready_event.wait()
+        return {"status": "ok", "pg": pg.snapshot()}
+
+    async def rpc_remove_placement_group(self, conn, payload) -> dict:
+        pg = self.pgs.get(payload["pg_id"])
+        if pg is None:
+            return {"status": "missing"}
+        await self._remove_pg(pg)
+        return {"status": "ok"}
+
+    async def _remove_pg(self, pg: PlacementGroupInfo) -> None:
+        pg.state = "REMOVED"
+        for index, node_id in enumerate(pg.bundle_nodes):
+            node = self.nodes.get(node_id or "")
+            if node is None or not node.alive:
+                continue
+            try:
+                client = await self._node_client(node)
+                await client.call(
+                    "release_bundle", {"pg_id": pg.pg_id, "bundle_index": index}
+                )
+            except Exception:
+                pass
+        await self.publish("pg_state", pg.snapshot())
+
+    async def rpc_list_placement_groups(self, conn, payload) -> list:
+        return [pg.snapshot() for pg in self.pgs.values()]
+
+    # ------------------------------------------------------------------
+    # task events / state API feed [N5]
+    # ------------------------------------------------------------------
+    async def rpc_report_task_events(self, conn, payload) -> dict:
+        self.task_events.extend(payload["events"])
+        return {"status": "ok"}
+
+    async def rpc_list_task_events(self, conn, payload) -> list:
+        limit = payload.get("limit", 1000)
+        events = list(self.task_events)[-limit:]
+        return events
+
+    # ------------------------------------------------------------------
+    # cluster state queries
+    # ------------------------------------------------------------------
+    async def rpc_list_nodes(self, conn, payload) -> list:
+        return [n.snapshot() for n in self.nodes.values()]
+
+    async def rpc_cluster_resources(self, conn, payload) -> dict:
+        total: dict[str, float] = {}
+        for node in self.nodes.values():
+            if node.alive:
+                for k, v in node.resources_total.items():
+                    total[k] = total.get(k, 0.0) + v
+        return total
+
+    async def rpc_available_resources(self, conn, payload) -> dict:
+        total: dict[str, float] = {}
+        for node in self.nodes.values():
+            if node.alive:
+                for k, v in node.resources_available.items():
+                    total[k] = total.get(k, 0.0) + v
+        return total
+
+    async def rpc_list_workers(self, conn, payload) -> list:
+        return list(self.clients.values())
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session-dir", required=True)
+    args = parser.parse_args()
+
+    async def run() -> None:
+        controller = Controller(args.session_dir)
+        port = await controller.start(args.host, args.port)
+        # Write the bound port for the parent to discover.
+        with open(os.path.join(args.session_dir, "controller.addr"), "w") as f:
+            f.write(json.dumps({"host": args.host, "port": port}))
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,990 @@
+"""CoreContext — the in-process runtime of every driver and worker.
+
+Role-equivalent of the reference's C++ core worker
+(src/ray/core_worker/core_worker.cc :: CoreWorker [N18]) plus its satellite
+managers: task submission (transport/normal_task_submitter.cc,
+actor_task_submitter.cc [N19]), reference counting (reference_count.cc [N21]),
+task retries + lineage (task_manager.cc [N22]), object recovery
+(object_recovery_manager.cc [N23]), in-process memory store
+(memory_store.cc [N24]) and the plasma provider [N25].
+
+Sync public API over an asyncio core running on the IoThread.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import os
+import threading
+import time
+import traceback
+import weakref
+from typing import Any, Callable, Sequence
+
+from ray_tpu import exceptions
+from ray_tpu._private import serialization
+from ray_tpu._private.config import global_config
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFull
+from ray_tpu._private.rpc import ConnectionLost, IoThread, RpcClient, RpcError, RpcServer
+
+PENDING, INLINE, SHM, FAILED = "pending", "inline", "shm", "failed"
+
+# Zero-copy reads: values whose out-of-band buffers exceed this stay views
+# onto the arena (object pinned until the value is GC'd); smaller values are
+# copied out and released immediately.
+_ZERO_COPY_THRESHOLD = 1 << 20
+
+
+class ObjectState:
+    __slots__ = ("status", "data", "locations", "size", "error", "event")
+
+    def __init__(self):
+        self.status = PENDING
+        self.data: bytes | None = None
+        self.locations: list[dict] = []
+        self.size = 0
+        self.error: str | None = None
+        self.event = asyncio.Event()
+
+
+class LeasedWorker:
+    __slots__ = ("worker_id", "address", "client", "lease_id", "agent_addr", "resources_key")
+
+    def __init__(self, worker_id, address, client, lease_id, agent_addr, resources_key):
+        self.worker_id = worker_id
+        self.address = address
+        self.client = client
+        self.lease_id = lease_id
+        self.agent_addr = agent_addr
+        self.resources_key = resources_key
+
+
+class PendingTask:
+    __slots__ = ("spec", "attempts", "return_ids", "arg_refs")
+
+    def __init__(self, spec, return_ids, arg_refs):
+        self.spec = spec
+        self.attempts = 0
+        self.return_ids = return_ids
+        self.arg_refs = arg_refs
+
+
+def _resources_key(resources: dict, runtime_env_hash: str) -> str:
+    return repr(sorted(resources.items())) + "|" + runtime_env_hash
+
+
+class CoreContext:
+    def __init__(
+        self,
+        *,
+        job_id: str,
+        node_id: str,
+        controller_addr: tuple,
+        agent_addr: tuple,
+        store_info: dict,
+        is_driver: bool,
+        worker_id: str | None = None,
+    ):
+        self.job_id = JobID(job_id)
+        self.node_id = NodeID(node_id)
+        self.worker_id = WorkerID(worker_id) if worker_id else WorkerID.random()
+        self.is_driver = is_driver
+        self.io = IoThread()
+        self.controller_addr = tuple(controller_addr)
+        self.agent_addr = tuple(agent_addr)
+        self.store_info = store_info  # {socket, shm_path, capacity, spill_dir}
+        self._store: ObjectStoreClient | None = None
+        self._store_lock = threading.Lock()
+
+        # owner-side object state (memory store + object directory)
+        self._objects: dict[str, ObjectState] = {}
+        # distributed refcounting
+        self._local_refs: dict[str, int] = {}
+        self._submitted_refs: dict[str, int] = {}
+        self._borrowers: dict[str, set[str]] = {}
+        self._borrowed: dict[str, tuple] = {}  # obj_id -> owner_addr we registered with
+        self._refs_lock = threading.Lock()
+        # lineage: obj_id -> PendingTask of creating task (kept while refs live)
+        self._lineage: dict[str, PendingTask] = {}
+        self._task_counter = 0
+        self._put_counter = 0
+        self._counter_lock = threading.Lock()
+
+        # lease cache: resources_key -> list[LeasedWorker]
+        self._idle_leases: dict[str, list[LeasedWorker]] = {}
+        self._task_queues: dict[str, asyncio.Queue] = {}
+        self._active_dispatchers: dict[str, int] = {}
+        # direct clients: address -> RpcClient
+        self._clients: dict[tuple, RpcClient] = {}
+        self._clients_lock = asyncio.Lock()
+        # actor bookkeeping
+        self._actor_clients: dict[str, RpcClient] = {}
+        self._actor_addr_cache: dict[str, tuple] = {}
+        self._actor_seq: dict[str, int] = {}
+        self._actor_seq_lock = threading.Lock()
+
+        self.controller: RpcClient | None = None
+        self.agent: RpcClient | None = None
+        self.core_server = RpcServer(name=f"core-{self.worker_id[:12]}")
+        self.address: tuple | None = None
+
+        # function table cache (worker side)
+        self._function_cache: dict[str, Any] = {}
+        self._task_events: list[dict] = []
+        self._shutdown = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def connect(self) -> None:
+        self.io.run(self._connect_async())
+
+    async def _connect_async(self) -> None:
+        self.core_server.route_object(self)
+        port = await self.core_server.start()
+        self.address = ("127.0.0.1", port)
+        self.controller = RpcClient(self.controller_addr, name="to-controller")
+        await self.controller.connect()
+        self.agent = RpcClient(self.agent_addr, name="to-agent")
+        await self.agent.connect()
+        await self.controller.call(
+            "register_client",
+            {
+                "worker_id": self.worker_id,
+                "job_id": self.job_id,
+                "node_id": self.node_id,
+                "address": list(self.address),
+                "is_driver": self.is_driver,
+            },
+        )
+
+    @property
+    def store(self) -> ObjectStoreClient:
+        if self._store is None:
+            with self._store_lock:
+                if self._store is None:
+                    self._store = ObjectStoreClient(
+                        self.store_info["socket"],
+                        self.store_info["shm_path"],
+                        self.store_info["capacity"],
+                    )
+        return self._store
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        try:
+            self.io.run(self._shutdown_async(), timeout=5)
+        except Exception:
+            pass
+        self.io.stop()
+
+    async def _shutdown_async(self) -> None:
+        for addr, owner in list(self._borrowed.items()):
+            try:
+                client = await self._client_for(tuple(owner))
+                await client.call("remove_borrower", {"object_id": addr, "borrower": self.worker_id}, timeout=1)
+            except Exception:
+                pass
+        if self.controller is not None:
+            await self.controller.close()
+        if self.agent is not None:
+            await self.agent.close()
+        await self.core_server.stop()
+
+    async def _client_for(self, address: tuple) -> RpcClient:
+        address = tuple(address)
+        client = self._clients.get(address)
+        if client is not None and client.connected:
+            return client
+        client = RpcClient(address, name=f"to-{address}")
+        await client.connect()
+        self._clients[address] = client
+        return client
+
+    # ------------------------------------------------------------------
+    # reference counting (N21)
+    # ------------------------------------------------------------------
+    def add_local_ref(self, object_id: str) -> None:
+        with self._refs_lock:
+            self._local_refs[object_id] = self._local_refs.get(object_id, 0) + 1
+
+    def remove_local_ref(self, object_id: str) -> None:
+        if self._shutdown:
+            return
+        with self._refs_lock:
+            count = self._local_refs.get(object_id, 0) - 1
+            if count <= 0:
+                self._local_refs.pop(object_id, None)
+            else:
+                self._local_refs[object_id] = count
+                return
+        self._maybe_free(object_id)
+
+    def _maybe_free(self, object_id: str) -> None:
+        with self._refs_lock:
+            if (
+                self._local_refs.get(object_id, 0) > 0
+                or self._submitted_refs.get(object_id, 0) > 0
+                or self._borrowers.get(object_id)
+            ):
+                return
+            owned = object_id in self._objects
+        if not owned:
+            # We were a borrower: tell the owner we're done.
+            owner = self._borrowed.pop(object_id, None)
+            if owner is not None:
+                self.io.spawn(self._notify_remove_borrower(object_id, owner))
+            return
+        self.io.spawn(self._free_owned(object_id))
+
+    async def _notify_remove_borrower(self, object_id: str, owner: tuple) -> None:
+        try:
+            client = await self._client_for(owner)
+            await client.call(
+                "remove_borrower", {"object_id": object_id, "borrower": self.worker_id}
+            )
+        except Exception:
+            pass
+
+    async def _free_owned(self, object_id: str) -> None:
+        state = self._objects.pop(object_id, None)
+        self._lineage.pop(object_id, None)
+        if state is None or state.status != SHM:
+            return
+        for loc in state.locations:
+            try:
+                client = await self._client_for((loc["agent_host"], loc["agent_port"]))
+                await client.call("delete_object", {"object_id": object_id})
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # put / get / wait
+    # ------------------------------------------------------------------
+    def new_object_ref(self, object_id: str) -> ObjectRef:
+        return ObjectRef(object_id, self.address, runtime=self)
+
+    def put(self, value: Any) -> ObjectRef:
+        with self._counter_lock:
+            self._put_counter += 1
+            put_index = self._put_counter
+        task_scope = TaskID(f"tsk-{self.worker_id}")
+        object_id = ObjectID.for_put(task_scope, put_index)
+        payload, contained = serialization.serialize(value)
+        self._register_contained_borrows(contained)
+        state = ObjectState()
+        cfg = global_config()
+        if len(payload) <= cfg.max_direct_call_object_size:
+            state.status = INLINE
+            state.data = payload
+            state.size = len(payload)
+        else:
+            self._store_put_local(object_id, payload)
+            state.status = SHM
+            state.size = len(payload)
+            state.locations = [self._local_location()]
+        self.io.run(self._finish_state(object_id, state))
+        return self.new_object_ref(object_id)
+
+    async def _finish_state(self, object_id: str, state: ObjectState) -> None:
+        self._objects[object_id] = state
+        state.event.set()
+
+    def _store_put_local(self, object_id: str, payload: bytes) -> None:
+        try:
+            self.store.put(object_id, payload)
+            self.store.pin(object_id)
+        except FileExistsError:
+            pass
+        except ObjectStoreFull as exc:
+            raise exceptions.ObjectStoreFullError(str(exc)) from None
+
+    def _local_location(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "socket": self.store_info["socket"],
+            "shm_path": self.store_info["shm_path"],
+            "capacity": self.store_info["capacity"],
+            "agent_host": self.agent_addr[0],
+            "agent_port": self.agent_addr[1],
+        }
+
+    def _register_contained_borrows(self, refs: Sequence[ObjectRef]) -> None:
+        """Objects nested inside a stored value: keep them alive while the
+        outer value exists (simplified nested-ref handling of [N21])."""
+        for ref in refs:
+            self.add_local_ref(ref.id)  # leak-safe: freed at shutdown
+
+    def get(self, refs: ObjectRef | Sequence[ObjectRef], timeout: float | None = None) -> Any:
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+
+        async def _gather():
+            return await asyncio.wait_for(
+                asyncio.gather(*(self._get_one(r) for r in ref_list)), timeout
+            )
+
+        try:
+            values = self.io.run(_gather())
+        except (asyncio.TimeoutError, concurrent.futures.TimeoutError):
+            raise exceptions.GetTimeoutError(
+                f"get() timed out after {timeout}s"
+            ) from None
+        return values[0] if single else values
+
+    def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
+        return asyncio.run_coroutine_threadsafe(self._get_one(ref), self.io.loop)
+
+    async def _get_one(self, ref: ObjectRef) -> Any:
+        payload, pinned = await self._resolve_payload(ref)
+        return self._deserialize_value(ref.id, payload, pinned)
+
+    async def _resolve_payload(self, ref: ObjectRef) -> tuple[Any, bool]:
+        """Returns (payload bytes/memoryview, is_pinned_view)."""
+        state = self._objects.get(ref.id)
+        if state is not None:
+            await state.event.wait()
+            return await self._payload_from_state(ref.id, state)
+        # Not the owner: ask the owner (blocks server-side until ready).
+        owner = ref.owner_address
+        if owner is None:
+            raise exceptions.ObjectLostError(f"{ref.id}: no owner address")
+        client = await self._client_for(owner)
+        try:
+            resp = await client.call("get_object", {"object_id": ref.id})
+        except (ConnectionLost, RpcError) as exc:
+            raise exceptions.ObjectLostError(
+                f"{ref.id}: owner {owner} unreachable ({exc})"
+            ) from None
+        if resp["status"] == "failed":
+            self._raise_stored_error(resp["error"])
+        if resp["status"] == "inline":
+            return resp["data"], False
+        # shm
+        data = await self._fetch_shm(ref.id, resp["locations"], resp["size"])
+        return data, True
+
+    async def _payload_from_state(self, object_id: str, state: ObjectState):
+        if state.status == FAILED:
+            self._raise_stored_error(state.error)
+        if state.status == INLINE:
+            return state.data, False
+        data = await self._fetch_shm(object_id, state.locations, state.size)
+        return data, True
+
+    def _raise_stored_error(self, error_payload) -> None:
+        exc = serialization.deserialize(error_payload)
+        raise exc
+
+    async def _fetch_shm(self, object_id: str, locations: list[dict], size: int):
+        """Local store first; else pull via the remote node's agent
+        (object_manager.cc / pull_manager.cc-equivalent path [N16])."""
+        view = self.store.get(object_id, timeout_ms=0)
+        if view is not None:
+            return view
+        for loc in locations:
+            if loc["node_id"] == self.node_id:
+                view = self.store.get(object_id, timeout_ms=2000)
+                if view is not None:
+                    return view
+                continue
+            try:
+                data = await self._pull_remote(object_id, loc)
+            except Exception:
+                continue
+            if data is not None:
+                try:
+                    self.store.put(object_id, data)
+                except FileExistsError:
+                    pass
+                except ObjectStoreFull:
+                    return data  # serve from heap this once
+                view = self.store.get(object_id, timeout_ms=0)
+                return view if view is not None else data
+        # All copies gone: attempt lineage reconstruction (owner-side only).
+        if await self._try_reconstruct(object_id):
+            state = self._objects[object_id]
+            return (await self._payload_from_state(object_id, state))[0]
+        raise exceptions.ObjectLostError(f"{object_id}: all copies lost")
+
+    async def _pull_remote(self, object_id: str, loc: dict) -> bytes | None:
+        cfg = global_config()
+        client = await self._client_for((loc["agent_host"], loc["agent_port"]))
+        chunks: list[bytes] = []
+        offset = 0
+        while True:
+            resp = await client.call(
+                "pull_object_chunk",
+                {
+                    "object_id": object_id,
+                    "offset": offset,
+                    "chunk": cfg.object_transfer_chunk_bytes,
+                },
+            )
+            if resp["status"] != "ok":
+                return None
+            chunks.append(resp["data"])
+            offset += len(resp["data"])
+            if offset >= resp["total"]:
+                break
+        return b"".join(chunks)
+
+    def _deserialize_value(self, object_id: str, payload, pinned: bool) -> Any:
+        def resolver(ref_id: str, owner_address):
+            ref = ObjectRef(ref_id, owner_address, runtime=self)
+            self._note_borrow(ref_id, owner_address)
+            return ref
+
+        if pinned and len(payload) >= _ZERO_COPY_THRESHOLD:
+            value = serialization.deserialize(payload, resolver, zero_copy=True)
+            try:
+                self.store.pin(object_id)
+                store = self.store
+                weakref.finalize(
+                    value, _release_pinned, store, object_id
+                )
+                self.store.release(object_id)
+                return value
+            except TypeError:
+                pass  # not weakref-able: fall through to copy
+        value = serialization.deserialize(payload, resolver, zero_copy=False)
+        if pinned:
+            try:
+                self.store.release(object_id)
+            except Exception:
+                pass
+        return value
+
+    def _note_borrow(self, object_id: str, owner_address) -> None:
+        if owner_address is None or tuple(owner_address) == self.address:
+            return
+        if object_id in self._borrowed:
+            return
+        self._borrowed[object_id] = tuple(owner_address)
+        self.io.spawn(self._register_borrow(object_id, tuple(owner_address)))
+
+    async def _register_borrow(self, object_id: str, owner: tuple) -> None:
+        try:
+            client = await self._client_for(owner)
+            await client.call(
+                "add_borrower", {"object_id": object_id, "borrower": self.worker_id}
+            )
+        except Exception:
+            pass
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: float | None = None,
+        fetch_local: bool = True,
+    ) -> tuple[list[ObjectRef], list[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        return self.io.run(self._wait_async(list(refs), num_returns, timeout))
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        tasks = {
+            asyncio.ensure_future(self._wait_ready(ref)): ref for ref in refs
+        }
+        ready: list[ObjectRef] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = set(tasks.keys())
+        while pending and len(ready) < num_returns:
+            remaining = None
+            if deadline is not None:
+                remaining = max(0.0, deadline - time.monotonic())
+            done, pending = await asyncio.wait(
+                pending, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                # Retrieve exceptions: a ref whose owner is unreachable is
+                # "ready" in the sense that get() won't block (it will raise
+                # immediately) — same semantics the reference gives errored
+                # objects in ray.wait.
+                task.exception()
+                ready.append(tasks[task])
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+        for task in pending:
+            task.cancel()
+        ready_set = {r.id for r in ready}
+        not_ready = [r for r in refs if r.id not in ready_set]
+        return ready, not_ready
+
+    async def _wait_ready(self, ref: ObjectRef) -> None:
+        state = self._objects.get(ref.id)
+        if state is not None:
+            await state.event.wait()
+            return
+        client = await self._client_for(ref.owner_address)
+        await client.call("wait_object", {"object_id": ref.id})
+
+    # ------------------------------------------------------------------
+    # task submission (N19/N22)
+    # ------------------------------------------------------------------
+    def next_task_id(self) -> TaskID:
+        with self._counter_lock:
+            self._task_counter += 1
+            return TaskID(f"tsk-{self.worker_id[4:]}-{self._task_counter}")
+
+    def submit_task(
+        self,
+        *,
+        function_id: str,
+        name: str,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int = 1,
+        resources: dict | None = None,
+        max_retries: int | None = None,
+        retry_exceptions: bool = False,
+        runtime_env: dict | None = None,
+        scheduling_strategy: Any = None,
+    ) -> list[ObjectRef]:
+        cfg = global_config()
+        task_id = self.next_task_id()
+        payload, contained = serialization.serialize((args, kwargs))
+        arg_ref_ids = [r.id for r in contained]
+        # Submitted-task references: args stay alive until the task finishes.
+        with self._refs_lock:
+            for rid in arg_ref_ids:
+                self._submitted_refs[rid] = self._submitted_refs.get(rid, 0) + 1
+        return_ids = [
+            ObjectID.for_task_return(task_id, i) for i in range(num_returns)
+        ]
+        spec = {
+            "task_id": task_id,
+            "job_id": self.job_id,
+            "function_id": function_id,
+            "name": name,
+            "args": payload,
+            "num_returns": num_returns,
+            "resources": resources or {"CPU": 1},
+            "owner": {"worker_id": self.worker_id, "address": list(self.address)},
+            "runtime_env": runtime_env or {},
+            "scheduling_strategy": _encode_strategy(scheduling_strategy),
+            "max_retries": (
+                cfg.task_max_retries_default if max_retries is None else max_retries
+            ),
+            "retry_exceptions": retry_exceptions,
+        }
+        record = PendingTask(spec, return_ids, arg_ref_ids)
+        refs = []
+        for rid in return_ids:
+            state = ObjectState()
+            self._objects[rid] = state
+            if global_config().lineage_pinning_enabled:
+                self._lineage[rid] = record
+            refs.append(self.new_object_ref(rid))
+        self.io.spawn(self._enqueue_task(record))
+        return refs
+
+    # The submitter keeps a per-(resources, runtime_env) task queue drained by
+    # dispatcher coroutines that each hold one worker lease and pipeline tasks
+    # through it — the lease-reuse behavior of normal_task_submitter.cc.
+    _MAX_DISPATCHERS_PER_KEY = 16
+
+    async def _enqueue_task(self, record: PendingTask) -> None:
+        spec = record.spec
+        strategy = spec.get("scheduling_strategy") or {}
+        key = _resources_key(spec["resources"], repr(spec["runtime_env"])) + repr(
+            sorted(strategy.items())
+        )
+        queue = self._task_queues.get(key)
+        if queue is None:
+            queue = self._task_queues[key] = asyncio.Queue()
+        queue.put_nowait(record)
+        active = self._active_dispatchers.get(key, 0)
+        if active < min(queue.qsize(), self._MAX_DISPATCHERS_PER_KEY):
+            self._active_dispatchers[key] = active + 1
+            asyncio.get_running_loop().create_task(self._dispatcher(key, queue))
+
+    async def _dispatcher(self, key: str, queue: asyncio.Queue) -> None:
+        worker: LeasedWorker | None = None
+        lease_failures = 0
+        try:
+            while True:
+                if queue.empty():
+                    return
+                if worker is None:
+                    # Acquire before popping so a blocked acquire never holds
+                    # a task hostage — other dispatchers keep draining.
+                    spec_peek = queue._queue[0].spec  # safe: single loop
+                    try:
+                        worker = await self._acquire_lease(spec_peek)
+                        lease_failures = 0
+                    except Exception as exc:
+                        lease_failures += 1
+                        if lease_failures >= 5:
+                            # Can't get capacity: fail one task and keep trying
+                            # so an infeasible queue eventually drains with
+                            # errors rather than hanging forever.
+                            try:
+                                record = queue.get_nowait()
+                            except asyncio.QueueEmpty:
+                                return
+                            self._finish_record(
+                                record,
+                                error=exceptions.WorkerCrashedError(
+                                    f"task {record.spec['name']}: no worker "
+                                    f"lease after {lease_failures} attempts: {exc}"
+                                ),
+                            )
+                            lease_failures = 0
+                            continue
+                        await asyncio.sleep(min(0.2 * lease_failures, 2.0))
+                        continue
+                try:
+                    record = queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    return
+                spec = record.spec
+                record.attempts += 1
+                try:
+                    reply = await worker.client.call("push_task", spec)
+                except (ConnectionLost, RpcError, OSError) as exc:
+                    # Worker died mid-task: drop the lease, maybe retry.
+                    await self._release_lease(worker, reusable=False)
+                    worker = None
+                    if record.attempts <= spec["max_retries"]:
+                        queue.put_nowait(record)
+                        continue
+                    self._finish_record(
+                        record,
+                        error=exceptions.WorkerCrashedError(
+                            f"task {spec['name']} failed after "
+                            f"{record.attempts} attempts: {exc}"
+                        ),
+                    )
+                    continue
+                if (
+                    reply.get("status") == "error"
+                    and spec["retry_exceptions"]
+                    and record.attempts <= spec["max_retries"]
+                ):
+                    queue.put_nowait(record)
+                    continue
+                self._finish_record(record, reply=reply)
+        finally:
+            self._active_dispatchers[key] = self._active_dispatchers.get(key, 1) - 1
+            if worker is not None:
+                await self._release_lease(worker, reusable=True)
+
+    def _finish_record(
+        self,
+        record: PendingTask,
+        reply: dict | None = None,
+        error: Exception | None = None,
+    ) -> None:
+        if error is not None:
+            self._fail_returns(record, error)
+        else:
+            self._apply_reply(record, reply)
+        with self._refs_lock:
+            for rid in record.arg_refs:
+                count = self._submitted_refs.get(rid, 0) - 1
+                if count <= 0:
+                    self._submitted_refs.pop(rid, None)
+                else:
+                    self._submitted_refs[rid] = count
+        for rid in record.arg_refs:
+            self._maybe_free(rid)
+
+    async def _acquire_lease(self, spec: dict) -> LeasedWorker:
+        key = _resources_key(spec["resources"], repr(spec["runtime_env"]))
+        strategy = spec.get("scheduling_strategy") or {}
+        assert self.controller is not None
+        resp = await self.controller.call(
+            "request_lease",
+            {
+                "resources": spec["resources"],
+                "job_id": spec["job_id"],
+                "submitter_node": self.node_id,
+                "scheduling_strategy": strategy,
+            },
+        )
+        if resp.get("status") != "ok":
+            raise RuntimeError(f"lease request failed: {resp.get('status')}")
+        agent_addr = tuple(resp["agent_addr"])
+        agent = await self._client_for(agent_addr)
+        lease = await agent.call(
+            "lease_worker",
+            {
+                "resources": spec["resources"],
+                "runtime_env": spec["runtime_env"],
+                "job_id": spec["job_id"],
+                "bundle": resp.get("bundle"),
+            },
+        )
+        if lease.get("status") != "ok":
+            raise RuntimeError(
+                f"worker lease failed: {lease.get('status')} {lease.get('error', '')}"
+            )
+        client = await self._client_for(tuple(lease["worker_addr"]))
+        return LeasedWorker(
+            lease["worker_id"],
+            tuple(lease["worker_addr"]),
+            client,
+            lease["lease_id"],
+            agent_addr,
+            key,
+        )
+
+    async def _release_lease(self, worker: LeasedWorker, reusable: bool) -> None:
+        # Always hand the lease back: the agent keeps the worker process warm
+        # in its pool, so the next lease is cheap, and the node's resources
+        # are never held hostage by an idle submitter (worker_pool.cc [N11]).
+        try:
+            agent = await self._client_for(worker.agent_addr)
+            await agent.call("return_worker", {"lease_id": worker.lease_id})
+        except Exception:
+            pass
+
+    def _apply_reply(self, record: PendingTask, reply: dict) -> None:
+        if reply.get("status") == "error":
+            self._fail_returns_payload(record, reply["error"])
+            return
+        for rid, result in zip(record.return_ids, reply["returns"]):
+            state = self._objects.get(rid)
+            if state is None:
+                continue
+            if result["kind"] == "inline":
+                state.status = INLINE
+                state.data = result["data"]
+                state.size = len(result["data"])
+            else:
+                state.status = SHM
+                state.size = result["size"]
+                state.locations = [result["location"]]
+            state.event.set()
+
+    def _fail_returns(self, record: PendingTask, exc: Exception) -> None:
+        payload, _ = serialization.serialize(exc)
+        self._fail_returns_payload(record, payload)
+
+    def _fail_returns_payload(self, record: PendingTask, error_payload) -> None:
+        for rid in record.return_ids:
+            state = self._objects.get(rid)
+            if state is None:
+                continue
+            state.status = FAILED
+            state.error = error_payload
+            state.event.set()
+
+    async def _try_reconstruct(self, object_id: str) -> bool:
+        """Object recovery via lineage re-execution ([N23]): reset the return
+        states to PENDING and resubmit the creating task through the normal
+        dispatch queue, then wait for it to finish."""
+        record = self._lineage.get(object_id)
+        if record is None or record.spec.get("actor_id"):
+            return False
+        fresh = PendingTask(record.spec, record.return_ids, [])
+        states = []
+        for rid in record.return_ids:
+            state = ObjectState()
+            self._objects[rid] = state
+            states.append(state)
+        await self._enqueue_task(fresh)
+        for state in states:
+            await state.event.wait()
+        state = self._objects.get(object_id)
+        return state is not None and state.status in (INLINE, SHM)
+
+    # ------------------------------------------------------------------
+    # actor task submission (ordered, direct connection — N19 actor path)
+    # ------------------------------------------------------------------
+    def submit_actor_task(
+        self,
+        actor_id: str,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        max_task_retries: int = 0,
+    ) -> list[ObjectRef]:
+        with self._actor_seq_lock:
+            seq = self._actor_seq.get(actor_id, 0)
+            self._actor_seq[actor_id] = seq + 1
+        task_id = self.next_task_id()
+        payload, contained = serialization.serialize((args, kwargs))
+        arg_ref_ids = [r.id for r in contained]
+        with self._refs_lock:
+            for rid in arg_ref_ids:
+                self._submitted_refs[rid] = self._submitted_refs.get(rid, 0) + 1
+        return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
+        spec = {
+            "task_id": task_id,
+            "job_id": self.job_id,
+            "actor_id": actor_id,
+            "method": method_name,
+            "name": f"{actor_id}.{method_name}",
+            "args": payload,
+            "num_returns": num_returns,
+            "owner": {"worker_id": self.worker_id, "address": list(self.address)},
+            "caller_id": self.worker_id,
+            "seq": seq,
+            "max_retries": max_task_retries,
+            "retry_exceptions": False,
+        }
+        record = PendingTask(spec, return_ids, arg_ref_ids)
+        refs = []
+        for rid in return_ids:
+            self._objects[rid] = ObjectState()
+            refs.append(self.new_object_ref(rid))
+        self.io.spawn(self._run_actor_task(record))
+        return refs
+
+    async def _run_actor_task(self, record: PendingTask) -> None:
+        spec = record.spec
+        actor_id = spec["actor_id"]
+        attempts = 0
+        try:
+            while True:
+                attempts += 1
+                try:
+                    client = await self._actor_client(actor_id)
+                    reply = await client.call("push_actor_task", spec)
+                    self._apply_reply(record, reply)
+                    return
+                except exceptions.ActorUnavailableError:
+                    self._fail_returns(
+                        record, exceptions.ActorUnavailableError(actor_id)
+                    )
+                    return
+                except (ConnectionLost, RpcError, OSError):
+                    # Actor possibly dead/restarting: consult the controller.
+                    self._actor_addr_cache.pop(actor_id, None)
+                    self._actor_clients.pop(actor_id, None)
+                    info = await self.controller.call(
+                        "get_actor_info", {"actor_id": actor_id}
+                    )
+                    state = info.get("state")
+                    # In-flight calls when an actor dies fail immediately
+                    # unless max_task_retries allows a retry on the restarted
+                    # incarnation (reference actor_task_submitter.cc policy).
+                    if attempts <= spec["max_retries"]:
+                        if state in ("RESTARTING", "PENDING", "ALIVE"):
+                            await asyncio.sleep(0.2)
+                            continue
+                    exc: Exception
+                    if state in ("RESTARTING", "PENDING"):
+                        exc = exceptions.ActorUnavailableError(
+                            f"actor {actor_id} is {state} during {spec['method']}"
+                            " (set max_task_retries to retry across restarts)"
+                        )
+                    else:
+                        exc = exceptions.ActorDiedError(
+                            f"actor {actor_id} died (state={state}) during "
+                            f"{spec['method']}"
+                        )
+                    self._fail_returns(record, exc)
+                    return
+        finally:
+            with self._refs_lock:
+                for rid in record.arg_refs:
+                    count = self._submitted_refs.get(rid, 0) - 1
+                    if count <= 0:
+                        self._submitted_refs.pop(rid, None)
+                    else:
+                        self._submitted_refs[rid] = count
+            for rid in record.arg_refs:
+                self._maybe_free(rid)
+
+    async def _actor_client(self, actor_id: str) -> RpcClient:
+        addr = self._actor_addr_cache.get(actor_id)
+        if addr is None:
+            info = await self.controller.call("get_actor_info", {"actor_id": actor_id})
+            deadline = time.monotonic() + 60
+            while info.get("state") in ("PENDING", "RESTARTING"):
+                if time.monotonic() > deadline:
+                    raise exceptions.ActorUnavailableError(actor_id)
+                await asyncio.sleep(0.1)
+                info = await self.controller.call(
+                    "get_actor_info", {"actor_id": actor_id}
+                )
+            if info.get("state") != "ALIVE":
+                raise ConnectionLost(f"actor {actor_id} state={info.get('state')}")
+            addr = tuple(info["address"])
+            self._actor_addr_cache[actor_id] = addr
+        return await self._client_for(addr)
+
+    # ------------------------------------------------------------------
+    # owner-protocol RPC handlers (served to other processes)
+    # ------------------------------------------------------------------
+    async def rpc_get_object(self, conn, payload) -> dict:
+        object_id = payload["object_id"]
+        state = self._objects.get(object_id)
+        if state is None:
+            return {"status": "failed", "error": serialization.serialize(
+                exceptions.ObjectLostError(f"{object_id}: unknown to owner")
+            )[0]}
+        await state.event.wait()
+        if state.status == FAILED:
+            return {"status": "failed", "error": state.error}
+        if state.status == INLINE:
+            return {"status": "inline", "data": state.data}
+        return {"status": "shm", "locations": state.locations, "size": state.size}
+
+    async def rpc_wait_object(self, conn, payload) -> dict:
+        state = self._objects.get(payload["object_id"])
+        if state is not None:
+            await state.event.wait()
+        return {"status": "ok"}
+
+    async def rpc_add_borrower(self, conn, payload) -> dict:
+        self._borrowers.setdefault(payload["object_id"], set()).add(payload["borrower"])
+        return {"status": "ok"}
+
+    async def rpc_remove_borrower(self, conn, payload) -> dict:
+        borrowers = self._borrowers.get(payload["object_id"])
+        if borrowers is not None:
+            borrowers.discard(payload["borrower"])
+            if not borrowers:
+                self._borrowers.pop(payload["object_id"], None)
+                self._maybe_free(payload["object_id"])
+        return {"status": "ok"}
+
+    async def rpc_add_location(self, conn, payload) -> dict:
+        state = self._objects.get(payload["object_id"])
+        if state is not None:
+            state.locations.append(payload["location"])
+        return {"status": "ok"}
+
+    async def rpc_ping(self, conn, payload) -> dict:
+        return {"status": "ok", "worker_id": self.worker_id}
+
+
+def _release_pinned(store: ObjectStoreClient, object_id: str) -> None:
+    try:
+        store.unpin(object_id)
+    except Exception:
+        pass
+
+
+def _encode_strategy(strategy: Any) -> dict:
+    """Normalize a scheduling strategy object to a wire dict."""
+    if strategy is None:
+        return {}
+    if isinstance(strategy, str):
+        return {"kind": strategy}  # "SPREAD" | "DEFAULT"
+    if isinstance(strategy, dict):
+        return strategy
+    # PlacementGroupSchedulingStrategy / NodeAffinitySchedulingStrategy
+    kind = type(strategy).__name__
+    if kind == "PlacementGroupSchedulingStrategy":
+        return {
+            "kind": "pg",
+            "pg_id": strategy.placement_group.id,
+            "bundle_index": strategy.placement_group_bundle_index,
+            "capture_child_tasks": getattr(
+                strategy, "placement_group_capture_child_tasks", False
+            ),
+        }
+    if kind == "NodeAffinitySchedulingStrategy":
+        return {"kind": "node_affinity", "node_id": strategy.node_id, "soft": strategy.soft}
+    raise ValueError(f"unknown scheduling strategy: {strategy!r}")

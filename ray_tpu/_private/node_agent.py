@@ -1,0 +1,615 @@
+"""Per-node agent — worker pool, local scheduling, object plane host.
+
+Role-equivalent of the reference raylet
+(src/ray/raylet/main.cc + node_manager.cc :: NodeManager [N9]) including:
+  * WorkerPool            — worker_pool.cc [N11]: spawn/cache/kill workers,
+                            per-runtime-env pools, registration handshake
+  * lease queue           — local_task_manager.cc-style grant queue [N10]
+  * bundle reservations   — placement-group prepare/commit/release (the
+                            raylet side of the GCS 2PC [N3])
+  * object plane host     — owns the shared-memory store server [N17] and
+                            serves chunked pulls (object_manager.cc [N16])
+  * resource reporting    — heartbeats to the controller (ray_syncer [N33])
+  * worker-death watch    — SIGCHLD-equivalent monitoring, reports to the
+                            controller for actor restart decisions
+  * log forwarding        — log_monitor.py-equivalent: worker stdout/stderr
+                            to per-session files + pubsub to drivers
+  * TPU detection         — enumerates local TPU chips into the node's
+                            resource vocabulary (the TPU-native addition)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import json
+import os
+import sys
+import time
+from typing import Any
+
+from ray_tpu._private.config import global_config
+from ray_tpu._private.ids import WorkerID
+from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreServer
+from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection
+
+
+def detect_tpu_resources() -> dict:
+    """TPU topology detection (SURVEY §2.1 'TPU build implication').
+
+    Order: (1) RAY_TPU_tpu_slice_override flag (resource lying for tests,
+    §4.4.3), (2) /dev/accel* | /dev/vfio device nodes (TPU VM), (3) opt-in
+    jax probe in a throwaway subprocess (RAY_TPU_DETECT_TPU=1) — never in
+    this process: initializing the TPU backend here would hold the chip lock
+    the workers need, and costs ~20s of agent startup.
+    """
+    override = global_config().tpu_slice_override
+    if override:
+        # e.g. "v4-8" -> 4 chips (v4/v5p sizes count TensorCores)
+        try:
+            generation, size = override.split("-")
+            chips = max(1, int(size) // 2) if generation in ("v4", "v5p") else int(size)
+            return {"TPU": float(chips), f"TPU-{override}": float(chips)}
+        except ValueError:
+            return {}
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return {}
+    try:
+        accels = [d for d in os.listdir("/dev") if d.startswith("accel")]
+        if accels:
+            return {"TPU": float(len(accels))}
+    except OSError:
+        pass
+    if os.environ.get("RAY_TPU_DETECT_TPU") == "1":  # pragma: no cover
+        import subprocess as sp
+
+        try:
+            out = sp.run(
+                [sys.executable, "-c",
+                 "import jax,json;print(json.dumps([d.device_kind for d in "
+                 "jax.devices() if d.platform=='tpu']))"],
+                capture_output=True, text=True, timeout=60,
+            )
+            kinds = json.loads(out.stdout.strip().splitlines()[-1])
+            if kinds:
+                kind = kinds[0].replace(" ", "-")
+                return {"TPU": float(len(kinds)), f"TPU-{kind}": float(len(kinds))}
+        except Exception:
+            pass
+    return {}
+
+
+class WorkerProcess:
+    def __init__(self, worker_id: str, proc: asyncio.subprocess.Process, env_hash: str):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.env_hash = env_hash
+        self.address: tuple | None = None
+        self.registered = asyncio.Event()
+        self.actor_id: str | None = None
+        self.intended_exit = False
+        self.resources: dict = {}
+        self.bundle: dict | None = None
+
+
+class Lease:
+    _ids = itertools.count(1)
+
+    def __init__(
+        self, worker: WorkerProcess, resources: dict, bundle_key: tuple | None
+    ):
+        self.lease_id = f"lease-{next(Lease._ids)}"
+        self.worker = worker
+        self.resources = resources
+        # Resolved (pg_id, bundle_index) the resources were consumed from —
+        # never the caller's raw request (whose index may be the -1 wildcard).
+        self.bundle_key = bundle_key
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        node_id: str,
+        controller_addr: tuple,
+        session_dir: str,
+        resources: dict | None = None,
+        store_capacity: int = 0,
+        labels: dict | None = None,
+    ):
+        self.node_id = node_id
+        self.controller_addr = controller_addr
+        self.session_dir = session_dir
+        self.labels = labels or {}
+        self.server = RpcServer(name=f"agent-{node_id[:10]}")
+        self.controller: RpcClient | None = None
+        self.address: tuple | None = None
+
+        if store_capacity <= 0:
+            import psutil
+
+            store_capacity = min(
+                int(psutil.virtual_memory().total * 0.3), 16 * (1 << 30)
+            )
+        self.store_capacity = store_capacity
+        suffix = node_id[-8:]
+        self.store_socket = os.path.join(session_dir, f"store-{suffix}.sock")
+        self.store_shm = f"/dev/shm/raytpu-{os.getpid()}-{suffix}"
+        self.spill_dir = os.path.join(session_dir, f"spill-{suffix}")
+        self.store_server: ObjectStoreServer | None = None
+        self._store_client: ObjectStoreClient | None = None
+
+        base = {"CPU": float(os.cpu_count() or 1), "memory": float(store_capacity)}
+        base.update(detect_tpu_resources())
+        base[f"node:{node_id}"] = 1.0
+        if resources:
+            base.update({k: float(v) for k, v in resources.items()})
+        self.resources_total = base
+        self.resources_available = dict(base)
+
+        self.workers: dict[str, WorkerProcess] = {}
+        self.idle_workers: dict[str, list[WorkerProcess]] = {}
+        self.leases: dict[str, Lease] = {}
+        self.bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> {resources, available, committed}
+        self._resource_waiters: list[asyncio.Future] = []
+        self.log_dir = os.path.join(session_dir, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        os.makedirs(self.spill_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    async def start(self, port: int = 0) -> tuple:
+        self.store_server = ObjectStoreServer(
+            self.store_socket, self.store_shm, self.store_capacity, self.spill_dir
+        )
+        self.server.route_object(self)
+        bound = await self.server.start("127.0.0.1", port)
+        self.address = ("127.0.0.1", bound)
+        self.controller = RpcClient(self.controller_addr, name="agent-to-controller")
+        await self.controller.connect()
+        await self.controller.call(
+            "register_node",
+            {
+                "node_id": self.node_id,
+                "agent_addr": list(self.address),
+                "resources": self.resources_total,
+                "labels": self.labels,
+                "store_info": self.store_info(),
+            },
+        )
+        asyncio.get_running_loop().create_task(self._heartbeat_loop())
+        return self.address
+
+    def store_info(self) -> dict:
+        return {
+            "socket": self.store_socket,
+            "shm_path": self.store_shm,
+            "capacity": self.store_capacity,
+        }
+
+    @property
+    def store(self) -> ObjectStoreClient:
+        if self._store_client is None:
+            self._store_client = ObjectStoreClient(
+                self.store_socket, self.store_shm, self.store_capacity
+            )
+        return self._store_client
+
+    async def _heartbeat_loop(self) -> None:
+        cfg = global_config()
+        while True:
+            await asyncio.sleep(cfg.health_check_period_ms / 1000.0)
+            try:
+                await self.controller.call(
+                    "heartbeat",
+                    {
+                        "node_id": self.node_id,
+                        "resources_available": self.resources_available,
+                    },
+                )
+            except Exception:
+                # Controller unreachable: keep trying (reconnect w/ backoff).
+                try:
+                    await self.controller.connect()
+                except Exception:
+                    await asyncio.sleep(1.0)
+
+    # ------------------------------------------------------------------
+    # resource accounting
+    # ------------------------------------------------------------------
+    def _try_consume(self, resources: dict, bundle_key: tuple | None) -> bool:
+        pool = (
+            self.bundles[bundle_key]["available"]
+            if bundle_key is not None and bundle_key in self.bundles
+            else self.resources_available
+        )
+        for k, v in resources.items():
+            if v > 0 and pool.get(k, 0.0) + 1e-9 < v:
+                return False
+        for k, v in resources.items():
+            if v > 0:
+                pool[k] = pool.get(k, 0.0) - v
+        return True
+
+    def _give_back(self, resources: dict, bundle_key: tuple | None) -> None:
+        pool = (
+            self.bundles[bundle_key]["available"]
+            if bundle_key is not None and bundle_key in self.bundles
+            else self.resources_available
+        )
+        for k, v in resources.items():
+            if v > 0:
+                pool[k] = pool.get(k, 0.0) + v
+        for waiter in self._resource_waiters:
+            if not waiter.done():
+                waiter.set_result(None)
+        self._resource_waiters.clear()
+
+    async def _wait_for_resources(self) -> None:
+        future = asyncio.get_running_loop().create_future()
+        self._resource_waiters.append(future)
+        try:
+            await asyncio.wait_for(future, timeout=5.0)
+        except asyncio.TimeoutError:
+            pass
+
+    # ------------------------------------------------------------------
+    # worker pool [N11]
+    # ------------------------------------------------------------------
+    def _env_hash(self, runtime_env: dict) -> str:
+        return repr(sorted((runtime_env or {}).items()))
+
+    async def _spawn_worker(
+        self, runtime_env: dict, job_id: str, actor_mode: bool = False
+    ) -> WorkerProcess:
+        worker_id = WorkerID.random()
+        env = dict(os.environ)
+        env_vars = (runtime_env or {}).get("env_vars") or {}
+        env.update({str(k): str(v) for k, v in env_vars.items()})
+        env.update(
+            {
+                "RAYTPU_WORKER_ID": worker_id,
+                "RAYTPU_NODE_ID": self.node_id,
+                "RAYTPU_JOB_ID": job_id,
+                "RAYTPU_CONTROLLER": json.dumps(list(self.controller_addr)),
+                "RAYTPU_AGENT": json.dumps(list(self.address)),
+                "RAYTPU_STORE": json.dumps(self.store_info()),
+                "RAYTPU_SESSION_DIR": self.session_dir,
+            }
+        )
+        working_dir = (runtime_env or {}).get("working_dir")
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-u",
+            "-m",
+            "ray_tpu._private.worker_proc",
+            env=env,
+            cwd=working_dir or None,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        worker = WorkerProcess(worker_id, proc, self._env_hash(runtime_env))
+        self.workers[worker_id] = worker
+        loop = asyncio.get_running_loop()
+        loop.create_task(self._forward_logs(worker, proc.stdout, "out", job_id))
+        loop.create_task(self._forward_logs(worker, proc.stderr, "err", job_id))
+        loop.create_task(self._watch_worker(worker))
+        try:
+            await asyncio.wait_for(
+                worker.registered.wait(),
+                timeout=global_config().worker_register_timeout_s,
+            )
+        except asyncio.TimeoutError:
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+            self.workers.pop(worker_id, None)
+            raise RuntimeError("worker failed to register in time")
+        return worker
+
+    async def _forward_logs(self, worker, stream, kind: str, job_id: str) -> None:
+        path = os.path.join(
+            self.log_dir, f"worker-{worker.worker_id[-12:]}.{kind}"
+        )
+        with open(path, "ab", buffering=0) as sink:
+            while True:
+                try:
+                    line = await stream.readline()
+                except (ValueError, asyncio.LimitOverrunError):
+                    continue
+                if not line:
+                    break
+                sink.write(line)
+                try:
+                    await self.controller.call(
+                        "publish",
+                        {
+                            "channel": "logs",
+                            "message": {
+                                "job_id": job_id,
+                                "pid": worker.proc.pid,
+                                "kind": kind,
+                                "line": line.decode(errors="replace").rstrip("\n"),
+                            },
+                        },
+                    )
+                except Exception:
+                    pass
+
+    async def _watch_worker(self, worker: WorkerProcess) -> None:
+        code = await worker.proc.wait()
+        self.workers.pop(worker.worker_id, None)
+        pool = self.idle_workers.get(worker.env_hash)
+        if pool and worker in pool:
+            pool.remove(worker)
+        # Release any lease resources still held.
+        for lease in [l for l in self.leases.values() if l.worker is worker]:
+            self.leases.pop(lease.lease_id, None)
+            self._give_back(lease.resources, lease.bundle_key)
+        if worker.actor_id and worker.resources:
+            self._give_back(
+                worker.resources,
+                (worker.bundle["pg_id"], worker.bundle["bundle_index"])
+                if worker.bundle
+                else None,
+            )
+        try:
+            await self.controller.call(
+                "worker_died",
+                {
+                    "worker_id": worker.worker_id,
+                    "node_id": self.node_id,
+                    "actor_id": worker.actor_id,
+                    "exit_code": code,
+                    "intended": worker.intended_exit,
+                },
+            )
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # RPC: worker registration + leases
+    # ------------------------------------------------------------------
+    async def rpc_register_worker(self, conn: ServerConnection, payload) -> dict:
+        worker = self.workers.get(payload["worker_id"])
+        if worker is None:
+            return {"status": "unknown_worker"}
+        worker.address = tuple(payload["address"])
+        worker.registered.set()
+        return {"status": "ok"}
+
+    async def rpc_lease_worker(self, conn, payload) -> dict:
+        resources = payload["resources"]
+        runtime_env = payload.get("runtime_env") or {}
+        bundle = payload.get("bundle")
+        bundle_key = (bundle["pg_id"], bundle["bundle_index"]) if bundle else None
+        if bundle_key is not None and bundle_key not in self.bundles:
+            # bundle_index -1: any bundle of the pg on this node
+            if bundle and bundle["bundle_index"] == -1:
+                match = next(
+                    (k for k in self.bundles if k[0] == bundle["pg_id"]), None
+                )
+                bundle_key = match
+            if bundle_key is None or bundle_key not in self.bundles:
+                return {"status": "no_bundle"}
+        deadline = time.monotonic() + 8.0
+        while not self._try_consume(resources, bundle_key):
+            if time.monotonic() > deadline:
+                return {"status": "busy"}
+            await self._wait_for_resources()
+        env_hash = self._env_hash(runtime_env)
+        pool = self.idle_workers.setdefault(env_hash, [])
+        worker = None
+        while pool:
+            candidate = pool.pop()
+            if candidate.proc.returncode is None:
+                worker = candidate
+                break
+        if worker is None:
+            try:
+                worker = await self._spawn_worker(runtime_env, payload.get("job_id", ""))
+            except Exception as exc:
+                self._give_back(resources, bundle_key)
+                return {"status": "spawn_failed", "error": str(exc)}
+        lease = Lease(worker, resources, bundle_key)
+        self.leases[lease.lease_id] = lease
+        return {
+            "status": "ok",
+            "lease_id": lease.lease_id,
+            "worker_id": worker.worker_id,
+            "worker_addr": list(worker.address),
+        }
+
+    async def rpc_return_worker(self, conn, payload) -> dict:
+        lease = self.leases.pop(payload["lease_id"], None)
+        if lease is None:
+            return {"status": "unknown_lease"}
+        self._give_back(lease.resources, lease.bundle_key)
+        if lease.worker.proc.returncode is None and not lease.worker.actor_id:
+            self.idle_workers.setdefault(lease.worker.env_hash, []).append(lease.worker)
+        return {"status": "ok"}
+
+    # ------------------------------------------------------------------
+    # RPC: actors
+    # ------------------------------------------------------------------
+    async def rpc_start_actor(self, conn, payload) -> dict:
+        spec = payload["spec"]
+        resources = spec.get("resources") or {"CPU": 1}
+        strategy = spec.get("scheduling_strategy") or {}
+        bundle = None
+        bundle_key = None
+        if strategy.get("kind") == "pg":
+            index = strategy.get("bundle_index", -1)
+            if index == -1:
+                bundle_key = next(
+                    (k for k in self.bundles if k[0] == strategy["pg_id"]), None
+                )
+            else:
+                bundle_key = (strategy["pg_id"], index)
+            if bundle_key is None or bundle_key not in self.bundles:
+                return {"status": "no_bundle"}
+            bundle = {"pg_id": bundle_key[0], "bundle_index": bundle_key[1]}
+        if not self._try_consume(resources, bundle_key):
+            return {"status": "busy"}
+        try:
+            worker = await self._spawn_worker(
+                spec.get("runtime_env") or {}, spec.get("job_id", ""), actor_mode=True
+            )
+        except Exception as exc:
+            self._give_back(resources, bundle_key)
+            return {"status": "spawn_failed", "error": str(exc)}
+        worker.actor_id = spec["actor_id"]
+        worker.resources = resources
+        worker.bundle = bundle
+        worker_client = RpcClient(worker.address, name="agent-to-worker")
+        try:
+            await worker_client.connect()
+            resp = await worker_client.call(
+                "create_actor",
+                {"spec": spec, "creation_args": payload.get("creation_args")},
+            )
+        except Exception as exc:
+            worker.intended_exit = True
+            try:
+                worker.proc.kill()
+            except ProcessLookupError:
+                pass
+            self._give_back(resources, bundle_key)
+            return {"status": "creation_failed", "error": str(exc)}
+        finally:
+            await worker_client.close()
+        if resp.get("status") != "ok":
+            worker.intended_exit = True
+            try:
+                worker.proc.kill()
+            except ProcessLookupError:
+                pass
+            self._give_back(resources, bundle_key)
+            return {"status": "creation_failed", "error": resp.get("error")}
+        return {
+            "status": "ok",
+            "worker_id": worker.worker_id,
+            "worker_addr": list(worker.address),
+            "pid": worker.proc.pid,
+        }
+
+    async def rpc_kill_worker(self, conn, payload) -> dict:
+        worker = self.workers.get(payload["worker_id"])
+        if worker is None:
+            return {"status": "missing"}
+        worker.intended_exit = bool(payload.get("intended", True))
+        try:
+            worker.proc.kill()
+        except ProcessLookupError:
+            pass
+        return {"status": "ok"}
+
+    # ------------------------------------------------------------------
+    # RPC: placement group bundles (raylet side of the 2PC [N3])
+    # ------------------------------------------------------------------
+    async def rpc_prepare_bundle(self, conn, payload) -> dict:
+        key = (payload["pg_id"], payload["bundle_index"])
+        if key in self.bundles:
+            return {"status": "ok"}
+        resources = payload["resources"]
+        if not self._try_consume(resources, None):
+            return {"status": "insufficient"}
+        self.bundles[key] = {
+            "resources": dict(resources),
+            "available": dict(resources),
+            "committed": False,
+        }
+        return {"status": "ok"}
+
+    async def rpc_commit_bundle(self, conn, payload) -> dict:
+        key = (payload["pg_id"], payload["bundle_index"])
+        bundle = self.bundles.get(key)
+        if bundle is None:
+            return {"status": "missing"}
+        bundle["committed"] = True
+        return {"status": "ok"}
+
+    async def rpc_release_bundle(self, conn, payload) -> dict:
+        key = (payload["pg_id"], payload["bundle_index"])
+        bundle = self.bundles.pop(key, None)
+        if bundle is None:
+            return {"status": "missing"}
+        self._give_back(bundle["resources"], None)
+        return {"status": "ok"}
+
+    # ------------------------------------------------------------------
+    # RPC: object plane (chunked pull — object_manager.cc [N16])
+    # ------------------------------------------------------------------
+    async def rpc_pull_object_chunk(self, conn, payload) -> dict:
+        object_id = payload["object_id"]
+        view = self.store.get(object_id, timeout_ms=0)
+        if view is None:
+            return {"status": "missing"}
+        try:
+            total = len(view)
+            start = payload.get("offset", 0)
+            end = min(start + payload.get("chunk", 5 * 1024 * 1024), total)
+            return {"status": "ok", "data": bytes(view[start:end]), "total": total}
+        finally:
+            self.store.release(object_id)
+
+    async def rpc_delete_object(self, conn, payload) -> dict:
+        ok = self.store.delete(payload["object_id"])
+        return {"status": "ok" if ok else "missing"}
+
+    async def rpc_store_stats(self, conn, payload) -> dict:
+        return self.store.stats()
+
+    async def rpc_node_info(self, conn, payload) -> dict:
+        return {
+            "node_id": self.node_id,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "num_workers": len(self.workers),
+        }
+
+    async def shutdown(self) -> None:
+        for worker in list(self.workers.values()):
+            worker.intended_exit = True
+            try:
+                worker.proc.kill()
+            except ProcessLookupError:
+                pass
+        await self.server.stop()
+        if self.store_server is not None:
+            self.store_server.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--controller", required=True, help="host:port")
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--store-capacity", type=int, default=0)
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+    host, port = args.controller.rsplit(":", 1)
+
+    async def run() -> None:
+        agent = NodeAgent(
+            args.node_id,
+            (host, int(port)),
+            args.session_dir,
+            resources=json.loads(args.resources),
+            store_capacity=args.store_capacity,
+        )
+        addr = await agent.start(args.port)
+        with open(
+            os.path.join(args.session_dir, f"agent-{args.node_id[-8:]}.addr"), "w"
+        ) as f:
+            f.write(
+                json.dumps({"addr": list(addr), "store": agent.store_info()})
+            )
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

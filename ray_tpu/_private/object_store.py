@@ -1,0 +1,208 @@
+"""Python client for the native shared-memory object store.
+
+Role-equivalent of plasma's client
+(reference: src/ray/object_manager/plasma/client.cc and the core worker's
+store_provider/plasma_store_provider.cc). Object *bytes* never traverse the
+socket: clients mmap the arena file once and read/write through memoryviews
+(zero-copy); only control messages (create/seal/get/...) use the socket.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import socket
+import struct
+import threading
+
+from ray_tpu import _native
+
+OP_CREATE, OP_SEAL, OP_GET, OP_RELEASE, OP_DELETE = 1, 2, 3, 4, 5
+OP_CONTAINS, OP_LIST, OP_STATS, OP_PIN, OP_UNPIN = 6, 7, 8, 9, 10
+ST_OK, ST_NOT_FOUND, ST_FULL, ST_EXISTS, ST_TIMEOUT, ST_ERROR = range(6)
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_I64 = struct.Struct("<q")
+
+
+class ObjectStoreFull(Exception):
+    pass
+
+
+class ObjectStoreServer:
+    """Owns the native store server thread (lives in the node agent)."""
+
+    def __init__(
+        self,
+        socket_path: str,
+        shm_path: str,
+        capacity: int,
+        spill_dir: str | None = None,
+    ):
+        self._lib = _native.load()
+        self._handle = self._lib.raytpu_store_start(
+            socket_path.encode(),
+            shm_path.encode(),
+            capacity,
+            (spill_dir or "").encode(),
+        )
+        if not self._handle:
+            raise RuntimeError(f"failed to start object store at {socket_path}")
+        self.socket_path = socket_path
+        self.shm_path = shm_path
+        self.capacity = capacity
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.raytpu_store_stop(self._handle)
+            self._handle = None
+
+
+class ObjectStoreClient:
+    """Thread-safe synchronous client; one per process is typical."""
+
+    def __init__(self, socket_path: str, shm_path: str, capacity: int):
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.connect(socket_path)
+        self._lock = threading.Lock()
+        self._reqid = 0
+        shm_fd = os.open(shm_path, os.O_RDWR)
+        try:
+            self._arena = mmap.mmap(shm_fd, capacity, mmap.MAP_SHARED)
+        finally:
+            os.close(shm_fd)
+        self._view = memoryview(self._arena)
+
+    # -- protocol helpers --------------------------------------------------
+    def _request(self, op: int, payload: bytes) -> tuple[int, bytes]:
+        with self._lock:
+            self._reqid += 1
+            reqid = self._reqid
+            frame = _U32.pack(reqid) + bytes([op]) + payload
+            self._sock.sendall(_U32.pack(len(frame)) + frame)
+            while True:
+                reply = self._recv_frame()
+                (rid,) = _U32.unpack_from(reply, 0)
+                status = reply[4]
+                if rid == reqid:
+                    return status, reply[5:]
+                # Stale reply from an abandoned (timed-out) request: skip.
+
+    def _recv_frame(self) -> bytes:
+        header = self._recv_exact(4)
+        (length,) = _U32.unpack(header)
+        return self._recv_exact(length)
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            chunk = self._sock.recv(n)
+            if not chunk:
+                raise ConnectionError("object store connection closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    @staticmethod
+    def _enc_id(object_id: str) -> bytes:
+        raw = object_id.encode()
+        return struct.pack("<H", len(raw)) + raw
+
+    # -- public API --------------------------------------------------------
+    def create(self, object_id: str, size: int) -> memoryview:
+        """Allocate; returns a writable view. Call seal() when done."""
+        status, payload = self._request(
+            OP_CREATE, self._enc_id(object_id) + _U64.pack(size)
+        )
+        if status == ST_FULL:
+            raise ObjectStoreFull(f"store full creating {object_id} ({size}B)")
+        if status == ST_EXISTS:
+            raise FileExistsError(object_id)
+        if status != ST_OK:
+            raise RuntimeError(f"create({object_id}) failed: status={status}")
+        (offset,) = _U64.unpack(payload)
+        return self._view[offset : offset + size]
+
+    def seal(self, object_id: str) -> None:
+        status, _ = self._request(OP_SEAL, self._enc_id(object_id))
+        if status != ST_OK:
+            raise RuntimeError(f"seal({object_id}) failed: status={status}")
+
+    def put(self, object_id: str, data: bytes | memoryview) -> None:
+        buf = self.create(object_id, len(data))
+        buf[:] = data
+        self.seal(object_id)
+
+    def get(self, object_id: str, timeout_ms: int = -1) -> memoryview | None:
+        """Zero-copy read view, or None on timeout/absent (timeout_ms=0)."""
+        status, payload = self._request(
+            OP_GET, self._enc_id(object_id) + _I64.pack(timeout_ms)
+        )
+        if status in (ST_NOT_FOUND, ST_TIMEOUT):
+            return None
+        if status != ST_OK:
+            raise RuntimeError(f"get({object_id}) failed: status={status}")
+        offset, size = _U64.unpack_from(payload, 0)[0], _U64.unpack_from(payload, 8)[0]
+        return self._view[offset : offset + size].toreadonly()
+
+    def release(self, object_id: str) -> None:
+        self._request(OP_RELEASE, self._enc_id(object_id))
+
+    def delete(self, object_id: str) -> bool:
+        status, _ = self._request(OP_DELETE, self._enc_id(object_id))
+        return status == ST_OK
+
+    def contains(self, object_id: str) -> bool:
+        status, _ = self._request(OP_CONTAINS, self._enc_id(object_id))
+        return status == ST_OK
+
+    def pin(self, object_id: str) -> None:
+        self._request(OP_PIN, self._enc_id(object_id))
+
+    def unpin(self, object_id: str) -> None:
+        self._request(OP_UNPIN, self._enc_id(object_id))
+
+    def list(self) -> dict[str, dict]:
+        status, payload = self._request(OP_LIST, b"")
+        if status != ST_OK:
+            return {}
+        (count,) = _U64.unpack_from(payload, 0)
+        pos = 8
+        out: dict[str, dict] = {}
+        for _ in range(count):
+            (idlen,) = struct.unpack_from("<H", payload, pos)
+            pos += 2
+            object_id = payload[pos : pos + idlen].decode()
+            pos += idlen
+            size, flags, refcount = struct.unpack_from("<QQQ", payload, pos)
+            pos += 24
+            out[object_id] = {
+                "size": size,
+                "sealed": bool(flags & 1),
+                "spilled": bool(flags & 2),
+                "refcount": refcount,
+            }
+        return out
+
+    def stats(self) -> dict:
+        status, payload = self._request(OP_STATS, b"")
+        if status != ST_OK:
+            raise RuntimeError("stats failed")
+        capacity, used, num_objects, spilled, evictions, restores = struct.unpack(
+            "<6Q", payload
+        )
+        return {
+            "capacity": capacity,
+            "used": used,
+            "num_objects": num_objects,
+            "spilled_bytes": spilled,
+            "evictions": evictions,
+            "restores": restores,
+        }
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except Exception:
+            pass

@@ -1,0 +1,305 @@
+"""Control-plane RPC transport.
+
+Role-equivalent of the reference's typed async gRPC wrappers
+(src/ray/rpc/ :: GrpcServer/ServerCall/ClientCallManager + retryable clients).
+We use length-prefixed msgpack frames over asyncio TCP/unix sockets: compact,
+zero-dependency, and fast enough for a control plane (bulk data rides the
+shared-memory object store, never this channel).
+
+Frame layout (msgpack array):
+    [kind, msgid, method, payload]
+kind: 0=request, 1=reply, 2=error-reply, 3=push (server->client, no reply).
+
+Features mirrored from the reference RPC layer:
+  - per-call async completion (ClientCallManager)
+  - retry with exponential backoff on connect failure (retryable clients)
+  - server push over an established connection (used by pubsub, §N8)
+  - optional injected delay for chaos tests (RAY_testing_asio_delay_us twin:
+    RAY_TPU_testing_rpc_delay_ms).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import struct
+import threading
+import traceback
+from typing import Any, Awaitable, Callable
+
+import msgpack
+
+from ray_tpu._private.config import global_config
+
+REQ, REP, ERR, PUSH = 0, 1, 2, 3
+_LEN = struct.Struct("<I")
+
+Handler = Callable[..., Awaitable[Any]]
+
+
+class RpcError(Exception):
+    """Remote handler raised; carries the remote traceback string."""
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+def _pack(kind: int, msgid: int, method: str, payload: Any) -> bytes:
+    body = msgpack.packb((kind, msgid, method, payload), use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, int, str, Any]:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    body = await reader.readexactly(length)
+    return tuple(msgpack.unpackb(body, raw=False, strict_map_key=False))
+
+
+class ServerConnection:
+    """One accepted client connection; lets handlers push to this client."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self._write_lock = asyncio.Lock()
+        self.closed = asyncio.Event()
+        # Server-side scratch: handlers stash identity here (e.g. node id
+        # after a Register call) so disconnect cleanup knows who died.
+        self.context: dict[str, Any] = {}
+
+    async def send(self, kind: int, msgid: int, method: str, payload: Any) -> None:
+        async with self._write_lock:
+            self.writer.write(_pack(kind, msgid, method, payload))
+            await self.writer.drain()
+
+    async def push(self, channel: str, payload: Any) -> None:
+        try:
+            await self.send(PUSH, 0, channel, payload)
+        except (ConnectionError, RuntimeError):
+            self.closed.set()
+
+
+class RpcServer:
+    """Asyncio RPC server. Handlers are async callables(conn, payload)."""
+
+    def __init__(self, name: str = "rpc"):
+        self.name = name
+        self._handlers: dict[str, Handler] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self.connections: set[ServerConnection] = set()
+        self.on_disconnect: Callable[[ServerConnection], Awaitable[None]] | None = None
+
+    def route(self, method: str, handler: Handler) -> None:
+        self._handlers[method] = handler
+
+    def route_object(self, obj: Any, prefix: str = "") -> None:
+        """Register every ``rpc_<name>`` coroutine method of obj as <name>."""
+        for attr in dir(obj):
+            if attr.startswith("rpc_"):
+                self.route(prefix + attr[4:], getattr(obj, attr))
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._on_client, host, port)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start_unix(self, path: str) -> None:
+        self._server = await asyncio.start_unix_server(self._on_client, path)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            try:
+                conn.writer.close()
+            except Exception:
+                pass
+
+    async def _on_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn = ServerConnection(reader, writer)
+        self.connections.add(conn)
+        try:
+            while True:
+                kind, msgid, method, payload = await _read_frame(reader)
+                if kind != REQ:
+                    continue
+                asyncio.get_running_loop().create_task(
+                    self._dispatch(conn, msgid, method, payload)
+                )
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self.connections.discard(conn)
+            conn.closed.set()
+            if self.on_disconnect is not None:
+                try:
+                    await self.on_disconnect(conn)
+                except Exception:
+                    traceback.print_exc()
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(
+        self, conn: ServerConnection, msgid: int, method: str, payload: Any
+    ) -> None:
+        delay_ms = global_config().testing_rpc_delay_ms
+        if delay_ms:
+            await asyncio.sleep(delay_ms / 1000.0)
+        handler = self._handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r} on {self.name}")
+            result = await handler(conn, payload)
+            await conn.send(REP, msgid, method, result)
+        except (ConnectionError, RuntimeError):
+            conn.closed.set()
+        except Exception:
+            try:
+                await conn.send(ERR, msgid, method, traceback.format_exc())
+            except Exception:
+                conn.closed.set()
+
+
+class RpcClient:
+    """Async RPC client with reconnect/backoff and push subscription."""
+
+    def __init__(self, address: tuple[str, int] | str, name: str = "client"):
+        self.address = address
+        self.name = name
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._msgids = itertools.count(1)
+        self._write_lock: asyncio.Lock | None = None
+        self._recv_task: asyncio.Task | None = None
+        self._push_handlers: dict[str, Callable[[Any], Awaitable[None] | None]] = {}
+        self.connected = False
+
+    def on_push(self, channel: str, handler: Callable[[Any], Any]) -> None:
+        self._push_handlers[channel] = handler
+
+    async def connect(self, retry: bool = True) -> None:
+        cfg = global_config()
+        backoff = cfg.rpc_retry_initial_backoff_s
+        attempts = cfg.rpc_retry_max_attempts if retry else 1
+        last_exc: Exception | None = None
+        for _ in range(attempts):
+            try:
+                if isinstance(self.address, str):
+                    self._reader, self._writer = await asyncio.open_unix_connection(
+                        self.address
+                    )
+                else:
+                    self._reader, self._writer = await asyncio.open_connection(
+                        *self.address
+                    )
+                self._write_lock = asyncio.Lock()
+                self._recv_task = asyncio.get_running_loop().create_task(
+                    self._recv_loop()
+                )
+                self.connected = True
+                return
+            except (ConnectionError, OSError) as exc:
+                last_exc = exc
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, cfg.rpc_retry_max_backoff_s)
+        raise ConnectionLost(
+            f"{self.name}: cannot connect to {self.address}: {last_exc}"
+        )
+
+    async def _recv_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                kind, msgid, method, payload = await _read_frame(self._reader)
+                if kind == PUSH:
+                    handler = self._push_handlers.get(method)
+                    if handler is not None:
+                        result = handler(payload)
+                        if asyncio.iscoroutine(result):
+                            asyncio.get_running_loop().create_task(result)
+                    continue
+                future = self._pending.pop(msgid, None)
+                if future is None or future.done():
+                    continue
+                if kind == REP:
+                    future.set_result(payload)
+                else:
+                    future.set_exception(RpcError(payload))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self.connected = False
+            for future in self._pending.values():
+                if not future.done():
+                    future.set_exception(ConnectionLost(f"{self.name} lost connection"))
+            self._pending.clear()
+
+    async def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
+        if not self.connected:
+            raise ConnectionLost(f"{self.name}: not connected")
+        msgid = next(self._msgids)
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[msgid] = future
+        assert self._writer is not None and self._write_lock is not None
+        async with self._write_lock:
+            self._writer.write(_pack(REQ, msgid, method, payload))
+            await self._writer.drain()
+        if timeout is None:
+            return await future
+        return await asyncio.wait_for(future, timeout)
+
+    async def close(self) -> None:
+        self.connected = False
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+
+class IoThread:
+    """Background asyncio loop thread: the driver/worker 'io service'.
+
+    Equivalent in role to the core worker's io_service threads
+    (reference: core_worker.cc io_service_). Sync API code schedules
+    coroutines here via run().
+    """
+
+    def __init__(self, name: str = "raytpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro: Awaitable[Any], timeout: float | None = None) -> Any:
+        future = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return future.result(timeout)
+
+    def spawn(self, coro: Awaitable[Any]) -> None:
+        asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self) -> None:
+        def _shutdown() -> None:
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            # Let cancellations run one tick before stopping, so tasks are
+            # reaped instead of warning "Task was destroyed but it is pending".
+            self.loop.call_soon(self.loop.stop)
+
+        try:
+            self.loop.call_soon_threadsafe(_shutdown)
+            self._thread.join(timeout=2)
+        except Exception:
+            pass

@@ -1,0 +1,118 @@
+"""Serialization for task args/returns and ray_tpu.put values.
+
+Role-equivalent of the reference's SerializationContext
+(python/ray/_private/serialization.py): cloudpickle for code/closures,
+pickle protocol 5 with out-of-band buffers so large numpy/jax host arrays are
+written as raw bytes (and reconstructed zero-copy as views onto the
+shared-memory arena on the read side).
+
+Wire layout of a serialized value:
+    [u32 nbufs][u64 len_meta][meta pickle][u64 len_buf0][buf0]...
+ObjectRefs inside values are replaced at pickle time by _RefPlaceholder and
+collected, so the runtime can (a) register borrows with owners and (b)
+resolve them back to live ObjectRefs on the consumer side.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, Callable
+
+import cloudpickle
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+class _RefPlaceholder:
+    __slots__ = ("object_id", "owner_address")
+
+    def __init__(self, object_id: str, owner_address: tuple | None):
+        self.object_id = object_id
+        self.owner_address = owner_address
+
+    def __reduce__(self):
+        return (_RefPlaceholder, (self.object_id, self.owner_address))
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, collected_refs: list, protocol: int = 5, **kw):
+        super().__init__(file, protocol=protocol, **kw)
+        self._collected_refs = collected_refs
+
+    def persistent_id(self, obj: Any):
+        from ray_tpu._private.object_ref import ObjectRef
+
+        if isinstance(obj, ObjectRef):
+            self._collected_refs.append(obj)
+            return ("raytpu_ref", obj.id, obj.owner_address)
+        return None
+
+
+class _Unpickler(pickle.Unpickler):
+    def __init__(self, file, ref_resolver, buffers=None):
+        super().__init__(file, buffers=buffers)
+        self._ref_resolver = ref_resolver
+
+    def persistent_load(self, pid):
+        tag, object_id, owner_address = pid
+        if tag != "raytpu_ref":
+            raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+        if self._ref_resolver is None:
+            raise pickle.UnpicklingError("ObjectRef found but no resolver given")
+        return self._ref_resolver(object_id, owner_address)
+
+
+def serialize(value: Any) -> tuple[bytes, list]:
+    """Returns (payload, contained_object_refs)."""
+    buffers: list[pickle.PickleBuffer] = []
+    refs: list = []
+    meta_io = io.BytesIO()
+    pickler = _Pickler(meta_io, refs, protocol=5, buffer_callback=buffers.append)
+    pickler.dump(value)
+    meta = meta_io.getvalue()
+
+    parts = [_U32.pack(len(buffers)), _U64.pack(len(meta)), meta]
+    for buffer in buffers:
+        raw = buffer.raw()
+        parts.append(_U64.pack(raw.nbytes))
+        parts.append(raw)
+    return b"".join(bytes(p) if isinstance(p, memoryview) else p for p in parts), refs
+
+
+def serialized_size(payload: bytes) -> int:
+    return len(payload)
+
+
+def deserialize(
+    payload: bytes | memoryview,
+    ref_resolver: Callable[[str, Any], Any] | None = None,
+    zero_copy: bool = True,
+) -> Any:
+    view = memoryview(payload)
+    (nbufs,) = _U32.unpack_from(view, 0)
+    (meta_len,) = _U64.unpack_from(view, 4)
+    pos = 12
+    meta = view[pos : pos + meta_len]
+    pos += meta_len
+    buffers = []
+    for _ in range(nbufs):
+        (blen,) = _U64.unpack_from(view, pos)
+        pos += 8
+        buf = view[pos : pos + blen]
+        # zero_copy=False makes an owning copy (needed if the arena slice is
+        # released after get, e.g. values that outlive the store mapping).
+        buffers.append(buf if zero_copy else bytes(buf))
+        pos += blen
+    unpickler = _Unpickler(io.BytesIO(bytes(meta)), ref_resolver, buffers)
+    return unpickler.load()
+
+
+def dumps_function(fn: Any) -> bytes:
+    return cloudpickle.dumps(fn)
+
+
+def loads_function(raw: bytes) -> Any:
+    return cloudpickle.loads(raw)

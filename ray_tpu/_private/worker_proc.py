@@ -1,0 +1,255 @@
+"""Worker process — executes tasks and hosts actors.
+
+Role-equivalent of the reference's worker side of the core worker:
+task_receiver.cc / actor_scheduling_queue.cc / concurrency_group_manager.cc
+[N20] plus the Python execution callback in _raylet.pyx [N30].
+
+Execution runs on dedicated executor threads (the RPC loop stays free),
+actor calls are ordered per caller by sequence number, and async actor
+methods run on a separate asyncio loop (the reference's async-actor fibers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import inspect
+import json
+import os
+import sys
+import threading
+import traceback
+from typing import Any
+
+from ray_tpu import exceptions
+from ray_tpu._private import serialization
+from ray_tpu._private.config import global_config
+from ray_tpu._private.core_context import CoreContext
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.rpc import RpcClient
+
+
+class WorkerRuntime:
+    def __init__(self) -> None:
+        self.ctx = CoreContext(
+            job_id=os.environ["RAYTPU_JOB_ID"],
+            node_id=os.environ["RAYTPU_NODE_ID"],
+            controller_addr=tuple(json.loads(os.environ["RAYTPU_CONTROLLER"])),
+            agent_addr=tuple(json.loads(os.environ["RAYTPU_AGENT"])),
+            store_info=json.loads(os.environ["RAYTPU_STORE"]),
+            is_driver=False,
+            worker_id=os.environ["RAYTPU_WORKER_ID"],
+        )
+        self.executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="exec"
+        )
+        self._async_loop: asyncio.AbstractEventLoop | None = None
+        self.actor_instance: Any = None
+        self.actor_spec: dict | None = None
+        # per-caller ordered queues (actor_scheduling_queue.cc)
+        self._order: dict[str, dict] = {}
+        self._fn_cache: dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        ctx = self.ctx
+        for method in ("push_task", "push_actor_task", "create_actor", "exit"):
+            ctx.core_server.route(method, getattr(self, f"rpc_{method}"))
+        ctx.connect()
+        # Make the global API (ray_tpu.get/put/remote...) work inside tasks.
+        from ray_tpu._private import worker as worker_mod
+
+        worker_mod.set_global_context(ctx, is_driver=False)
+        ctx.io.run(self._register_with_agent())
+
+    async def _register_with_agent(self) -> None:
+        await self.ctx.agent.call(
+            "register_worker",
+            {"worker_id": self.ctx.worker_id, "address": list(self.ctx.address)},
+        )
+
+    def _async_exec_loop(self) -> asyncio.AbstractEventLoop:
+        if self._async_loop is None:
+            loop = asyncio.new_event_loop()
+            thread = threading.Thread(
+                target=loop.run_forever, name="actor-async", daemon=True
+            )
+            thread.start()
+            self._async_loop = loop
+        return self._async_loop
+
+    # ------------------------------------------------------------------
+    # function / class resolution via the controller KV (function table)
+    # ------------------------------------------------------------------
+    async def _load_callable(self, function_id: str) -> Any:
+        """Fetch+cache from the controller KV function table. Runs on the io
+        loop (must not block it with sync ctx calls)."""
+        cached = self._fn_cache.get(function_id)
+        if cached is not None:
+            return cached
+        resp = await self.ctx.controller.call(
+            "kv_get", {"namespace": "funcs", "key": function_id}
+        )
+        if resp["status"] != "ok":
+            raise RuntimeError(f"function {function_id} not found in function table")
+        fn = serialization.loads_function(resp["value"])
+        self._fn_cache[function_id] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def _resolve_args(self, payload) -> tuple[tuple, dict]:
+        def resolver(ref_id, owner_address):
+            ref = ObjectRef(ref_id, owner_address, runtime=self.ctx)
+            self.ctx._note_borrow(ref_id, owner_address)
+            return ref
+
+        args, kwargs = serialization.deserialize(payload, resolver, zero_copy=False)
+        # Top-level ObjectRef args are resolved to values before invocation
+        # (reference semantics; nested refs stay refs).
+        args = tuple(
+            self.ctx.get(a) if isinstance(a, ObjectRef) else a for a in args
+        )
+        kwargs = {
+            k: self.ctx.get(v) if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+        return args, kwargs
+
+    def _package_returns(self, spec: dict, values: list[Any]) -> list[dict]:
+        cfg = global_config()
+        out = []
+        for index, value in enumerate(values):
+            payload, _ = serialization.serialize(value)
+            if len(payload) <= cfg.max_direct_call_object_size:
+                out.append({"kind": "inline", "data": payload})
+            else:
+                object_id = f"obj-{spec['task_id']}-r{index}"
+                try:
+                    self.ctx.store.put(object_id, payload)
+                except FileExistsError:
+                    pass
+                out.append(
+                    {
+                        "kind": "shm",
+                        "size": len(payload),
+                        "location": self.ctx._local_location(),
+                    }
+                )
+        return out
+
+    def _execute(self, spec: dict, fn: Any, is_method: bool) -> dict:
+        name = spec.get("name", "task")
+        try:
+            args, kwargs = self._resolve_args(spec["args"])
+            if inspect.iscoroutinefunction(fn):
+                loop = self._async_exec_loop()
+                value = asyncio.run_coroutine_threadsafe(
+                    fn(*args, **kwargs), loop
+                ).result()
+            else:
+                value = fn(*args, **kwargs)
+            num_returns = spec.get("num_returns", 1)
+            values = [value] if num_returns == 1 else list(value)
+            return {"status": "ok", "returns": self._package_returns(spec, values)}
+        except Exception:
+            err = exceptions.TaskError(name, traceback.format_exc())
+            payload, _ = serialization.serialize(err)
+            return {"status": "error", "error": payload}
+
+    # ------------------------------------------------------------------
+    # RPC handlers
+    # ------------------------------------------------------------------
+    async def rpc_push_task(self, conn, spec) -> dict:
+        fn = await self._load_callable(spec["function_id"])
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self.executor, self._execute, spec, fn, False
+        )
+
+    async def rpc_create_actor(self, conn, payload) -> dict:
+        spec = payload["spec"]
+        try:
+            cls = await self._load_callable(spec["class_id"])
+            concurrency = spec.get("max_concurrency", 1)
+            if concurrency > 1:
+                self.executor = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=concurrency, thread_name_prefix="exec"
+                )
+            loop = asyncio.get_running_loop()
+
+            def instantiate():
+                # Arg resolution may ray_tpu.get() — must run off the io loop.
+                args, kwargs = (
+                    self._resolve_args(payload["creation_args"])
+                    if payload.get("creation_args")
+                    else ((), {})
+                )
+                self.actor_instance = cls(*args, **kwargs)
+
+            await loop.run_in_executor(self.executor, instantiate)
+            self.actor_spec = spec
+            return {"status": "ok"}
+        except Exception:
+            return {"status": "error", "error": traceback.format_exc()}
+
+    async def rpc_push_actor_task(self, conn, spec) -> dict:
+        caller = spec.get("caller_id", "?")
+        seq = spec.get("seq", 0)
+        state = self._order.get(caller)
+        if state is None:
+            # Baseline on the first seq seen from this caller: after an actor
+            # restart the caller's counter does not reset, so "first seen" is
+            # the correct start of this incarnation's stream.
+            state = self._order[caller] = {"expected": seq, "waiters": {}}
+        # Order per caller: wait until all earlier seqs have *started*
+        # (actor_scheduling_queue.cc). A bounded wait guards against gaps
+        # from callers whose earlier submissions died with a previous
+        # incarnation.
+        while seq > state["expected"]:
+            event = state["waiters"].setdefault(seq, asyncio.Event())
+            try:
+                await asyncio.wait_for(event.wait(), timeout=5.0)
+            except asyncio.TimeoutError:
+                state["expected"] = seq
+                break
+        state["expected"] = max(state["expected"], seq + 1)
+        for s, ev in list(state["waiters"].items()):
+            if s <= state["expected"]:
+                ev.set()
+                state["waiters"].pop(s, None)
+        method_name = spec["method"]
+        if self.actor_instance is None:
+            payload, _ = serialization.serialize(
+                exceptions.ActorDiedError("actor not initialized")
+            )
+            return {"status": "error", "error": payload}
+        if method_name == "__ray_terminate__":
+            asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+            return {"status": "ok", "returns": [{"kind": "inline", "data": serialization.serialize(None)[0]}]}
+        method = getattr(self.actor_instance, method_name, None)
+        if method is None:
+            payload, _ = serialization.serialize(
+                AttributeError(f"actor has no method {method_name!r}")
+            )
+            return {"status": "error", "error": payload}
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self.executor, self._execute, spec, method, True
+        )
+
+    async def rpc_exit(self, conn, payload) -> dict:
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return {"status": "ok"}
+
+
+def main() -> None:
+    runtime = WorkerRuntime()
+    runtime.start()
+    # Park the main thread; all work happens on the io/executor threads.
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""In-process multi-node cluster simulation for tests.
+
+Role-equivalent of python/ray/cluster_utils.py :: Cluster — multiple node
+agents (each with its own shm store and worker pool) + one controller on a
+single machine, with add_node/remove_node for failure testing (the
+reference's core multi-node-without-a-cluster trick, SURVEY §4.4.1).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu._private import worker as _worker
+from ray_tpu._private.node import LocalCluster
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True, head_node_args: dict | None = None):
+        self._cluster = LocalCluster()
+        self._nodes: dict[str, object] = {}
+        if initialize_head:
+            args = head_node_args or {}
+            self._cluster.start_head(
+                resources=args.get("resources"),
+                store_capacity=args.get("object_store_memory", 0),
+            )
+            self._nodes[self._cluster.head_node_id] = self._cluster.agents[0]
+
+    @property
+    def address(self) -> str:
+        host, port = self._cluster.controller_addr
+        return f"{host}:{port}"
+
+    @property
+    def session_dir(self) -> str:
+        return self._cluster.session_dir
+
+    def add_node(self, resources: dict | None = None, num_cpus: float | None = None,
+                 object_store_memory: int = 0, **kw) -> str:
+        merged = dict(resources or {})
+        if num_cpus is not None:
+            merged["CPU"] = num_cpus
+        node_id = self._cluster.add_node(
+            resources=merged, store_capacity=object_store_memory
+        )
+        self._nodes[node_id] = self._cluster.agents[-1]
+        return node_id
+
+    def remove_node(self, node_id: str) -> None:
+        """Kill a node's agent process (and its workers die with the store)."""
+        handle = self._nodes.pop(node_id, None)
+        if handle is not None:
+            handle.kill()
+
+    def wait_for_nodes(self, expected: int | None = None, timeout: float = 30.0) -> None:
+        import ray_tpu
+
+        expected = expected if expected is not None else len(self._nodes)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["alive"]]
+            if len(alive) >= expected:
+                return
+            time.sleep(0.1)
+        raise TimeoutError(f"cluster did not reach {expected} alive nodes")
+
+    def shutdown(self) -> None:
+        self._cluster.shutdown()

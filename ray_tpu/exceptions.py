@@ -1,0 +1,66 @@
+"""User-facing exceptions.
+
+Role-equivalent of python/ray/exceptions.py in the reference
+(RayError/RayTaskError/ActorDiedError/ObjectLostError/...).
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised; carries the remote traceback.
+
+    Reference: RayTaskError — raised from ray.get() at the caller, so the
+    remote failure surfaces at the point the value is consumed.
+    """
+
+    def __init__(self, task_name: str, remote_traceback: str):
+        self.task_name = task_name
+        self.remote_traceback = remote_traceback
+        super().__init__(
+            f"task {task_name!r} failed remotely:\n{remote_traceback}"
+        )
+
+    def __reduce__(self):
+        return (TaskError, (self.task_name, self.remote_traceback))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died (e.g. OOM-killed, segfault)."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is permanently dead (restarts exhausted or never restartable)."""
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """All copies of the object are gone and it could not be reconstructed."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """ray_tpu.get(..., timeout=) expired."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The shared-memory store cannot fit the object even after eviction."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Worker runtime environment failed to materialize."""
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    """The placement group cannot fit on the cluster."""
+
+
+class GangDiedError(RayTpuError):
+    """A member of an SPMD worker gang died; the gang's collectives are wedged
+    and the whole gang is the failure domain (see SURVEY §5.3)."""

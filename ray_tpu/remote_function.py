@@ -1,0 +1,100 @@
+"""@ray_tpu.remote functions.
+
+Role-equivalent of python/ray/remote_function.py :: RemoteFunction._remote:
+options handling (num_cpus/resources/num_returns/max_retries/runtime_env/
+scheduling_strategy) and pickled-function export through the controller KV
+function table (the reference exports via GCS KV the same way).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any
+
+from ray_tpu._private import serialization, worker
+
+
+class RemoteFunction:
+    def __init__(self, fn, **default_options):
+        self._fn = fn
+        self._options = {
+            "num_returns": 1,
+            "num_cpus": 1,
+            "resources": None,
+            "max_retries": None,
+            "retry_exceptions": False,
+            "runtime_env": None,
+            "scheduling_strategy": None,
+        }
+        self._options.update(default_options)
+        self._function_id: str | None = None
+        self._export_lock = threading.Lock()
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function cannot be called directly; use "
+            f"{self.__name__}.remote(...)"
+        )
+
+    def options(self, **options) -> "RemoteFunction":
+        clone = RemoteFunction(self._fn, **{**self._options, **options})
+        clone._function_id = self._function_id
+        return clone
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_export_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._export_lock = threading.Lock()
+
+    def _ensure_exported(self) -> str:
+        if self._function_id is not None:
+            return self._function_id
+        with self._export_lock:
+            if self._function_id is None:
+                raw = serialization.dumps_function(self._fn)
+                function_id = "fn-" + hashlib.sha1(raw).hexdigest()[:20]
+                ctx = worker.get_global_context()
+                ctx.io.run(
+                    ctx.controller.call(
+                        "kv_put",
+                        {
+                            "namespace": "funcs",
+                            "key": function_id,
+                            "value": raw,
+                            "overwrite": False,
+                        },
+                    )
+                )
+                self._function_id = function_id
+        return self._function_id
+
+    def remote(self, *args, **kwargs):
+        ctx = worker.get_global_context()
+        function_id = self._ensure_exported()
+        opts = self._options
+        resources = dict(opts["resources"] or {})
+        if opts["num_cpus"] is not None:
+            resources.setdefault("CPU", opts["num_cpus"])
+        num_tpus = opts.get("num_tpus")
+        if num_tpus:
+            resources["TPU"] = num_tpus
+        refs = ctx.submit_task(
+            function_id=function_id,
+            name=self.__name__,
+            args=args,
+            kwargs=kwargs,
+            num_returns=opts["num_returns"],
+            resources=resources,
+            max_retries=opts["max_retries"],
+            retry_exceptions=opts["retry_exceptions"],
+            runtime_env=opts["runtime_env"],
+            scheduling_strategy=opts["scheduling_strategy"],
+        )
+        return refs[0] if opts["num_returns"] == 1 else refs

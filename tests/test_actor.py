@@ -1,0 +1,137 @@
+"""Actor tests (reference: python/ray/tests/test_actor*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def read(self):
+        return self.value
+
+    def crash(self):
+        import os
+
+        os._exit(1)
+
+
+def test_actor_create_and_call(ray_start_shared):
+    counter = Counter.remote(5)
+    assert ray_tpu.get(counter.increment.remote(), timeout=60) == 6
+
+
+def test_actor_state_persists(ray_start_shared):
+    counter = Counter.remote()
+    ray_tpu.get([counter.increment.remote() for _ in range(10)], timeout=60)
+    assert ray_tpu.get(counter.read.remote(), timeout=60) == 10
+
+
+def test_actor_call_ordering(ray_start_shared):
+    counter = Counter.remote()
+    # In-order execution per handle: final value deterministic.
+    results = ray_tpu.get(
+        [counter.increment.remote(i) for i in range(1, 11)], timeout=60
+    )
+    assert results == [sum(range(1, k + 1)) for k in range(1, 11)]
+
+
+def test_actor_constructor_args(ray_start_shared):
+    counter = Counter.remote(start=100)
+    assert ray_tpu.get(counter.read.remote(), timeout=60) == 100
+
+
+def test_named_actor(ray_start_shared):
+    Counter.options(name="global-counter").remote(7)
+    handle = ray_tpu.get_actor("global-counter")
+    assert ray_tpu.get(handle.read.remote(), timeout=60) == 7
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("does-not-exist")
+
+
+def test_actor_handle_passing(ray_start_shared):
+    counter = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(handle):
+        return ray_tpu.get(handle.increment.remote(), timeout=30)
+
+    assert ray_tpu.get(bump.remote(counter), timeout=120) == 1
+
+
+def test_actor_method_error(ray_start_shared):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor-err")
+
+    bad = Bad.remote()
+    with pytest.raises(exceptions.TaskError, match="actor-err"):
+        ray_tpu.get(bad.fail.remote(), timeout=60)
+
+
+def test_kill_actor(ray_start_shared):
+    counter = Counter.remote()
+    ray_tpu.get(counter.read.remote(), timeout=60)
+    ray_tpu.kill(counter)
+    with pytest.raises((exceptions.ActorDiedError, exceptions.ActorUnavailableError)):
+        ray_tpu.get(counter.read.remote(), timeout=60)
+
+
+def test_actor_restart_on_crash(ray_start_shared):
+    restartable = Counter.options(max_restarts=1).remote(3)
+    assert ray_tpu.get(restartable.read.remote(), timeout=60) == 3
+    try:
+        ray_tpu.get(restartable.crash.remote(), timeout=60)
+    except (exceptions.ActorDiedError, exceptions.TaskError, exceptions.WorkerCrashedError):
+        pass
+    # State resets after restart (no automatic state checkpointing — same as
+    # the reference), but the actor is alive again.
+    deadline = time.monotonic() + 60
+    value = None
+    while time.monotonic() < deadline:
+        try:
+            value = ray_tpu.get(restartable.read.remote(), timeout=30)
+            break
+        except (exceptions.ActorDiedError, exceptions.ActorUnavailableError):
+            time.sleep(0.5)
+    assert value == 3
+
+
+def test_actor_no_restart_dies(ray_start_shared):
+    fragile = Counter.remote()
+    try:
+        ray_tpu.get(fragile.crash.remote(), timeout=60)
+    except (exceptions.ActorDiedError, exceptions.TaskError, exceptions.WorkerCrashedError):
+        pass
+    with pytest.raises((exceptions.ActorDiedError, exceptions.ActorUnavailableError)):
+        ray_tpu.get(fragile.read.remote(), timeout=60)
+
+
+def test_async_actor(ray_start_shared):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def double(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return 2 * x
+
+    actor = AsyncActor.remote()
+    assert ray_tpu.get(actor.double.remote(21), timeout=60) == 42
+
+
+def test_detached_actor_survives_named_lookup(ray_start_shared):
+    Counter.options(name="detached-one", lifetime="detached").remote(1)
+    handle = ray_tpu.get_actor("detached-one")
+    assert ray_tpu.get(handle.read.remote(), timeout=60) == 1

@@ -1,0 +1,152 @@
+"""Core task/object API tests (reference: python/ray/tests/test_basic*.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+def test_simple_task(ray_start_shared):
+    assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+
+
+def test_task_with_object_ref_arg(ray_start_shared):
+    ref = add.remote(1, 2)
+    assert ray_tpu.get(add.remote(ref, 10), timeout=60) == 13
+
+
+def test_many_tasks(ray_start_shared):
+    refs = [add.remote(i, i) for i in range(100)]
+    assert ray_tpu.get(refs, timeout=120) == [2 * i for i in range(100)]
+
+
+def test_put_get_small(ray_start_shared):
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref, timeout=30) == {"k": [1, 2, 3]}
+
+
+def test_put_get_large_numpy_zero_copy(ray_start_shared):
+    arr = np.arange(2_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref, timeout=60)
+    np.testing.assert_array_equal(out, arr)
+    # Large arrays come back as read-only views onto the shm arena.
+    assert not out.flags.writeable
+
+
+def test_multiple_returns(ray_start_shared):
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    r1, r2 = two.remote()
+    assert ray_tpu.get([r1, r2], timeout=60) == [1, 2]
+
+
+def test_task_error_propagates(ray_start_shared):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bang")
+
+    with pytest.raises(exceptions.TaskError, match="bang"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_error_propagates_through_dependency(ray_start_shared):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bang")
+
+    # Consuming a failed upstream ref fails the downstream task too.
+    with pytest.raises(exceptions.TaskError):
+        ray_tpu.get(add.remote(boom.remote(), 1), timeout=60)
+
+
+def test_wait_basics(ray_start_shared):
+    refs = [echo.remote(i) for i in range(4)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=4, timeout=60)
+    assert len(ready) == 4 and not not_ready
+
+
+def test_wait_timeout(ray_start_shared):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    ready, not_ready = ray_tpu.wait([slow.remote()], timeout=0.2)
+    assert not ready and len(not_ready) == 1
+
+
+def test_get_timeout_raises(ray_start_shared):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(exceptions.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.3)
+
+
+def test_nested_remote_calls(ray_start_shared):
+    @ray_tpu.remote
+    def outer(n):
+        # Tasks can submit tasks (worker acts as owner/submitter).
+        return ray_tpu.get(add.remote(n, 1), timeout=30)
+
+    assert ray_tpu.get(outer.remote(5), timeout=120) == 6
+
+
+def test_ref_inside_container(ray_start_shared):
+    inner = ray_tpu.put(41)
+
+    @ray_tpu.remote
+    def unwrap(box):
+        # Nested refs are NOT auto-resolved (reference semantics).
+        return ray_tpu.get(box["ref"], timeout=30) + 1
+
+    assert ray_tpu.get(unwrap.remote({"ref": inner}), timeout=120) == 42
+
+
+def test_cluster_and_available_resources(ray_start_shared):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) >= 8
+    assert total.get("TPU", 0) == 8  # resource lying works
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) > 0
+
+
+def test_task_with_custom_resources(ray_start_shared):
+    @ray_tpu.remote(num_tpus=2)
+    def uses_tpu():
+        return "ok"
+
+    assert ray_tpu.get(uses_tpu.remote(), timeout=60) == "ok"
+
+
+def test_runtime_env_env_vars(ray_start_shared):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RAYTPU_TEST_MARKER": "42"}})
+    def read_env():
+        import os
+
+        return os.environ.get("RAYTPU_TEST_MARKER")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "42"
+
+
+def test_runtime_context(ray_start_shared):
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx["is_driver"]
+    assert ctx["job_id"].startswith("job-")

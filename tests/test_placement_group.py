@@ -1,0 +1,82 @@
+"""Placement group tests (reference: python/ray/tests/test_placement_group*.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+    tpu_slice_bundles,
+)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+def test_pg_create_ready(ray_start_shared):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    pg.ready(timeout=60)
+    table = {row["pg_id"]: row for row in placement_group_table()}
+    assert table[pg.id]["state"] == "CREATED"
+    remove_placement_group(pg)
+
+
+def test_pg_strict_pack_single_node(ray_start_shared):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    pg.ready(timeout=60)
+    row = next(r for r in placement_group_table() if r["pg_id"] == pg.id)
+    assert len(set(row["bundle_nodes"])) == 1
+    remove_placement_group(pg)
+
+
+def test_task_in_pg_bundle(ray_start_shared):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    pg.ready(timeout=60)
+
+    @ray_tpu.remote(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        )
+    )
+    def where():
+        return ray_tpu.get_runtime_context()["node_id"]
+
+    node_id = ray_tpu.get(where.remote(), timeout=120)
+    row = next(r for r in placement_group_table() if r["pg_id"] == pg.id)
+    assert node_id == row["bundle_nodes"][0]
+    remove_placement_group(pg)
+
+
+def test_actor_in_pg(ray_start_shared):
+    pg = placement_group([{"CPU": 1, "TPU": 2}], strategy="PACK")
+    pg.ready(timeout=60)
+
+    @ray_tpu.remote(
+        num_tpus=2,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        ),
+    )
+    class TpuActor:
+        def ping(self):
+            return "pong"
+
+    actor = TpuActor.remote()
+    assert ray_tpu.get(actor.ping.remote(), timeout=120) == "pong"
+    ray_tpu.kill(actor)
+    remove_placement_group(pg)
+
+
+def test_infeasible_pg_stays_pending(ray_start_shared):
+    pg = placement_group([{"CPU": 10000}], strategy="STRICT_PACK")
+    with pytest.raises(Exception):
+        pg.ready(timeout=2)
+    remove_placement_group(pg)
+
+
+def test_tpu_slice_bundles():
+    bundles = tpu_slice_bundles("v4-32")
+    # v4-32 = 16 chips over 4 hosts of 4 chips.
+    assert len(bundles) == 4
+    assert all(b["TPU"] == 4.0 for b in bundles)
+    bundles = tpu_slice_bundles("v5e-8")
+    assert len(bundles) == 1 and bundles[0]["TPU"] == 8.0
