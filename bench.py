@@ -1,0 +1,107 @@
+"""Headline benchmark — prints ONE JSON line for the driver.
+
+Metric: flagship-transformer training throughput (tokens/s) on the local
+accelerator, single chip.
+
+vs_baseline is the GPU-parity ratio from BASELINE.json's north star
+("GPU-parity throughput ... with num_gpus=0"): achieved model FLOP/s divided
+by an A100's effective training FLOP/s on the same model (312 TFLOP/s bf16
+peak × 40% MFU = 125 TFLOP/s — the standard well-tuned-GPU operating
+point). vs_baseline >= 1.0 means one TPU chip matches/beats one A100.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.transformer import (
+        TransformerConfig, init_params, loss_fn, num_params,
+    )
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "gpu")
+    if on_accel:
+        config = TransformerConfig(
+            vocab_size=8192, dim=1024, n_layers=8, n_heads=16, n_kv_heads=16,
+            hidden_dim=2816, max_seq=1024, dtype=jnp.bfloat16,
+        )
+        batch, steps = 8, 10
+    else:  # CPU smoke fallback so the bench never crashes the driver
+        config = TransformerConfig.tiny()
+        batch, steps = 2, 2
+
+    params = init_params(config, jax.random.PRNGKey(0))
+    optimizer = optax.adamw(3e-4)
+    opt_state = jax.jit(optimizer.init)(params)
+    # seq+1 tokens so the shifted inputs keep a block-aligned length.
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, config.max_seq + 1), 0, config.vocab_size
+    )
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        # Next-token LM objective (shifted targets).
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        loss, grads = jax.value_and_grad(loss_fn)(params, inputs, targets, config)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # warmup/compile. float() forces a device->host read — on remote-attached
+    # chips block_until_ready alone does not guarantee execution finished.
+    params, opt_state, loss = train_step(params, opt_state, tokens)
+    float(loss)
+    start = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    loss_value = float(loss)  # chained params => all steps must complete
+    elapsed = time.perf_counter() - start
+
+    tokens_per_step = batch * config.max_seq
+    tokens_per_s = tokens_per_step * steps / elapsed
+    p = num_params(params)
+    achieved_flops = 6.0 * p * tokens_per_s          # fwd+bwd rule of thumb
+    a100_effective = 312e12 * 0.40                   # GPU-parity yardstick
+    vs_baseline = achieved_flops / a100_effective
+
+    print(
+        json.dumps(
+            {
+                "metric": "transformer_train_tokens_per_s_per_chip",
+                "value": round(tokens_per_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(vs_baseline, 4),
+                "detail": {
+                    "backend": backend,
+                    "params": p,
+                    "achieved_tflops": round(achieved_flops / 1e12, 2),
+                    "loss": loss_value,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as exc:  # never crash the driver: report the failure
+        print(
+            json.dumps(
+                {
+                    "metric": "transformer_train_tokens_per_s_per_chip",
+                    "value": 0.0,
+                    "unit": "tokens/s",
+                    "vs_baseline": 0.0,
+                    "detail": {"error": f"{type(exc).__name__}: {exc}"[:500]},
+                }
+            )
+        )
+        sys.exit(0)

@@ -1,0 +1,347 @@
+"""Flagship model: LLaMA-style decoder-only transformer, TPU-first.
+
+Design notes (SURVEY §7.0.3 "parallelism is mesh axes"):
+  * functional: params are a pytree of jnp arrays; every leaf has a logical
+    dim annotation in PARAM_LOGICAL_DIMS, so DP/FSDP/TP/EP sharding is one
+    LogicalRules switchboard away — model code never mentions mesh axes.
+  * layers are scanned (lax.scan over stacked layer params): O(1) compile
+    time in depth, XLA-friendly control flow.
+  * attention = in-tree Pallas flash kernel (ops/flash_attention.py); ring /
+    Ulysses sequence parallelism plug in via `attention_fn` (parallel/).
+  * MoE blocks use dense dispatch/combine einsums with an "expert" logical
+    dim — under pjit, GSPMD partitions the expert matmuls over the ep axis
+    and inserts the token all_to_alls (first-class EP, which the reference
+    lacks entirely — SURVEY §2.9).
+  * weights default to bfloat16 (MXU-native); norms/softmax accumulate f32.
+
+Reference parity: the reference has no model zoo of its own (models arrive
+via torch); this model family is the TPU build's equivalent of the LLM
+examples the reference runs through vLLM/DeepSpeed integrations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.flash_attention import attention_reference, flash_attention
+from ray_tpu.ops.rmsnorm import rmsnorm_reference
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    hidden_dim: int = 11008
+    max_seq: int = 4096
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    moe: MoEConfig | None = None
+    # "flash" | "reference" | callable(q,k,v,causal)->o supplied by
+    # parallel/ (ring attention, ulysses).
+    attention: str = "flash"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def tiny(**overrides) -> "TransformerConfig":
+        base = dict(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            hidden_dim=128, max_seq=128, dtype=jnp.float32,
+        )
+        base.update(overrides)
+        return TransformerConfig(**base)
+
+    @staticmethod
+    def llama2_7b(**overrides) -> "TransformerConfig":
+        base = dict(
+            vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=32, hidden_dim=11008, max_seq=4096,
+        )
+        base.update(overrides)
+        return TransformerConfig(**base)
+
+
+# Logical dim names per param leaf (layer-stacked leaves lead with "layer").
+def param_logical_dims(config: TransformerConfig) -> dict:
+    dense_mlp = {
+        "w_gate": ("layer", "embed", "mlp"),
+        "w_up": ("layer", "embed", "mlp"),
+        "w_down": ("layer", "mlp", "embed"),
+    }
+    moe_mlp = {
+        "router": ("layer", "embed", None),
+        "w_gate": ("layer", "expert", "embed", "mlp"),
+        "w_up": ("layer", "expert", "embed", "mlp"),
+        "w_down": ("layer", "expert", "mlp", "embed"),
+    }
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layer", None),
+            "wq": ("layer", "embed", "heads"),
+            "wk": ("layer", "embed", "kv"),
+            "wv": ("layer", "embed", "kv"),
+            "wo": ("layer", "heads", "embed"),
+            "mlp_norm": ("layer", None),
+            **(moe_mlp if config.moe else dense_mlp),
+        },
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(config: TransformerConfig, key: jax.Array) -> dict:
+    keys = iter(jax.random.split(key, 16))
+    dt = config.dtype
+    d, hd = config.dim, config.head_dim
+    nl = config.n_layers
+    q_out = config.n_heads * hd
+    kv_out = config.n_kv_heads * hd
+
+    def dense(key, *shape, scale=None):
+        scale = scale if scale is not None else shape[-2] ** -0.5
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    if config.moe:
+        experts = config.moe.num_experts
+        mlp = {
+            "router": dense(next(keys), nl, d, experts).astype(jnp.float32),
+            "w_gate": dense(next(keys), nl, experts, d, config.hidden_dim),
+            "w_up": dense(next(keys), nl, experts, d, config.hidden_dim),
+            "w_down": dense(
+                next(keys), nl, experts, config.hidden_dim, d,
+                scale=config.hidden_dim ** -0.5,
+            ),
+        }
+    else:
+        mlp = {
+            "w_gate": dense(next(keys), nl, d, config.hidden_dim),
+            "w_up": dense(next(keys), nl, d, config.hidden_dim),
+            "w_down": dense(
+                next(keys), nl, config.hidden_dim, d,
+                scale=config.hidden_dim ** -0.5,
+            ),
+        }
+    return {
+        "embed": dense(next(keys), config.vocab_size, d, scale=0.02),
+        "layers": {
+            "attn_norm": jnp.ones((nl, d), dt),
+            "wq": dense(next(keys), nl, d, q_out),
+            "wk": dense(next(keys), nl, d, kv_out),
+            "wv": dense(next(keys), nl, d, kv_out),
+            "wo": dense(next(keys), nl, q_out, d, scale=q_out ** -0.5),
+            "mlp_norm": jnp.ones((nl, d), dt),
+            **mlp,
+        },
+        "final_norm": jnp.ones((d,), dt),
+        "lm_head": dense(next(keys), d, config.vocab_size, scale=d ** -0.5),
+    }
+
+
+def _attention_impl(config: TransformerConfig) -> Callable:
+    if callable(config.attention):
+        return config.attention
+    if config.attention == "flash":
+        return lambda q, k, v, causal: flash_attention(q, k, v, causal=causal)
+    return lambda q, k, v, causal: attention_reference(q, k, v, causal=causal)
+
+
+def _repeat_kv(x: jax.Array, repeats: int) -> jax.Array:
+    if repeats == 1:
+        return x
+    return jnp.repeat(x, repeats, axis=1)
+
+
+def _attention_block(x, layer, config, cos_sin, positions, attention_fn):
+    batch, seq, d = x.shape
+    hd = config.head_dim
+    h = rmsnorm_reference(x, layer["attn_norm"])
+    q = (h @ layer["wq"]).reshape(batch, seq, config.n_heads, hd)
+    k = (h @ layer["wk"]).reshape(batch, seq, config.n_kv_heads, hd)
+    v = (h @ layer["wv"]).reshape(batch, seq, config.n_kv_heads, hd)
+    q = q.transpose(0, 2, 1, 3)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    cos, sin = cos_sin
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    rep = config.n_heads // config.n_kv_heads
+    o = attention_fn(q, _repeat_kv(k, rep), _repeat_kv(v, rep), True)
+    o = o.transpose(0, 2, 1, 3).reshape(batch, seq, config.n_heads * hd)
+    return x + (o @ layer["wo"]).astype(x.dtype)
+
+
+def _dense_mlp(h, layer):
+    gate = jax.nn.silu((h @ layer["w_gate"]).astype(jnp.float32))
+    up = (h @ layer["w_up"]).astype(jnp.float32)
+    return (gate * up).astype(h.dtype) @ layer["w_down"]
+
+
+def _moe_mlp(h, layer, config: TransformerConfig):
+    """Dense dispatch/combine MoE (Mesh-TF style). Static shapes via
+    capacity buckets; expert dim carries the "expert" logical annotation so
+    GSPMD shards the expert matmuls over ep and inserts all_to_alls."""
+    moe = config.moe
+    batch, seq, d = h.shape
+    tokens = batch * seq
+    ht = h.reshape(tokens, d)
+    logits = (ht.astype(jnp.float32) @ layer["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)              # [T, E]
+    capacity = max(
+        1, int(moe.capacity_factor * moe.top_k * tokens / moe.num_experts)
+    )
+
+    combine = jnp.zeros((tokens, moe.num_experts, capacity), jnp.float32)
+    remaining = probs
+    for _ in range(moe.top_k):
+        gate, choice = jnp.max(remaining, axis=-1), jnp.argmax(remaining, axis=-1)
+        onehot = jax.nn.one_hot(choice, moe.num_experts, dtype=jnp.float32)
+        position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # pos within expert
+        pos_idx = jnp.sum(position, axis=-1).astype(jnp.int32)
+        keep = pos_idx < capacity
+        slot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)
+        contribution = (
+            gate[:, None, None] * keep[:, None, None]
+            * onehot[:, :, None] * slot[:, None, :]
+        )
+        combine = combine + contribution
+        remaining = remaining * (1.0 - onehot)
+    dispatch = (combine > 0).astype(h.dtype)             # [T, E, C]
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, ht)  # [E, C, D]
+    gate_o = jax.nn.silu(
+        jnp.einsum("ecd,edm->ecm", expert_in, layer["w_gate"]).astype(jnp.float32)
+    )
+    up_o = jnp.einsum("ecd,edm->ecm", expert_in, layer["w_up"]).astype(jnp.float32)
+    expert_out = jnp.einsum(
+        "ecm,emd->ecd", (gate_o * up_o).astype(h.dtype), layer["w_down"]
+    )
+    out = jnp.einsum("tec,ecd->td", combine.astype(h.dtype), expert_out)
+    return out.reshape(batch, seq, d)
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    positions: jax.Array | None = None,
+) -> jax.Array:
+    """tokens: [batch, seq] int32 -> logits [batch, seq, vocab] (f32)."""
+    attention_fn = _attention_impl(config)
+    cos, sin = rope_frequencies(config.head_dim, config.max_seq, config.rope_theta)
+    x = params["embed"][tokens]
+
+    def layer_step(carry, layer):
+        x = carry
+        x = _attention_block(x, layer, config, (cos, sin), positions, attention_fn)
+        h = rmsnorm_reference(x, layer["mlp_norm"])
+        if config.moe:
+            x = x + _moe_mlp(h, layer, config).astype(x.dtype)
+        else:
+            x = x + _dense_mlp(h, layer).astype(x.dtype)
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    x = rmsnorm_reference(x, params["final_norm"])
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(
+    params: dict,
+    tokens: jax.Array,
+    targets: jax.Array,
+    config: TransformerConfig,
+    mask: jax.Array | None = None,
+) -> jax.Array:
+    logits = forward(params, tokens, config)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def num_params(params: dict) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode (serving path)
+# ---------------------------------------------------------------------------
+def init_kv_cache(config: TransformerConfig, batch: int, max_seq: int) -> dict:
+    hd = config.head_dim
+    shape = (config.n_layers, batch, config.n_kv_heads, max_seq, hd)
+    return {
+        "k": jnp.zeros(shape, config.dtype),
+        "v": jnp.zeros(shape, config.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def decode_step(
+    params: dict, cache: dict, tokens: jax.Array, config: TransformerConfig
+) -> tuple[jax.Array, dict]:
+    """One greedy decode step. tokens: [batch, 1] -> (logits [batch, vocab],
+    new cache). Static shapes: cache is a fixed-size ring the XLA compiler
+    can tile; `length` is a traced scalar."""
+    cos, sin = rope_frequencies(config.head_dim, config.max_seq, config.rope_theta)
+    batch = tokens.shape[0]
+    hd = config.head_dim
+    length = cache["length"]
+    positions = jnp.full((batch, 1), length, jnp.int32)
+    x = params["embed"][tokens]
+
+    def layer_step(carry, inputs):
+        x = carry
+        layer, k_cache, v_cache = inputs
+        h = rmsnorm_reference(x, layer["attn_norm"])
+        q = (h @ layer["wq"]).reshape(batch, 1, config.n_heads, hd).transpose(0, 2, 1, 3)
+        k = (h @ layer["wk"]).reshape(batch, 1, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        v = (h @ layer["wv"]).reshape(batch, 1, config.n_kv_heads, hd).transpose(0, 2, 1, 3)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, length, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, length, 0)
+        )
+        rep = config.n_heads // config.n_kv_heads
+        keys = _repeat_kv(k_cache, rep).astype(jnp.float32)
+        vals = _repeat_kv(v_cache, rep).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), keys) * hd ** -0.5
+        idx = jnp.arange(keys.shape[2])
+        s = jnp.where(idx[None, None, None, :] <= length, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vals)
+        o = o.transpose(0, 2, 1, 3).reshape(batch, 1, config.n_heads * hd)
+        x = x + (o.astype(x.dtype) @ layer["wo"])
+        h2 = rmsnorm_reference(x, layer["mlp_norm"])
+        x = x + _dense_mlp(h2, layer).astype(x.dtype)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rmsnorm_reference(x, params["final_norm"])
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    new_cache = {"k": new_k, "v": new_v, "length": length + 1}
+    return logits, new_cache

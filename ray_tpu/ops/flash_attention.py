@@ -1,0 +1,220 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the flagship models (SURVEY §2.9 SP row: the reference has no
+native attention kernels at all — attention arrives via user engines; here it
+is in-tree). Blocked online-softmax attention:
+
+  grid = (batch*heads, q_blocks, kv_blocks)   # last dim sequential on TPU
+  VMEM scratch carries the running max/sum/accumulator across kv steps.
+
+On non-TPU backends the same kernel runs in interpreter mode (the CPU twin,
+SURVEY §4.4), so tests exercise the identical code path the TPU compiles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal,
+    block_q, block_k, num_kv_blocks, precision, causal_offset
+):
+    kv_index = pl.program_id(2)
+
+    @pl.when(kv_index == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)            # [block_q, d]
+    k = k_ref[0].astype(jnp.float32)            # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)            # [block_k, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=precision,
+    ) * scale                                    # [block_q, block_k]
+
+    if causal:
+        q_index = pl.program_id(1)
+        # causal_offset = seq_k - seq_q aligns queries to the END of the key
+        # sequence (decode convention; matches attention_reference's
+        # tril(..., seq_k - seq_q)).
+        q_pos = causal_offset + q_index * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kv_index * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+    m_prev = m_scr[:]                            # [block_q, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)                       # [block_q, block_k]
+    correction = jnp.exp(m_prev - m_new)         # [block_q, 1]
+    l_new = correction * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=precision,
+    )
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+
+    @pl.when(kv_index == num_kv_blocks - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _should_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+    precision: jax.lax.Precision | None = None,
+) -> jax.Array:
+    """q,k,v: [batch, heads, seq, head_dim] (kv heads may be fewer: GQA is
+    handled by the caller repeating kv heads). Returns same shape as q.
+
+    Differentiable: forward is the Pallas kernel; backward recomputes
+    attention in plain jax (flash-style recompute trades FLOPs for the O(S²)
+    probs it never stored). precision=None keeps the MXU's fast bf16
+    multiply; tests pass Precision.HIGHEST for tight reference comparison.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_vjp(q, k, v, causal, float(scale), block_q, block_k,
+                      interpret, precision)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_vjp(q, k, v, causal, scale, block_q, block_k, interpret, precision):
+    return _flash_forward(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret, precision=precision,
+    )
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret, precision):
+    out = _flash_forward(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret, precision=precision,
+    )
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, precision,
+                   residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(
+            q_, k_, v_, causal=causal, scale=scale
+        ),
+        q, k, v,
+    )
+    return vjp(g.astype(q.dtype))
+
+
+_flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret", "precision"),
+)
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+    precision: jax.lax.Precision | None = None,
+) -> jax.Array:
+    batch, heads, seq_q, dim = q.shape
+    _, kv_heads, seq_k, _ = k.shape
+    assert kv_heads == heads, "repeat kv heads before calling (GQA)"
+    if scale is None:
+        scale = dim ** -0.5
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    assert seq_q % block_q == 0 and seq_k % block_k == 0, (
+        f"seq lengths ({seq_q},{seq_k}) must divide blocks ({block_q},{block_k})"
+    )
+    if interpret is None:
+        interpret = _should_interpret()
+
+    bh = batch * heads
+    qr = q.reshape(bh, seq_q, dim)
+    kr = k.reshape(bh, seq_k, dim)
+    vr = v.reshape(bh, seq_k, dim)
+    num_q_blocks = seq_q // block_q
+    num_kv_blocks = seq_k // block_k
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        num_kv_blocks=num_kv_blocks,
+        precision=precision,
+        causal_offset=seq_k - seq_q,
+    )
+    from jax.experimental.pallas import tpu as pltpu
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, num_q_blocks, num_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dim), lambda i, j, kv: (i, j, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda i, j, kv: (i, kv, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda i, j, kv: (i, kv, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dim), lambda i, j, kv: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),    # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),    # running sum
+            pltpu.VMEM((block_q, dim), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(batch, heads, seq_q, dim)
+
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True,
+    scale: float | None = None
+) -> jax.Array:
+    """Pure-jax reference used for kernel numerics tests."""
+    dim = q.shape[-1]
+    if scale is None:
+        scale = dim ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        seq_q, seq_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((seq_q, seq_k), dtype=bool), seq_k - seq_q)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)

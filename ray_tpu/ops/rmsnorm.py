@@ -1,0 +1,62 @@
+"""Fused RMSNorm Pallas kernel (+ jax reference).
+
+One VMEM pass instead of separate square/mean/rsqrt/mul HLOs — the classic
+HBM-bandwidth fusion (SURVEY 'HBM bandwidth' guidance). Falls back to
+interpreter mode off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    o_ref[:] = (x * jax.lax.rsqrt(var + eps) * w_ref[:].astype(jnp.float32)).astype(
+        o_ref.dtype
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "block_rows", "interpret"))
+def rmsnorm(
+    x: jax.Array,
+    weight: jax.Array,
+    *,
+    eps: float = 1e-6,
+    block_rows: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """x: [..., dim]; weight: [dim]."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    orig_shape = x.shape
+    dim = orig_shape[-1]
+    rows = x.size // dim
+    xr = x.reshape(rows, dim)
+    block_rows = min(block_rows, rows)
+    if rows % block_rows != 0:
+        # Odd row counts: plain jax fallback keeps semantics.
+        return rmsnorm_reference(x, weight, eps=eps)
+    out = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+            pl.BlockSpec((dim,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, dim), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, dim), x.dtype),
+        interpret=interpret,
+    )(xr, weight)
+    return out.reshape(orig_shape)
+
+
+def rmsnorm_reference(x: jax.Array, weight: jax.Array, *, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,44 @@
+"""Rotary position embeddings (RoPE).
+
+Pure jax — XLA fuses this into the surrounding attention ops; a Pallas
+kernel buys nothing here (elementwise, bandwidth-bound, already fused).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int, max_seq: int, theta: float = 10000.0
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (cos, sin) tables of shape [max_seq, head_dim // 2]."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array | None = None
+) -> jax.Array:
+    """x: [batch, heads, seq, head_dim]; cos/sin: [max_seq, head_dim//2].
+
+    positions: optional [batch, seq] absolute positions (for KV-cache decode
+    or sequence-parallel shards whose local index != absolute index).
+    """
+    seq = x.shape[-2]
+    if positions is None:
+        c = cos[:seq][None, None, :, :]
+        s = sin[:seq][None, None, :, :]
+    else:
+        c = cos[positions][:, None, :, :]
+        s = sin[positions][:, None, :, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    rotated = jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1
+    )
+    return rotated.astype(x.dtype)

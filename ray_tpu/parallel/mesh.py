@@ -1,0 +1,155 @@
+"""Device mesh + logical sharding vocabulary.
+
+This is the heart of the TPU-first design (SURVEY §2.9): every parallelism
+strategy the reference delegates to third-party engines (DeepSpeed/Megatron)
+is a named axis of ONE jax mesh here:
+
+    dp    — data parallel (batch split; gradients psum over dp)
+    fsdp  — fully-sharded data parallel (params/opt-state sharded; ZeRO-3
+            equivalent falls out of NamedSharding + pjit)
+    tp    — tensor parallel (embed/mlp/heads split; matmul partials psum
+            over ICI neighbors)
+    sp    — sequence/context parallel (ring attention / Ulysses all_to_all)
+    pp    — pipeline parallel (stage axis, ppermute microbatch hand-off)
+    ep    — expert parallel (MoE expert sharding, all_to_all token routing)
+
+Model code annotates arrays with *logical* dim names ("batch", "embed", ...);
+`LogicalRules` maps logical names to mesh axes, giving one switchboard where
+a whole model's sharding is reconfigured without touching model code (the
+flax `logical_axis_rules` idea, rebuilt standalone).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+
+# Default logical-dim -> mesh-axis rules (overridable per model/run).
+DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
+    ("batch", ("dp", "fsdp")),   # batch splits over both data axes
+    ("seq", "sp"),               # sequence/context parallelism
+    ("embed", "fsdp"),           # param sharding for ZeRO-style FSDP
+    ("mlp", "tp"),               # feed-forward hidden dim over tensor axis
+    ("heads", "tp"),             # attention heads over tensor axis
+    ("kv", None),                # head_dim stays replicated
+    ("vocab", "tp"),
+    ("expert", "ep"),
+    ("stage", "pp"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape: axis name -> size. Order fixed by AXES so
+    collective-heavy axes (tp/sp) land on the innermost (fastest, ICI-
+    adjacent) mesh dimensions — the scaling-book layout recipe."""
+
+    axes: dict[str, int]
+
+    def __post_init__(self):
+        for name in self.axes:
+            if name not in AXES:
+                raise ValueError(f"unknown mesh axis {name!r}; valid: {AXES}")
+        if any(v <= 0 for v in self.axes.values()):
+            raise ValueError("axis sizes must be positive")
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.axes.values()) if self.axes else 1
+
+    def axis_names(self) -> tuple[str, ...]:
+        """All declared axes (size-1 included: a PartitionSpec may name any
+        declared axis; dropping trivial axes would break those consumers)."""
+        return tuple(a for a in AXES if a in self.axes) or ("dp",)
+
+    def build(self, devices: Sequence[Any] | None = None) -> Mesh:
+        devices = list(devices) if devices is not None else jax.devices()
+        if len(devices) < self.size:
+            raise ValueError(
+                f"mesh needs {self.size} devices, have {len(devices)}"
+            )
+        names = self.axis_names()
+        shape = tuple(self.axes.get(a, 1) for a in names)
+        if math.prod(shape) == 0:
+            shape = (1,)
+        grid = np.array(devices[: math.prod(shape)]).reshape(shape)
+        return Mesh(grid, names)
+
+
+class LogicalRules:
+    """Maps logical dim names to mesh axes and builds shardings."""
+
+    def __init__(self, rules: Sequence[tuple[str, Any]] = DEFAULT_RULES):
+        self._rules = dict(rules)
+
+    def with_overrides(self, **overrides: Any) -> "LogicalRules":
+        merged = dict(self._rules)
+        merged.update(overrides)
+        return LogicalRules(tuple(merged.items()))
+
+    def spec(self, logical_dims: Sequence[str | None], mesh: Mesh) -> P:
+        """PartitionSpec for an array whose dims carry these logical names.
+        Mesh axes not present in the mesh (size 1 / absent) degrade to
+        replication, so one set of annotations serves every mesh shape."""
+        entries = []
+        used: set[str] = set()
+        for dim in logical_dims:
+            if dim is None:
+                entries.append(None)
+                continue
+            axis = self._rules.get(dim)
+            if axis is None:
+                entries.append(None)
+                continue
+            if isinstance(axis, (tuple, list)):
+                present = tuple(
+                    a for a in axis if a in mesh.axis_names and a not in used
+                )
+                used.update(present)
+                entries.append(present if present else None)
+            else:
+                if axis in mesh.axis_names and axis not in used:
+                    used.add(axis)
+                    entries.append(axis)
+                else:
+                    entries.append(None)
+        return P(*entries)
+
+    def sharding(
+        self, logical_dims: Sequence[str | None], mesh: Mesh
+    ) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_dims, mesh))
+
+    def tree_shardings(
+        self, logical_tree: Any, mesh: Mesh
+    ) -> Any:
+        """Map a pytree of logical-dim tuples to a pytree of NamedShardings."""
+        return jax.tree.map(
+            lambda dims: self.sharding(dims, mesh),
+            logical_tree,
+            is_leaf=lambda x: isinstance(x, (tuple, list))
+            and all(isinstance(d, (str, type(None))) for d in x),
+        )
+
+
+def single_host_mesh(**axes: int) -> Mesh:
+    """Convenience: build a mesh over this process's local devices."""
+    return MeshSpec(axes).build(jax.local_devices())
+
+
+def shard_batch(batch: Any, mesh: Mesh, rules: LogicalRules | None = None) -> Any:
+    """device_put a host batch with its leading dim split over the data axes."""
+    rules = rules or LogicalRules()
+
+    def _put(x):
+        dims = ["batch"] + [None] * (np.ndim(x) - 1)
+        return jax.device_put(x, rules.sharding(dims, mesh))
+
+    return jax.tree.map(_put, batch)

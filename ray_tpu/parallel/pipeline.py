@@ -1,0 +1,110 @@
+"""Pipeline parallelism — GPipe-style microbatching over the `pp` mesh axis.
+
+The reference expresses pipelines via compiled-graph NCCL channels between
+actor stages (python/ray/dag/, SURVEY §2.9 PP row). TPU-native version:
+stages live on a `pp` mesh axis; activations hop stage→stage with
+`ppermute` inside ONE compiled program (lax.fori_loop over pipeline ticks),
+so XLA overlaps the ICI hand-off with each stage's compute.
+
+Layout: layer-stacked params get their leading "layer" dim sharded over pp
+(each pp rank holds n_layers / pp_size consecutive layers). The schedule is
+the classic (M + P - 1)-tick GPipe fill/drain loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name, num_micro):
+    """Runs inside shard_map. stage_params: this rank's layer shard.
+    x_micro: [num_micro, micro_batch, ...] (replicated across pp ranks).
+    Returns [num_micro, micro_batch, ...] outputs (replicated)."""
+    size = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    shift = [(i, (i + 1) % size) for i in range(size)]
+
+    micro_shape = x_micro.shape[1:]
+    outputs = jnp.zeros_like(x_micro)
+
+    def tick(t, carry):
+        outputs, buffer = carry
+        # Which microbatch does this rank work on at tick t?
+        micro_index = t - rank
+        active = (micro_index >= 0) & (micro_index < num_micro)
+        safe_index = jnp.clip(micro_index, 0, num_micro - 1)
+        # Stage 0 reads fresh input; later stages read the hand-off buffer.
+        x_in = jnp.where(rank == 0, x_micro[safe_index], buffer)
+        y = stage_fn(stage_params, x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # Last stage records its finished microbatch.
+        record = active & (rank == size - 1)
+        outputs = jax.lax.cond(
+            record,
+            lambda o: o.at[safe_index].set(y),
+            lambda o: o,
+            outputs,
+        )
+        # Hand activations to the next stage (ICI neighbor hop).
+        buffer = jax.lax.ppermute(y, axis_name, shift)
+        return outputs, buffer
+
+    init_buffer = jnp.zeros(micro_shape, x_micro.dtype)
+    outputs, _ = jax.lax.fori_loop(
+        0, num_micro + size - 1, tick, (outputs, init_buffer)
+    )
+    # Broadcast final outputs from the last stage to every rank.
+    outputs = jax.lax.psum(
+        jnp.where(rank == size - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name,
+    )
+    return outputs
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+    param_specs=None,
+) -> jax.Array:
+    """Apply a layer-stacked function as a pipeline.
+
+    stage_fn(stage_params, x) must apply ONE rank's layer shard (e.g. a
+    lax.scan over the local layers). stacked_params: pytree whose leaves
+    lead with the full layer dim (sharded over `axis_name` here).
+    x: [batch, ...] with batch divisible by num_microbatches.
+    """
+    batch = x.shape[0]
+    assert batch % num_microbatches == 0, (batch, num_microbatches)
+    micro = batch // num_microbatches
+    x_micro = x.reshape(num_microbatches, micro, *x.shape[1:])
+
+    if param_specs is None:
+        param_specs = jax.tree.map(
+            lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
+            stacked_params,
+        )
+    local = functools.partial(
+        _pipeline_local,
+        stage_fn=stage_fn,
+        axis_name=axis_name,
+        num_micro=num_microbatches,
+    )
+    out = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x_micro)
+    return out.reshape(batch, *out.shape[2:])

@@ -1,0 +1,387 @@
+"""Process-group collectives for actors.
+
+Role-equivalent of python/ray/util/collective/collective.py
+(:: init_collective_group, allreduce, allgather, reducescatter, broadcast,
+barrier, send, recv) with the reference's NCCL/Gloo backends replaced by
+(SURVEY §5.8):
+
+  * "xla"  — the TPU data plane: collectives compile into XLA programs over
+    the caller's jax device mesh (psum/all_gather/... on ICI). Multi-host
+    gangs share one global jax runtime via jax.distributed (rendezvous
+    coordinates come from the gang, §gang.py); a single host's chips work
+    out of the box.
+  * "ring" — host-memory ring collectives over the framework's own RPC p2p
+    (reduce-scatter + all-gather ring), the Gloo-equivalent CPU fallback
+    AND the hostless test twin (SURVEY §4.4.4).
+
+Rendezvous replaces the reference's NCCL-unique-id "Info" actor with the
+controller KV [N6].
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import time
+from typing import Any
+
+import numpy as np
+
+from ray_tpu._private import worker as worker_mod
+
+_groups: dict[str, "BaseGroup"] = {}
+
+SUM, PRODUCT, MIN, MAX = "sum", "product", "min", "max"
+_REDUCERS = {SUM: np.add, PRODUCT: np.multiply, MIN: np.minimum, MAX: np.maximum}
+
+
+class BaseGroup:
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
+
+    # subclasses implement: allreduce, allgather, reducescatter, broadcast,
+    # barrier, send, recv, destroy
+
+
+# ---------------------------------------------------------------------------
+# ring backend (host memory over RPC p2p)
+# ---------------------------------------------------------------------------
+class RingGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        self.ctx = worker_mod.get_global_context()
+        self._mailbox: dict[tuple, Any] = {}
+        self._mailbox_events: dict[tuple, asyncio.Event] = {}
+        self.ctx.core_server.route(
+            f"coll_send/{group_name}", self._rpc_coll_send
+        )
+        self._register()
+        self._peer_addrs = self._resolve_peers()
+        self._barrier_epoch = 0
+        self._send_seq: dict[tuple, int] = {}
+        self._recv_seq: dict[tuple, int] = {}
+
+    # -- rendezvous via controller KV ----------------------------------
+    def _kv(self, method: str, payload: dict) -> Any:
+        return self.ctx.io.run(self.ctx.controller.call(method, payload))
+
+    def _register(self) -> None:
+        self._kv(
+            "kv_put",
+            {
+                "namespace": "collective",
+                "key": f"{self.group_name}/rank/{self.rank}",
+                "value": pickle.dumps(tuple(self.ctx.address)),
+            },
+        )
+
+    def _resolve_peers(self) -> dict[int, tuple]:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            keys = self._kv(
+                "kv_keys",
+                {"namespace": "collective", "prefix": f"{self.group_name}/rank/"},
+            )
+            if len(keys) >= self.world_size:
+                peers = {}
+                for r in range(self.world_size):
+                    resp = self._kv(
+                        "kv_get",
+                        {
+                            "namespace": "collective",
+                            "key": f"{self.group_name}/rank/{r}",
+                        },
+                    )
+                    peers[r] = pickle.loads(resp["value"])
+                return peers
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"collective group {self.group_name}: only {len(keys)}/"
+            f"{self.world_size} ranks registered"
+        )
+
+    # -- p2p ------------------------------------------------------------
+    async def _rpc_coll_send(self, conn, payload) -> dict:
+        key = (payload["src"], payload["tag"])
+        self._mailbox[key] = payload["data"]
+        event = self._mailbox_events.setdefault(key, asyncio.Event())
+        event.set()
+        return {"status": "ok"}
+
+    def send(self, array: np.ndarray, dst_rank: int, tag: str = "") -> None:
+        seq_key = (dst_rank, tag)
+        seq = self._send_seq.get(seq_key, 0)
+        self._send_seq[seq_key] = seq + 1
+
+        async def _send():
+            client = await self.ctx._client_for(self._peer_addrs[dst_rank])
+            await client.call(
+                f"coll_send/{self.group_name}",
+                {
+                    "src": self.rank,
+                    "tag": f"{tag}#{seq}",
+                    "data": pickle.dumps(np.asarray(array)),
+                },
+            )
+
+        self.ctx.io.run(_send())
+
+    def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0) -> np.ndarray:
+        seq_key = (src_rank, tag)
+        seq = self._recv_seq.get(seq_key, 0)
+        key = (src_rank, f"{tag}#{seq}")
+
+        async def _recv():
+            event = self._mailbox_events.setdefault(key, asyncio.Event())
+            await asyncio.wait_for(event.wait(), timeout)
+            return self._mailbox.pop(key)
+
+        data = self.ctx.io.run(_recv())
+        # Advance the stream only on success: a timed-out recv can be retried
+        # for the SAME sequence number (otherwise every later message would be
+        # delivered shifted by one).
+        self._recv_seq[seq_key] = seq + 1
+        self._mailbox_events.pop(key, None)
+        return pickle.loads(data)
+
+    # -- collectives ----------------------------------------------------
+    def barrier(self) -> None:
+        epoch = self._barrier_epoch
+        self._barrier_epoch += 1
+        token = np.zeros(1)
+        tag = f"__barrier{epoch}"
+        # Dissemination barrier: log2 rounds of peer notifications.
+        round_num, step = 0, 1
+        while step < self.world_size:
+            dst = (self.rank + step) % self.world_size
+            src = (self.rank - step) % self.world_size
+            self.send(token, dst, tag=f"{tag}/r{round_num}")
+            self.recv(src, tag=f"{tag}/r{round_num}")
+            step *= 2
+            round_num += 1
+
+    def broadcast(self, array: np.ndarray, src_rank: int = 0, tag: str = "__bc") -> np.ndarray:
+        if self.world_size == 1:
+            return np.asarray(array)
+        if self.rank == src_rank:
+            for r in range(self.world_size):
+                if r != src_rank:
+                    self.send(array, r, tag=tag)
+            return np.asarray(array)
+        return self.recv(src_rank, tag=tag)
+
+    def allgather(self, array: np.ndarray, tag: str = "__ag") -> list[np.ndarray]:
+        """Ring all-gather: world_size-1 neighbor hops."""
+        if self.world_size == 1:
+            return [np.asarray(array)]
+        chunks: list[Any] = [None] * self.world_size
+        chunks[self.rank] = np.asarray(array)
+        next_rank = (self.rank + 1) % self.world_size
+        prev_rank = (self.rank - 1) % self.world_size
+        current = self.rank
+        for _ in range(self.world_size - 1):
+            self.send(chunks[current], next_rank, tag=tag)
+            current = (current - 1) % self.world_size
+            chunks[current] = self.recv(prev_rank, tag=tag)
+        return chunks
+
+    def allreduce(self, array: np.ndarray, op: str = SUM, tag: str = "__ar") -> np.ndarray:
+        """Ring reduce-scatter + all-gather (bandwidth-optimal)."""
+        reducer = _REDUCERS[op]
+        array = np.asarray(array)
+        if self.world_size == 1:
+            return array
+        flat = array.reshape(-1).astype(np.float64 if array.dtype.kind == "f" else array.dtype)
+        chunks = np.array_split(flat, self.world_size)
+        next_rank = (self.rank + 1) % self.world_size
+        prev_rank = (self.rank - 1) % self.world_size
+        # reduce-scatter
+        send_idx = self.rank
+        for step in range(self.world_size - 1):
+            self.send(chunks[send_idx], next_rank, tag=f"{tag}/rs")
+            recv_idx = (send_idx - 1) % self.world_size
+            incoming = self.recv(prev_rank, tag=f"{tag}/rs")
+            chunks[recv_idx] = reducer(chunks[recv_idx], incoming)
+            send_idx = recv_idx
+        # all-gather of reduced chunks
+        send_idx = (self.rank + 1) % self.world_size
+        for step in range(self.world_size - 1):
+            self.send(chunks[send_idx], next_rank, tag=f"{tag}/ag")
+            recv_idx = (send_idx - 1) % self.world_size
+            chunks[recv_idx] = self.recv(prev_rank, tag=f"{tag}/ag")
+            send_idx = recv_idx
+        out = np.concatenate(chunks).astype(array.dtype)
+        return out.reshape(array.shape)
+
+    def reducescatter(self, array: np.ndarray, op: str = SUM) -> np.ndarray:
+        """Each rank gets its 1/world_size slice of the reduction."""
+        reduced = self.allreduce(array, op=op, tag="__rsc")
+        return np.array_split(reduced.reshape(-1), self.world_size)[self.rank]
+
+    def destroy(self) -> None:
+        self._kv(
+            "kv_del",
+            {"namespace": "collective", "key": f"{self.group_name}/rank/{self.rank}"},
+        )
+
+
+# ---------------------------------------------------------------------------
+# xla backend (device collectives over the local / global jax mesh)
+# ---------------------------------------------------------------------------
+class XlaGroup(BaseGroup):
+    """Elementwise collectives ACROSS RANKS, executed as XLA programs.
+
+    Semantics match RingGroup (each rank contributes one array, every rank
+    gets the reduction). Requirements: either world_size == 1 (trivial), or
+    every gang member shares one jax.distributed runtime
+    (jax.process_count() == world_size) so the collective rides ICI/DCN
+    between processes. Single-process multi-device reductions are NOT group
+    collectives — use jax.lax.psum inside your own jit for those (the in-jit
+    fusion path, SURVEY §7.0.4).
+    """
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        import jax
+
+        self._jax = jax
+        if world_size > 1 and jax.process_count() != world_size:
+            raise RuntimeError(
+                "xla backend needs one jax.distributed runtime spanning the "
+                f"gang (jax.process_count()={jax.process_count()} != "
+                f"world_size={world_size}); use backend='ring' for plain "
+                "actor groups"
+            )
+        # One device per process carries that rank's contribution.
+        if world_size > 1:  # pragma: no cover - needs real multi-host
+            per_process = {}
+            for device in jax.devices():
+                per_process.setdefault(device.process_index, device)
+            self._rank_devices = [per_process[i] for i in range(world_size)]
+
+    def _cross_rank(self, array, reducer):  # pragma: no cover - multi-host
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(self._rank_devices), ("ranks",))
+        sharding = NamedSharding(mesh, P("ranks"))
+        local = jnp.asarray(array)[None]
+        global_arr = jax.make_array_from_single_device_arrays(
+            (self.world_size, *local.shape[1:]),
+            sharding,
+            [jax.device_put(local, self._rank_devices[self.rank])],
+        )
+        out = jax.jit(
+            reducer, out_shardings=NamedSharding(mesh, P())
+        )(global_arr)
+        return np.asarray(out.addressable_data(0))
+
+    def allreduce(self, array, op: str = SUM):
+        import jax.numpy as jnp
+
+        reducers = {
+            SUM: lambda a: jnp.sum(a, axis=0),
+            MAX: lambda a: jnp.max(a, axis=0),
+            MIN: lambda a: jnp.min(a, axis=0),
+            PRODUCT: lambda a: jnp.prod(a, axis=0),
+        }
+        if op not in reducers:
+            raise ValueError(f"xla backend does not support op={op}")
+        if self.world_size == 1:
+            return np.asarray(array)
+        return self._cross_rank(array, reducers[op])
+
+    def allgather(self, array):
+        if self.world_size == 1:
+            return [np.asarray(array)]
+        stacked = self._cross_rank(  # pragma: no cover - multi-host
+            array, lambda a: a
+        )
+        return list(stacked)
+
+    def broadcast(self, array, src_rank: int = 0):
+        if self.world_size == 1:
+            return np.asarray(array)
+        return self.allgather(array)[src_rank]  # pragma: no cover
+
+    def reducescatter(self, array, op: str = SUM):
+        reduced = self.allreduce(array, op=op)
+        return np.array_split(reduced.reshape(-1), self.world_size)[self.rank]
+
+    def barrier(self):
+        self.allreduce(np.zeros((1,), np.float32))
+
+    def send(self, array, dst_rank: int, tag: str = ""):
+        raise NotImplementedError(
+            "xla backend has no host p2p; use backend='ring' for send/recv"
+        )
+
+    def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0):
+        raise NotImplementedError(
+            "xla backend has no host p2p; use backend='ring' for send/recv"
+        )
+
+    def destroy(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# public API (reference signatures)
+# ---------------------------------------------------------------------------
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "ring",
+    group_name: str = "default",
+) -> None:
+    if group_name in _groups:
+        raise ValueError(f"collective group {group_name!r} already initialized")
+    if backend in ("ring", "gloo"):
+        _groups[group_name] = RingGroup(world_size, rank, group_name)
+    elif backend == "xla":
+        _groups[group_name] = XlaGroup(world_size, rank, group_name)
+    else:
+        raise ValueError(f"unknown backend {backend!r} (use 'ring' or 'xla')")
+
+
+def get_group(group_name: str = "default") -> BaseGroup:
+    if group_name not in _groups:
+        raise ValueError(f"collective group {group_name!r} not initialized")
+    return _groups[group_name]
+
+
+def allreduce(array, group_name: str = "default", op: str = SUM):
+    return get_group(group_name).allreduce(array, op=op)
+
+
+def allgather(array, group_name: str = "default"):
+    return get_group(group_name).allgather(array)
+
+
+def reducescatter(array, group_name: str = "default", op: str = SUM):
+    return get_group(group_name).reducescatter(array, op=op)
+
+
+def broadcast(array, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(array, src_rank=src_rank)
+
+
+def barrier(group_name: str = "default"):
+    get_group(group_name).barrier()
+
+
+def send(array, dst_rank: int, group_name: str = "default"):
+    get_group(group_name).send(array, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
+    return get_group(group_name).recv(src_rank, timeout=timeout)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    group = _groups.pop(group_name, None)
+    if group is not None:
+        group.destroy()

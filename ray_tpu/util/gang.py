@@ -1,0 +1,203 @@
+"""WorkerGang — atomic SPMD groups of actors (the TPU-first actor concept).
+
+SURVEY §7.0.2: Ray is MPMD; TPUs want SPMD gangs. A WorkerGang is one actor
+per TPU host of a slice, gang-scheduled via a placement group, sharing a
+collective group (and, on real multi-host slices, one jax.distributed
+runtime so in-jit collectives span the slice's ICI).
+
+Failure semantics (SURVEY §5.3): ICI makes failure correlated — one dead
+member wedges every member's collectives. The gang is therefore the failure
+domain: any member death surfaces as GangDiedError, and recovery means
+restart-the-gang-from-checkpoint (JaxTrainer builds exactly that on top).
+
+The reference's closest analogue is Train's WorkerGroup
+(python/ray/train/_internal/worker_group.py) — but gangs are a core
+primitive here, reused by train, rllib learners, and serve TPU replicas.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Sequence
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+class GangContext:
+    """Handed to every function a gang runs: rank identity + scratch state
+    that persists across run() calls on the same member."""
+
+    def __init__(self, rank: int, world_size: int, group_name: str, node_id: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.group_name = group_name
+        self.node_id = node_id
+        self.state: dict[str, Any] = {}
+
+    def collective(self):
+        from ray_tpu.util.collective import collective
+
+        return collective.get_group(self.group_name)
+
+
+class _GangMember:
+    """Actor hosting one rank of the gang."""
+
+    def __init__(
+        self,
+        rank: int,
+        world_size: int,
+        group_name: str,
+        backend: str,
+        env_vars: dict | None,
+        coordinator: str | None,
+    ):
+        for key, value in (env_vars or {}).items():
+            os.environ[str(key)] = str(value)
+        if coordinator:
+            # Real multi-host slice: one jax runtime across the gang, so
+            # in-jit collectives ride ICI (jax.distributed replaces the
+            # reference's NCCL-unique-id rendezvous, SURVEY §5.8).
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=world_size,
+                process_id=rank,
+            )
+        from ray_tpu.util.collective import collective
+
+        collective.init_collective_group(
+            world_size, rank, backend=backend, group_name=group_name
+        )
+        self.gang_ctx = GangContext(
+            rank, world_size, group_name,
+            ray_tpu.get_runtime_context()["node_id"],
+        )
+
+    def run(self, fn: Callable, args: tuple, kwargs: dict) -> Any:
+        return fn(self.gang_ctx, *args, **kwargs)
+
+    def rank_info(self) -> dict:
+        return {
+            "rank": self.gang_ctx.rank,
+            "node_id": self.gang_ctx.node_id,
+            "pid": os.getpid(),
+        }
+
+    def ping(self) -> str:
+        return "ok"
+
+
+class WorkerGang:
+    def __init__(
+        self,
+        num_workers: int,
+        *,
+        resources_per_worker: dict | None = None,
+        backend: str = "ring",
+        group_name: str | None = None,
+        placement_strategy: str = "SPREAD",
+        env_vars: dict | None = None,
+        coordinator: str | None = None,
+        ready_timeout: float = 120.0,
+    ):
+        self.num_workers = num_workers
+        self.group_name = group_name or f"gang-{os.urandom(4).hex()}"
+        resources = dict(resources_per_worker or {"CPU": 1})
+        bundles = [dict(resources) for _ in range(num_workers)]
+        self.pg = placement_group(bundles, strategy=placement_strategy)
+        self.pg.ready(timeout=ready_timeout)
+        member_cls = ray_tpu.remote(_GangMember)
+        cpu = resources.pop("CPU", 1)
+        self.members = [
+            member_cls.options(
+                num_cpus=cpu,
+                resources=resources or None,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg, placement_group_bundle_index=i
+                ),
+            ).remote(
+                i, num_workers, self.group_name, backend, env_vars, coordinator
+            )
+            for i in range(num_workers)
+        ]
+        # Block until every member finished collective rendezvous.
+        try:
+            ray_tpu.get(
+                [m.ping.remote() for m in self.members], timeout=ready_timeout
+            )
+        except Exception as exc:
+            self.shutdown()
+            raise exceptions.GangDiedError(
+                f"gang failed to start: {exc}"
+            ) from exc
+
+    def run(
+        self,
+        fn: Callable,
+        per_rank_args: Sequence[tuple] | None = None,
+        timeout: float | None = None,
+        **kwargs,
+    ) -> list:
+        """SPMD-execute fn(gang_ctx, *args, **kwargs) on every member."""
+        if per_rank_args is not None and len(per_rank_args) != self.num_workers:
+            raise ValueError(
+                f"per_rank_args has {len(per_rank_args)} entries for "
+                f"{self.num_workers} workers"
+            )
+        refs = [
+            member.run.remote(
+                fn, tuple(per_rank_args[i]) if per_rank_args else (), kwargs
+            )
+            for i, member in enumerate(self.members)
+        ]
+        try:
+            return ray_tpu.get(refs, timeout=timeout)
+        except (
+            exceptions.ActorDiedError,
+            exceptions.ActorUnavailableError,
+            exceptions.WorkerCrashedError,
+        ) as exc:
+            raise exceptions.GangDiedError(
+                f"gang member died during run: {exc}"
+            ) from exc
+
+    def run_async(self, fn: Callable, per_rank_args=None, **kwargs) -> list:
+        if per_rank_args is not None and len(per_rank_args) != self.num_workers:
+            raise ValueError(
+                f"per_rank_args has {len(per_rank_args)} entries for "
+                f"{self.num_workers} workers"
+            )
+        return [
+            member.run.remote(
+                fn, tuple(per_rank_args[i]) if per_rank_args else (), kwargs
+            )
+            for i, member in enumerate(self.members)
+        ]
+
+    def rank_infos(self) -> list[dict]:
+        return ray_tpu.get(
+            [m.rank_info.remote() for m in self.members], timeout=60
+        )
+
+    def healthy(self) -> bool:
+        try:
+            ray_tpu.get([m.ping.remote() for m in self.members], timeout=30)
+            return True
+        except Exception:
+            return False
+
+    def shutdown(self) -> None:
+        for member in self.members if hasattr(self, "members") else []:
+            try:
+                ray_tpu.kill(member)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self.pg)
+        except Exception:
+            pass

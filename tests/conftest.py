@@ -11,13 +11,29 @@ Mirrors the reference's python/ray/tests/conftest.py patterns:
 
 import os
 
-# Must happen before any jax import anywhere in the test process tree.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Pin the whole test process tree to a virtual 8-device CPU mesh (the CPU
+# twin of a TPU slice, SURVEY §4.4). Two subtleties of this environment:
+#  * a sitecustomize may import jax before us and pin the real-TPU plugin —
+#    jax.config.update('jax_platforms', ...) still wins while backends are
+#    uninitialized;
+#  * spawned worker processes inherit os.environ, so force the env vars too
+#    (and drop the sitecustomize dir from PYTHONPATH so children never touch
+#    the real chip).
 _flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p
+    for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and "axon" not in p
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,88 @@
+"""Pallas kernel numerics vs pure-jax references (CPU interpret mode — the
+same kernel code the TPU compiles, SURVEY §4.4 'CPU twin' trick)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu.ops.flash_attention import attention_reference, flash_attention
+from ray_tpu.ops.rmsnorm import rmsnorm, rmsnorm_reference
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_matches_reference(causal):
+    key = jax.random.PRNGKey(0)
+    batch, heads, seq, dim = 2, 4, 256, 64
+    q, k, v = (
+        jax.random.normal(jax.random.fold_in(key, i), (batch, heads, seq, dim))
+        for i in range(3)
+    )
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = attention_reference(q, k, v, causal=causal)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_flash_attention_rectangular_blocks():
+    key = jax.random.PRNGKey(1)
+    q, k, v = (
+        jax.random.normal(jax.random.fold_in(key, i), (1, 2, 128, 32))
+        for i in range(3)
+    )
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=32)
+    ref = attention_reference(q, k, v, causal=True)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
+def test_flash_attention_bf16():
+    key = jax.random.PRNGKey(2)
+    q, k, v = (
+        jax.random.normal(
+            jax.random.fold_in(key, i), (1, 2, 128, 64), jnp.bfloat16
+        )
+        for i in range(3)
+    )
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = attention_reference(q, k, v, causal=True)
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))) < 3e-2
+
+
+def test_rmsnorm_matches_reference():
+    key = jax.random.PRNGKey(3)
+    x = jax.random.normal(key, (4, 128, 512))
+    w = jax.random.normal(jax.random.fold_in(key, 1), (512,))
+    out = rmsnorm(x, w)
+    ref = rmsnorm_reference(x, w)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
+def test_rmsnorm_odd_rows_falls_back():
+    key = jax.random.PRNGKey(4)
+    x = jax.random.normal(key, (7, 512))
+    w = jnp.ones((512,))
+    out = rmsnorm(x, w, block_rows=4)
+    ref = rmsnorm_reference(x, w)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
+def test_rope_rotation_properties():
+    cos, sin = rope_frequencies(64, 128)
+    x = jax.random.normal(jax.random.PRNGKey(5), (1, 2, 16, 64))
+    rotated = apply_rope(x, cos, sin)
+    # Norm-preserving per position.
+    assert jnp.allclose(
+        jnp.linalg.norm(rotated, axis=-1), jnp.linalg.norm(x, axis=-1), atol=1e-4
+    )
+    # Position 0 is identity.
+    assert jnp.allclose(rotated[..., 0, :], x[..., 0, :], atol=1e-6)
+    # Explicit positions select rows of the table: rotating x2's two vectors
+    # with positions [3, 7] must equal placing those vectors at seq positions
+    # 3 and 7 and applying the default (implicit-position) rope.
+    positions = jnp.array([[3, 7]])
+    x2 = x[:, :, :2]
+    shifted = apply_rope(x2, cos, sin, positions=positions)
+    placed = jnp.zeros_like(x).at[:, :, 3, :].set(x2[:, :, 0, :])
+    placed = placed.at[:, :, 7, :].set(x2[:, :, 1, :])
+    full = apply_rope(placed, cos, sin)
+    assert jnp.allclose(shifted[0, :, 0], full[0, :, 3], atol=1e-5)
+    assert jnp.allclose(shifted[0, :, 1], full[0, :, 7], atol=1e-5)
