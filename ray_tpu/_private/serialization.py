@@ -16,14 +16,55 @@ resolve them back to live ObjectRefs on the consumer side.
 from __future__ import annotations
 
 import io
+import os
 import pickle
 import struct
+import sys
+import sysconfig
+import types
 from typing import Any, Callable
 
 import cloudpickle
 
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
+
+# Directories whose modules are importable on every worker (stdlib +
+# site-packages + this framework). Functions/classes from any OTHER module
+# (user scripts, pytest files) are registered for pickle-by-value — workers
+# must not need the driver's sys.path to unpickle user code. The reference
+# only gets this for __main__; we extend it to all non-installed modules.
+_INSTALLED_ROOTS = tuple(
+    os.path.realpath(p)
+    for p in {
+        sysconfig.get_paths().get("stdlib", ""),
+        sysconfig.get_paths().get("purelib", ""),
+        sysconfig.get_paths().get("platlib", ""),
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    }
+    if p
+)
+_byvalue_checked: set[str] = set()
+
+
+def _maybe_register_by_value(obj: Any) -> None:
+    mod_name = getattr(obj, "__module__", None)
+    if not mod_name or mod_name in _byvalue_checked:
+        return
+    _byvalue_checked.add(mod_name)
+    if mod_name == "__main__" or mod_name.partition(".")[0] in sys.builtin_module_names:
+        return
+    module = sys.modules.get(mod_name)
+    mod_file = getattr(module, "__file__", None)
+    if module is None or not mod_file:
+        return
+    real = os.path.realpath(mod_file)
+    if any(real.startswith(root + os.sep) for root in _INSTALLED_ROOTS):
+        return
+    try:
+        cloudpickle.register_pickle_by_value(module)
+    except Exception:
+        pass
 
 
 class _RefPlaceholder:
@@ -48,6 +89,8 @@ class _Pickler(cloudpickle.CloudPickler):
         if isinstance(obj, ObjectRef):
             self._collected_refs.append(obj)
             return ("raytpu_ref", obj.id, obj.owner_address)
+        if isinstance(obj, (types.FunctionType, type)):
+            _maybe_register_by_value(obj)
         return None
 
 
@@ -111,8 +154,13 @@ def deserialize(
 
 
 def dumps_function(fn: Any) -> bytes:
-    return cloudpickle.dumps(fn)
+    # Run through _Pickler (not bare cloudpickle.dumps) so persistent_id
+    # fires for every NESTED function/class too — a task fn calling a helper
+    # from a sibling user module must ship that module by value as well.
+    out = io.BytesIO()
+    _Pickler(out, [], protocol=5).dump(fn)
+    return out.getvalue()
 
 
 def loads_function(raw: bytes) -> Any:
-    return cloudpickle.loads(raw)
+    return _Unpickler(io.BytesIO(raw), None).load()

@@ -211,10 +211,16 @@ def _moe_mlp(h, layer, config: TransformerConfig):
 
     combine = jnp.zeros((tokens, moe.num_experts, capacity), jnp.float32)
     remaining = probs
+    # Per-expert slots already claimed by earlier top-k iterations: a token's
+    # 2nd-choice position must start AFTER every 1st-choice pick for that
+    # expert (GShard-style offset), or slots collide and tokens get summed.
+    occupancy = jnp.zeros((moe.num_experts,), jnp.float32)
     for _ in range(moe.top_k):
         gate, choice = jnp.max(remaining, axis=-1), jnp.argmax(remaining, axis=-1)
         onehot = jax.nn.one_hot(choice, moe.num_experts, dtype=jnp.float32)
-        position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # pos within expert
+        position = (
+            jnp.cumsum(onehot, axis=0) - 1.0 + occupancy[None, :]
+        ) * onehot
         pos_idx = jnp.sum(position, axis=-1).astype(jnp.int32)
         keep = pos_idx < capacity
         slot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)
@@ -223,6 +229,7 @@ def _moe_mlp(h, layer, config: TransformerConfig):
             * onehot[:, :, None] * slot[:, None, :]
         )
         combine = combine + contribution
+        occupancy = occupancy + jnp.sum(onehot, axis=0)
         remaining = remaining * (1.0 - onehot)
     dispatch = (combine > 0).astype(h.dtype)             # [T, E, C]
 

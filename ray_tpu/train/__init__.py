@@ -1,0 +1,76 @@
+"""ray_tpu.train — distributed training (Ray Train equivalent, TPU-first).
+
+Public surface mirrors ray.train + ray.train.torch (SURVEY §2.4), with
+JaxTrainer in TorchTrainer's role:
+
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def loop(config):
+        ctx = train.get_context()
+        ...
+        train.report({"loss": l}, checkpoint=ckpt)
+
+    JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=8)).fit()
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    load_pytree,
+    load_pytree_checkpoint,
+    save_pytree,
+    save_pytree_checkpoint,
+)
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.jax_trainer import DataParallelTrainer, JaxTrainer, Result
+from ray_tpu.train._internal import session as _session_mod
+from ray_tpu.train._internal.session import TrainContext
+
+
+def report(metrics: dict, *, checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from a train worker.
+    Blocks until the trainer consumed the previous round — a lockstep
+    barrier across ranks, like the reference's ray.train.report."""
+    _session_mod.get_session().report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    return _session_mod.get_session().ctx
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _session_mod.get_session().ctx.latest_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    return _session_mod.get_session().ctx.dataset_shards.get(name)
+
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+    "save_pytree",
+    "load_pytree",
+    "save_pytree_checkpoint",
+    "load_pytree_checkpoint",
+]

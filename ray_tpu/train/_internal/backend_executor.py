@@ -1,0 +1,170 @@
+"""BackendExecutor — drives a WorkerGang through a training run.
+
+Role-equivalent of python/ray/train/_internal/backend_executor.py ::
+BackendExecutor + worker_group.py :: WorkerGroup, collapsed onto the core
+WorkerGang primitive (gangs already do placement-group scheduling, collective
+rendezvous, and correlated-failure semantics — SURVEY §7.0.2).
+
+Lockstep protocol: every rank's session must produce one result before the
+executor hands the round to the trainer (matching the reference, where
+`ray.train.report` is a barrier across workers).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Any, Callable, Optional
+
+from ray_tpu.train._internal.session import TrainContext, init_session
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.util.gang import WorkerGang
+
+
+def _start_session_fn(
+    gang_ctx,
+    train_fn: Callable,
+    train_loop_config: dict,
+    experiment_name: str,
+    trial_dir: str,
+    latest_checkpoint: Optional[Checkpoint],
+    dataset_shards_per_rank: list[dict],
+    mesh_axes: dict,
+) -> bool:
+    ctx = TrainContext(
+        world_size=gang_ctx.world_size,
+        world_rank=gang_ctx.rank,
+        local_rank=0,
+        node_id=gang_ctx.node_id,
+        experiment_name=experiment_name,
+        trial_dir=trial_dir,
+        train_loop_config=dict(train_loop_config),
+        latest_checkpoint=latest_checkpoint,
+        dataset_shards=dataset_shards_per_rank[gang_ctx.rank],
+        mesh=mesh_axes,
+        collective_group=gang_ctx.group_name,
+    )
+    session = init_session(ctx, lambda: train_fn(dict(train_loop_config)))
+    gang_ctx.state["session"] = session
+    session.start()
+    return True
+
+
+def _poll_fn(gang_ctx, poll_timeout: float) -> dict | None:
+    return gang_ctx.state["session"].next_result(timeout=poll_timeout)
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        scaling_config: ScalingConfig,
+        *,
+        backend: str = "ring",
+        experiment_name: str,
+        trial_dir: str,
+    ):
+        self.scaling_config = scaling_config
+        self.backend = backend
+        self.experiment_name = experiment_name
+        self.trial_dir = trial_dir
+        self.gang: WorkerGang | None = None
+
+    def start(
+        self,
+        train_fn: Callable,
+        train_loop_config: dict,
+        latest_checkpoint: Optional[Checkpoint],
+        dataset_shards_per_rank: list[dict],
+    ) -> None:
+        sc = self.scaling_config
+        self.gang = WorkerGang(
+            sc.total_workers,
+            resources_per_worker=sc.worker_resources(),
+            backend=self.backend,
+            placement_strategy=sc.placement_strategy,
+        )
+        self.gang.run(
+            _start_session_fn,
+            train_fn=train_fn,
+            train_loop_config=train_loop_config,
+            experiment_name=self.experiment_name,
+            trial_dir=self.trial_dir,
+            latest_checkpoint=latest_checkpoint,
+            dataset_shards_per_rank=dataset_shards_per_rank,
+            mesh_axes=dict(sc.mesh_axes),
+        )
+
+    def poll_round(self, timeout: float = 600.0) -> list[dict]:
+        """Block until every rank produced one result (or finished/errored).
+
+        Returns the per-rank result dicts. Raises GangDiedError if a member
+        process dies (the trainer turns that into restart-from-checkpoint).
+        """
+        assert self.gang is not None
+        import ray_tpu
+        from ray_tpu import exceptions
+
+        deadline = time.monotonic() + timeout
+        results: dict[int, dict] = {}
+        pending = set(range(self.gang.num_workers))
+        while pending:
+            if time.monotonic() > deadline:
+                raise TrainingFailedError(
+                    f"train workers stalled: only {len(results)}/"
+                    f"{self.gang.num_workers} ranks reported within {timeout}s"
+                )
+            # Poll ONLY ranks still missing a result this round — polling a
+            # rank that already reported would consume (and drop) its next
+            # report, breaking the cross-rank lockstep.
+            refs = {
+                rank: self.gang.members[rank].run.remote(
+                    _poll_fn, (), {"poll_timeout": 1.0}
+                )
+                for rank in sorted(pending)
+            }
+            for rank, ref in refs.items():
+                try:
+                    res = ray_tpu.get(ref, timeout=120.0)
+                except (
+                    exceptions.ActorDiedError,
+                    exceptions.ActorUnavailableError,
+                    exceptions.WorkerCrashedError,
+                ) as exc:
+                    raise exceptions.GangDiedError(
+                        f"gang member rank={rank} died during training: {exc}"
+                    ) from exc
+                if res is not None:
+                    results[rank] = res
+                    pending.discard(rank)
+        return [results[r] for r in range(self.gang.num_workers)]
+
+    def merge_sharded_checkpoints(self, reported: list[Optional[Checkpoint]]) -> Optional[Checkpoint]:
+        """Rank 0's checkpoint dir is canonical; other ranks' `shards/p*`
+        subdirs (written by checkpoint.save_pytree(process_index=rank)) are
+        merged in so a multi-host sharded save arrives whole."""
+        base = reported[0]
+        if base is None:
+            return None
+        for ckpt in reported[1:]:
+            if ckpt is None or ckpt.path == base.path:
+                continue
+            src_shards = os.path.join(ckpt.path, "shards")
+            if os.path.isdir(src_shards):
+                for proc_dir in os.listdir(src_shards):
+                    dst = os.path.join(base.path, "shards", proc_dir)
+                    if not os.path.isdir(dst):
+                        shutil.copytree(
+                            os.path.join(src_shards, proc_dir), dst
+                        )
+        return base
+
+    def shutdown(self) -> None:
+        if self.gang is not None:
+            self.gang.shutdown()
+            self.gang = None

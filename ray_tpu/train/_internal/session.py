@@ -1,0 +1,128 @@
+"""Per-worker train session.
+
+Role-equivalent of python/ray/train/_internal/session.py :: _TrainSession —
+the user's train loop runs on a background thread; `report(metrics,
+checkpoint)` hands (metrics, checkpoint) to the trainer's polling loop and
+blocks until consumed, which keeps every rank's loop in lockstep with the
+driver the way the reference's session does.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclass
+class TrainContext:
+    """What `ray_tpu.train.get_context()` returns inside a worker."""
+
+    world_size: int = 1
+    world_rank: int = 0
+    local_rank: int = 0
+    node_id: str = ""
+    experiment_name: str = ""
+    trial_dir: str = ""
+    train_loop_config: dict = field(default_factory=dict)
+    latest_checkpoint: Optional[Checkpoint] = None
+    dataset_shards: dict = field(default_factory=dict)
+    mesh: Any = None
+    collective_group: str = ""
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+
+class _Session:
+    def __init__(self, ctx: TrainContext, fn: Callable[[], Any]):
+        self.ctx = ctx
+        self._results: queue.Queue = queue.Queue(maxsize=1)
+        self._consumed = threading.Event()
+        self._consumed.set()
+        self.error: Exception | None = None
+        self.finished = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(fn,), daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self, fn: Callable[[], Any]) -> None:
+        try:
+            fn()
+        except Exception as exc:  # surfaced via next_result poll
+            exc._traceback_str = traceback.format_exc()  # type: ignore[attr-defined]
+            self.error = exc
+        finally:
+            self.finished.set()
+
+    # -- called from the user thread ------------------------------------
+    def report(
+        self, metrics: dict, checkpoint: Checkpoint | None = None
+    ) -> None:
+        self._consumed.wait()
+        self._consumed.clear()
+        self._results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+    # -- called from the actor (poll) -----------------------------------
+    def next_result(self, timeout: float = 0.0) -> dict | None:
+        """One reported result, or {'done': True}/{'error': ...} at the end."""
+        try:
+            item = self._results.get(timeout=timeout)
+            self._consumed.set()
+            return item
+        except queue.Empty:
+            pass
+        if self.finished.is_set() and self._results.empty():
+            if self.error is not None:
+                return {
+                    "error": self.error,
+                    "traceback": getattr(self.error, "_traceback_str", ""),
+                }
+            return {"done": True}
+        return None
+
+
+_session: _Session | None = None
+
+
+def init_session(ctx: TrainContext, fn: Callable[[], Any]) -> _Session:
+    global _session
+    _session = _Session(ctx, fn)
+    return _session
+
+
+def get_session() -> _Session:
+    if _session is None:
+        raise RuntimeError(
+            "ray_tpu.train.report()/get_context() called outside a train "
+            "worker — they only work inside train_loop_per_worker."
+        )
+    return _session
+
+
+def in_session() -> bool:
+    return _session is not None
+
+
+def shutdown_session() -> None:
+    global _session
+    _session = None

@@ -1,0 +1,126 @@
+"""Checkpoint persistence + retention.
+
+Role-equivalent of python/ray/train/_internal/storage.py :: StorageContext.
+Persists worker-reported checkpoint directories into
+`<storage_path>/<experiment>/<trial>/checkpoint_NNNNNN`, tracks
+latest/best, and enforces CheckpointConfig retention (num_to_keep,
+score-attribute ordering). Local filesystem only in this build; the fs
+boundary is kept narrow (persist/list/delete) so a cloud fs can slot in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig
+
+
+class StorageContext:
+    def __init__(
+        self,
+        storage_path: str,
+        experiment_name: str,
+        trial_name: str = "",
+        checkpoint_config: CheckpointConfig | None = None,
+    ):
+        self.experiment_dir = os.path.join(
+            os.path.expanduser(storage_path), experiment_name
+        )
+        self.trial_dir = (
+            os.path.join(self.experiment_dir, trial_name)
+            if trial_name
+            else self.experiment_dir
+        )
+        os.makedirs(self.trial_dir, exist_ok=True)
+        self.checkpoint_config = checkpoint_config or CheckpointConfig()
+        self._index = 0
+        self._kept: list[tuple[str, dict]] = []  # (path, metrics)
+        self._load_state()
+
+    # -- persistence of the tracker itself (for experiment resume) ------
+    @property
+    def _state_path(self) -> str:
+        return os.path.join(self.trial_dir, ".storage_state.json")
+
+    def _load_state(self) -> None:
+        if os.path.exists(self._state_path):
+            with open(self._state_path) as f:
+                state = json.load(f)
+            self._index = state["index"]
+            self._kept = [
+                (p, m) for p, m in state["kept"] if os.path.isdir(p)
+            ]
+
+    def _save_state(self) -> None:
+        with open(self._state_path, "w") as f:
+            json.dump({"index": self._index, "kept": self._kept}, f)
+
+    # -- API -------------------------------------------------------------
+    def persist(self, checkpoint: Checkpoint, metrics: dict) -> Checkpoint:
+        dest = os.path.join(self.trial_dir, f"checkpoint_{self._index:06d}")
+        self._index += 1
+        if os.path.abspath(checkpoint.path) != dest:
+            if os.path.isdir(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(checkpoint.path, dest)
+        clean_metrics = {
+            k: v for k, v in metrics.items()
+            if isinstance(v, (int, float, str, bool))
+        }
+        self._kept.append((dest, clean_metrics))
+        self._enforce_retention()
+        self._save_state()
+        return Checkpoint(dest)
+
+    def _enforce_retention(self) -> None:
+        cfg = self.checkpoint_config
+        if cfg.num_to_keep is None or len(self._kept) <= cfg.num_to_keep:
+            return
+        if cfg.checkpoint_score_attribute:
+            # Drop the worst-scoring, but never the most recent (needed for
+            # failure recovery).
+            latest = self._kept[-1]
+            candidates = self._kept[:-1]
+            reverse = cfg.checkpoint_score_order == "max"
+            candidates.sort(
+                key=lambda pm: pm[1].get(
+                    cfg.checkpoint_score_attribute,
+                    float("-inf") if reverse else float("inf"),
+                ),
+                reverse=reverse,
+            )
+            keep = candidates[: cfg.num_to_keep - 1] + [latest]
+            drop = [pm for pm in self._kept if pm not in keep]
+            self._kept = [pm for pm in self._kept if pm in keep]
+        else:
+            drop = self._kept[: -cfg.num_to_keep]
+            self._kept = self._kept[-cfg.num_to_keep :]
+        for path, _ in drop:
+            shutil.rmtree(path, ignore_errors=True)
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        return Checkpoint(self._kept[-1][0]) if self._kept else None
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        cfg = self.checkpoint_config
+        if not self._kept:
+            return None
+        if not cfg.checkpoint_score_attribute:
+            return self.latest_checkpoint()
+        reverse = cfg.checkpoint_score_order == "max"
+        best = sorted(
+            self._kept,
+            key=lambda pm: pm[1].get(
+                cfg.checkpoint_score_attribute,
+                float("-inf") if reverse else float("inf"),
+            ),
+            reverse=reverse,
+        )[0]
+        return Checkpoint(best[0])
+
+    def checkpoints(self) -> list[tuple[Checkpoint, dict]]:
+        return [(Checkpoint(p), m) for p, m in self._kept]

@@ -1,0 +1,98 @@
+"""AIR-style config dataclasses shared by train/tune.
+
+Role-equivalents of the reference's python/ray/air/config.py ::
+ScalingConfig / RunConfig / FailureConfig / CheckpointConfig, with TPU-first
+vocabulary: workers are per-HOST gang members (one jax process per TPU host),
+`topology` names a pod-slice shape, and `mesh_axes` declares the named
+parallelism axes the trainer builds its jax.sharding.Mesh with.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+
+@dataclass
+class ScalingConfig:
+    """How many gang workers, with what resources, over what mesh.
+
+    num_workers        — gang size (one worker per TPU host of the slice).
+    use_tpu            — pin each worker to TPU resources.
+    chips_per_worker   — TPU chips each worker's jax process owns.
+    topology           — optional slice topology label (e.g. "v4-32"); the
+                         scheduler treats it as a pod-slice placement-group
+                         request (STRICT_PACK on the ICI domain).
+    mesh_axes          — named axis sizes for the global device mesh, e.g.
+                         {"dp": 4, "tp": 2}. Sizes must multiply to the
+                         global chip count; {} means pure DP over all chips.
+    resources_per_worker — extra scheduler resources per worker.
+    placement_strategy — bundle placement: SPREAD (default, one worker per
+                         node) / STRICT_SPREAD / PACK / STRICT_PACK.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    topology: str | None = None
+    mesh_axes: Mapping[str, int] = field(default_factory=dict)
+    resources_per_worker: Mapping[str, float] = field(default_factory=dict)
+    placement_strategy: str = "SPREAD"
+
+    def worker_resources(self) -> dict[str, float]:
+        resources = {"CPU": 1.0, **dict(self.resources_per_worker)}
+        if self.use_tpu and "TPU" not in resources:
+            resources["TPU"] = float(self.chips_per_worker or 1)
+        return resources
+
+    @property
+    def total_workers(self) -> int:
+        return int(self.num_workers)
+
+
+@dataclass
+class FailureConfig:
+    """max_failures: gang restarts from the latest checkpoint before the run
+    is declared failed. 0 = fail fast; -1 = retry forever.
+
+    fail_fast: raise immediately on the first worker error (skips retries)."""
+
+    max_failures: int = 0
+    fail_fast: bool = False
+
+
+@dataclass
+class CheckpointConfig:
+    """num_to_keep: retain only the last/best K persisted checkpoints.
+    checkpoint_score_attribute/order: 'best' selection for result + retention.
+    checkpoint_frequency: used by trainers that drive their own loop."""
+
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+
+    def __post_init__(self) -> None:
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+
+
+@dataclass
+class RunConfig:
+    """Where results/checkpoints land and how failures are handled."""
+
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+    callbacks: list[Any] = field(default_factory=list)
+    stop: Mapping[str, float] | None = None
+
+    def resolved_storage_path(self) -> str:
+        return os.path.expanduser(
+            self.storage_path or "~/ray_tpu_results"
+        )

@@ -1,0 +1,225 @@
+"""JaxTrainer / DataParallelTrainer — distributed training on gangs.
+
+Role-equivalent of python/ray/train/data_parallel_trainer.py ::
+DataParallelTrainer + torch/torch_trainer.py :: TorchTrainer, re-designed
+TPU-first (SURVEY §3.3, §7.1 P6):
+
+  * workers are gang members — one jax process per TPU host, gang-scheduled
+    via a placement group; on real slices they share one jax.distributed
+    runtime so the training step is ONE jitted XLA program whose psum /
+    all_gather collectives ride ICI.
+  * the "ring" backend is the CPU test twin (SURVEY §4.4.4): per-process
+    jax + eager host-memory allreduce through ray_tpu.util.collective.
+  * failure recovery is slice-granular (SURVEY §5.3): any member death ⇒
+    GangDiedError ⇒ restart the whole gang from the latest persisted
+    checkpoint, up to FailureConfig.max_failures.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ray_tpu.train._internal.backend_executor import (
+    BackendExecutor,
+    TrainingFailedError,
+)
+from ray_tpu.train._internal.storage import StorageContext
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+
+
+@dataclass
+class Result:
+    """What fit() returns — mirrors ray.train.Result."""
+
+    metrics: dict = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    path: str = ""
+    error: Optional[Exception] = None
+    metrics_history: list = field(default_factory=list)
+
+    @property
+    def best_checkpoints(self) -> list:
+        return [self.checkpoint] if self.checkpoint else []
+
+
+def _split_datasets(datasets: dict, num_workers: int) -> list[dict]:
+    """Per-rank dataset shards. A ray_tpu.data.Dataset splits via
+    streaming_split (locality-aware iterators); plain sequences shard by
+    striding; anything else is replicated."""
+    shards: list[dict] = [dict() for _ in range(num_workers)]
+    for name, ds in (datasets or {}).items():
+        if hasattr(ds, "streaming_split"):
+            for rank, it in enumerate(ds.streaming_split(num_workers)):
+                shards[rank][name] = it
+        elif isinstance(ds, (list, tuple)):
+            for rank in range(num_workers):
+                shards[rank][name] = ds[rank::num_workers]
+        else:
+            for rank in range(num_workers):
+                shards[rank][name] = ds
+    return shards
+
+
+class DataParallelTrainer:
+    """N workers × train_loop_per_worker(config), lockstep report rounds."""
+
+    _default_backend = "ring"
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable[[dict], Any],
+        *,
+        train_loop_config: dict | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+        datasets: dict | None = None,
+        resume_from_checkpoint: Checkpoint | None = None,
+        backend: str | None = None,
+    ):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = dict(train_loop_config or {})
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.backend = backend or self._default_backend
+
+    # -- hooks for Tune integration (tune wraps fit() in a trial actor) --
+    def _experiment_name(self) -> str:
+        return self.run_config.name or type(self).__name__.lower()
+
+    def fit(self) -> Result:
+        run_cfg = self.run_config
+        storage = StorageContext(
+            run_cfg.resolved_storage_path(),
+            self._experiment_name(),
+            checkpoint_config=run_cfg.checkpoint_config,
+        )
+        latest_ckpt = self.resume_from_checkpoint or storage.latest_checkpoint()
+        failures = 0
+        last_metrics: dict = {}
+        history: list[dict] = []
+        error: Exception | None = None
+
+        while True:
+            executor = BackendExecutor(
+                self.scaling_config,
+                backend=self.backend,
+                experiment_name=self._experiment_name(),
+                trial_dir=storage.trial_dir,
+            )
+            try:
+                executor.start(
+                    self.train_loop_per_worker,
+                    self.train_loop_config,
+                    latest_ckpt,
+                    _split_datasets(
+                        self.datasets, self.scaling_config.total_workers
+                    ),
+                )
+                done, last_metrics, error = self._drive(
+                    executor, storage, history, last_metrics
+                )
+                if done:
+                    break
+            except Exception as exc:
+                from ray_tpu import exceptions as core_exc
+
+                recoverable = isinstance(
+                    exc,
+                    (
+                        core_exc.GangDiedError,
+                        core_exc.ActorDiedError,
+                        core_exc.WorkerCrashedError,
+                        TrainingFailedError,
+                    ),
+                )
+                if not recoverable:
+                    raise
+                error = exc
+            finally:
+                executor.shutdown()
+
+            if error is not None:
+                max_failures = run_cfg.failure_config.max_failures
+                if run_cfg.failure_config.fail_fast or (
+                    0 <= max_failures <= failures
+                ):
+                    break
+                failures += 1
+                latest_ckpt = storage.latest_checkpoint()
+                error = None
+                time.sleep(0.1)
+                continue
+            break
+
+        return Result(
+            metrics=last_metrics,
+            checkpoint=storage.best_checkpoint(),
+            path=storage.trial_dir,
+            error=error,
+            metrics_history=history,
+        )
+
+    def _drive(
+        self,
+        executor: BackendExecutor,
+        storage: StorageContext,
+        history: list,
+        last_metrics: dict,
+    ) -> tuple[bool, dict, Exception | None]:
+        """Poll rounds until every rank is done, an error surfaces, or a
+        stop criterion is met. Returns (done, last_metrics, error)."""
+        stop = self.run_config.stop or {}
+        while True:
+            round_results = executor.poll_round()
+            errors = [r for r in round_results if "error" in r]
+            if errors:
+                err = errors[0]["error"]
+                err.worker_traceback = errors[0].get("traceback", "")  # type: ignore
+                return True, last_metrics, err
+            if all(r.get("done") for r in round_results):
+                return True, last_metrics, None
+            reports = [r for r in round_results if "metrics" in r]
+            if not reports:
+                continue
+            metrics = dict(reports[0]["metrics"])
+            ckpt = executor.merge_sharded_checkpoints(
+                [r.get("checkpoint") for r in round_results]
+            )
+            if ckpt is not None:
+                persisted = storage.persist(ckpt, metrics)
+                metrics["checkpoint_path"] = persisted.path
+            last_metrics = metrics
+            history.append(metrics)
+            for cb in self.run_config.callbacks:
+                handler = getattr(cb, "on_result", None)
+                if handler:
+                    handler(metrics)
+            if any(
+                key in metrics and metrics[key] >= bound
+                for key, bound in stop.items()
+            ):
+                return True, last_metrics, None
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The flagship trainer. Same driver loop as DataParallelTrainer; the
+    jax-specific machinery (mesh construction, param sharding, in-jit
+    collectives, sharded checkpoints) lives in ray_tpu.train.jax_utils and
+    runs inside train_loop_per_worker.
+
+    backend="xla" (default on real slices) assumes gang members joined one
+    jax.distributed runtime — collectives happen inside jit on ICI.
+    backend="ring" (tests / CPU) gives eager host-memory collectives.
+    """
+
+    _default_backend = "ring"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.scaling_config.use_tpu and kwargs.get("backend") is None:
+            self.backend = "xla"

@@ -1,0 +1,87 @@
+"""In-worker jax helpers for JaxTrainer loops.
+
+Role-equivalent of python/ray/train/torch/train_loop_utils.py ::
+prepare_model / prepare_data_loader, TPU-first: instead of wrapping a model
+in DDP, we build the device mesh, place params with NamedSharding, and sync
+gradients — in-jit (psum over ICI, the "xla" path) or eagerly through the
+collective group (the "ring" CPU twin).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator
+
+import numpy as np
+
+
+def build_mesh(axes: dict[str, int] | None = None):
+    """Mesh over THIS jax runtime's devices. On a real multi-host gang
+    (jax.distributed initialized) that is the whole slice; on the ring
+    backend it is the process-local devices. axes={} → 1-D "dp" mesh."""
+    import jax
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    devices = jax.devices()
+    if not axes:
+        axes = {"dp": len(devices)}
+    return MeshSpec(dict(axes)).build(devices)
+
+
+def shard_params(params: Any, mesh, logical_dims: Any = None):
+    """Place a param pytree onto the mesh. With logical_dims (see
+    parallel.mesh.LogicalRules), params get TP/FSDP shardings; without,
+    they are replicated."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ray_tpu.parallel.mesh import LogicalRules
+
+    if logical_dims is not None:
+        shardings = LogicalRules().tree_shardings(logical_dims, mesh)
+        return jax.device_put(params, shardings)
+    return jax.device_put(
+        params, jax.tree.map(lambda _: NamedSharding(mesh, P()), params)
+    )
+
+
+def sync_gradients(grads: Any, group_name: str) -> Any:
+    """Eager cross-worker gradient mean for the ring backend. (On the xla
+    backend gradients sync in-jit via psum — never call this there.)"""
+    from ray_tpu.util.collective import collective
+
+    group = collective.get_group(group_name)
+    if group.world_size == 1:
+        return grads
+    import jax
+
+    leaves, treedef = jax.tree.flatten(grads)
+    flat = np.concatenate([np.asarray(x, np.float32).ravel() for x in leaves])
+    flat = np.asarray(group.allreduce(flat)) / group.world_size
+    out, offset = [], 0
+    for leaf in leaves:
+        size = int(np.prod(np.shape(leaf))) or 1
+        out.append(
+            flat[offset : offset + size].reshape(np.shape(leaf)).astype(
+                np.asarray(leaf).dtype
+            )
+        )
+        offset += size
+    return jax.tree.unflatten(treedef, out)
+
+
+def shard_batch(batch: Any, mesh, axis: str = "dp"):
+    """device_put a host batch with batch-dim sharding over `axis`."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda x: jax.device_put(x, spec), batch)
+
+
+def iter_global_batches(
+    it: Iterable, *, world_rank: int, world_size: int
+) -> Iterator:
+    """Stride an iterable of batches across ranks (the ring-backend data
+    path; ray_tpu.data shards upstream instead)."""
+    for i, batch in enumerate(it):
+        if i % world_size == world_rank:
+            yield batch

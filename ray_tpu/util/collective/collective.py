@@ -196,16 +196,9 @@ class RingGroup(BaseGroup):
         flat = array.reshape(-1).astype(np.float64 if array.dtype.kind == "f" else array.dtype)
         chunks = np.array_split(flat, self.world_size)
         next_rank = (self.rank + 1) % self.world_size
+        # reduce-scatter, then all-gather of the reduced chunks
+        self._ring_reduce_scatter(chunks, reducer, f"{tag}/rs", start_idx=self.rank)
         prev_rank = (self.rank - 1) % self.world_size
-        # reduce-scatter
-        send_idx = self.rank
-        for step in range(self.world_size - 1):
-            self.send(chunks[send_idx], next_rank, tag=f"{tag}/rs")
-            recv_idx = (send_idx - 1) % self.world_size
-            incoming = self.recv(prev_rank, tag=f"{tag}/rs")
-            chunks[recv_idx] = reducer(chunks[recv_idx], incoming)
-            send_idx = recv_idx
-        # all-gather of reduced chunks
         send_idx = (self.rank + 1) % self.world_size
         for step in range(self.world_size - 1):
             self.send(chunks[send_idx], next_rank, tag=f"{tag}/ag")
@@ -215,10 +208,37 @@ class RingGroup(BaseGroup):
         out = np.concatenate(chunks).astype(array.dtype)
         return out.reshape(array.shape)
 
+    def _ring_reduce_scatter(self, chunks, reducer, tag, start_idx: int) -> int:
+        """N-1 ring rounds; afterwards this rank holds the fully-reduced
+        chunk at index (start_idx + 1) % world_size (returned)."""
+        next_rank = (self.rank + 1) % self.world_size
+        prev_rank = (self.rank - 1) % self.world_size
+        send_idx = start_idx
+        for step in range(self.world_size - 1):
+            self.send(chunks[send_idx], next_rank, tag=tag)
+            recv_idx = (send_idx - 1) % self.world_size
+            incoming = self.recv(prev_rank, tag=tag)
+            chunks[recv_idx] = reducer(chunks[recv_idx], incoming)
+            send_idx = recv_idx
+        return send_idx
+
     def reducescatter(self, array: np.ndarray, op: str = SUM) -> np.ndarray:
-        """Each rank gets its 1/world_size slice of the reduction."""
-        reduced = self.allreduce(array, op=op, tag="__rsc")
-        return np.array_split(reduced.reshape(-1), self.world_size)[self.rank]
+        """Each rank gets its 1/world_size slice of the reduction. Runs ONLY
+        the reduce-scatter phase (half an allreduce's communication)."""
+        if self.world_size == 1:
+            return np.asarray(array).reshape(-1)
+        reducer = _REDUCERS[op]
+        flat = array.reshape(-1).astype(
+            np.float64 if array.dtype.kind == "f" else array.dtype
+        )
+        chunks = np.array_split(flat, self.world_size)
+        # Starting one chunk earlier makes the fully-reduced chunk land on
+        # index == self.rank, matching the allreduce-based semantics.
+        owned = self._ring_reduce_scatter(
+            chunks, reducer, "__rsc/rs", start_idx=(self.rank - 1) % self.world_size
+        )
+        assert owned == self.rank
+        return chunks[self.rank].astype(array.dtype)
 
     def destroy(self) -> None:
         self._kv(
