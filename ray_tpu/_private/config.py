@@ -58,7 +58,11 @@ class RayTpuConfig:
     scheduler_spread_threshold: float = _env("scheduler_spread_threshold", 0.5)
     # Max number of workers a node agent keeps warm per (runtime_env, lang).
     worker_pool_prestart: int = _env("worker_pool_prestart", 0)
-    worker_register_timeout_s: float = _env("worker_register_timeout_s", 30.0)
+    worker_register_timeout_s: float = _env("worker_register_timeout_s", 60.0)
+    # How long a caller waits for a PENDING/RESTARTING actor to come up
+    # before failing the call (reference: wait_for_death_info + lease
+    # backoff behaviour).
+    actor_ready_timeout_s: float = _env("actor_ready_timeout_s", 150.0)
     worker_startup_batch: int = _env("worker_startup_batch", 4)
 
     # --- tasks / fault tolerance ---

@@ -26,12 +26,13 @@ import collections
 import itertools
 import json
 import os
+import sys
 import time
 from typing import Any
 
 from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import ActorID, PlacementGroupID
-from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection
+from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection, spawn_task
 
 ACTOR_STATES = ("PENDING", "ALIVE", "RESTARTING", "DEAD")
 PG_STATES = ("PENDING", "CREATED", "REMOVED", "RESCHEDULING")
@@ -130,13 +131,15 @@ class Controller:
         self.task_events: collections.deque = collections.deque(
             maxlen=global_config().task_events_max_buffer
         )
+        # Queued-but-unplaceable resource demands, for the autoscaler [N4].
+        self.pending_demands: dict[str, dict] = {}
         self._rr = itertools.count()
 
     # ------------------------------------------------------------------
     async def start(self, host: str, port: int) -> int:
         self.server.route_object(self)
         bound = await self.server.start(host, port)
-        asyncio.get_running_loop().create_task(self._health_check_loop())
+        spawn_task(self._health_check_loop())
         return bound
 
     async def _node_client(self, node: NodeInfo) -> RpcClient:
@@ -217,7 +220,7 @@ class Controller:
                 for i, nid in enumerate(pg.bundle_nodes):
                     if nid == node.node_id:
                         pg.bundle_nodes[i] = None
-                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+                spawn_task(self._schedule_pg(pg))
 
     async def _on_disconnect(self, conn: ServerConnection) -> None:
         node_id = conn.context.get("node_id")
@@ -377,12 +380,30 @@ class Controller:
             return min(feasible_total, key=self._utilization)
         return None
 
+    async def rpc_get_load(self, conn, payload) -> dict:
+        """Aggregated resource load for the autoscaler (reference:
+        gcs_resource_manager.cc resource load reports → autoscaler)."""
+        return {
+            "pending_demands": list(self.pending_demands.values()),
+            "nodes": [
+                {
+                    "node_id": n.node_id,
+                    "alive": n.alive,
+                    "resources_total": n.resources_total,
+                    "resources_available": n.resources_available,
+                }
+                for n in self.nodes.values()
+            ],
+        }
+
     async def rpc_request_lease(self, conn, payload) -> dict:
         resources = payload["resources"]
         strategy = payload.get("scheduling_strategy") or {}
         deadline = time.monotonic() + 60.0
+        demand_id = f"lease-{id(payload)}-{time.monotonic()}"
         while True:
             node = self._pick_node(resources, payload.get("submitter_node"), strategy)
+            self.pending_demands.pop(demand_id, None)
             if node is not None:
                 bundle = None
                 if strategy.get("kind") == "pg":
@@ -399,13 +420,14 @@ class Controller:
             if time.monotonic() > deadline:
                 return {"status": "infeasible"}
             # Wait for capacity/new nodes (the reference queues in raylets;
-            # we queue here).
+            # we queue here). Queued demand is visible to the autoscaler.
+            self.pending_demands[demand_id] = dict(resources)
             await asyncio.sleep(0.2)
 
     async def _retry_pending(self) -> None:
         for pg in list(self.pgs.values()):
             if pg.state in ("PENDING", "RESCHEDULING"):
-                asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+                spawn_task(self._schedule_pg(pg))
 
     # ------------------------------------------------------------------
     # actors [N2]
@@ -419,7 +441,7 @@ class Controller:
                 return {"status": "name_exists", "actor_id": self.named_actors[key]}
             self.named_actors[key] = actor.actor_id
         self.actors[actor.actor_id] = actor
-        asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        spawn_task(self._schedule_actor(actor))
         return {"status": "ok", "actor_id": actor.actor_id}
 
     async def _schedule_actor(self, actor: ActorInfo) -> None:
@@ -455,8 +477,17 @@ class Controller:
                         actor.ready_event.set()
                         await self.publish("actor_state", actor.snapshot())
                         return
-                except Exception:
-                    pass
+                    print(
+                        f"[controller] start_actor {actor.actor_id[:12]} on "
+                        f"{node.node_id[:12]}: {resp}",
+                        file=sys.stderr, flush=True,
+                    )
+                except Exception as exc:
+                    print(
+                        f"[controller] start_actor {actor.actor_id[:12]} "
+                        f"error: {type(exc).__name__}: {exc}",
+                        file=sys.stderr, flush=True,
+                    )
             if time.monotonic() > deadline:
                 actor.state = "DEAD"
                 actor.death_cause = "unschedulable: no feasible node"
@@ -475,7 +506,7 @@ class Controller:
             actor.address = None
             actor.ready_event.clear()
             await self.publish("actor_state", actor.snapshot())
-            asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+            spawn_task(self._schedule_actor(actor))
         else:
             actor.state = "DEAD"
             actor.death_cause = cause
@@ -576,7 +607,7 @@ class Controller:
             payload.get("job_id", ""),
         )
         self.pgs[pg.pg_id] = pg
-        asyncio.get_running_loop().create_task(self._schedule_pg(pg))
+        spawn_task(self._schedule_pg(pg))
         return {"status": "ok", "pg_id": pg.pg_id}
 
     def _plan_bundles(self, pg: PlacementGroupInfo) -> list[NodeInfo] | None:

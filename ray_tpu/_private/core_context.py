@@ -28,7 +28,7 @@ from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFull
-from ray_tpu._private.rpc import ConnectionLost, IoThread, RpcClient, RpcError, RpcServer
+from ray_tpu._private.rpc import ConnectionLost, IoThread, RpcClient, RpcError, RpcServer, spawn_task
 
 PENDING, INLINE, SHM, FAILED = "pending", "inline", "shm", "failed"
 
@@ -601,7 +601,7 @@ class CoreContext:
         active = self._active_dispatchers.get(key, 0)
         if active < min(queue.qsize(), self._MAX_DISPATCHERS_PER_KEY):
             self._active_dispatchers[key] = active + 1
-            asyncio.get_running_loop().create_task(self._dispatcher(key, queue))
+            spawn_task(self._dispatcher(key, queue))
 
     async def _dispatcher(self, key: str, queue: asyncio.Queue) -> None:
         worker: LeasedWorker | None = None
@@ -878,9 +878,11 @@ class CoreContext:
                             " (set max_task_retries to retry across restarts)"
                         )
                     else:
+                        cause = info.get("death_cause")
                         exc = exceptions.ActorDiedError(
-                            f"actor {actor_id} died (state={state}) during "
-                            f"{spec['method']}"
+                            f"actor {actor_id} died (state={state}"
+                            + (f", cause: {cause}" if cause else "")
+                            + f") during {spec['method']}"
                         )
                     self._fail_returns(record, exc)
                     return
@@ -899,10 +901,13 @@ class CoreContext:
         addr = self._actor_addr_cache.get(actor_id)
         if addr is None:
             info = await self.controller.call("get_actor_info", {"actor_id": actor_id})
-            deadline = time.monotonic() + 60
+            deadline = time.monotonic() + global_config().actor_ready_timeout_s
             while info.get("state") in ("PENDING", "RESTARTING"):
                 if time.monotonic() > deadline:
-                    raise exceptions.ActorUnavailableError(actor_id)
+                    raise exceptions.ActorUnavailableError(
+                        f"actor {actor_id} still {info.get('state')} after "
+                        f"{global_config().actor_ready_timeout_s:.0f}s"
+                    )
                 await asyncio.sleep(0.1)
                 info = await self.controller.call(
                     "get_actor_info", {"actor_id": actor_id}

@@ -51,7 +51,9 @@ def new_session_dir() -> str:
     return session
 
 
-def _wait_for_file(path: str, timeout: float = 30.0) -> str:
+def _wait_for_file(path: str, timeout: float = 120.0) -> str:
+    # Generous default: on a loaded single-core host, a fresh subprocess's
+    # interpreter+import startup alone can exceed 30s.
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if os.path.exists(path):

@@ -32,7 +32,7 @@ from typing import Any
 from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import WorkerID
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreServer
-from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection
+from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection, spawn_task
 
 
 def detect_tpu_resources() -> dict:
@@ -81,10 +81,17 @@ def detect_tpu_resources() -> dict:
 
 
 class WorkerProcess:
-    def __init__(self, worker_id: str, proc: asyncio.subprocess.Process, env_hash: str):
+    def __init__(
+        self,
+        worker_id: str,
+        proc: asyncio.subprocess.Process,
+        env_hash: str,
+        job_id: str = "",
+    ):
         self.worker_id = worker_id
         self.proc = proc
         self.env_hash = env_hash
+        self.job_id = job_id
         self.address: tuple | None = None
         self.registered = asyncio.Event()
         self.actor_id: str | None = None
@@ -176,7 +183,7 @@ class NodeAgent:
                 "store_info": self.store_info(),
             },
         )
-        asyncio.get_running_loop().create_task(self._heartbeat_loop())
+        spawn_task(self._heartbeat_loop())
         return self.address
 
     def store_info(self) -> dict:
@@ -258,6 +265,21 @@ class NodeAgent:
     def _env_hash(self, runtime_env: dict) -> str:
         return repr(sorted((runtime_env or {}).items()))
 
+    def _pop_idle_worker(self, env_hash: str, job_id: str):
+        """Reuse a live idle worker only when it belongs to the SAME job —
+        its log-forwarding tasks and RAYTPU_JOB_ID were bound at spawn, so
+        a cross-job handout would misroute stdout/err to the old driver."""
+        pool = self.idle_workers.get(env_hash) or []
+        for i in range(len(pool) - 1, -1, -1):
+            candidate = pool[i]
+            if candidate.proc.returncode is not None:
+                pool.pop(i)
+                continue
+            if candidate.job_id == job_id:
+                pool.pop(i)
+                return candidate
+        return None
+
     async def _spawn_worker(
         self, runtime_env: dict, job_id: str, actor_mode: bool = False
     ) -> WorkerProcess:
@@ -287,12 +309,14 @@ class NodeAgent:
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.PIPE,
         )
-        worker = WorkerProcess(worker_id, proc, self._env_hash(runtime_env))
+        worker = WorkerProcess(
+            worker_id, proc, self._env_hash(runtime_env), job_id
+        )
         self.workers[worker_id] = worker
         loop = asyncio.get_running_loop()
-        loop.create_task(self._forward_logs(worker, proc.stdout, "out", job_id))
-        loop.create_task(self._forward_logs(worker, proc.stderr, "err", job_id))
-        loop.create_task(self._watch_worker(worker))
+        spawn_task(self._forward_logs(worker, proc.stdout, "out", job_id))
+        spawn_task(self._forward_logs(worker, proc.stderr, "err", job_id))
+        spawn_task(self._watch_worker(worker))
         try:
             await asyncio.wait_for(
                 worker.registered.wait(),
@@ -398,13 +422,7 @@ class NodeAgent:
                 return {"status": "busy"}
             await self._wait_for_resources()
         env_hash = self._env_hash(runtime_env)
-        pool = self.idle_workers.setdefault(env_hash, [])
-        worker = None
-        while pool:
-            candidate = pool.pop()
-            if candidate.proc.returncode is None:
-                worker = candidate
-                break
+        worker = self._pop_idle_worker(env_hash, payload.get("job_id", ""))
         if worker is None:
             try:
                 worker = await self._spawn_worker(runtime_env, payload.get("job_id", ""))
@@ -451,39 +469,41 @@ class NodeAgent:
             bundle = {"pg_id": bundle_key[0], "bundle_index": bundle_key[1]}
         if not self._try_consume(resources, bundle_key):
             return {"status": "busy"}
-        try:
-            worker = await self._spawn_worker(
-                spec.get("runtime_env") or {}, spec.get("job_id", ""), actor_mode=True
-            )
-        except Exception as exc:
-            self._give_back(resources, bundle_key)
-            return {"status": "spawn_failed", "error": str(exc)}
+        # Prefer a warm idle worker (reference WorkerPool reuse): a fresh
+        # interpreter costs seconds of imports, which under CPU contention
+        # can push actor readiness past client deadlines.
+        env_hash = self._env_hash(spec.get("runtime_env") or {})
+        worker = self._pop_idle_worker(env_hash, spec.get("job_id", ""))
+        if worker is None:
+            try:
+                worker = await self._spawn_worker(
+                    spec.get("runtime_env") or {}, spec.get("job_id", ""),
+                    actor_mode=True,
+                )
+            except Exception as exc:
+                self._give_back(resources, bundle_key)
+                return {"status": "spawn_failed", "error": str(exc)}
         worker.actor_id = spec["actor_id"]
         worker.resources = resources
         worker.bundle = bundle
         worker_client = RpcClient(worker.address, name="agent-to-worker")
         try:
             await worker_client.connect()
+            # Bounded: a wedged worker must surface as creation_failed (the
+            # controller retries on a fresh worker), not hang the scheduler.
             resp = await worker_client.call(
                 "create_actor",
                 {"spec": spec, "creation_args": payload.get("creation_args")},
+                timeout=global_config().worker_register_timeout_s + 60,
             )
         except Exception as exc:
-            worker.intended_exit = True
-            try:
-                worker.proc.kill()
-            except ProcessLookupError:
-                pass
+            self._fail_actor_worker(worker)
             self._give_back(resources, bundle_key)
             return {"status": "creation_failed", "error": str(exc)}
         finally:
             await worker_client.close()
         if resp.get("status") != "ok":
-            worker.intended_exit = True
-            try:
-                worker.proc.kill()
-            except ProcessLookupError:
-                pass
+            self._fail_actor_worker(worker)
             self._give_back(resources, bundle_key)
             return {"status": "creation_failed", "error": resp.get("error")}
         return {
@@ -492,6 +512,19 @@ class NodeAgent:
             "worker_addr": list(worker.address),
             "pid": worker.proc.pid,
         }
+
+    def _fail_actor_worker(self, worker: WorkerProcess) -> None:
+        """Kill a worker whose actor creation failed. Clears the actor
+        bookkeeping FIRST so _watch_worker does not give the same resources
+        back a second time (the creation path already does)."""
+        worker.actor_id = None
+        worker.resources = {}
+        worker.bundle = None
+        worker.intended_exit = True
+        try:
+            worker.proc.kill()
+        except ProcessLookupError:
+            pass
 
     async def rpc_kill_worker(self, conn, payload) -> dict:
         worker = self.workers.get(payload["worker_id"])

@@ -37,6 +37,20 @@ _LEN = struct.Struct("<I")
 Handler = Callable[..., Awaitable[Any]]
 
 
+# Strong references to fire-and-forget tasks: asyncio's loop only weakly
+# references tasks, so an unreferenced create_task() can be GC'd mid-flight
+# (silently dropping an RPC dispatch or a scheduler coroutine). Every
+# fire-and-forget task in the runtime goes through spawn_task().
+_BG_TASKS: set = set()
+
+
+def spawn_task(coro) -> "asyncio.Task":
+    task = asyncio.get_running_loop().create_task(coro)
+    _BG_TASKS.add(task)
+    task.add_done_callback(_BG_TASKS.discard)
+    return task
+
+
 class RpcError(Exception):
     """Remote handler raised; carries the remote traceback string."""
 
@@ -127,9 +141,7 @@ class RpcServer:
                 kind, msgid, method, payload = await _read_frame(reader)
                 if kind != REQ:
                     continue
-                asyncio.get_running_loop().create_task(
-                    self._dispatch(conn, msgid, method, payload)
-                )
+                spawn_task(self._dispatch(conn, msgid, method, payload))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -223,7 +235,7 @@ class RpcClient:
                     if handler is not None:
                         result = handler(payload)
                         if asyncio.iscoroutine(result):
-                            asyncio.get_running_loop().create_task(result)
+                            spawn_task(result)
                     continue
                 future = self._pending.pop(msgid, None)
                 if future is None or future.done():

@@ -162,5 +162,5 @@ def dumps_function(fn: Any) -> bytes:
     return out.getvalue()
 
 
-def loads_function(raw: bytes) -> Any:
-    return _Unpickler(io.BytesIO(raw), None).load()
+def loads_function(raw: bytes, ref_resolver: Callable | None = None) -> Any:
+    return _Unpickler(io.BytesIO(raw), ref_resolver).load()

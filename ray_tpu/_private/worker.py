@@ -78,6 +78,14 @@ def init(
         global_config().apply_system_config(_system_config)
 
         job_id = JobID.random()
+        if address == "auto":
+            # Reference's ray.init("auto"): resolve from the environment
+            # (set for job-submission drivers and `ray_tpu start` shells).
+            address = os.environ.get("RAYTPU_ADDRESS")
+            if not address:
+                raise ConnectionError(
+                    'init("auto") needs RAYTPU_ADDRESS in the environment'
+                )
         if address is None:
             custom = dict(resources or {})
             if num_cpus is not None:

@@ -49,11 +49,21 @@ class WorkerRuntime:
         # per-caller ordered queues (actor_scheduling_queue.cc)
         self._order: dict[str, dict] = {}
         self._fn_cache: dict[str, Any] = {}
+        self._task_event_lock = threading.Lock()
+        self._task_events_last_flush = 0.0
+        # compiled-graph state: dag_id → stage spec; (dag_id, seq) → buffers
+        self._dag_stages: dict[str, dict] = {}
+        self._dag_buffers: dict[str, dict] = {}
+        self._dag_results: dict[tuple, Any] = {}
+        self._dag_events: dict[tuple, asyncio.Event] = {}
 
     # ------------------------------------------------------------------
     def start(self) -> None:
         ctx = self.ctx
-        for method in ("push_task", "push_actor_task", "create_actor", "exit"):
+        for method in (
+            "push_task", "push_actor_task", "create_actor", "exit",
+            "dag_register", "dag_push", "dag_pop",
+        ):
             ctx.core_server.route(method, getattr(self, f"rpc_{method}"))
         ctx.connect()
         # Make the global API (ray_tpu.get/put/remote...) work inside tasks.
@@ -92,7 +102,14 @@ class WorkerRuntime:
         )
         if resp["status"] != "ok":
             raise RuntimeError(f"function {function_id} not found in function table")
-        fn = serialization.loads_function(resp["value"])
+        # Functions/classes may close over ObjectRefs — resolve them the
+        # same way task args do (register the borrow with the owner).
+        def resolver(ref_id, owner_address):
+            ref = ObjectRef(ref_id, owner_address, runtime=self.ctx)
+            self.ctx._note_borrow(ref_id, owner_address)
+            return ref
+
+        fn = serialization.loads_function(resp["value"], ref_resolver=resolver)
         self._fn_cache[function_id] = fn
         return fn
 
@@ -141,6 +158,7 @@ class WorkerRuntime:
 
     def _execute(self, spec: dict, fn: Any, is_method: bool) -> dict:
         name = spec.get("name", "task")
+        self._record_task_event(spec, "RUNNING")
         try:
             args, kwargs = self._resolve_args(spec["args"])
             if inspect.iscoroutinefunction(fn):
@@ -152,11 +170,53 @@ class WorkerRuntime:
                 value = fn(*args, **kwargs)
             num_returns = spec.get("num_returns", 1)
             values = [value] if num_returns == 1 else list(value)
+            self._record_task_event(spec, "FINISHED")
             return {"status": "ok", "returns": self._package_returns(spec, values)}
         except Exception:
+            self._record_task_event(spec, "FAILED")
             err = exceptions.TaskError(name, traceback.format_exc())
             payload, _ = serialization.serialize(err)
             return {"status": "error", "error": payload}
+
+    def _record_task_event(self, spec: dict, state: str) -> None:
+        """Task lifecycle events feed the state API + `ray_tpu timeline`
+        (reference: profile_event.cc → gcs_task_manager.cc [N5])."""
+        import time as _time
+
+        with self._task_event_lock:
+            self.ctx._task_events.append(
+                {
+                    "task_id": spec.get("task_id"),
+                    "name": spec.get("name"),
+                    "state": state,
+                    "node_id": self.ctx.node_id,
+                    "worker_id": self.ctx.worker_id,
+                    "pid": os.getpid(),
+                    "ts": _time.time(),
+                }
+            )
+            # Batch: size- or time-triggered, never per-event (the reference
+            # buffers in a ring and reports periodically, gcs_task_manager).
+            now = _time.monotonic()
+            due = (
+                len(self.ctx._task_events) >= 100
+                or now - self._task_events_last_flush > 1.0
+            )
+            if not due:
+                return
+            events = self.ctx._task_events[:]
+            self.ctx._task_events.clear()
+            self._task_events_last_flush = now
+
+        async def _flush():
+            try:
+                await self.ctx.controller.call(
+                    "report_task_events", {"events": events}
+                )
+            except Exception:
+                pass
+
+        self.ctx.io.spawn(_flush())
 
     # ------------------------------------------------------------------
     # RPC handlers
@@ -238,6 +298,89 @@ class WorkerRuntime:
         return await loop.run_in_executor(
             self.executor, self._execute, spec, method, True
         )
+
+    # ------------------------------------------------------------------
+    # compiled-graph (aDAG) channels [SURVEY §2.2 "Compiled graphs"]
+    # ------------------------------------------------------------------
+    # The driver registers one stage spec per participating actor; pushes
+    # then flow actor→actor over direct worker RPC without driver
+    # round-trips (the reference's NCCL-channel role; here the channel is
+    # the worker's ordered RPC stream, device arrays ride ICI inside the
+    # stage's jitted fns).
+
+    async def rpc_dag_register(self, conn, payload) -> dict:
+        stage = payload["stage"]
+        self._dag_stages[payload["dag_id"]] = stage
+        self._dag_buffers.setdefault(payload["dag_id"], {})
+        return {"status": "ok"}
+
+    async def rpc_dag_push(self, conn, payload) -> dict:
+        dag_id = payload["dag_id"]
+        seq = payload["seq"]
+        stage = self._dag_stages.get(dag_id)
+        if stage is None:
+            return {"status": "error", "error": f"dag {dag_id} not registered"}
+        value = serialization.deserialize(payload["value"], zero_copy=False)
+        buffers = self._dag_buffers[dag_id]
+        slots = buffers.setdefault(seq, {})
+        slots[payload["slot"]] = value
+        if set(slots) != set(stage["slots"]):
+            return {"status": "ok"}
+        buffers.pop(seq)
+        # Detach execution+forward: the push RPC acks as soon as inputs are
+        # buffered, so upstream (and the driver) pipelines the next seq while
+        # this stage computes — the whole point of compiled-graph channels.
+        from ray_tpu._private.rpc import spawn_task
+
+        spawn_task(self._dag_run_stage(dag_id, seq, stage, slots))
+        return {"status": "ok"}
+
+    async def _dag_run_stage(
+        self, dag_id: str, seq: int, stage: dict, slots: dict
+    ) -> None:
+        method = getattr(self.actor_instance, stage["method"])
+        args = [slots[name] for name in stage["slots"]]
+        loop = asyncio.get_running_loop()
+
+        def run():
+            return method(*args)
+
+        try:
+            result = await loop.run_in_executor(self.executor, run)
+        except Exception:
+            result = exceptions.TaskError(stage["method"], traceback.format_exc())
+        if stage.get("is_output"):
+            key = (dag_id, seq)
+            self._dag_results[key] = result
+            self._dag_events.setdefault(key, asyncio.Event()).set()
+            return
+        raw, _ = serialization.serialize(result)
+        for target in stage.get("downstream", ()):
+            try:
+                client = await self.ctx._actor_client(target["actor_id"])
+                await client.call(
+                    "dag_push",
+                    {
+                        "dag_id": dag_id,
+                        "seq": seq,
+                        "slot": target["slot"],
+                        "value": raw,
+                    },
+                )
+            except Exception:
+                traceback.print_exc()
+
+    async def rpc_dag_pop(self, conn, payload) -> dict:
+        key = (payload["dag_id"], payload["seq"])
+        event = self._dag_events.setdefault(key, asyncio.Event())
+        try:
+            await asyncio.wait_for(event.wait(), timeout=payload.get("timeout", 300))
+        except asyncio.TimeoutError:
+            return {"status": "timeout"}
+        result = self._dag_results.pop(key)
+        self._dag_events.pop(key, None)
+        raw, _ = serialization.serialize(result)
+        return {"status": "ok", "value": raw}
 
     async def rpc_exit(self, conn, payload) -> dict:
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)

@@ -1,0 +1,17 @@
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    FakeNodeProvider,
+    NodeProvider,
+    NodeTypeConfig,
+    StandardAutoscaler,
+    bin_pack_unmet_demand,
+)
+
+__all__ = [
+    "StandardAutoscaler",
+    "AutoscalerConfig",
+    "NodeTypeConfig",
+    "NodeProvider",
+    "FakeNodeProvider",
+    "bin_pack_unmet_demand",
+]

@@ -1,0 +1,9 @@
+from ray_tpu.dag.dag import (
+    ClassMethodNode,
+    CompiledDAG,
+    DAGNode,
+    DAGRef,
+    InputNode,
+)
+
+__all__ = ["InputNode", "DAGNode", "ClassMethodNode", "CompiledDAG", "DAGRef"]

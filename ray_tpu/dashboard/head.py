@@ -1,0 +1,192 @@
+"""Dashboard — REST backend + minimal UI.
+
+Role-equivalent of python/ray/dashboard/head.py + modules/{node,actor,job,
+state,metrics} (SURVEY §2.3, §5.5): an aiohttp server aggregating
+controller state into JSON endpoints, a Prometheus /metrics endpoint
+(fed by ray_tpu.util.metrics), per-node log listing from the session dir,
+and a single-page HTML overview. Runs in-process of the driver (thread)
+or as a detached actor via start_dashboard().
+"""
+
+from __future__ import annotations
+
+import asyncio
+import glob
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import state as state_mod
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+ table { border-collapse: collapse; min-width: 40rem; }
+ td, th { border: 1px solid #ccc; padding: 4px 10px; font-size: 0.85rem; }
+ th { background: #f4f4f4; text-align: left; }
+ code { background: #f4f4f4; padding: 1px 4px; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="content">loading…</div>
+<script>
+async function refresh() {
+  const [cluster, nodes, actors] = await Promise.all([
+    fetch('/api/cluster').then(r => r.json()),
+    fetch('/api/nodes').then(r => r.json()),
+    fetch('/api/actors').then(r => r.json()),
+  ]);
+  let html = '<h2>Cluster resources</h2><table><tr><th>resource</th><th>available</th><th>total</th></tr>';
+  for (const k of Object.keys(cluster.total)) {
+    html += `<tr><td>${k}</td><td>${cluster.available[k] ?? 0}</td><td>${cluster.total[k]}</td></tr>`;
+  }
+  html += '</table><h2>Nodes</h2><table><tr><th>node</th><th>alive</th><th>resources</th></tr>';
+  for (const n of nodes) {
+    html += `<tr><td><code>${n.node_id}</code></td><td>${n.alive}</td><td>${JSON.stringify(n.resources_total)}</td></tr>`;
+  }
+  html += '</table><h2>Actors</h2><table><tr><th>actor</th><th>class</th><th>state</th><th>node</th></tr>';
+  for (const a of actors) {
+    html += `<tr><td><code>${a.actor_id}</code></td><td>${a.class_name ?? ''}</td><td>${a.state}</td><td><code>${a.node_id ?? ''}</code></td></tr>`;
+  }
+  html += '</table>';
+  document.getElementById('content').innerHTML = html;
+}
+refresh(); setInterval(refresh, 3000);
+</script>
+</body></html>
+"""
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265,
+                 session_dir: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.session_dir = session_dir
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("dashboard failed to start")
+
+    def _serve(self) -> None:
+        asyncio.run(self._amain())
+
+    async def _amain(self) -> None:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/cluster", self._cluster)
+        app.router.add_get("/api/nodes", self._nodes)
+        app.router.add_get("/api/actors", self._actors)
+        app.router.add_get("/api/tasks", self._tasks)
+        app.router.add_get("/api/placement_groups", self._pgs)
+        app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_get("/api/logs", self._logs)
+        app.router.add_get("/api/logs/{name}", self._log_file)
+        app.router.add_get("/api/timeline", self._timeline)
+        app.router.add_get("/metrics", self._metrics)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.port)
+        await site.start()
+        self._started.set()
+        while True:
+            await asyncio.sleep(3600)
+
+    async def _index(self, request):
+        from aiohttp import web
+
+        return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+    async def _cluster(self, request):
+        from aiohttp import web
+
+        total = await asyncio.to_thread(ray_tpu.cluster_resources)
+        available = await asyncio.to_thread(ray_tpu.available_resources)
+        return web.json_response({"total": total, "available": available})
+
+    async def _nodes(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            await asyncio.to_thread(state_mod.list_nodes)
+        )
+
+    async def _actors(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            await asyncio.to_thread(state_mod.list_actors)
+        )
+
+    async def _tasks(self, request):
+        from aiohttp import web
+
+        return web.json_response(await asyncio.to_thread(state_mod.list_tasks))
+
+    async def _pgs(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            await asyncio.to_thread(state_mod.list_placement_groups)
+        )
+
+    async def _jobs(self, request):
+        from aiohttp import web
+
+        return web.json_response(await asyncio.to_thread(state_mod.list_jobs))
+
+    async def _logs(self, request):
+        from aiohttp import web
+
+        if not self.session_dir:
+            return web.json_response([])
+        files = sorted(
+            os.path.basename(p)
+            for p in glob.glob(os.path.join(self.session_dir, "logs", "*"))
+        )
+        return web.json_response(files)
+
+    async def _log_file(self, request):
+        from aiohttp import web
+
+        name = os.path.basename(request.match_info["name"])
+        path = os.path.join(self.session_dir or "", "logs", name)
+        if not os.path.exists(path):
+            return web.Response(status=404, text="no such log")
+        lines = int(request.query.get("lines", "200"))
+        with open(path, "rb") as f:
+            data = f.read()[-200_000:]
+        text = data.decode(errors="replace")
+        return web.Response(text="\n".join(text.splitlines()[-lines:]))
+
+    async def _timeline(self, request):
+        from aiohttp import web
+
+        return web.json_response(await asyncio.to_thread(ray_tpu.timeline))
+
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        text = await asyncio.to_thread(metrics_mod.collect_prometheus_text)
+        return web.Response(text=text, content_type="text/plain")
+
+
+def start_dashboard(
+    host: str = "127.0.0.1", port: int = 8265
+) -> DashboardHead:
+    from ray_tpu._private import worker as worker_mod
+
+    ctx = worker_mod.get_global_context()
+    session_dir = getattr(ctx, "session_dir", None) or os.environ.get(
+        "RAYTPU_SESSION_DIR"
+    )
+    return DashboardHead(host, port, session_dir)

@@ -1,0 +1,60 @@
+"""ray_tpu.data — streaming distributed datasets (Ray Data-equivalent).
+
+Lazy logical plans over Arrow blocks in the object store, a streaming
+executor with bounded in-flight backpressure, task/actor-pool map
+operators, map/reduce shuffles, and ML-ingest iterators (streaming_split
+into train gangs). SURVEY §2.7.
+"""
+
+from ray_tpu.data.block import BlockAccessor, BlockMetadata, DataContext
+from ray_tpu.data.dataset import Dataset, GroupedData, from_block_refs
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_huggingface,
+    from_items,
+    from_numpy,
+    from_pandas,
+    from_torch,
+    range,
+    range_tensor,
+    read_csv,
+    read_images,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+    read_tfrecords,
+)
+from ray_tpu.data._internal.shuffle import Count, Max, Mean, Min, Std, Sum
+
+__all__ = [
+    "Dataset",
+    "GroupedData",
+    "DataIterator",
+    "DataContext",
+    "BlockAccessor",
+    "BlockMetadata",
+    "from_block_refs",
+    "from_items",
+    "from_numpy",
+    "from_arrow",
+    "from_pandas",
+    "from_torch",
+    "from_huggingface",
+    "range",
+    "range_tensor",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_images",
+    "read_text",
+    "read_tfrecords",
+    "Count",
+    "Sum",
+    "Min",
+    "Max",
+    "Mean",
+    "Std",
+]

@@ -1,0 +1,99 @@
+"""Fused map-chain application — the body of every map task.
+
+Role-equivalent of the transform functions the reference's planner emits
+(python/ray/data/_internal/planner/plan_udf_map_op.py): one function that
+applies a fused run of map-like logical ops to one block, including batch
+slicing + format conversion for map_batches UDFs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import pyarrow as pa
+
+from ray_tpu.data.block import BlockAccessor, BlockBuilder, _normalize
+from ray_tpu.data._internal.plan import Filter, FlatMap, MapBatches, MapRows
+
+
+def format_batch(table: pa.Table, batch_format: str) -> Any:
+    if batch_format in ("numpy", "default", None):
+        return BlockAccessor.for_block(table).to_numpy()
+    if batch_format == "pandas":
+        return table.to_pandas()
+    if batch_format in ("pyarrow", "arrow"):
+        return table
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def batch_blocks(
+    table: pa.Table, batch_size: int | None
+) -> Iterator[pa.Table]:
+    if batch_size is None or table.num_rows <= batch_size:
+        if table.num_rows:
+            yield table
+        return
+    for start in range(0, table.num_rows, batch_size):
+        yield table.slice(start, min(batch_size, table.num_rows - start))
+
+
+def _apply_map_batches(op: MapBatches, fn: Callable, table: pa.Table) -> pa.Table:
+    builder = BlockBuilder()
+    for batch in batch_blocks(table, op.batch_size):
+        formatted = format_batch(batch, op.batch_format)
+        out = fn(formatted, *op.fn_args, **op.fn_kwargs)
+        if out is None:
+            continue
+        # UDFs may yield multiple output batches (generator UDF).
+        outs = out if isinstance(out, Iterator) else [out]
+        for item in outs:
+            builder.add_block(_normalize(item))
+    return builder.build()
+
+
+def _apply_rowwise(op, table: pa.Table) -> pa.Table:
+    rows = table.to_pylist()
+    if isinstance(op, MapRows):
+        new_rows = [op.fn(row) for row in rows]
+    elif isinstance(op, FlatMap):
+        new_rows = [out for row in rows for out in op.fn(row)]
+    elif isinstance(op, Filter):
+        new_rows = [row for row in rows if op.fn(row)]
+    else:
+        raise TypeError(op)
+    if not new_rows:
+        return table.slice(0, 0)
+    builder = BlockBuilder()
+    for row in new_rows:
+        builder.add_row(row)
+    return builder.build()
+
+
+def make_fused_fn(ops: list, udf_instances: dict[int, Callable] | None = None):
+    """Build block → block applying the fused chain. `udf_instances` maps
+    op index → constructed callable for actor-compute MapBatches classes."""
+
+    def fused(block) -> pa.Table:
+        table = _normalize(block)
+        for idx, op in enumerate(ops):
+            if isinstance(op, MapBatches):
+                fn = (udf_instances or {}).get(idx)
+                if fn is None:
+                    fn = op.fn
+                    if isinstance(fn, type):
+                        fn = fn(*op.fn_constructor_args)
+                table = _apply_map_batches(op, fn, table)
+            else:
+                table = _apply_rowwise(op, table)
+        return table
+
+    return fused
+
+
+def instantiate_udfs(ops: list) -> dict[int, Callable]:
+    """Construct stateful UDF classes once (actor-pool compute)."""
+    instances: dict[int, Callable] = {}
+    for idx, op in enumerate(ops):
+        if isinstance(op, MapBatches) and isinstance(op.fn, type):
+            instances[idx] = op.fn(*op.fn_constructor_args)
+    return instances

@@ -1,0 +1,213 @@
+"""All-to-all stages: repartition, random_shuffle, sort, groupby-aggregate.
+
+Role-equivalent of the reference's shuffle ops (SURVEY §2.7 "shuffle via
+map/reduce task stages"): a map wave partitions each input block into N
+parts, a reduce wave concatenates each partition's parts — all parts move
+through the object store, so the shuffle is fully distributed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor
+
+
+@ray_tpu.remote
+def _split_block(block, num_parts: int, mode: str, key, seed) -> list:
+    """Map side. mode: 'slice' (repartition), 'random', 'range' (sort,
+    key+bounds), 'hash' (groupby)."""
+    table = BlockAccessor.for_block(block).block
+    n = table.num_rows
+    if mode == "slice":
+        # Even contiguous split; reducer i gets rows [i*n/N, (i+1)*n/N).
+        cuts = [round(i * n / num_parts) for i in range(num_parts + 1)]
+        return [table.slice(cuts[i], cuts[i + 1] - cuts[i]) for i in range(num_parts)]
+    if mode == "random":
+        rng = np.random.default_rng(seed)
+        assignment = rng.integers(0, num_parts, size=n)
+    elif mode == "range":
+        bounds = key["bounds"]
+        col = table.column(key["key"]).to_numpy(zero_copy_only=False)
+        assignment = np.searchsorted(bounds, col, side="right")
+        if key.get("descending"):
+            assignment = (num_parts - 1) - assignment
+    elif mode == "hash":
+        col = table.column(key).to_pandas()
+        assignment = col.map(lambda v: hash(v) % num_parts).to_numpy()
+    else:
+        raise ValueError(mode)
+    parts = []
+    for i in range(num_parts):
+        idx = np.nonzero(assignment == i)[0]
+        parts.append(table.take(pa.array(idx)))
+    return parts
+
+
+@ray_tpu.remote
+def _merge_parts(mode: str, key, seed, *parts):
+    """Reduce side: concat my parts (+ per-mode post-processing)."""
+    table = BlockAccessor.concat(list(parts))
+    if mode == "random" and table.num_rows:
+        rng = np.random.default_rng(seed)
+        table = table.take(pa.array(rng.permutation(table.num_rows)))
+    elif mode == "range" and table.num_rows:
+        order = "descending" if key.get("descending") else "ascending"
+        table = table.sort_by([(key["key"], order)])
+    return table
+
+
+def shuffle_blocks(
+    block_refs: list,
+    num_out: int,
+    mode: str,
+    key: Any = None,
+    seed: Optional[int] = None,
+) -> list:
+    """Run the 2-wave shuffle; returns num_out output block refs."""
+    if not block_refs:
+        return []
+    part_lists = [
+        _split_block.options(num_returns=num_out).remote(
+            ref, num_out, mode, key, None if seed is None else seed + i
+        )
+        for i, ref in enumerate(block_refs)
+    ]
+    if num_out == 1:
+        part_lists = [[p] for p in part_lists]
+    out = []
+    for j in range(num_out):
+        parts_j = [parts[j] for parts in part_lists]
+        out.append(
+            _merge_parts.remote(
+                mode, key, None if seed is None else seed + 7919 * (j + 1), *parts_j
+            )
+        )
+    return out
+
+
+def sample_sort_bounds(block_refs: list, sort_key: str, num_out: int) -> np.ndarray:
+    """Range-partition boundaries from a uniform sample (reference: sort's
+    boundary sampling)."""
+
+    @ray_tpu.remote
+    def _sample(block, k):
+        table = BlockAccessor.for_block(block).block
+        if not table.num_rows:
+            return np.array([])
+        rng = np.random.default_rng(0)
+        idx = rng.choice(table.num_rows, size=min(k, table.num_rows), replace=False)
+        return table.take(pa.array(np.sort(idx))).column(sort_key).to_numpy(
+            zero_copy_only=False
+        )
+
+    samples = ray_tpu.get([_sample.remote(ref, 64) for ref in block_refs])
+    merged = np.sort(np.concatenate([s for s in samples if len(s)] or [np.array([])]))
+    if not len(merged):
+        return np.array([])
+    quantiles = [
+        merged[min(len(merged) - 1, int(len(merged) * q / num_out))]
+        for q in range(1, num_out)
+    ]
+    return np.asarray(quantiles)
+
+
+# ---- groupby aggregation ----
+
+class AggregateFn:
+    """name/init/accumulate(pa.Table column chunk)/merge/finalize."""
+
+    def __init__(self, name: str, on: Optional[str]):
+        self.name = name
+        self.on = on
+
+    def accumulate(self, table: pa.Table):
+        raise NotImplementedError
+
+
+class Count(AggregateFn):
+    def __init__(self):
+        super().__init__("count()", None)
+
+    def accumulate(self, table: pa.Table):
+        return table.num_rows
+
+
+class _ColumnAgg(AggregateFn):
+    _pc_fn: str = ""
+
+    def __init__(self, on: str):
+        super().__init__(f"{self._pc_fn}({on})", on)
+
+    def accumulate(self, table: pa.Table):
+        value = getattr(pc, self._pc_fn)(table.column(self.on))
+        return value.as_py()
+
+
+class Sum(_ColumnAgg):
+    _pc_fn = "sum"
+
+
+class Min(_ColumnAgg):
+    _pc_fn = "min"
+
+
+class Max(_ColumnAgg):
+    _pc_fn = "max"
+
+
+class Mean(_ColumnAgg):
+    _pc_fn = "mean"
+
+
+class Std(_ColumnAgg):
+    _pc_fn = "stddev"
+
+
+@ray_tpu.remote
+def _agg_partition(key: Optional[str], aggs: list, *parts):
+    table = BlockAccessor.concat(list(parts))
+    if table.num_rows == 0:
+        return table
+    if key is None:
+        row = {a.name: a.accumulate(table) for a in aggs}
+        return BlockAccessor.for_block([row]).block
+    out_rows = []
+    # Partition is hash-complete per key: group locally.
+    keys = table.column(key).to_pandas()
+    for value in keys.drop_duplicates():
+        mask = pc.equal(table.column(key), pa.scalar(value))
+        group = table.filter(mask)
+        row = {key: value}
+        for agg in aggs:
+            row[agg.name] = agg.accumulate(group)
+        out_rows.append(row)
+    out_rows.sort(key=lambda r: (r[key] is None, r[key]))
+    return BlockAccessor.for_block(out_rows).block
+
+
+def groupby_aggregate(
+    block_refs: list, key: Optional[str], aggs: list, num_out: int
+) -> list:
+    if not block_refs:
+        return []
+    if key is None:
+        # Global aggregate: single reduce over per-block partials would need
+        # mergeable partials; simplest correct path: one reduce task.
+        return [_agg_partition.remote(None, aggs, *block_refs)]
+    num_out = min(num_out, len(block_refs)) or 1
+    part_lists = [
+        _split_block.options(num_returns=num_out).remote(ref, num_out, "hash", key, None)
+        for ref in block_refs
+    ]
+    if num_out == 1:
+        part_lists = [[p] for p in part_lists]
+    return [
+        _agg_partition.remote(key, aggs, *[parts[j] for parts in part_lists])
+        for j in range(num_out)
+    ]

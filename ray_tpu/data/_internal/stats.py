@@ -1,0 +1,29 @@
+"""DatasetStats — per-operator execution stats.
+
+Role-equivalent of python/ray/data/_internal/stats.py :: DatasetStats:
+wall time, block and row counts per stage, rendered by Dataset.stats().
+"""
+
+from __future__ import annotations
+
+
+class DatasetStats:
+    def __init__(self):
+        self.stages: list[dict] = []
+        self.total_wall_s: float = 0.0
+
+    def record_stage(self, name: str, wall_s: float, blocks: int, rows: int) -> None:
+        self.stages.append(
+            {"stage": name, "wall_s": wall_s, "blocks": blocks, "rows": rows}
+        )
+        self.total_wall_s += wall_s
+
+    def summary_string(self) -> str:
+        lines = ["Dataset execution stats:"]
+        for s in self.stages:
+            lines.append(
+                f"  {s['stage']}: {s['wall_s'] * 1000:.1f}ms, "
+                f"{s['blocks']} blocks, {s['rows']} rows"
+            )
+        lines.append(f"  total: {self.total_wall_s * 1000:.1f}ms")
+        return "\n".join(lines)

@@ -1,0 +1,310 @@
+"""Streaming executor — drives the physical stage pipeline.
+
+Role-equivalent of python/ray/data/_internal/execution/streaming_executor.py
+(SURVEY §2.7, §3.6): blocks stream through fused map stages with a bounded
+in-flight task window (backpressure — the ReservationOpResourceAllocator's
+budget role), materializing only at all-to-all barriers. Map stages run as
+ray_tpu tasks (stateless UDFs) or an autoscaling actor pool (stateful/class
+UDFs), mirroring TaskPoolMapOperator / ActorPoolMapOperator.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterator
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor, DataContext
+from ray_tpu.data._internal.map_fn import instantiate_udfs, make_fused_fn
+from ray_tpu.data._internal.plan import (
+    Aggregate,
+    AllToAllStage,
+    InputData,
+    Limit,
+    MapStage,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+    SourceStage,
+    Union,
+    Zip,
+)
+from ray_tpu.data._internal.shuffle import (
+    groupby_aggregate,
+    sample_sort_bounds,
+    shuffle_blocks,
+)
+
+
+@ray_tpu.remote
+def _run_read_task(read_task) -> Any:
+    blocks = list(read_task())
+    return BlockAccessor.concat(blocks)
+
+
+@ray_tpu.remote
+def _map_task(ops: list, block) -> Any:
+    return make_fused_fn(ops)(block)
+
+
+@ray_tpu.remote
+def _num_rows(block) -> int:
+    return BlockAccessor.for_block(block).num_rows()
+
+
+@ray_tpu.remote
+def _slice_block(block, start: int, end: int):
+    return BlockAccessor.for_block(block).slice(start, end)
+
+
+@ray_tpu.remote
+class _MapActor:
+    """Actor-pool worker: constructs stateful UDFs once, maps blocks."""
+
+    def __init__(self, ops: list):
+        self._ops = ops
+        self._fused = make_fused_fn(ops, instantiate_udfs(ops))
+
+    def map(self, block) -> Any:
+        return self._fused(block)
+
+
+class _StageStats:
+    def __init__(self, name: str):
+        self.name = name
+        self.wall_s = 0.0
+        self.blocks_out = 0
+        self.rows_out = 0
+
+
+class StreamingExecutor:
+    def __init__(self, stages: list, ctx: DataContext | None = None):
+        self.stages = stages
+        self.ctx = ctx or DataContext.get_current()
+        self.stage_stats: list[_StageStats] = []
+
+    # -- public --
+
+    def execute(self) -> Iterator:
+        """Yield output block refs as they become available."""
+        stream: Iterator = iter(())
+        for stage in self.stages:
+            stats = _StageStats(stage.describe())
+            self.stage_stats.append(stats)
+            if isinstance(stage, SourceStage):
+                stream = self._run_source(stage, stats)
+            elif isinstance(stage, MapStage):
+                stream = self._run_map(stage, stream, stats)
+            elif isinstance(stage, AllToAllStage):
+                stream = self._run_all_to_all(stage, stream, stats)
+            else:
+                raise TypeError(stage)
+        return stream
+
+    def execute_to_refs(self) -> list:
+        return list(self.execute())
+
+    # -- stages --
+
+    def _run_source(self, stage: SourceStage, stats: _StageStats) -> Iterator:
+        op = stage.op
+        start = time.perf_counter()
+        if isinstance(op, InputData):
+            for block in op.blocks:
+                stats.blocks_out += 1
+                yield block if _is_ref(block) else ray_tpu.put(
+                    BlockAccessor.for_block(block).block
+                )
+            stats.wall_s += time.perf_counter() - start
+            return
+        assert isinstance(op, Read)
+        window = self.ctx.streaming_max_inflight_tasks
+        pending: list = []
+        tasks = list(op.read_tasks)
+        idx = 0
+        while idx < len(tasks) or pending:
+            while idx < len(tasks) and len(pending) < window:
+                pending.append(_run_read_task.remote(tasks[idx]))
+                idx += 1
+            ready, pending_rest = ray_tpu.wait(pending, num_returns=1)
+            pending = list(pending_rest)
+            for ref in ready:
+                stats.blocks_out += 1
+                stats.wall_s += time.perf_counter() - start
+                yield ref
+                start = time.perf_counter()
+
+    def _run_map(
+        self, stage: MapStage, stream: Iterator, stats: _StageStats
+    ) -> Iterator:
+        if stage.compute == "actors":
+            yield from self._run_map_actors(stage, stream, stats)
+            return
+        window = self.ctx.streaming_max_inflight_tasks
+        pending: list = []
+        start = time.perf_counter()
+        exhausted = False
+        while not exhausted or pending:
+            while not exhausted and len(pending) < window:
+                try:
+                    block_ref = next(stream)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.append(_map_task.remote(stage.ops, block_ref))
+            if not pending:
+                break
+            ready, pending_rest = ray_tpu.wait(pending, num_returns=1)
+            pending = list(pending_rest)
+            for ref in ready:
+                stats.blocks_out += 1
+                stats.wall_s += time.perf_counter() - start
+                yield ref
+                start = time.perf_counter()
+
+    def _run_map_actors(
+        self, stage: MapStage, stream: Iterator, stats: _StageStats
+    ) -> Iterator:
+        pool_size = self.ctx.actor_pool_min_size
+        actors = [_MapActor.remote(stage.ops) for _ in range(pool_size)]
+        per_actor_inflight = 2
+        pending: dict[Any, int] = {}  # ref -> actor idx
+        load = [0] * len(actors)
+        start = time.perf_counter()
+        exhausted = False
+        try:
+            while not exhausted or pending:
+                while not exhausted and min(load) < per_actor_inflight:
+                    # autoscale up to max while all actors are busy
+                    if (
+                        all(l > 0 for l in load)
+                        and len(actors) < self.ctx.actor_pool_max_size
+                    ):
+                        actors.append(_MapActor.remote(stage.ops))
+                        load.append(0)
+                    try:
+                        block_ref = next(stream)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    target = load.index(min(load))
+                    ref = actors[target].map.remote(block_ref)
+                    pending[ref] = target
+                    load[target] += 1
+                if not pending:
+                    break
+                ready, _ = ray_tpu.wait(list(pending), num_returns=1)
+                for ref in ready:
+                    load[pending.pop(ref)] -= 1
+                    stats.blocks_out += 1
+                    stats.wall_s += time.perf_counter() - start
+                    yield ref
+                    start = time.perf_counter()
+        finally:
+            for actor in actors:
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+
+    def _run_all_to_all(
+        self, stage: AllToAllStage, stream: Iterator, stats: _StageStats
+    ) -> Iterator:
+        op = stage.op
+        start = time.perf_counter()
+
+        if isinstance(op, Limit):
+            taken = 0
+            for ref in stream:
+                if taken >= op.limit:
+                    break
+                rows = ray_tpu.get(_num_rows.remote(ref))
+                if taken + rows <= op.limit:
+                    taken += rows
+                    stats.blocks_out += 1
+                    yield ref
+                else:
+                    keep = op.limit - taken
+                    taken = op.limit
+                    stats.blocks_out += 1
+                    yield _slice_block.remote(ref, 0, keep)
+            stats.wall_s += time.perf_counter() - start
+            return
+
+        if isinstance(op, Union):
+            for ref in stream:
+                stats.blocks_out += 1
+                yield ref
+            for other_refs in op.others:
+                for ref in other_refs:
+                    stats.blocks_out += 1
+                    yield ref
+            stats.wall_s += time.perf_counter() - start
+            return
+
+        refs = list(stream)
+
+        if isinstance(op, Repartition):
+            out = shuffle_blocks(refs, op.num_blocks, "slice")
+        elif isinstance(op, RandomShuffle):
+            out = shuffle_blocks(
+                refs, max(1, len(refs)), "random",
+                seed=op.seed if op.seed is not None else int(time.time()),
+            )
+        elif isinstance(op, Sort):
+            bounds = sample_sort_bounds(refs, op.key, max(1, len(refs)))
+            out = shuffle_blocks(
+                refs,
+                max(1, len(refs)),
+                "range",
+                key={"key": op.key, "bounds": bounds, "descending": op.descending},
+            )
+            if op.descending:
+                out = list(out)
+        elif isinstance(op, Aggregate):
+            out = groupby_aggregate(refs, op.key, op.aggs, max(1, len(refs)))
+        elif isinstance(op, Zip):
+            out = self._zip(refs, list(op.other))
+        else:
+            raise TypeError(op)
+        for ref in out:
+            stats.blocks_out += 1
+            yield ref
+        stats.wall_s += time.perf_counter() - start
+
+    @staticmethod
+    def _zip(left_refs: list, right_refs: list) -> list:
+        @ray_tpu.remote
+        def _concat_all(*blocks):
+            return BlockAccessor.concat(list(blocks))
+
+        @ray_tpu.remote
+        def _zip_tables(left, right):
+            import pyarrow as pa
+
+            lt = BlockAccessor.for_block(left).block
+            rt = BlockAccessor.for_block(right).block
+            if lt.num_rows != rt.num_rows:
+                raise ValueError(
+                    f"zip row-count mismatch: {lt.num_rows} vs {rt.num_rows}"
+                )
+            cols = {name: lt.column(name) for name in lt.column_names}
+            for name in rt.column_names:
+                out_name = name
+                while out_name in cols:
+                    out_name = out_name + "_1"
+                cols[out_name] = rt.column(name)
+            return pa.table(cols)
+
+        left = _concat_all.remote(*left_refs) if len(left_refs) != 1 else left_refs[0]
+        right = (
+            _concat_all.remote(*right_refs) if len(right_refs) != 1 else right_refs[0]
+        )
+        return [_zip_tables.remote(left, right)]
+
+
+def _is_ref(obj: Any) -> bool:
+    from ray_tpu import ObjectRef
+
+    return isinstance(obj, ObjectRef)

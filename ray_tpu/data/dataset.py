@@ -1,0 +1,364 @@
+"""Dataset — lazy distributed data transformations.
+
+Role-equivalent of python/ray/data/dataset.py :: Dataset (SURVEY §2.7):
+methods append logical ops; execution happens on consumption (iter_*,
+take, count, write_*, materialize) through the streaming executor. Blocks
+are Arrow tables in the object store.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Iterator, Optional
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor, DataContext
+from ray_tpu.data.iterator import DataIterator, streaming_split
+from ray_tpu.data._internal import shuffle as shuffle_mod
+from ray_tpu.data._internal.plan import (
+    Aggregate,
+    Filter,
+    FlatMap,
+    Limit,
+    LogicalPlan,
+    MapBatches,
+    MapRows,
+    RandomShuffle,
+    Repartition,
+    Sort,
+    Union,
+    Zip,
+)
+from ray_tpu.data._internal.stats import DatasetStats
+from ray_tpu.data._internal.streaming_executor import StreamingExecutor, _num_rows
+from ray_tpu.data._internal.plan import plan_stages
+
+
+class Dataset:
+    def __init__(self, plan: LogicalPlan):
+        self._plan = plan
+        self._materialized_refs: Optional[list] = None
+        self._stats = DatasetStats()
+
+    # ---- transformations (lazy) ----
+
+    def _with_op(self, op) -> "Dataset":
+        return Dataset(self._plan.with_op(op))
+
+    def map(self, fn: Callable[[dict], dict]) -> "Dataset":
+        return self._with_op(MapRows(fn=fn))
+
+    def map_batches(
+        self,
+        fn: Any,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        compute: Optional[str] = None,
+        fn_args: tuple = (),
+        fn_kwargs: dict | None = None,
+        fn_constructor_args: tuple = (),
+        num_cpus: float = 1.0,
+        concurrency: Optional[int] = None,
+    ) -> "Dataset":
+        if compute is None:
+            compute = "actors" if isinstance(fn, type) else "tasks"
+        if concurrency is not None:
+            ctx = DataContext.get_current()
+            ctx.actor_pool_max_size = max(ctx.actor_pool_max_size, concurrency)
+        return self._with_op(
+            MapBatches(
+                fn=fn,
+                batch_size=batch_size,
+                batch_format=batch_format,
+                compute=compute,
+                fn_args=fn_args,
+                fn_kwargs=fn_kwargs or {},
+                fn_constructor_args=fn_constructor_args,
+                num_cpus=num_cpus,
+            )
+        )
+
+    def flat_map(self, fn: Callable[[dict], list]) -> "Dataset":
+        return self._with_op(FlatMap(fn=fn))
+
+    def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
+        return self._with_op(Filter(fn=fn))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with_op(Limit(limit=n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with_op(Repartition(num_blocks=num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with_op(RandomShuffle(seed=seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._with_op(Sort(key=key, descending=descending))
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with_op(Zip(other=other._refs()))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with_op(Union(others=[o._refs() for o in others]))
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: b.select(cols), batch_format="pyarrow"
+        )
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        def drop(table):
+            keep = [c for c in table.column_names if c not in cols]
+            return table.select(keep)
+
+        return self.map_batches(drop, batch_format="pyarrow")
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(table):
+            return table.append_column(name, fn(table))
+
+        return self.map_batches(add, batch_format="pyarrow")
+
+    def random_sample(self, fraction: float, *, seed: Optional[int] = None) -> "Dataset":
+        import numpy as np
+
+        def sample(table):
+            rng = np.random.default_rng(seed)
+            mask = rng.random(table.num_rows) < fraction
+            import pyarrow as pa
+
+            return table.filter(pa.array(mask))
+
+        return self.map_batches(sample, batch_format="pyarrow")
+
+    # ---- execution ----
+
+    def _refs(self) -> list:
+        if self._materialized_refs is None:
+            executor = StreamingExecutor(plan_stages(self._plan))
+            self._materialized_refs = executor.execute_to_refs()
+            for s in executor.stage_stats:
+                self._stats.record_stage(s.name, s.wall_s, s.blocks_out, s.rows_out)
+        return self._materialized_refs
+
+    def _streaming_refs(self) -> Iterator:
+        if self._materialized_refs is not None:
+            return iter(self._materialized_refs)
+        return StreamingExecutor(plan_stages(self._plan)).execute()
+
+    def materialize(self) -> "Dataset":
+        self._refs()
+        return self
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._streaming_refs)
+
+    def iter_batches(self, **kwargs) -> Iterator:
+        return self.iterator().iter_batches(**kwargs)
+
+    def iter_torch_batches(self, **kwargs) -> Iterator:
+        return self.iterator().iter_torch_batches(**kwargs)
+
+    def iter_rows(self) -> Iterator[dict]:
+        return self.iterator().iter_rows()
+
+    def streaming_split(self, n: int, *, equal: bool = True) -> list[DataIterator]:
+        return streaming_split(self._refs(), n)
+
+    def split(self, n: int) -> list["Dataset"]:
+        refs = self._refs()
+        shards = [refs[i::n] for i in range(n)]
+        return [from_block_refs(shard) for shard in shards]
+
+    # ---- consumption ----
+
+    def take(self, n: int = 20) -> list[dict]:
+        rows: list[dict] = []
+        for row in self.iter_rows():
+            rows.append(row)
+            if len(rows) >= n:
+                break
+        return rows
+
+    def take_all(self) -> list[dict]:
+        return list(self.iter_rows())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        return sum(ray_tpu.get([_num_rows.remote(r) for r in self._refs()]))
+
+    def num_blocks(self) -> int:
+        return len(self._refs())
+
+    def schema(self):
+        refs = self._refs()
+        if not refs:
+            return None
+        return BlockAccessor.for_block(ray_tpu.get(refs[0])).schema()
+
+    def columns(self) -> list[str]:
+        schema = self.schema()
+        return list(schema.names) if schema is not None else []
+
+    def to_pandas(self):
+        import pandas as pd
+
+        tables = [
+            BlockAccessor.for_block(b).to_pandas()
+            for b in ray_tpu.get(self._refs())
+        ]
+        tables = [t for t in tables if len(t)]
+        if not tables:
+            return pd.DataFrame()
+        return pd.concat(tables, ignore_index=True)
+
+    def to_arrow(self):
+        return BlockAccessor.concat(ray_tpu.get(self._refs()))
+
+    def stats(self) -> str:
+        self._refs()
+        return self._stats.summary_string()
+
+    # aggregates
+    def sum(self, on: str):
+        return self._global_agg(shuffle_mod.Sum(on))
+
+    def min(self, on: str):
+        return self._global_agg(shuffle_mod.Min(on))
+
+    def max(self, on: str):
+        return self._global_agg(shuffle_mod.Max(on))
+
+    def mean(self, on: str):
+        return self._global_agg(shuffle_mod.Mean(on))
+
+    def std(self, on: str):
+        return self._global_agg(shuffle_mod.Std(on))
+
+    def _global_agg(self, agg):
+        out = self._with_op(Aggregate(key=None, aggs=[agg]))
+        rows = out.take_all()
+        return rows[0][agg.name] if rows else None
+
+    def aggregate(self, *aggs):
+        out = self._with_op(Aggregate(key=None, aggs=list(aggs)))
+        rows = out.take_all()
+        return rows[0] if rows else {}
+
+    # ---- writes ----
+
+    def write_parquet(self, path: str) -> None:
+        self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> None:
+        self._write(path, "csv")
+
+    def write_json(self, path: str) -> None:
+        self._write(path, "json")
+
+    def _write(self, path: str, fmt: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+
+        @ray_tpu.remote
+        def _write_block(block, out_path: str, fmt: str) -> str:
+            table = BlockAccessor.for_block(block).block
+            if fmt == "parquet":
+                import pyarrow.parquet as pq
+
+                pq.write_table(table, out_path)
+            elif fmt == "csv":
+                import pyarrow.csv as pacsv
+
+                pacsv.write_csv(table, out_path)
+            elif fmt == "json":
+                table.to_pandas().to_json(out_path, orient="records", lines=True)
+            return out_path
+
+        ext = {"parquet": "parquet", "csv": "csv", "json": "json"}[fmt]
+        refs = [
+            _write_block.remote(
+                block_ref, f"{path}/part-{i:05d}.{ext}", fmt
+            )
+            for i, block_ref in enumerate(self._refs())
+        ]
+        ray_tpu.get(refs)
+
+    def __repr__(self):
+        return f"Dataset(plan={self._plan.describe()})"
+
+
+class GroupedData:
+    """Dataset.groupby(key) result — reference: grouped_data.py."""
+
+    def __init__(self, ds: Dataset, key: Optional[str]):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, *aggs) -> Dataset:
+        return self._ds._with_op(Aggregate(key=self._key, aggs=list(aggs)))
+
+    def aggregate(self, *aggs) -> Dataset:
+        return self._agg(*aggs)
+
+    def count(self) -> Dataset:
+        return self._agg(shuffle_mod.Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self._agg(shuffle_mod.Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self._agg(shuffle_mod.Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self._agg(shuffle_mod.Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self._agg(shuffle_mod.Mean(on))
+
+    def std(self, on: str) -> Dataset:
+        return self._agg(shuffle_mod.Std(on))
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Apply fn to each whole group (hash-partitioned by key)."""
+        key = self._key
+
+        def apply_groups(table):
+            import pyarrow.compute as pc
+            import pyarrow as pa
+
+            out = []
+            values = table.column(key).to_pandas().drop_duplicates()
+            for value in values:
+                group = table.filter(pc.equal(table.column(key), pa.scalar(value)))
+                result = fn(BlockAccessor.for_block(group).to_numpy())
+                out.append(BlockAccessor.for_block(result).block)
+            return BlockAccessor.concat(out) if out else table.slice(0, 0)
+
+        shuffled = self._ds._with_op(
+            Repartition(num_blocks=max(1, self._ds.num_blocks()))
+        )
+        # Hash-partition so each group lands wholly in one block.
+        refs = shuffle_mod.shuffle_blocks(
+            shuffled._refs(), max(1, len(shuffled._refs())), "hash", key
+        )
+        return from_block_refs(refs).map_batches(
+            apply_groups, batch_format="pyarrow", batch_size=None
+        )
+
+
+def from_block_refs(refs: list) -> Dataset:
+    from ray_tpu.data._internal.plan import InputData
+
+    ds = Dataset(LogicalPlan([InputData(blocks=list(refs))]))
+    ds._materialized_refs = list(refs)
+    return ds

@@ -1,0 +1,162 @@
+"""DataIterator — consumption-side streaming with prefetch.
+
+Role-equivalent of python/ray/data/iterator.py :: DataIterator.iter_batches
+(threaded block prefetch, format conversion) and streaming_split's
+per-consumer iterators (SURVEY §2.7 "ML ingest"). Batches come out as
+numpy dicts (default), pandas, arrow, or torch CPU tensors.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Optional
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data._internal.map_fn import batch_blocks, format_batch
+
+
+class DataIterator:
+    def __init__(self, ref_iter_factory, owner_name: str = "dataset"):
+        """ref_iter_factory: () -> iterator of block refs (fresh each epoch)."""
+        self._factory = ref_iter_factory
+        self._owner_name = owner_name
+
+    def _block_iter(self, prefetch_blocks: int) -> Iterator:
+        """Fetch blocks with a prefetch thread (depth = prefetch_blocks+1)."""
+        refs = self._factory()
+        q: queue.Queue = queue.Queue(maxsize=max(1, prefetch_blocks + 1))
+        _DONE = object()
+
+        def producer():
+            try:
+                for ref in refs:
+                    q.put(ray_tpu.get(ref))
+            except BaseException as exc:
+                q.put(exc)
+                return
+            q.put(_DONE)
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        while True:
+            item = q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        prefetch_blocks: int = 2,
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Any]:
+        import numpy as np
+
+        carry = None
+        shuffle_rng = (
+            np.random.default_rng(local_shuffle_seed)
+            if local_shuffle_buffer_size
+            else None
+        )
+        buffer = []
+        buffered_rows = 0
+
+        def emit(table):
+            nonlocal carry
+            for batch in batch_blocks(table, batch_size):
+                if batch_size and batch.num_rows < batch_size:
+                    carry = batch
+                    return
+                yield format_batch(batch, batch_format)
+
+        for block in self._block_iter(prefetch_blocks):
+            table = BlockAccessor.for_block(block).block
+            if carry is not None:
+                table = BlockAccessor.concat([carry, table])
+                carry = None
+            if shuffle_rng is not None:
+                buffer.append(table)
+                buffered_rows += table.num_rows
+                if buffered_rows < local_shuffle_buffer_size:
+                    continue
+                merged = BlockAccessor.concat(buffer)
+                buffer, buffered_rows = [], 0
+                import pyarrow as pa
+
+                table = merged.take(
+                    pa.array(shuffle_rng.permutation(merged.num_rows))
+                )
+            yield from emit(table)
+        if buffer:
+            merged = BlockAccessor.concat(buffer)
+            import pyarrow as pa
+
+            table = merged.take(pa.array(shuffle_rng.permutation(merged.num_rows)))
+            if carry is not None:
+                table = BlockAccessor.concat([carry, table])
+                carry = None
+            yield from emit(table)
+        if carry is not None and (not drop_last or batch_size is None):
+            yield format_batch(carry, batch_format)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for batch in self.iter_batches(batch_size=None, batch_format="pyarrow"):
+            yield from batch.to_pylist()
+
+    def iter_torch_batches(
+        self, *, batch_size: Optional[int] = 256, dtypes=None, **kwargs
+    ) -> Iterator[dict]:
+        import torch
+
+        for batch in self.iter_batches(
+            batch_size=batch_size, batch_format="numpy", **kwargs
+        ):
+            out = {}
+            for key, value in batch.items():
+                tensor = torch.as_tensor(value)
+                if dtypes is not None:
+                    want = dtypes.get(key) if isinstance(dtypes, dict) else dtypes
+                    if want is not None:
+                        tensor = tensor.to(want)
+                out[key] = tensor
+            yield out
+
+    def materialize_refs(self) -> list:
+        return list(self._factory())
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """Round-robin block assignment to n consumers (locality-blind twin of
+    the reference's streaming_split OutputSplitter; equalize=True keeps
+    per-consumer row counts within one block)."""
+
+    def __init__(self, block_refs: list, n: int):
+        self._queues: list[list] = [[] for _ in range(n)]
+        for i, ref in enumerate(block_refs):
+            self._queues[i % n].append(ref)
+
+    def get_blocks(self, rank: int) -> list:
+        return self._queues[rank]
+
+
+def streaming_split(block_refs: list, n: int) -> list[DataIterator]:
+    """n independent DataIterators over a disjoint partition of blocks."""
+    coordinator = _SplitCoordinator.remote(list(block_refs), n)
+    iterators = []
+    for rank in range(n):
+        shard_refs = ray_tpu.get(coordinator.get_blocks.remote(rank))
+
+        def factory(refs=shard_refs):
+            return iter(refs)
+
+        iterators.append(DataIterator(factory, owner_name=f"split[{rank}]"))
+    return iterators

@@ -1,0 +1,205 @@
+"""Read API — parallel datasource reads.
+
+Role-equivalent of python/ray/data/read_api.py :: read_parquet/read_csv/
+read_json/read_images/range/from_items/... (SURVEY §2.7). Each read_*
+builds a Read logical op whose read tasks run as ray_tpu tasks; file lists
+are split across `parallelism` tasks (metadata-pruned parallel reads).
+"""
+
+from __future__ import annotations
+
+import glob as globmod
+import os
+from typing import Any, Iterable, Optional
+
+from ray_tpu.data.block import BlockAccessor, DataContext
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data._internal.plan import InputData, LogicalPlan, Read
+
+
+def _resolve_paths(paths) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    files: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _, names in os.walk(path):
+                files += [
+                    os.path.join(root, n) for n in names if not n.startswith(".")
+                ]
+        elif any(ch in path for ch in "*?["):
+            files += globmod.glob(path)
+        else:
+            files.append(path)
+    return sorted(files)
+
+
+def _split_files(files: list[str], parallelism: int) -> list[list[str]]:
+    import builtins
+
+    parallelism = min(parallelism, len(files)) or 1
+    return [files[i::parallelism] for i in builtins.range(parallelism)]
+
+
+def _file_dataset(paths, parallelism: int, reader, name: str) -> Dataset:
+    files = _resolve_paths(paths)
+    if not files:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    if parallelism <= 0:
+        parallelism = min(
+            DataContext.get_current().read_op_min_num_blocks, len(files)
+        )
+    tasks = []
+    for chunk in _split_files(files, parallelism):
+        def task(chunk=chunk, reader=reader):
+            for path in chunk:
+                yield reader(path)
+
+        tasks.append(task)
+    return Dataset(LogicalPlan([Read(read_tasks=tasks, name=name)]))
+
+
+def read_parquet(paths, *, parallelism: int = -1, columns=None) -> Dataset:
+    def reader(path):
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path, columns=columns)
+
+    return _file_dataset(paths, parallelism, reader, "ReadParquet")
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    def reader(path):
+        import pyarrow.csv as pacsv
+
+        return pacsv.read_csv(path)
+
+    return _file_dataset(paths, parallelism, reader, "ReadCSV")
+
+
+def read_json(paths, *, parallelism: int = -1, lines: bool = True) -> Dataset:
+    def reader(path):
+        import pandas as pd
+        import pyarrow as pa
+
+        df = pd.read_json(path, lines=lines)
+        return pa.Table.from_pandas(df, preserve_index=False)
+
+    return _file_dataset(paths, parallelism, reader, "ReadJSON")
+
+
+def read_numpy(paths, *, parallelism: int = -1, column: str = "data") -> Dataset:
+    def reader(path):
+        import numpy as np
+
+        return BlockAccessor.for_block({column: np.load(path)}).block
+
+    return _file_dataset(paths, parallelism, reader, "ReadNumpy")
+
+
+def read_images(
+    paths, *, parallelism: int = -1, size: Optional[tuple] = None, mode: str = "RGB"
+) -> Dataset:
+    def reader(path):
+        import numpy as np
+        from PIL import Image
+
+        img = Image.open(path).convert(mode)
+        if size is not None:
+            img = img.resize(size)
+        arr = np.asarray(img)[None]  # [1, H, W, C]
+        return BlockAccessor.for_block(
+            {"image": arr, "path": np.array([path], dtype=object)}
+        ).block
+
+    return _file_dataset(paths, parallelism, reader, "ReadImages")
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    def reader(path):
+        with open(path) as f:
+            lines = [line.rstrip("\n") for line in f]
+        return BlockAccessor.for_block({"text": lines}).block
+
+    return _file_dataset(paths, parallelism, reader, "ReadText")
+
+
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    raise NotImplementedError(
+        "tfrecords need tensorflow, which is not in this image; "
+        "convert to parquet or use read_numpy"
+    )
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    import numpy as np
+
+    if parallelism <= 0:
+        parallelism = min(DataContext.get_current().read_op_min_num_blocks, max(n, 1))
+    import builtins
+
+    tasks = []
+    edges = [round(i * n / parallelism) for i in builtins.range(parallelism + 1)]
+    for i in builtins.range(parallelism):
+        lo, hi = edges[i], edges[i + 1]
+
+        def task(lo=lo, hi=hi):
+            yield BlockAccessor.for_block({"id": np.arange(lo, hi)}).block
+
+        tasks.append(task)
+    return Dataset(LogicalPlan([Read(read_tasks=tasks, name="ReadRange")]))
+
+
+def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = -1) -> Dataset:
+    import numpy as np
+
+    def to_tensor(batch):
+        ids = batch["id"]
+        data = np.broadcast_to(
+            ids.reshape((-1,) + (1,) * len(shape)), (len(ids),) + shape
+        ).copy()
+        return {"data": data}
+
+    return range(n, parallelism=parallelism).map_batches(to_tensor)
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    rows = [
+        item if isinstance(item, dict) else {"item": item} for item in items
+    ]
+    if parallelism <= 0:
+        parallelism = min(DataContext.get_current().read_op_min_num_blocks, max(len(rows), 1))
+    import builtins
+
+    chunks = [rows[i::parallelism] for i in builtins.range(parallelism)]
+    blocks = [
+        BlockAccessor.for_block(chunk).block for chunk in chunks if chunk
+    ]
+    return Dataset(LogicalPlan([InputData(blocks=blocks)]))
+
+
+def from_numpy(array, *, column: str = "data") -> Dataset:
+    return Dataset(
+        LogicalPlan([InputData(blocks=[BlockAccessor.for_block({column: array}).block])])
+    )
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset(LogicalPlan([InputData(blocks=[table])]))
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset(
+        LogicalPlan([InputData(blocks=[BlockAccessor.for_block(df).block])])
+    )
+
+
+def from_torch(torch_dataset) -> Dataset:
+    rows = []
+    for item in torch_dataset:
+        rows.append({"item": item})
+    return from_items(rows)
+
+
+def from_huggingface(hf_dataset) -> Dataset:
+    return from_arrow(hf_dataset.data.table)

@@ -1,0 +1,202 @@
+"""Job submission — run driver scripts on the cluster.
+
+Role-equivalent of python/ray/dashboard/modules/job/ :: JobSubmissionClient
++ job_manager.py (SURVEY §2.2): a detached JobManager actor spawns the
+entrypoint as a subprocess with RAYTPU_ADDRESS set (so the script's
+ray_tpu.init("auto") connects to this cluster), captures combined output,
+and tracks status PENDING → RUNNING → SUCCEEDED | FAILED | STOPPED.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Optional
+
+import ray_tpu
+
+JOB_MANAGER_NAME = "JOB_MANAGER"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class _JobManager:
+    """Detached actor owning job subprocesses."""
+
+    def __init__(self, controller_address: str):
+        self._jobs: dict[str, dict] = {}
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._controller_address = controller_address
+        self._lock = threading.Lock()
+
+    def submit(
+        self,
+        entrypoint: str,
+        submission_id: str | None = None,
+        runtime_env: dict | None = None,
+        metadata: dict | None = None,
+    ) -> str:
+        job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:8]}"
+        env = dict(os.environ)
+        env["RAYTPU_ADDRESS"] = self._controller_address
+        for key, value in ((runtime_env or {}).get("env_vars") or {}).items():
+            env[str(key)] = str(value)
+        cwd = (runtime_env or {}).get("working_dir") or None
+        with self._lock:
+            self._jobs[job_id] = {
+                "job_id": job_id,
+                "entrypoint": entrypoint,
+                "status": JobStatus.PENDING,
+                "metadata": metadata or {},
+                "logs": "",
+                "start_time": time.time(),
+                "end_time": None,
+            }
+        try:
+            proc = subprocess.Popen(
+                entrypoint,
+                shell=True,
+                env=env,
+                cwd=cwd,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        except OSError as exc:
+            with self._lock:
+                self._jobs[job_id]["status"] = JobStatus.FAILED
+                self._jobs[job_id]["logs"] = str(exc)
+                self._jobs[job_id]["end_time"] = time.time()
+            return job_id
+        with self._lock:
+            self._jobs[job_id]["status"] = JobStatus.RUNNING
+            self._procs[job_id] = proc
+        threading.Thread(
+            target=self._watch, args=(job_id, proc), daemon=True
+        ).start()
+        return job_id
+
+    def _watch(self, job_id: str, proc: subprocess.Popen) -> None:
+        for line in proc.stdout:
+            with self._lock:
+                self._jobs[job_id]["logs"] += line
+        code = proc.wait()
+        with self._lock:
+            job = self._jobs[job_id]
+            if job["status"] != JobStatus.STOPPED:
+                job["status"] = (
+                    JobStatus.SUCCEEDED if code == 0 else JobStatus.FAILED
+                )
+            job["end_time"] = time.time()
+            job["exit_code"] = code
+            self._procs.pop(job_id, None)
+
+    def status(self, job_id: str) -> Optional[str]:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            return job["status"] if job else None
+
+    def info(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            return dict(job) if job else None
+
+    def logs(self, job_id: str) -> str:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            return job["logs"] if job else ""
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(job_id)
+            if proc is None:
+                return False
+            self._jobs[job_id]["status"] = JobStatus.STOPPED
+        proc.terminate()
+        return True
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [
+                {k: v for k, v in job.items() if k != "logs"}
+                for job in self._jobs.values()
+            ]
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str | None = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        ctx = ray_tpu.get_runtime_context()
+        from ray_tpu._private import worker as worker_mod
+
+        controller = worker_mod.get_global_context().controller_addr
+        controller_address = f"{controller[0]}:{controller[1]}"
+        try:
+            self._manager = ray_tpu.get_actor(JOB_MANAGER_NAME)
+        except ValueError:
+            try:
+                self._manager = (
+                    ray_tpu.remote(_JobManager)
+                    .options(name=JOB_MANAGER_NAME, lifetime="detached")
+                    .remote(controller_address)
+                )
+            except ValueError:
+                self._manager = ray_tpu.get_actor(JOB_MANAGER_NAME)
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: str | None = None,
+        runtime_env: dict | None = None,
+        metadata: dict | None = None,
+    ) -> str:
+        return ray_tpu.get(
+            self._manager.submit.remote(
+                entrypoint, submission_id, runtime_env, metadata
+            ),
+            timeout=60,
+        )
+
+    def get_job_status(self, job_id: str) -> str:
+        status = ray_tpu.get(self._manager.status.remote(job_id), timeout=30)
+        if status is None:
+            raise ValueError(f"unknown job {job_id!r}")
+        return status
+
+    def get_job_info(self, job_id: str) -> dict:
+        info = ray_tpu.get(self._manager.info.remote(job_id), timeout=30)
+        if info is None:
+            raise ValueError(f"unknown job {job_id!r}")
+        return info
+
+    def get_job_logs(self, job_id: str) -> str:
+        return ray_tpu.get(self._manager.logs.remote(job_id), timeout=30)
+
+    def stop_job(self, job_id: str) -> bool:
+        return ray_tpu.get(self._manager.stop.remote(job_id), timeout=30)
+
+    def list_jobs(self) -> list[dict]:
+        return ray_tpu.get(self._manager.list.remote(), timeout=30)
+
+    def wait_until_finished(
+        self, job_id: str, timeout: float = 300.0
+    ) -> str:
+        deadline = time.time() + timeout
+        terminal = (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED)
+        while time.time() < deadline:
+            status = self.get_job_status(job_id)
+            if status in terminal:
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(f"job {job_id} still running after {timeout}s")

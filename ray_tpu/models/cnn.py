@@ -1,0 +1,157 @@
+"""Small conv nets for the vision benchmark configs.
+
+Covers BASELINE configs 1 (Fashion-MNIST CNN) and 3 (ResNet-18/CIFAR-10):
+a LeNet-style CNN and a compact ResNet, both pure-jax param-pytree models
+(same conventions as models/transformer.py) so they jit/shard with the
+same machinery. Convs run in NHWC which XLA maps onto the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class CNNConfig:
+    num_classes: int = 10
+    channels: Sequence[int] = (32, 64)
+    hidden: int = 128
+    in_channels: int = 1
+    image_size: int = 28
+    dtype: object = jnp.float32
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    scale = jnp.sqrt(2.0 / (kh * kw * cin))
+    return {
+        "w": (jax.random.normal(key, (kh, kw, cin, cout)) * scale).astype(dtype),
+        "b": jnp.zeros((cout,), dtype),
+    }
+
+
+def init_cnn(config: CNNConfig, key: jax.Array) -> dict:
+    keys = jax.random.split(key, len(config.channels) + 2)
+    params = {"convs": [], "dense": {}, "out": {}}
+    cin = config.in_channels
+    for i, cout in enumerate(config.channels):
+        params["convs"].append(_conv_init(keys[i], 3, 3, cin, cout, config.dtype))
+        cin = cout
+    spatial = config.image_size // (2 ** len(config.channels))
+    flat = spatial * spatial * cin
+    scale = jnp.sqrt(2.0 / flat)
+    params["dense"] = {
+        "w": (jax.random.normal(keys[-2], (flat, config.hidden)) * scale).astype(
+            config.dtype
+        ),
+        "b": jnp.zeros((config.hidden,), config.dtype),
+    }
+    scale = jnp.sqrt(2.0 / config.hidden)
+    params["out"] = {
+        "w": (
+            jax.random.normal(keys[-1], (config.hidden, config.num_classes)) * scale
+        ).astype(config.dtype),
+        "b": jnp.zeros((config.num_classes,), config.dtype),
+    }
+    return params
+
+
+def cnn_forward(params: dict, images: jax.Array, config: CNNConfig) -> jax.Array:
+    """images: [B, H, W, C] → logits [B, num_classes]."""
+    x = images
+    for conv in params["convs"]:
+        x = jax.lax.conv_general_dilated(
+            x, conv["w"], window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + conv["b"]
+        x = jax.nn.relu(x)
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+        )
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense"]["w"] + params["dense"]["b"])
+    return x @ params["out"]["w"] + params["out"]["b"]
+
+
+def cnn_loss(params: dict, images, labels, config: CNNConfig):
+    logits = cnn_forward(params, images, config)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+    return loss, accuracy
+
+
+# ---- compact ResNet (CIFAR-scale ResNet-18 stand-in) ----
+
+@dataclass
+class ResNetConfig:
+    num_classes: int = 10
+    width: int = 64
+    blocks_per_stage: Sequence[int] = (2, 2, 2, 2)  # ResNet-18 layout
+    in_channels: int = 3
+    image_size: int = 32
+    dtype: object = jnp.float32
+
+
+def init_resnet(config: ResNetConfig, key: jax.Array) -> dict:
+    n_blocks = sum(config.blocks_per_stage)
+    keys = iter(jax.random.split(key, 2 * n_blocks + n_blocks + 3))
+    params = {"stem": _conv_init(next(keys), 3, 3, config.in_channels,
+                                 config.width, config.dtype), "stages": []}
+    cin = config.width
+    for stage, blocks in enumerate(config.blocks_per_stage):
+        cout = config.width * (2 ** stage)
+        stage_params = []
+        for b in range(blocks):
+            block = {
+                "conv1": _conv_init(next(keys), 3, 3, cin, cout, config.dtype),
+                "conv2": _conv_init(next(keys), 3, 3, cout, cout, config.dtype),
+            }
+            if cin != cout:
+                block["proj"] = _conv_init(next(keys), 1, 1, cin, cout, config.dtype)
+            stage_params.append(block)
+            cin = cout
+        params["stages"].append(stage_params)
+    scale = jnp.sqrt(2.0 / cin)
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (cin, config.num_classes)) * scale
+              ).astype(config.dtype),
+        "b": jnp.zeros((config.num_classes,), config.dtype),
+    }
+    return params
+
+
+def _conv(x, p, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, p["w"], window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ) + p["b"]
+
+
+def resnet_forward(params: dict, images, config: ResNetConfig):
+    x = jax.nn.relu(_conv(images, params["stem"]))
+    for stage_idx, stage in enumerate(params["stages"]):
+        for block_idx, block in enumerate(stage):
+            stride = 2 if (stage_idx > 0 and block_idx == 0) else 1
+            shortcut = x
+            h = jax.nn.relu(_conv(x, block["conv1"], stride))
+            h = _conv(h, block["conv2"])
+            if "proj" in block:
+                shortcut = _conv(shortcut, block["proj"], stride)
+            elif stride != 1:
+                shortcut = shortcut[:, ::stride, ::stride, :]
+            x = jax.nn.relu(h + shortcut)
+    x = jnp.mean(x, axis=(1, 2))
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+def resnet_loss(params, images, labels, config: ResNetConfig):
+    logits = resnet_forward(params, images, config)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+    return loss, accuracy

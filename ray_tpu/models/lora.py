@@ -1,0 +1,109 @@
+"""LoRA — low-rank adapters for the flagship transformer.
+
+Fills the role the reference reaches via DeepSpeed/PEFT through its Torch
+integration shims (SURVEY §2.9 "integration-delegated"): here first-class.
+Adapters target the attention projections (wq/wv by default, per the LoRA
+paper): effective W = W + (alpha/r)·A@B with A:[d_in,r], B:[r,d_out].
+Only adapters train — the frozen base params can stay bfloat16 and fully
+sharded while the tiny A/B pytree is what the optimizer touches (the
+memory shape that makes multi-host Llama-2-7B LoRA cheap, BASELINE
+config 5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import TransformerConfig, forward
+
+
+@dataclass
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Sequence[str] = ("wq", "wv")
+
+
+def init_lora(
+    model_config: TransformerConfig, lora_config: LoRAConfig, key: jax.Array
+) -> dict:
+    """A ~ N(0, 1/r), B = 0 → adapters start as identity (paper init)."""
+    d = model_config.dim
+    hd = model_config.head_dim
+    out_dims = {
+        "wq": model_config.n_heads * hd,
+        "wk": model_config.n_kv_heads * hd,
+        "wv": model_config.n_kv_heads * hd,
+        "wo": d,
+    }
+    nl = model_config.n_layers
+    r = lora_config.rank
+    adapters = {}
+    keys = iter(jax.random.split(key, len(lora_config.targets)))
+    for target in lora_config.targets:
+        d_in = out_dims["wo"] if target == "wo" else d
+        d_out = out_dims[target]
+        adapters[target] = {
+            "a": jax.random.normal(next(keys), (nl, d_in, r), jnp.float32)
+            * (1.0 / r),
+            "b": jnp.zeros((nl, r, d_out), jnp.float32),
+        }
+    return adapters
+
+
+def merge_lora(params: dict, adapters: dict, lora_config: LoRAConfig) -> dict:
+    """Base params with adapters folded in: W += (alpha/r)·A@B.
+    Used for inference export; training applies adapters unmerged."""
+    scale = lora_config.alpha / lora_config.rank
+    merged_layers = dict(params["layers"])
+    for target, ab in adapters.items():
+        delta = jnp.einsum("lir,lro->lio", ab["a"], ab["b"]) * scale
+        merged_layers[target] = params["layers"][target] + delta.astype(
+            params["layers"][target].dtype
+        )
+    return {**params, "layers": merged_layers}
+
+
+def lora_forward(
+    params: dict,
+    adapters: dict,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    lora_config: LoRAConfig,
+):
+    """Forward with adapters applied (unmerged: base stays frozen).
+
+    Implementation note: the transformer's layer scan consumes stacked
+    [layer, ...] weights, so applying LoRA = adding the per-layer low-rank
+    delta to the stacked weight before the scan. XLA fuses the einsum into
+    the surrounding graph; the base weight tensor itself is not updated
+    (stop_gradient), so grads flow only to A/B.
+    """
+    frozen = jax.tree_util.tree_map(jax.lax.stop_gradient, params)
+    effective = merge_lora(frozen, adapters, lora_config)
+    return forward(effective, tokens, config)
+
+
+def lora_loss(
+    params: dict,
+    adapters: dict,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    lora_config: LoRAConfig,
+):
+    """Next-token cross entropy, differentiating w.r.t. adapters only."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = lora_forward(params, adapters, inputs, config, lora_config)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def num_lora_params(adapters: dict) -> int:
+    return sum(
+        leaf.size for leaf in jax.tree_util.tree_leaves(adapters)
+    )

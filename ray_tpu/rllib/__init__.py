@@ -1,0 +1,25 @@
+"""ray_tpu.rllib — reinforcement learning (RLlib-equivalent, TPU-first).
+
+New-API-stack architecture only (SURVEY §2.8): RLModule (jax nets),
+Learner/LearnerGroup (jitted XLA updates, DP grad-allreduce), EnvRunner
+actors (CPU gymnasium vector envs), SampleBatch, GAE/vtrace in jax, and
+PPO / IMPALA / DQN algorithms with fluent AlgorithmConfigs.
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import Learner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import MLPModule, RLModule, RLModuleSpec
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
+    "IMPALAConfig", "DQN", "DQNConfig", "Learner", "LearnerGroup",
+    "RLModule", "RLModuleSpec", "MLPModule", "SingleAgentEnvRunner",
+    "EnvRunnerGroup", "SampleBatch",
+]

@@ -1,0 +1,192 @@
+"""Algorithm — the top-level RL training loop.
+
+Role-equivalent of rllib/algorithms/algorithm.py :: Algorithm
+(SURVEY §2.8, §3.5): owns an EnvRunnerGroup + LearnerGroup; train() runs
+one iteration (sample → learner update → weight sync → metrics); save()/
+from_checkpoint() round-trip learner + config state; evaluate() runs
+greedy episodes. Doubles as a Tune trainable via the same step() protocol
+(ray_tpu.tune.Trainable duck-type).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Optional
+
+import gymnasium as gym
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+
+
+class Algorithm:
+    learner_class = None  # subclasses set
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._start = time.time()
+        spec = config.rl_module_spec or RLModuleSpec(
+            model_config=dict(config.model)
+        )
+        probe_env = gym.make(config.env, **config.env_config) if isinstance(
+            config.env, str
+        ) else config.env(config.env_config)
+        self.observation_space = probe_env.observation_space
+        self.action_space = probe_env.action_space
+        probe_env.close()
+
+        self.learner_group = LearnerGroup(
+            self.learner_class,
+            spec,
+            self.observation_space,
+            self.action_space,
+            self._learner_config(),
+            num_learners=config.num_learners,
+        )
+        self.env_runner_group = EnvRunnerGroup(
+            self._env_creator(),
+            spec,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_env_runner,
+            rollout_fragment_length=config.rollout_fragment_length,
+            seed=config.seed,
+        )
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def _env_creator(self):
+        config = self.config
+
+        if isinstance(config.env, str):
+            env_id = config.env
+            env_config = dict(config.env_config)
+
+            def creator(num_envs: int):
+                return gym.make_vec(env_id, num_envs=num_envs, **env_config)
+
+            return creator
+        return config.env
+
+    def _learner_config(self) -> dict:
+        return self.config.learner_config_dict()
+
+    # -- the iteration ---------------------------------------------------
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def train(self) -> dict:
+        metrics = self.training_step() or {}
+        self.iteration += 1
+        runner_metrics = self.env_runner_group.get_metrics()
+        result = {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "time_total_s": time.time() - self._start,
+            "env_runners": runner_metrics,
+            **{f"learner/{k}": v for k, v in metrics.items()},
+        }
+        result["episode_return_mean"] = runner_metrics.get(
+            "episode_return_mean", np.nan
+        )
+        if (
+            self.config.evaluation_interval
+            and self.iteration % self.config.evaluation_interval == 0
+        ):
+            result["evaluation"] = self.evaluate()
+        return result
+
+    # tune.Trainable duck-type
+    def step(self) -> dict:
+        return self.train()
+
+    def evaluate(self) -> dict:
+        """Greedy episodes on a fresh env (evaluation duck-type of the
+        reference's evaluation workers)."""
+        env = (
+            gym.make(self.config.env, **self.config.env_config)
+            if isinstance(self.config.env, str)
+            else self.config.env(self.config.env_config)
+        )
+        spec = self.config.rl_module_spec or RLModuleSpec(
+            model_config=dict(self.config.model)
+        )
+        module = spec.build(self.observation_space, self.action_space)
+        import jax
+
+        params = self.learner_group.get_weights()
+        fwd = jax.jit(module.forward_inference)
+        returns = []
+        for _ in range(self.config.evaluation_duration):
+            obs, _ = env.reset()
+            total, done = 0.0, False
+            while not done:
+                action = np.asarray(fwd(params, obs[None]))[0]
+                obs, reward, term, trunc, _ = env.step(
+                    action.item() if action.shape == () else action
+                )
+                total += reward
+                done = term or trunc
+            returns.append(total)
+        env.close()
+        return {
+            "episode_return_mean": float(np.mean(returns)),
+            "num_episodes": len(returns),
+        }
+
+    # -- checkpointing ----------------------------------------------------
+    def save(self, checkpoint_dir: str | None = None) -> str:
+        checkpoint_dir = checkpoint_dir or os.path.join(
+            os.path.expanduser("~/ray_tpu_results"),
+            f"{type(self).__name__.lower()}_ckpt_{self.iteration}",
+        )
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        state = {
+            "learner": self.learner_group.get_state(),
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+            "config": self.config.to_dict(),
+            "algo_class": type(self).__name__,
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir: str, config: AlgorithmConfig):
+        algo = config.build_algo()
+        algo.restore(checkpoint_dir)
+        return algo
+
+    # tune.Trainable duck-type
+    def save_checkpoint(self) -> Any:
+        state = {
+            "learner": self.learner_group.get_state(),
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+        return pickle.dumps(state)
+
+    def load_checkpoint(self, blob: Any) -> None:
+        state = pickle.loads(blob)
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def stop(self) -> None:
+        self.env_runner_group.stop()
+        self.learner_group.stop()

@@ -1,0 +1,158 @@
+"""DQN — double Q-learning with (prioritized) replay.
+
+Role-equivalent of rllib/algorithms/dqn/dqn.py + dqn_rainbow_learner
+(SURVEY §2.8): epsilon-greedy rollouts into a replay buffer, double-DQN
+targets (online net argmax, target net value), periodic target sync, and
+the TD update jitted end-to-end. Dueling/n-step kept out for clarity;
+prioritized replay is config-switchable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS, NEXT_OBS, OBS, REWARDS, SampleBatch, TERMINATEDS,
+)
+from ray_tpu.rllib.utils.replay_buffers import (
+    PrioritizedReplayBuffer, ReplayBuffer,
+)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DQN)
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.replay_buffer_capacity: int = 50_000
+        self.prioritized_replay: bool = False
+        self.num_steps_sampled_before_learning_starts: int = 1000
+        self.target_network_update_freq: int = 500  # env steps
+        self.epsilon_initial: float = 1.0
+        self.epsilon_final: float = 0.05
+        self.epsilon_timesteps: int = 10_000
+        self.double_q: bool = True
+        self.updates_per_iteration: int = 50
+        self.rollout_fragment_length = 4
+
+
+class DQNLearner(Learner):
+    """Q-net learner; module's pi tower doubles as the Q head."""
+
+    def __init__(self, module, config, seed: int = 0):
+        super().__init__(module, config, seed)
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+
+    def compute_loss(self, params, batch: dict):
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        q_all = self.module.forward_train(params, batch[OBS])["logits"]
+        actions = batch[ACTIONS].astype(jnp.int32)
+        q = jnp.take_along_axis(q_all, actions[:, None], axis=-1)[:, 0]
+        q_next_target = self.module.forward_train(
+            batch["target_params"], batch[NEXT_OBS]
+        )["logits"]
+        if cfg.get("double_q", True):
+            q_next_online = self.module.forward_train(params, batch[NEXT_OBS])[
+                "logits"
+            ]
+            next_actions = jnp.argmax(q_next_online, axis=-1)
+        else:
+            next_actions = jnp.argmax(q_next_target, axis=-1)
+        q_next = jnp.take_along_axis(
+            q_next_target, next_actions[:, None], axis=-1
+        )[:, 0]
+        not_done = 1.0 - batch[TERMINATEDS].astype(jnp.float32)
+        target = batch[REWARDS] + gamma * not_done * jax.lax.stop_gradient(q_next)
+        td_error = q - target
+        weights = batch.get("weights", jnp.ones_like(q))
+        loss = jnp.mean(weights * td_error**2)
+        return loss, {"td_error_mean": jnp.mean(jnp.abs(td_error))}
+
+    def update(self, batch: SampleBatch) -> dict:
+        device_batch = {k: jnp.asarray(v) for k, v in batch.items()
+                        if k != "batch_indexes"}
+        device_batch["target_params"] = self.target_params
+        self.params, self.opt_state, metrics = self._step(
+            self.params, self.opt_state, device_batch
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def sync_target(self) -> None:
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+
+
+class DQN(Algorithm):
+    learner_class = DQNLearner
+
+    def __init__(self, config):
+        super().__init__(config)
+        buffer_cls = (
+            PrioritizedReplayBuffer if config.prioritized_replay else ReplayBuffer
+        )
+        self.replay = buffer_cls(config.replay_buffer_capacity, seed=config.seed)
+        self._steps_since_target_sync = 0
+
+    def _learner_config(self) -> dict:
+        cfg = super()._learner_config()
+        cfg.update(double_q=self.config.double_q)
+        return cfg
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._total_env_steps / max(1, cfg.epsilon_timesteps))
+        return cfg.epsilon_initial + frac * (
+            cfg.epsilon_final - cfg.epsilon_initial
+        )
+
+    def training_step(self) -> dict:
+        config = self.config
+        # 1. collect with epsilon-greedy IN the runners (greedy action with
+        #    prob 1-ε, uniform random with prob ε, applied before env.step
+        #    so replay transitions are consistent).
+        eps = self._epsilon()
+        import ray_tpu as _rt
+
+        _rt.get(
+            [
+                r.set_epsilon.remote(eps)
+                for r in self.env_runner_group.runners
+            ],
+            timeout=60,
+        )
+        fragment = self.env_runner_group.sample()
+        self._total_env_steps += len(fragment)
+        self._steps_since_target_sync += len(fragment)
+        self.replay.add(fragment)
+
+        metrics: dict = {"epsilon": eps, "buffer_size": len(self.replay)}
+        if len(self.replay) < config.num_steps_sampled_before_learning_starts:
+            return metrics
+        # 2. replayed TD updates
+        learner = self._local_dqn_learner()
+        for _ in range(config.updates_per_iteration):
+            batch = self.replay.sample(config.train_batch_size)
+            update_metrics = learner.update(batch)
+            if config.prioritized_replay and "batch_indexes" in batch:
+                self.replay.update_priorities(
+                    batch["batch_indexes"],
+                    np.full(len(batch), update_metrics["td_error_mean"]),
+                )
+        metrics.update(update_metrics)
+        # 3. target sync + weight broadcast
+        if self._steps_since_target_sync >= config.target_network_update_freq:
+            learner.sync_target()
+            self._steps_since_target_sync = 0
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return metrics
+
+    def _local_dqn_learner(self) -> DQNLearner:
+        assert self.learner_group.local_learner is not None, (
+            "DQN uses a local learner (num_learners=0)"
+        )
+        return self.learner_group.local_learner

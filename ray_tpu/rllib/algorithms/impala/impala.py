@@ -1,0 +1,165 @@
+"""IMPALA — async sampling + V-trace off-policy correction.
+
+Role-equivalent of rllib/algorithms/impala/impala.py (+ the vtrace math of
+rllib/algorithms/impala/torch/vtrace_torch_v2.py, originally the IMPALA
+paper's tf implementation), TPU-first (SURVEY §2.8, §3.5): env runners
+push rollouts continuously (async queue via EnvRunnerGroup.collect_ready),
+the learner consumes whatever arrived — stale-by-k policies corrected with
+V-trace importance weights ρ/c — and the whole update is one jitted XLA
+function.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTION_LOGP, ACTIONS, EPS_ID, NEXT_OBS, OBS, REWARDS, SampleBatch,
+    TERMINATEDS, TRUNCATEDS,
+)
+
+
+def vtrace(
+    behaviour_logp,
+    target_logp,
+    rewards,
+    values,
+    bootstrap_value,
+    discounts,
+    clip_rho_threshold: float = 1.0,
+    clip_c_threshold: float = 1.0,
+):
+    """V-trace targets (Espeholt et al. 2018) over one [T] sequence, in
+    jax with a backward lax.scan (XLA-friendly — no Python loop)."""
+    rhos = jnp.exp(target_logp - behaviour_logp)
+    clipped_rhos = jnp.minimum(clip_rho_threshold, rhos)
+    clipped_cs = jnp.minimum(clip_c_threshold, rhos)
+    next_values = jnp.concatenate([values[1:], bootstrap_value[None]])
+    deltas = clipped_rhos * (rewards + discounts * next_values - values)
+
+    def backward(acc, inputs):
+        delta_t, discount_t, c_t = inputs
+        acc = delta_t + discount_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward,
+        jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, clipped_cs),
+        reverse=True,
+    )
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], bootstrap_value[None]])
+    pg_advantages = clipped_rhos * (rewards + discounts * next_vs - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_advantages)
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or IMPALA)
+        self.lr = 5e-4
+        self.train_batch_size = 500
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.clip_rho_threshold: float = 1.0
+        self.clip_c_threshold: float = 1.0
+        self.max_queue_len: int = 8
+        self.rollout_fragment_length = 50
+
+
+class IMPALALearner(Learner):
+    def compute_loss(self, params, batch: dict):
+        cfg = self.config
+        logp, entropy, vf = self.module.action_logp(
+            params, batch[OBS], batch[ACTIONS]
+        )
+        # [T] sequences laid out env-major & episode-contiguous by the
+        # runner; treat the whole fragment as one sequence with discounts
+        # zeroed at episode ends (the standard flattened-vtrace trick).
+        done = jnp.logical_or(batch[TERMINATEDS], batch[TRUNCATEDS])
+        discounts = cfg.get("gamma", 0.99) * (1.0 - done.astype(jnp.float32))
+        vs, pg_adv = vtrace(
+            batch[ACTION_LOGP],
+            logp,
+            batch[REWARDS],
+            vf,
+            batch["bootstrap_value"][0],
+            discounts,
+            cfg.get("clip_rho_threshold", 1.0),
+            cfg.get("clip_c_threshold", 1.0),
+        )
+        policy_loss = -jnp.mean(logp * pg_adv)
+        vf_loss = 0.5 * jnp.mean((vf - vs) ** 2)
+        entropy_mean = jnp.mean(entropy)
+        total = (
+            policy_loss
+            + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+            - cfg.get("entropy_coeff", 0.01) * entropy_mean
+        )
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy_mean,
+        }
+
+
+class IMPALA(Algorithm):
+    learner_class = IMPALALearner
+
+    def _learner_config(self) -> dict:
+        cfg = super()._learner_config()
+        cfg.update(
+            vf_loss_coeff=self.config.vf_loss_coeff,
+            entropy_coeff=self.config.entropy_coeff,
+            clip_rho_threshold=self.config.clip_rho_threshold,
+            clip_c_threshold=self.config.clip_c_threshold,
+        )
+        return cfg
+
+    def training_step(self) -> dict:
+        config = self.config
+        # Async harvest: take whatever fragments finished; runners are
+        # immediately re-submitted (continuous sampling).
+        ready = self.env_runner_group.collect_ready(timeout=10.0)
+        if not ready:
+            return {}
+        metrics: dict = {}
+        trained = 0
+        for fragment in ready[: config.max_queue_len]:
+            self._total_env_steps += len(fragment)
+            fragment["bootstrap_value"] = np.full(
+                len(fragment), self._bootstrap_value(fragment), dtype=np.float32
+            )
+            metrics = self.learner_group.update(fragment)
+            trained += len(fragment)
+        # Weights go back at iteration cadence (runners run off-policy).
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        metrics["num_env_steps_trained"] = trained
+        return metrics
+
+    def _bootstrap_value(self, fragment: SampleBatch) -> float:
+        if bool(fragment[TERMINATEDS][-1]):
+            return 0.0
+        if not hasattr(self, "_vf_jit"):
+            from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+            spec = self.config.rl_module_spec or RLModuleSpec(
+                model_config=dict(self.config.model)
+            )
+            self._vf_module = spec.build(
+                self.observation_space, self.action_space
+            )
+            self._vf_jit = jax.jit(
+                lambda params, obs: self._vf_module.forward_train(params, obs)["vf"]
+            )
+        params = self.learner_group.get_weights()
+        return float(
+            np.asarray(
+                self._vf_jit(params, jnp.asarray(fragment[NEXT_OBS][-1][None]))
+            )[0]
+        )

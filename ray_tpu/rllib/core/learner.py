@@ -1,0 +1,230 @@
+"""Learner / LearnerGroup — the accelerator-side update.
+
+Role-equivalents of rllib/core/learner/learner.py :: Learner and
+learner_group.py :: LearnerGroup (SURVEY §2.8, §3.5), TPU-first per the
+north star: the entire SGD step — loss, grads, optimizer — is ONE jitted
+XLA function (donated params/opt-state, bfloat16-friendly), so on TPU the
+update never leaves the device. Multi-learner data parallelism shards the
+train batch across learner actors and ring-allreduces gradients through
+ray_tpu.util.collective (ICI's psum inside jit when the learners share a
+jax mesh; the eager ring on CPU twins).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class Learner:
+    """Owns params + optimizer; subclasses define compute_loss."""
+
+    def __init__(self, module, config: dict, seed: int = 0):
+        self.module = module
+        self.config = dict(config)
+        self.params = module.init_params(jax.random.PRNGKey(seed))
+        self.optimizer = self._build_optimizer()
+        self.opt_state = self.optimizer.init(self.params)
+        self._step = jax.jit(self._jit_step, donate_argnums=(0, 1))
+        self._grad_only = jax.jit(jax.grad(self._loss_for_grads))
+        self._apply = jax.jit(self._jit_apply, donate_argnums=(0, 1))
+
+    def _build_optimizer(self):
+        lr = self.config.get("lr", 5e-4)
+        clip = self.config.get("grad_clip", 40.0)
+        return optax.chain(
+            optax.clip_by_global_norm(clip),
+            optax.adam(lr),
+        )
+
+    # -- subclass surface -----------------------------------------------
+    def compute_loss(self, params, batch: dict) -> tuple[jnp.ndarray, dict]:
+        raise NotImplementedError
+
+    # -- jitted internals -----------------------------------------------
+    def _loss_for_grads(self, params, batch):
+        loss, _ = self.compute_loss(params, batch)
+        return loss
+
+    def _jit_step(self, params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            self.compute_loss, has_aux=True
+        )(params, batch)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics["total_loss"] = loss
+        return params, opt_state, metrics
+
+    def _jit_apply(self, params, opt_state, grads):
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state
+
+    # -- public ----------------------------------------------------------
+    def update(self, batch: SampleBatch) -> dict:
+        device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._step(
+            self.params, self.opt_state, device_batch
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def compute_gradients(self, batch: SampleBatch):
+        device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        return self._grad_only(self.params, device_batch)
+
+    def apply_gradients(self, grads) -> None:
+        self.params, self.opt_state = self._apply(
+            self.params, self.opt_state, grads
+        )
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        self.params = jax.device_put(params)
+
+    def get_state(self) -> dict:
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+
+
+class _LearnerActor:
+    """Hosts one Learner shard for multi-learner DP."""
+
+    def __init__(self, learner_cls, module_spec, obs_space, act_space,
+                 config: dict, rank: int, world_size: int, group_name: str):
+        module = module_spec.build(obs_space, act_space)
+        self.learner: Learner = learner_cls(module, config, seed=0)
+        self.rank = rank
+        self.world_size = world_size
+        self.group_name = group_name
+        if world_size > 1:
+            from ray_tpu.util.collective import collective
+
+            collective.init_collective_group(
+                world_size, rank, backend="ring", group_name=group_name
+            )
+
+    def update_shard(self, batch: SampleBatch) -> dict:
+        """DDP step: local grads → ring allreduce → apply (SURVEY §3.5)."""
+        if self.world_size == 1:
+            return self.learner.update(batch)
+        from ray_tpu.util.collective import collective
+
+        grads = self.learner.compute_gradients(batch)
+        flat, tree = jax.tree_util.tree_flatten(grads)
+        group = collective.get_group(self.group_name)
+        reduced = []
+        for g in flat:
+            arr = np.asarray(g)
+            group.allreduce(arr)
+            reduced.append(arr / self.world_size)
+        self.learner.apply_gradients(jax.tree_util.tree_unflatten(tree, reduced))
+        return {"total_loss": float("nan")}
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, params) -> str:
+        self.learner.set_weights(params)
+        return "ok"
+
+    def get_state(self):
+        return self.learner.get_state()
+
+    def set_state(self, state) -> str:
+        self.learner.set_state(state)
+        return "ok"
+
+    def ping(self) -> str:
+        return "ok"
+
+
+class LearnerGroup:
+    """num_learners=0 → local in-process learner (default, single chip).
+    num_learners>=1 → learner actors with DP grad-allreduce."""
+
+    def __init__(
+        self,
+        learner_cls,
+        module_spec,
+        observation_space,
+        action_space,
+        config: dict,
+        num_learners: int = 0,
+    ):
+        self.num_learners = num_learners
+        if num_learners == 0:
+            module = module_spec.build(observation_space, action_space)
+            self.local_learner: Optional[Learner] = learner_cls(module, config)
+            self.actors = []
+        else:
+            self.local_learner = None
+            actor_cls = ray_tpu.remote(_LearnerActor)
+            group_name = f"learner-dp-{id(self) & 0xFFFF:x}"
+            self.actors = [
+                actor_cls.options(num_cpus=1).remote(
+                    learner_cls, module_spec, observation_space, action_space,
+                    config, rank, num_learners, group_name,
+                )
+                for rank in range(num_learners)
+            ]
+            ray_tpu.get([a.ping.remote() for a in self.actors], timeout=180)
+
+    def update(self, batch: SampleBatch) -> dict:
+        if self.local_learner is not None:
+            return self.local_learner.update(batch)
+        n = len(self.actors)
+        shard = max(1, len(batch) // n)
+        shards = [batch.slice(i * shard, (i + 1) * shard) for i in range(n)]
+        metrics = ray_tpu.get(
+            [a.update_shard.remote(s) for a, s in zip(self.actors, shards)],
+            timeout=600,
+        )
+        return metrics[0]
+
+    def get_weights(self):
+        if self.local_learner is not None:
+            return self.local_learner.get_weights()
+        return ray_tpu.get(self.actors[0].get_weights.remote(), timeout=120)
+
+    def set_weights(self, params) -> None:
+        if self.local_learner is not None:
+            self.local_learner.set_weights(params)
+        else:
+            ray_tpu.get(
+                [a.set_weights.remote(params) for a in self.actors], timeout=120
+            )
+
+    def get_state(self) -> dict:
+        if self.local_learner is not None:
+            return self.local_learner.get_state()
+        return ray_tpu.get(self.actors[0].get_state.remote(), timeout=120)
+
+    def set_state(self, state: dict) -> None:
+        if self.local_learner is not None:
+            self.local_learner.set_state(state)
+        else:
+            ray_tpu.get(
+                [a.set_state.remote(state) for a in self.actors], timeout=120
+            )
+
+    def stop(self) -> None:
+        for actor in self.actors:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass

@@ -1,0 +1,149 @@
+"""RLModule — the framework-native policy/value network.
+
+Role-equivalent of rllib/core/rl_module/rl_module.py :: RLModule (and
+torch/torch_rl_module.py) re-designed for jax (SURVEY §2.8, §3.5): a pure
+function suite over a params pytree — forward_inference (greedy),
+forward_exploration (sample + logp), forward_train (logits + values) —
+so the learner can jit the whole update and env runners call the same
+functions on CPU. `MLPModule` is the default catalog net (fcnet-equivalent
+of rllib/models :: ModelCatalog).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RLModuleSpec:
+    def __init__(self, module_class=None, model_config: dict | None = None):
+        self.module_class = module_class or MLPModule
+        self.model_config = dict(model_config or {})
+
+    def build(self, observation_space, action_space) -> "RLModule":
+        return self.module_class(
+            observation_space, action_space, self.model_config
+        )
+
+
+class RLModule:
+    """Stateless apart from construction metadata; params live outside."""
+
+    def __init__(self, observation_space, action_space, model_config: dict):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.model_config = model_config
+
+    def init_params(self, rng: jax.Array) -> Any:
+        raise NotImplementedError
+
+    def forward_train(self, params, obs) -> dict:
+        """returns {"logits"| "mean/log_std", "vf"}"""
+        raise NotImplementedError
+
+    def forward_inference(self, params, obs) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def forward_exploration(self, params, obs, rng) -> tuple:
+        """returns (actions, logp, extra)"""
+        raise NotImplementedError
+
+
+def _mlp_init(rng, sizes):
+    params = []
+    for i, (n_in, n_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        rng, key = jax.random.split(rng)
+        scale = jnp.sqrt(2.0 / n_in)
+        params.append(
+            {
+                "w": jax.random.normal(key, (n_in, n_out)) * scale,
+                "b": jnp.zeros((n_out,)),
+            }
+        )
+    return params
+
+
+def _mlp_apply(layers, x, activation=jax.nn.tanh):
+    for layer in layers[:-1]:
+        x = activation(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return x @ last["w"] + last["b"]
+
+
+class MLPModule(RLModule):
+    """Separate policy and value MLP towers (fcnet default: 2x256 tanh)."""
+
+    def __init__(self, observation_space, action_space, model_config):
+        super().__init__(observation_space, action_space, model_config)
+        self.hiddens = tuple(model_config.get("fcnet_hiddens", (256, 256)))
+        self.obs_dim = int(np.prod(observation_space.shape))
+        self.discrete = hasattr(action_space, "n")
+        if self.discrete:
+            self.num_outputs = int(action_space.n)
+        else:
+            self.act_dim = int(np.prod(action_space.shape))
+            self.num_outputs = 2 * self.act_dim  # mean + log_std
+
+    def init_params(self, rng) -> dict:
+        pi_rng, vf_rng = jax.random.split(rng)
+        return {
+            "pi": _mlp_init(pi_rng, (self.obs_dim, *self.hiddens, self.num_outputs)),
+            "vf": _mlp_init(vf_rng, (self.obs_dim, *self.hiddens, 1)),
+        }
+
+    def forward_train(self, params, obs) -> dict:
+        obs = obs.reshape(obs.shape[0], -1)
+        out = _mlp_apply(params["pi"], obs)
+        vf = _mlp_apply(params["vf"], obs)[..., 0]
+        if self.discrete:
+            return {"logits": out, "vf": vf}
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return {"mean": mean, "log_std": jnp.clip(log_std, -20, 2), "vf": vf}
+
+    def forward_inference(self, params, obs):
+        fwd = self.forward_train(params, obs)
+        if self.discrete:
+            return jnp.argmax(fwd["logits"], axis=-1)
+        return fwd["mean"]
+
+    def forward_exploration(self, params, obs, rng):
+        fwd = self.forward_train(params, obs)
+        if self.discrete:
+            logits = fwd["logits"]
+            actions = jax.random.categorical(rng, logits)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, actions[:, None], axis=-1
+            )[:, 0]
+            return actions, logp, {"vf_preds": fwd["vf"]}
+        mean, log_std = fwd["mean"], fwd["log_std"]
+        std = jnp.exp(log_std)
+        noise = jax.random.normal(rng, mean.shape)
+        actions = mean + std * noise
+        logp = -0.5 * jnp.sum(
+            ((actions - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi),
+            axis=-1,
+        )
+        return actions, logp, {"vf_preds": fwd["vf"]}
+
+    def action_logp(self, params, obs, actions) -> tuple:
+        """(logp(actions), entropy, vf) — used inside losses."""
+        fwd = self.forward_train(params, obs)
+        if self.discrete:
+            logp_all = jax.nn.log_softmax(fwd["logits"])
+            logp = jnp.take_along_axis(
+                logp_all, actions[:, None].astype(jnp.int32), axis=-1
+            )[:, 0]
+            entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+            return logp, entropy, fwd["vf"]
+        mean, log_std = fwd["mean"], fwd["log_std"]
+        std = jnp.exp(log_std)
+        logp = -0.5 * jnp.sum(
+            ((actions - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi),
+            axis=-1,
+        )
+        entropy = jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+        return logp, entropy, fwd["vf"]

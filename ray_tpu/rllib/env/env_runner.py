@@ -1,0 +1,177 @@
+"""EnvRunner — CPU rollout actors.
+
+Role-equivalent of rllib/env/single_agent_env_runner.py ::
+SingleAgentEnvRunner (SURVEY §2.8, §3.5): gymnasium vector envs stepped in
+a hot loop, actions from RLModule.forward_exploration on CPU, fixed-length
+rollout fragments returned as SampleBatch (the connector pipeline here is
+the obs/action flatten + logp/vf bookkeeping inline). Stays on CPU in the
+TPU build — learners own the accelerator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTION_LOGP, ACTIONS, EPS_ID, NEXT_OBS, OBS, REWARDS, SampleBatch,
+    TERMINATEDS, TRUNCATEDS, VF_PREDS,
+)
+
+
+class SingleAgentEnvRunner:
+    """One actor per runner; `sample()` returns a rollout fragment."""
+
+    def __init__(
+        self,
+        env_creator: Callable[[], Any] | str,
+        module_spec,
+        *,
+        num_envs: int = 1,
+        rollout_fragment_length: int = 200,
+        worker_index: int = 0,
+        explore: bool = True,
+        seed: Optional[int] = None,
+    ):
+        import gymnasium as gym
+
+        if isinstance(env_creator, str):
+            env_id = env_creator
+            self.env = gym.make_vec(env_id, num_envs=num_envs)
+        else:
+            self.env = env_creator(num_envs)
+        self.num_envs = num_envs
+        self.rollout_fragment_length = rollout_fragment_length
+        self.explore = explore
+        self.module = module_spec.build(
+            self.env.single_observation_space, self.env.single_action_space
+        )
+        self._params = None
+        self._rng = jax.random.PRNGKey(
+            seed if seed is not None else worker_index * 1000 + 17
+        )
+        self._fwd = jax.jit(self.module.forward_exploration)
+        self._fwd_greedy = jax.jit(self.module.forward_inference)
+        seed_val = None if seed is None else seed + worker_index
+        self._obs, _ = self.env.reset(seed=seed_val)
+        # Epsilon-greedy override (DQN-style): when set, actions are greedy
+        # w.r.t. the module with prob 1-ε and uniform-random with prob ε —
+        # applied BEFORE stepping the env so replay data stays consistent.
+        self._epsilon: Optional[float] = None
+        self._np_rng = np.random.default_rng(
+            (seed if seed is not None else 0) * 7919 + worker_index
+        )
+        self._eps_ids = np.arange(num_envs, dtype=np.int64) + worker_index * 10_000_000
+        self._next_eps = self._eps_ids.max() + 1
+        self._episode_returns = np.zeros(num_envs)
+        self._episode_lens = np.zeros(num_envs, dtype=np.int64)
+        self._completed: list[tuple[float, int]] = []
+
+    # -- weights sync ----------------------------------------------------
+    def set_weights(self, params) -> str:
+        self._params = jax.device_put(params)
+        return "ok"
+
+    def set_epsilon(self, epsilon: Optional[float]) -> str:
+        self._epsilon = epsilon
+        return "ok"
+
+    def get_weights(self):
+        return self._params
+
+    # -- rollout ---------------------------------------------------------
+    def sample(self, num_steps: int | None = None) -> SampleBatch:
+        assert self._params is not None, "set_weights before sample"
+        steps = num_steps or self.rollout_fragment_length
+        cols: dict[str, list] = {
+            OBS: [], ACTIONS: [], REWARDS: [], TERMINATEDS: [],
+            TRUNCATEDS: [], NEXT_OBS: [], ACTION_LOGP: [], VF_PREDS: [],
+            EPS_ID: [],
+        }
+        for _ in range(steps):
+            self._rng, key = jax.random.split(self._rng)
+            if self._epsilon is not None:
+                actions = np.asarray(self._fwd_greedy(self._params, self._obs))
+                mask = self._np_rng.random(self.num_envs) < self._epsilon
+                if mask.any():
+                    actions = np.where(
+                        mask,
+                        self._np_rng.integers(
+                            0, self.env.single_action_space.n, self.num_envs
+                        ),
+                        actions,
+                    )
+                logp = np.zeros(self.num_envs)
+                vf = np.zeros(self.num_envs)
+            elif self.explore:
+                actions, logp, extra = self._fwd(self._params, self._obs, key)
+                vf = extra["vf_preds"]
+            else:
+                actions = self._fwd_greedy(self._params, self._obs)
+                logp = np.zeros(self.num_envs)
+                vf = np.zeros(self.num_envs)
+            actions_np = np.asarray(actions)
+            env_actions = actions_np
+            next_obs, rewards, terms, truncs, _ = self.env.step(env_actions)
+            cols[OBS].append(self._obs)
+            cols[ACTIONS].append(actions_np)
+            cols[REWARDS].append(np.asarray(rewards, dtype=np.float32))
+            cols[TERMINATEDS].append(terms)
+            cols[TRUNCATEDS].append(truncs)
+            cols[NEXT_OBS].append(next_obs)
+            cols[ACTION_LOGP].append(np.asarray(logp))
+            cols[VF_PREDS].append(np.asarray(vf))
+            cols[EPS_ID].append(self._eps_ids.copy())
+
+            self._episode_returns += rewards
+            self._episode_lens += 1
+            done = np.logical_or(terms, truncs)
+            for i in np.nonzero(done)[0]:
+                self._completed.append(
+                    (float(self._episode_returns[i]), int(self._episode_lens[i]))
+                )
+                self._episode_returns[i] = 0.0
+                self._episode_lens[i] = 0
+                self._eps_ids[i] = self._next_eps
+                self._next_eps += 1
+            self._obs = next_obs
+
+        # [T, B, ...] → flatten env-major so each env's steps stay contiguous
+        # (episode boundaries remain detectable via EPS_ID).
+        def flat(stacked: list) -> np.ndarray:
+            arr = np.stack(stacked)  # [T, B, ...]
+            return np.swapaxes(arr, 0, 1).reshape(
+                (arr.shape[0] * arr.shape[1],) + arr.shape[2:]
+            )
+
+        return SampleBatch({k: flat(v) for k, v in cols.items()})
+
+    def sample_episodes(self, num_episodes: int) -> SampleBatch:
+        batches = []
+        completed_before = len(self._completed)
+        while len(self._completed) - completed_before < num_episodes:
+            batches.append(self.sample(self.rollout_fragment_length))
+        return SampleBatch.concat_samples(batches)
+
+    # -- metrics ---------------------------------------------------------
+    def get_metrics(self) -> dict:
+        episodes = self._completed[-100:]
+        out = {
+            "num_episodes": len(self._completed),
+            "episode_return_mean": (
+                float(np.mean([r for r, _ in episodes])) if episodes else np.nan
+            ),
+            "episode_len_mean": (
+                float(np.mean([l for _, l in episodes])) if episodes else np.nan
+            ),
+        }
+        return out
+
+    def ping(self) -> str:
+        return "ok"
+
+    def stop(self) -> str:
+        self.env.close()
+        return "ok"

@@ -1,0 +1,108 @@
+"""EnvRunnerGroup — manages the fleet of rollout actors.
+
+Role-equivalent of rllib/env/env_runner_group.py :: EnvRunnerGroup
+(SURVEY §2.8): spawns N SingleAgentEnvRunner actors, fans out sample()
+(sync for PPO, async queue-style for IMPALA via sample_async/collect),
+broadcasts weights, aggregates runner metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class EnvRunnerGroup:
+    def __init__(
+        self,
+        env_creator: Any,
+        module_spec,
+        *,
+        num_env_runners: int = 2,
+        num_envs_per_runner: int = 1,
+        rollout_fragment_length: int = 200,
+        seed: Optional[int] = None,
+    ):
+        runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self.num_env_runners = max(1, num_env_runners)
+        self.runners = [
+            runner_cls.options(num_cpus=1).remote(
+                env_creator,
+                module_spec,
+                num_envs=num_envs_per_runner,
+                rollout_fragment_length=rollout_fragment_length,
+                worker_index=i,
+                seed=seed,
+            )
+            for i in range(self.num_env_runners)
+        ]
+        ray_tpu.get([r.ping.remote() for r in self.runners], timeout=180)
+        self._inflight: dict = {}
+
+    def sync_weights(self, params) -> None:
+        ref = ray_tpu.put(params)
+        ray_tpu.get(
+            [r.set_weights.remote(ref) for r in self.runners], timeout=120
+        )
+
+    def sample(self) -> SampleBatch:
+        """Synchronous fan-out (PPO path)."""
+        batches = ray_tpu.get(
+            [r.sample.remote() for r in self.runners], timeout=600
+        )
+        return SampleBatch.concat_samples(batches)
+
+    # -- async pipeline (IMPALA path) -----------------------------------
+    def sample_async(self) -> None:
+        for i, runner in enumerate(self.runners):
+            if i not in self._inflight:
+                self._inflight[i] = runner.sample.remote()
+
+    def collect_ready(self, timeout: float = 0.05) -> list[SampleBatch]:
+        """Harvest finished rollouts; immediately resubmit those runners."""
+        if not self._inflight:
+            self.sample_async()
+        ref_to_idx = {ref: i for i, ref in self._inflight.items()}
+        ready, _ = ray_tpu.wait(
+            list(ref_to_idx), num_returns=len(ref_to_idx), timeout=timeout
+        )
+        out = []
+        for ref in ready:
+            idx = ref_to_idx[ref]
+            try:
+                out.append(ray_tpu.get(ref))
+            finally:
+                self._inflight[idx] = self.runners[idx].sample.remote()
+        return out
+
+    def get_metrics(self) -> dict:
+        metrics = ray_tpu.get(
+            [r.get_metrics.remote() for r in self.runners], timeout=120
+        )
+        returns = [
+            m["episode_return_mean"]
+            for m in metrics
+            if not np.isnan(m.get("episode_return_mean", np.nan))
+        ]
+        lens = [
+            m["episode_len_mean"]
+            for m in metrics
+            if not np.isnan(m.get("episode_len_mean", np.nan))
+        ]
+        return {
+            "episode_return_mean": float(np.mean(returns)) if returns else np.nan,
+            "episode_len_mean": float(np.mean(lens)) if lens else np.nan,
+            "num_episodes": int(sum(m["num_episodes"] for m in metrics)),
+        }
+
+    def stop(self) -> None:
+        for runner in self.runners:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:
+                pass

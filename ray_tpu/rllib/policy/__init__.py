@@ -1,0 +1,3 @@
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+__all__ = ["SampleBatch"]

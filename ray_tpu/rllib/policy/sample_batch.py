@@ -1,0 +1,81 @@
+"""SampleBatch — the lingua-franca tensor dict.
+
+Role-equivalent of rllib/policy/sample_batch.py :: SampleBatch
+(SURVEY §2.8): a dict of aligned numpy arrays with standard keys, slicing,
+concatenation, and minibatch shuffling. Flows env-runner → learner through
+the object store (pickle-5 zero copy).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping
+
+import numpy as np
+
+OBS = "obs"
+NEXT_OBS = "new_obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+TERMINATEDS = "terminateds"
+TRUNCATEDS = "truncateds"
+ACTION_LOGP = "action_logp"
+VF_PREDS = "vf_preds"
+ADVANTAGES = "advantages"
+VALUE_TARGETS = "value_targets"
+EPS_ID = "eps_id"
+AGENT_ID = "agent_id"
+
+
+class SampleBatch(dict):
+    def __init__(self, data: Mapping[str, np.ndarray] | None = None, **kwargs):
+        super().__init__()
+        for key, value in {**(data or {}), **kwargs}.items():
+            self[key] = np.asarray(value)
+
+    def __len__(self) -> int:
+        for value in self.values():
+            return len(value)
+        return 0
+
+    @property
+    def count(self) -> int:
+        return len(self)
+
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: v[start:end] for k, v in self.items()})
+
+    def shuffle(self, rng: np.random.Generator | None = None) -> "SampleBatch":
+        rng = rng or np.random.default_rng()
+        perm = rng.permutation(len(self))
+        return SampleBatch({k: v[perm] for k, v in self.items()})
+
+    def minibatches(
+        self, minibatch_size: int, rng: np.random.Generator | None = None
+    ) -> Iterator["SampleBatch"]:
+        shuffled = self.shuffle(rng)
+        for start in range(0, len(self), minibatch_size):
+            mb = shuffled.slice(start, start + minibatch_size)
+            if len(mb) == minibatch_size:
+                yield mb
+
+    @staticmethod
+    def concat_samples(batches: list["SampleBatch"]) -> "SampleBatch":
+        batches = [b for b in batches if len(b)]
+        if not batches:
+            return SampleBatch()
+        keys = set(batches[0])
+        return SampleBatch(
+            {k: np.concatenate([b[k] for b in batches]) for k in keys}
+        )
+
+    def split_by_episode(self) -> list["SampleBatch"]:
+        if EPS_ID not in self:
+            return [self]
+        out = []
+        ids = self[EPS_ID]
+        boundaries = np.nonzero(np.diff(ids))[0] + 1
+        start = 0
+        for end in list(boundaries) + [len(self)]:
+            out.append(self.slice(start, end))
+            start = end
+        return [b for b in out if len(b)]

@@ -1,0 +1,187 @@
+"""CLI — `python -m ray_tpu <command>`.
+
+Role-equivalent of python/ray/scripts/scripts.py (`ray start/stop/status/
+list/summary/timeline/microbenchmark`) + the job CLI (SURVEY §2.2 L7).
+`start --head` keeps a cluster alive in the foreground and prints the
+address for `init(address=...)` / RAYTPU_ADDRESS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _connect(args) -> None:
+    import ray_tpu
+
+    address = getattr(args, "address", None)
+    ray_tpu.init(address=address or "auto")
+
+
+def cmd_start(args) -> None:
+    import ray_tpu
+
+    if not args.head:
+        print("only --head is supported in-process; worker nodes join via "
+              "cluster_utils or the autoscaler", file=sys.stderr)
+        sys.exit(2)
+    resources = json.loads(args.resources) if args.resources else {}
+    ray_tpu.init(num_cpus=args.num_cpus, resources=resources)
+    from ray_tpu._private import worker as worker_mod
+
+    controller = worker_mod.get_global_context().controller_addr
+    address = f"{controller[0]}:{controller[1]}"
+    print(f"ray_tpu head started. Connect with:\n"
+          f"  RAYTPU_ADDRESS={address}\n"
+          f"  ray_tpu.init(address=\"{address}\")")
+    if args.dashboard:
+        from ray_tpu.dashboard import start_dashboard
+
+        start_dashboard(port=args.dashboard_port)
+        print(f"dashboard at http://127.0.0.1:{args.dashboard_port}")
+    if args.block:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+
+
+def cmd_status(args) -> None:
+    _connect(args)
+    import ray_tpu
+
+    print(json.dumps(
+        {
+            "cluster_resources": ray_tpu.cluster_resources(),
+            "available_resources": ray_tpu.available_resources(),
+            "nodes": len(ray_tpu.nodes()),
+        },
+        indent=2,
+    ))
+
+
+def cmd_list(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    fn = {
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "tasks": state.list_tasks,
+        "workers": state.list_workers,
+        "placement-groups": state.list_placement_groups,
+        "jobs": state.list_jobs,
+    }[args.kind]
+    print(json.dumps(fn(limit=args.limit), indent=2, default=str))
+
+
+def cmd_summary(args) -> None:
+    _connect(args)
+    from ray_tpu.util import state
+
+    fn = {"tasks": state.summarize_tasks, "actors": state.summarize_actors}[
+        args.kind
+    ]
+    print(json.dumps(fn(), indent=2))
+
+
+def cmd_timeline(args) -> None:
+    _connect(args)
+    import ray_tpu
+
+    events = ray_tpu.timeline()
+    with open(args.output, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {args.output} (chrome://tracing)")
+
+
+def cmd_microbenchmark(args) -> None:
+    from ray_tpu._private.ray_perf import main as perf_main
+
+    perf_main()
+
+
+def cmd_job(args) -> None:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(getattr(args, "address", None))
+    if args.job_cmd == "submit":
+        job_id = client.submit_job(entrypoint=args.entrypoint)
+        print(job_id)
+        if args.wait:
+            status = client.wait_until_finished(job_id)
+            print(status)
+            print(client.get_job_logs(job_id))
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id))
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.job_id))
+    elif args.job_cmd == "list":
+        print(json.dumps(client.list_jobs(), indent=2, default=str))
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--resources", default="")
+    p.add_argument("--block", action="store_true")
+    p.add_argument("--dashboard", action="store_true")
+    p.add_argument("--dashboard-port", type=int, default=8265)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list")
+    p.add_argument(
+        "kind",
+        choices=["actors", "nodes", "tasks", "workers", "placement-groups", "jobs"],
+    )
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary")
+    p.add_argument("kind", choices=["tasks", "actors"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("timeline")
+    p.add_argument("--output", default="timeline.json")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("microbenchmark")
+    p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser("job")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("entrypoint")
+    js.add_argument("--wait", action="store_true")
+    js.add_argument("--address", default=None)
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("job_id")
+        jp.add_argument("--address", default=None)
+    jl = jsub.add_parser("list")
+    jl.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_job)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""ray_tpu.serve — model serving (Ray Serve-equivalent, TPU-first).
+
+Controller/replica/proxy/router architecture with power-of-two routing,
+target-ongoing-requests autoscaling, bucketed dynamic batching for XLA
+static shapes, model multiplexing, and composition via DeploymentHandles.
+SURVEY §2.6.
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve._private.common import AutoscalingConfig, DeploymentConfig
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "run",
+    "start",
+    "status",
+    "delete",
+    "shutdown",
+    "get_app_handle",
+    "get_deployment_handle",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
+    "AutoscalingConfig",
+    "DeploymentConfig",
+]

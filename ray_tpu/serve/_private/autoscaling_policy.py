@@ -1,0 +1,70 @@
+"""Autoscaling policy — pure math, table-testable.
+
+Role-equivalent of python/ray/serve/_private/autoscaling_policy.py ::
+_calculate_desired_num_replicas and autoscaling_state.py's delay logic
+(SURVEY §2.6): desired = ceil(total_ongoing / target), smoothed, clamped to
+[min, max]; upscale/downscale only after the respective delay has been
+continuously satisfied.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+from ray_tpu.serve._private.common import AutoscalingConfig
+
+
+def calculate_desired_num_replicas(
+    config: AutoscalingConfig, total_ongoing_requests: float, current_replicas: int
+) -> int:
+    if current_replicas == 0:
+        # Scale from zero on any traffic.
+        raw = 1 if total_ongoing_requests > 0 else 0
+    else:
+        per_replica = total_ongoing_requests / current_replicas
+        error_ratio = per_replica / config.target_ongoing_requests
+        factor = (
+            config.upscale_smoothing_factor
+            if error_ratio > 1
+            else config.downscale_smoothing_factor
+        )
+        smoothed = 1 + factor * (error_ratio - 1)
+        raw = math.ceil(current_replicas * smoothed - 1e-9)
+    return max(config.min_replicas, min(config.max_replicas, raw))
+
+
+class AutoscalingState:
+    """Tracks the decision over time, enforcing up/downscale delays."""
+
+    def __init__(self, config: AutoscalingConfig):
+        self.config = config
+        self._proposal: int | None = None
+        self._proposal_since: float = 0.0
+
+    def decide(
+        self,
+        total_ongoing_requests: float,
+        current_replicas: int,
+        now: float | None = None,
+    ) -> int:
+        now = time.monotonic() if now is None else now
+        desired = calculate_desired_num_replicas(
+            self.config, total_ongoing_requests, current_replicas
+        )
+        if desired == current_replicas:
+            self._proposal = None
+            return current_replicas
+        if desired != self._proposal:
+            self._proposal = desired
+            self._proposal_since = now
+            return current_replicas
+        delay = (
+            self.config.upscale_delay_s
+            if desired > current_replicas
+            else self.config.downscale_delay_s
+        )
+        if now - self._proposal_since >= delay:
+            self._proposal = None
+            return desired
+        return current_replicas

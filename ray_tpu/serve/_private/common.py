@@ -1,0 +1,80 @@
+"""Shared Serve vocabulary (deployment configs, statuses, request metadata).
+
+Role-equivalent of python/ray/serve/_private/common.py + config dataclasses
+from python/ray/serve/config.py.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+DEFAULT_APP_NAME = "default"
+
+
+@dataclass
+class AutoscalingConfig:
+    """reference: ray.serve.config.AutoscalingConfig — desired replicas =
+    total ongoing requests / target_ongoing_requests, smoothed + clamped."""
+
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+    upscale_smoothing_factor: float = 1.0
+    downscale_smoothing_factor: float = 1.0
+    metrics_interval_s: float = 1.0
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    user_config: Any = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 10.0
+    health_check_timeout_s: float = 30.0
+    graceful_shutdown_timeout_s: float = 20.0
+    ray_actor_options: dict = field(default_factory=dict)
+    max_batch_queue: int = 1000
+
+
+@dataclass
+class DeploymentInfo:
+    name: str
+    app_name: str
+    config: DeploymentConfig
+    cls_or_fn: Any = None
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+    version: str = ""
+    route_prefix: Optional[str] = None
+
+    def qualified_name(self) -> str:
+        return f"{self.app_name}_{self.name}"
+
+
+@dataclass
+class ReplicaInfo:
+    replica_id: str
+    deployment: str  # qualified name
+    actor_name: str
+    state: str = "STARTING"  # STARTING/RUNNING/DRAINING/STOPPING/DEAD
+    version: str = ""
+    started_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class RequestMetadata:
+    request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+    method_name: str = "__call__"
+    multiplexed_model_id: str = ""
+    http: bool = False
+
+
+def new_replica_id(deployment: str) -> str:
+    return f"{deployment}#{uuid.uuid4().hex[:6]}"

@@ -1,0 +1,427 @@
+"""ServeController — the singleton reconciliation actor.
+
+Role-equivalent of python/ray/serve/_private/controller.py ::
+ServeController + deployment_state.py :: DeploymentStateManager +
+application_state.py (SURVEY §2.6, §3.4): holds target state (apps →
+deployments), runs a reconcile loop that starts/stops replica actors to
+match target counts, health-checks replicas, applies rolling updates on
+version change, autoscales from replica queue metrics, and checkpoints
+target state to the controller KV [N6] so a restarted controller replays
+the reconcile.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import threading
+import time
+import traceback
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.autoscaling_policy import AutoscalingState
+from ray_tpu.serve._private.common import (
+    DeploymentConfig,
+    DeploymentInfo,
+    ReplicaInfo,
+    new_replica_id,
+)
+from ray_tpu.serve._private.replica import Replica
+
+RECONCILE_PERIOD_S = 0.25
+
+
+def _kv_call(method: str, payload: dict) -> Any:
+    from ray_tpu._private import worker as worker_mod
+
+    ctx = worker_mod.get_global_context()
+    return ctx.io.run(ctx.controller.call(method, payload))
+
+
+class ServeController:
+    """Hosted in a detached named actor (max_concurrency > 1)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._deployments: dict[str, DeploymentInfo] = {}  # qualified name →
+        self._replicas: dict[str, list[ReplicaInfo]] = {}
+        self._actor_handles: dict[str, Any] = {}
+        self._autoscalers: dict[str, AutoscalingState] = {}
+        self._autoscale_counts: dict[str, int] = {}
+        self._routes: dict[str, str] = {}  # route_prefix → qualified name
+        self._app_deployments: dict[str, list[str]] = {}
+        self._app_status: dict[str, str] = {}
+        self._applied_user_config: dict[str, Any] = {}
+        self._stopped = False
+        self._last_health_check = 0.0
+        self._restore_checkpoint()
+        self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # target-state API (called by serve.run / CLI)
+    # ------------------------------------------------------------------
+    def deploy_application(
+        self, app_name: str, deployments: list[dict], route_prefix: Optional[str]
+    ) -> str:
+        with self._lock:
+            new_names = []
+            for spec in deployments:
+                info = DeploymentInfo(
+                    name=spec["name"],
+                    app_name=app_name,
+                    config=spec["config"],
+                    cls_or_fn=spec["cls_or_fn"],
+                    init_args=spec.get("init_args", ()),
+                    init_kwargs=spec.get("init_kwargs", {}),
+                    version=spec.get("version") or self._version_of(spec),
+                    route_prefix=spec.get("route_prefix"),
+                )
+                qname = info.qualified_name()
+                new_names.append(qname)
+                self._deployments[qname] = info
+                self._replicas.setdefault(qname, [])
+                if info.config.autoscaling_config:
+                    self._autoscalers[qname] = AutoscalingState(
+                        info.config.autoscaling_config
+                    )
+                    self._autoscale_counts.setdefault(
+                        qname, info.config.autoscaling_config.min_replicas
+                    )
+                # user_config change → in-place reconfigure of live replicas
+                prev = self._applied_user_config.get(qname, object())
+                if prev != info.config.user_config:
+                    self._applied_user_config[qname] = info.config.user_config
+                    for rep in self._replicas.get(qname, []):
+                        actor = self._actor_handles.get(rep.actor_name)
+                        if actor is not None and rep.state == "RUNNING":
+                            try:
+                                actor.reconfigure.remote(info.config.user_config)
+                            except Exception:
+                                pass
+            # Remove deployments dropped from the app.
+            for qname in self._app_deployments.get(app_name, []):
+                if qname not in new_names:
+                    self._deployments.pop(qname, None)
+            self._app_deployments[app_name] = new_names
+            self._app_status[app_name] = "DEPLOYING"
+            if route_prefix is not None and deployments:
+                ingress = deployments[-1]
+                self._routes[route_prefix] = f"{app_name}_{ingress['name']}"
+        self._save_checkpoint()
+        return "ok"
+
+    def delete_application(self, app_name: str) -> str:
+        with self._lock:
+            for qname in self._app_deployments.pop(app_name, []):
+                self._deployments.pop(qname, None)
+            self._routes = {
+                r: d for r, d in self._routes.items()
+                if not d.startswith(app_name + "_")
+            }
+            self._app_status.pop(app_name, None)
+        self._save_checkpoint()
+        return "ok"
+
+    def shutdown(self) -> str:
+        with self._lock:
+            self._deployments.clear()
+            self._routes.clear()
+            self._app_deployments.clear()
+        # reconcile loop will drain replicas; mark stop after one pass
+        time.sleep(2 * RECONCILE_PERIOD_S)
+        self._stopped = True
+        return "ok"
+
+    # ------------------------------------------------------------------
+    # introspection (routers, proxies, serve.status)
+    # ------------------------------------------------------------------
+    def get_deployment_replicas(self, qualified_name: str) -> dict:
+        with self._lock:
+            info = self._deployments.get(qualified_name)
+            running = [
+                r.actor_name
+                for r in self._replicas.get(qualified_name, [])
+                if r.state == "RUNNING"
+            ]
+            return {
+                "actor_names": running,
+                "max_ongoing_requests": (
+                    info.config.max_ongoing_requests if info else 100
+                ),
+            }
+
+    def get_routes(self) -> dict:
+        with self._lock:
+            return dict(self._routes)
+
+    def get_status(self) -> dict:
+        with self._lock:
+            apps = {}
+            for app, qnames in self._app_deployments.items():
+                deployments = {}
+                for qname in qnames:
+                    reps = self._replicas.get(qname, [])
+                    info = self._deployments.get(qname)
+                    target = self._target_count(qname, info) if info else 0
+                    running = sum(1 for r in reps if r.state == "RUNNING")
+                    deployments[qname.split("_", 1)[1]] = {
+                        "target_replicas": target,
+                        "running_replicas": running,
+                        "states": [r.state for r in reps],
+                    }
+                all_ok = all(
+                    d["running_replicas"] >= d["target_replicas"]
+                    for d in deployments.values()
+                )
+                apps[app] = {
+                    "status": "RUNNING" if all_ok else self._app_status.get(app, "DEPLOYING"),
+                    "deployments": deployments,
+                }
+            return apps
+
+    def get_metrics(self) -> dict:
+        out = {}
+        with self._lock:
+            replicas = {
+                q: [r for r in reps if r.state == "RUNNING"]
+                for q, reps in self._replicas.items()
+            }
+        for qname, reps in replicas.items():
+            metrics = []
+            for rep in reps:
+                try:
+                    handle = self._actor_handles.get(rep.actor_name)
+                    if handle:
+                        metrics.append(
+                            ray_tpu.get(handle.get_metrics.remote(), timeout=5)
+                        )
+                except Exception:
+                    pass
+            out[qname] = metrics
+        return out
+
+    def ping(self) -> str:
+        return "ok"
+
+    # ------------------------------------------------------------------
+    # reconcile loop
+    # ------------------------------------------------------------------
+    def _reconcile_loop(self) -> None:
+        while not self._stopped:
+            try:
+                self._reconcile_once()
+            except Exception:
+                traceback.print_exc()
+            time.sleep(RECONCILE_PERIOD_S)
+
+    def _target_count(self, qname: str, info: DeploymentInfo) -> int:
+        if info.config.autoscaling_config:
+            return self._autoscale_counts.get(
+                qname, info.config.autoscaling_config.min_replicas
+            )
+        return info.config.num_replicas
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            targets = dict(self._deployments)
+        # Drain replicas of deleted deployments.
+        for qname in list(self._replicas):
+            if qname not in targets:
+                for rep in self._replicas.get(qname, []):
+                    self._stop_replica(rep)
+                with self._lock:
+                    self._replicas.pop(qname, None)
+        for qname, info in targets.items():
+            self._autoscale(qname, info)
+            target = self._target_count(qname, info)
+            replicas = self._replicas.setdefault(qname, [])
+            # Rolling update: stop replicas of stale versions first.
+            stale = [r for r in replicas if r.version != info.version]
+            for rep in stale:
+                self._stop_replica(rep)
+                replicas.remove(rep)
+            alive = [r for r in replicas if r.state in ("STARTING", "RUNNING")]
+            for _ in range(target - len(alive)):
+                rep = self._start_replica(qname, info)
+                if rep is not None:
+                    replicas.append(rep)
+            excess = len(alive) - target
+            if excess > 0:
+                for rep in alive[-excess:]:
+                    self._stop_replica(rep)
+                    replicas.remove(rep)
+            self._health_check(qname, info, replicas)
+
+    def _start_replica(self, qname: str, info: DeploymentInfo) -> ReplicaInfo | None:
+        replica_id = new_replica_id(qname)
+        actor_name = f"SERVE_REPLICA::{replica_id}"
+        options = dict(
+            name=actor_name,
+            max_concurrency=max(8, info.config.max_ongoing_requests),
+            num_cpus=info.config.ray_actor_options.get("num_cpus", 1),
+        )
+        if info.config.ray_actor_options.get("num_tpus"):
+            options["num_tpus"] = info.config.ray_actor_options["num_tpus"]
+        if info.config.ray_actor_options.get("resources"):
+            options["resources"] = info.config.ray_actor_options["resources"]
+        try:
+            actor = ray_tpu.remote(Replica).options(**options).remote(
+                replica_id,
+                qname,
+                info.cls_or_fn,
+                info.init_args,
+                info.init_kwargs,
+                info.config.user_config,
+                info.version,
+            )
+        except Exception:
+            traceback.print_exc()
+            return None
+        self._actor_handles[actor_name] = actor
+        rep = ReplicaInfo(
+            replica_id=replica_id,
+            deployment=qname,
+            actor_name=actor_name,
+            state="STARTING",
+            version=info.version,
+        )
+        # Async readiness probe: mark RUNNING when first health check lands.
+        threading.Thread(
+            target=self._await_ready, args=(rep, actor), daemon=True
+        ).start()
+        return rep
+
+    def _await_ready(self, rep: ReplicaInfo, actor) -> None:
+        try:
+            ray_tpu.get(actor.check_health.remote(), timeout=120)
+            rep.state = "RUNNING"
+        except Exception:
+            rep.state = "DEAD"
+
+    def _stop_replica(self, rep: ReplicaInfo) -> None:
+        rep.state = "STOPPING"
+        actor = self._actor_handles.pop(rep.actor_name, None)
+        if actor is None:
+            return
+
+        def _drain():
+            try:
+                ray_tpu.get(actor.prepare_to_drain.remote(), timeout=10)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+            rep.state = "DEAD"
+
+        threading.Thread(target=_drain, daemon=True).start()
+
+    def _health_check(self, qname, info, replicas: list[ReplicaInfo]) -> None:
+        now = time.monotonic()
+        if now - self._last_health_check < info.config.health_check_period_s:
+            return
+        self._last_health_check = now
+        for rep in [r for r in replicas if r.state == "RUNNING"]:
+            actor = self._actor_handles.get(rep.actor_name)
+            if actor is None:
+                rep.state = "DEAD"
+                continue
+            try:
+                ray_tpu.get(
+                    actor.check_health.remote(),
+                    timeout=info.config.health_check_timeout_s,
+                )
+            except Exception:
+                rep.state = "DEAD"
+                self._actor_handles.pop(rep.actor_name, None)
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+        self._replicas[qname] = [r for r in replicas if r.state != "DEAD"]
+
+    def _autoscale(self, qname: str, info: DeploymentInfo) -> None:
+        state = self._autoscalers.get(qname)
+        if state is None:
+            return
+        running = [
+            r for r in self._replicas.get(qname, []) if r.state == "RUNNING"
+        ]
+        total_ongoing = 0.0
+        for rep in running:
+            actor = self._actor_handles.get(rep.actor_name)
+            if actor is None:
+                continue
+            try:
+                total_ongoing += ray_tpu.get(
+                    actor.get_num_ongoing.remote(), timeout=5
+                )
+            except Exception:
+                pass
+        current = self._autoscale_counts.get(
+            qname, info.config.autoscaling_config.min_replicas
+        )
+        decision = state.decide(total_ongoing, current)
+        if decision != current:
+            self._autoscale_counts[qname] = decision
+
+    # ------------------------------------------------------------------
+    # checkpoint/recovery via controller KV [N6]
+    # ------------------------------------------------------------------
+    def _save_checkpoint(self) -> None:
+        with self._lock:
+            state = {
+                "deployments": self._deployments,
+                "routes": self._routes,
+                "app_deployments": self._app_deployments,
+            }
+        try:
+            _kv_call(
+                "kv_put",
+                {
+                    "namespace": "serve",
+                    "key": "controller_checkpoint",
+                    "value": pickle.dumps(state),
+                    "overwrite": True,
+                },
+            )
+        except Exception:
+            pass
+
+    def _restore_checkpoint(self) -> None:
+        try:
+            resp = _kv_call(
+                "kv_get", {"namespace": "serve", "key": "controller_checkpoint"}
+            )
+            if resp.get("status") == "ok" and resp.get("value"):
+                state = pickle.loads(resp["value"])
+                self._deployments = state["deployments"]
+                self._routes = state["routes"]
+                self._app_deployments = state["app_deployments"]
+                for qname, info in self._deployments.items():
+                    self._replicas.setdefault(qname, [])
+                    if info.config.autoscaling_config:
+                        self._autoscalers[qname] = AutoscalingState(
+                            info.config.autoscaling_config
+                        )
+        except Exception:
+            pass
+
+    @staticmethod
+    def _version_of(spec: dict) -> str:
+        """Code/arg identity only — scaling num_replicas or changing
+        user_config must NOT roll replicas (user_config reconfigures in
+        place, reference deployment_state semantics)."""
+        import cloudpickle
+
+        try:
+            blob = cloudpickle.dumps(
+                (spec["name"], spec["cls_or_fn"], spec.get("init_args"),
+                 spec.get("init_kwargs"))
+            )
+        except Exception:
+            blob = repr(spec).encode()
+        return hashlib.sha1(blob).hexdigest()[:8]

@@ -1,0 +1,133 @@
+"""HTTP proxy — the ingress actor.
+
+Role-equivalent of python/ray/serve/_private/proxy.py :: ProxyActor +
+proxy_router.py (SURVEY §2.6, §3.4): an aiohttp server per node mapping
+route prefixes (refreshed from the controller) to deployment handles.
+JSON bodies pass to the ingress deployment's __call__; responses are
+JSON-encoded (bytes/str pass through). Health at /-/healthz, routes at
+/-/routes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import threading
+from typing import Any
+
+import ray_tpu
+from ray_tpu.serve._private.common import CONTROLLER_NAME
+
+
+class HTTPProxy:
+    """Runs inside a ray_tpu actor; owns an aiohttp server on `port`."""
+
+    ROUTE_REFRESH_S = 1.0
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._routes: dict[str, str] = {}
+        self._handles: dict[str, Any] = {}
+        self._last_refresh = 0.0
+        self._num_requests = 0
+        self._started = threading.Event()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread = threading.Thread(target=self._serve_forever, daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("proxy HTTP server failed to start")
+
+    # -- lifecycle ------------------------------------------------------
+    def _serve_forever(self) -> None:
+        asyncio.run(self._amain())
+
+    async def _amain(self) -> None:
+        from aiohttp import web
+
+        self._loop = asyncio.get_running_loop()
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.port)
+        await site.start()
+        self._started.set()
+        while True:
+            await asyncio.sleep(3600)
+
+    # -- request path ---------------------------------------------------
+    def _refresh_routes(self) -> None:
+        now = time.monotonic()
+        if now - self._last_refresh < self.ROUTE_REFRESH_S and self._routes:
+            return
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        self._routes = ray_tpu.get(controller.get_routes.remote(), timeout=30)
+        self._last_refresh = now
+
+    def _match(self, path: str) -> tuple[str, str] | None:
+        """Longest-prefix route match → (route, qualified deployment)."""
+        best = None
+        for route, deployment in self._routes.items():
+            if path == route or path.startswith(route.rstrip("/") + "/") or route == "/":
+                if best is None or len(route) > len(best[0]):
+                    best = (route, deployment)
+        return best
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = "/" + request.match_info.get("tail", "")
+        if path == "/-/healthz":
+            return web.Response(text="ok")
+        if path == "/-/routes":
+            await asyncio.to_thread(self._refresh_routes)
+            return web.json_response(self._routes)
+        await asyncio.to_thread(self._refresh_routes)
+        match = self._match(path)
+        if match is None:
+            return web.Response(status=404, text=f"no route for {path}")
+        _, qualified = match
+        app_name, dep_name = qualified.split("_", 1)
+        body: Any
+        if request.method in ("POST", "PUT", "PATCH"):
+            raw = await request.read()
+            try:
+                body = json.loads(raw) if raw else None
+            except json.JSONDecodeError:
+                body = raw
+        else:
+            body = dict(request.query)
+        self._num_requests += 1
+        try:
+            result = await asyncio.to_thread(
+                self._call_deployment, app_name, dep_name, body
+            )
+        except Exception as exc:
+            return web.Response(status=500, text=f"{type(exc).__name__}: {exc}")
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        if isinstance(result, str):
+            return web.Response(text=result)
+        try:
+            return web.json_response(result)
+        except TypeError:
+            return web.Response(text=str(result))
+
+    def _call_deployment(self, app_name: str, dep_name: str, body: Any) -> Any:
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        key = f"{app_name}_{dep_name}"
+        handle = self._handles.get(key)
+        if handle is None:
+            handle = DeploymentHandle(dep_name, app_name)
+            self._handles[key] = handle
+        return handle.remote(body).result(timeout=120)
+
+    # -- control --------------------------------------------------------
+    def ready(self) -> str:
+        return "ok"
+
+    def get_num_requests(self) -> int:
+        return self._num_requests

@@ -1,0 +1,112 @@
+"""Replica — the actor hosting one copy of a deployment.
+
+Role-equivalent of python/ray/serve/_private/replica.py ::
+UserCallableWrapper (SURVEY §2.6): constructs the user class (resolving
+DeploymentHandle placeholders for model composition), serves requests with
+an ongoing-request gauge (max_ongoing_requests backpressure lives in the
+router), supports reconfigure(user_config), health checks, and multiplexed
+model loading.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+import time
+from typing import Any
+
+_request_context: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_request_context", default=None
+)
+
+
+def get_current_request_metadata():
+    return _request_context.get()
+
+
+class Replica:
+    """Runs inside a ray_tpu actor with max_concurrency > 1."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        deployment_name: str,
+        cls_or_fn: Any,
+        init_args: tuple,
+        init_kwargs: dict,
+        user_config: Any,
+        version: str,
+    ):
+        from ray_tpu.serve.handle import _resolve_handle_placeholders
+
+        self.replica_id = replica_id
+        self.deployment_name = deployment_name
+        self.version = version
+        self._ongoing = 0
+        self._total = 0
+        self._latencies: list[float] = []
+        init_args = _resolve_handle_placeholders(init_args)
+        init_kwargs = _resolve_handle_placeholders(init_kwargs)
+        if isinstance(cls_or_fn, type):
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = cls_or_fn
+            self._is_function = True
+        if user_config is not None:
+            self._apply_reconfigure(user_config)
+
+    # -- request path ---------------------------------------------------
+    async def handle_request(self, meta: dict, args: tuple, kwargs: dict) -> Any:
+        self._ongoing += 1
+        self._total += 1
+        start = time.perf_counter()
+        token = _request_context.set(meta)
+        try:
+            if self._is_function:
+                target = self._callable
+            else:
+                target = getattr(self._callable, meta.get("method_name", "__call__"))
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            _request_context.reset(token)
+            self._ongoing -= 1
+            self._latencies.append(time.perf_counter() - start)
+            if len(self._latencies) > 1000:
+                del self._latencies[:500]
+
+    # -- control plane --------------------------------------------------
+    def reconfigure(self, user_config: Any) -> str:
+        self._apply_reconfigure(user_config)
+        return "ok"
+
+    def _apply_reconfigure(self, user_config: Any) -> None:
+        if not self._is_function and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    async def check_health(self) -> str:
+        if not self._is_function and hasattr(self._callable, "check_health"):
+            result = self._callable.check_health()
+            if inspect.iscoroutine(result):
+                await result
+        return "ok"
+
+    def get_metrics(self) -> dict:
+        lat = sorted(self._latencies[-200:])
+        return {
+            "replica_id": self.replica_id,
+            "ongoing": self._ongoing,
+            "total": self._total,
+            "p50_ms": 1e3 * lat[len(lat) // 2] if lat else 0.0,
+            "p99_ms": 1e3 * lat[int(len(lat) * 0.99)] if lat else 0.0,
+        }
+
+    def get_num_ongoing(self) -> int:
+        return self._ongoing
+
+    def prepare_to_drain(self) -> str:
+        return "ok"

@@ -1,0 +1,288 @@
+"""Public Serve API.
+
+Role-equivalent of python/ray/serve/api.py :: @serve.deployment /
+serve.run / .bind() / serve.status / serve.shutdown (SURVEY §2.6, §3.4).
+`Deployment.bind(...)` builds an Application graph (bound sub-deployments
+become handles at replica init — model composition); `serve.run` ships the
+graph to the singleton controller and returns the ingress handle.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.common import (
+    CONTROLLER_NAME,
+    DEFAULT_APP_NAME,
+    AutoscalingConfig,
+    DeploymentConfig,
+)
+from ray_tpu.serve.handle import DeploymentHandle, _HandlePlaceholder
+
+_proxy_handle = None
+_proxy_port: Optional[int] = None
+
+
+class Application:
+    """A bound deployment DAG node (reference: serve's built Application)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    def _collect(self, app_name: str, seen: dict) -> list[dict]:
+        """Topo-sort bound nodes into deployment specs (dependencies first)."""
+        specs: list[dict] = []
+
+        def resolve(obj: Any) -> Any:
+            if isinstance(obj, Application):
+                for spec in obj._collect(app_name, seen):
+                    if spec["name"] not in [s["name"] for s in specs]:
+                        specs.append(spec)
+                return _HandlePlaceholder(obj.deployment.name, app_name)
+            if isinstance(obj, tuple):
+                return tuple(resolve(x) for x in obj)
+            if isinstance(obj, list):
+                return [resolve(x) for x in obj]
+            if isinstance(obj, dict):
+                return {k: resolve(v) for k, v in obj.items()}
+            return obj
+
+        if self.deployment.name in seen:
+            return specs
+        seen[self.deployment.name] = True
+        init_args = resolve(self.args)
+        init_kwargs = resolve(self.kwargs)
+        specs.append(
+            {
+                "name": self.deployment.name,
+                "cls_or_fn": self.deployment.func_or_class,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "config": self.deployment._config,
+                "route_prefix": self.deployment._route_prefix,
+            }
+        )
+        return specs
+
+
+class Deployment:
+    def __init__(
+        self,
+        func_or_class: Any,
+        name: str,
+        config: DeploymentConfig,
+        route_prefix: Optional[str] = None,
+    ):
+        self.func_or_class = func_or_class
+        self.name = name
+        self._config = config
+        self._route_prefix = route_prefix
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def options(self, **overrides) -> "Deployment":
+        import copy
+
+        config = copy.deepcopy(self._config)
+        route_prefix = overrides.pop("route_prefix", self._route_prefix)
+        name = overrides.pop("name", self.name)
+        for key, value in overrides.items():
+            if key == "autoscaling_config" and isinstance(value, dict):
+                value = AutoscalingConfig(**value)
+            if not hasattr(config, key):
+                raise TypeError(f"unknown deployment option {key!r}")
+            setattr(config, key, value)
+        return Deployment(self.func_or_class, name, config, route_prefix)
+
+    def __repr__(self):
+        return f"Deployment({self.name})"
+
+
+def deployment(
+    _func_or_class: Any = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int | str | None = None,
+    max_ongoing_requests: int = 100,
+    user_config: Any = None,
+    autoscaling_config: AutoscalingConfig | dict | None = None,
+    ray_actor_options: dict | None = None,
+    health_check_period_s: float = 10.0,
+    health_check_timeout_s: float = 30.0,
+    route_prefix: Optional[str] = None,
+):
+    """@serve.deployment — same shapes as the reference decorator."""
+
+    def wrap(target):
+        if isinstance(autoscaling_config, dict):
+            asc = AutoscalingConfig(**autoscaling_config)
+        else:
+            asc = autoscaling_config
+        n_replicas = num_replicas
+        if n_replicas == "auto":
+            n_replicas = None
+            nonlocal_asc = asc or AutoscalingConfig()
+            config = DeploymentConfig(
+                num_replicas=1,
+                max_ongoing_requests=max_ongoing_requests,
+                user_config=user_config,
+                autoscaling_config=nonlocal_asc,
+                ray_actor_options=ray_actor_options or {},
+                health_check_period_s=health_check_period_s,
+                health_check_timeout_s=health_check_timeout_s,
+            )
+        else:
+            config = DeploymentConfig(
+                num_replicas=n_replicas or 1,
+                max_ongoing_requests=max_ongoing_requests,
+                user_config=user_config,
+                autoscaling_config=asc,
+                ray_actor_options=ray_actor_options or {},
+                health_check_period_s=health_check_period_s,
+                health_check_timeout_s=health_check_timeout_s,
+            )
+        return Deployment(
+            target,
+            name or getattr(target, "__name__", "deployment"),
+            config,
+            route_prefix,
+        )
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# cluster-facing API
+# ---------------------------------------------------------------------------
+
+def _get_or_create_controller():
+    from ray_tpu.serve._private.controller import ServeController
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    try:
+        return (
+            ray_tpu.remote(ServeController)
+            .options(name=CONTROLLER_NAME, lifetime="detached", max_concurrency=16)
+            .remote()
+        )
+    except ValueError:
+        # Raced with another creator.
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+def start(http_host: str = "127.0.0.1", http_port: int = 8000):
+    """Start controller + HTTP proxy (reference: serve.start)."""
+    global _proxy_handle, _proxy_port
+    controller = _get_or_create_controller()
+    if _proxy_handle is None or _proxy_port != http_port:
+        from ray_tpu.serve._private.proxy import HTTPProxy
+
+        name = f"SERVE_PROXY::{http_port}"
+        try:
+            _proxy_handle = ray_tpu.get_actor(name)
+        except ValueError:
+            _proxy_handle = (
+                ray_tpu.remote(HTTPProxy)
+                .options(name=name, lifetime="detached", max_concurrency=64)
+                .remote(http_host, http_port)
+            )
+        ray_tpu.get(_proxy_handle.ready.remote(), timeout=60)
+        _proxy_port = http_port
+    return controller
+
+
+def run(
+    target: Application,
+    *,
+    name: str = DEFAULT_APP_NAME,
+    route_prefix: Optional[str] = "/",
+    _blocking_timeout_s: float = 120.0,
+    http_port: Optional[int] = None,
+) -> DeploymentHandle:
+    """Deploy an application; block until running; return ingress handle."""
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects Deployment.bind(...) output")
+    if http_port is not None:
+        start(http_port=http_port)
+    else:
+        controller = _get_or_create_controller()
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    specs = target._collect(name, {})
+    ray_tpu.get(
+        controller.deploy_application.remote(name, specs, route_prefix),
+        timeout=60,
+    )
+    # Block until every deployment reports enough running replicas.
+    deadline = time.time() + _blocking_timeout_s
+    while time.time() < deadline:
+        status = ray_tpu.get(controller.get_status.remote(), timeout=30)
+        app = status.get(name)
+        if app and app["status"] == "RUNNING":
+            break
+        time.sleep(0.2)
+    else:
+        raise TimeoutError(f"application {name!r} did not become RUNNING")
+    return DeploymentHandle(target.deployment.name, name)
+
+
+def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    status = ray_tpu.get(controller.get_status.remote(), timeout=30)
+    if name not in status:
+        raise ValueError(f"no application {name!r}")
+    routes = ray_tpu.get(controller.get_routes.remote(), timeout=30)
+    for _, qualified in routes.items():
+        app, dep = qualified.split("_", 1)
+        if app == name:
+            return DeploymentHandle(dep, name)
+    deployments = list(status[name]["deployments"])
+    return DeploymentHandle(deployments[-1], name)
+
+
+def get_deployment_handle(
+    deployment_name: str, app_name: str = DEFAULT_APP_NAME
+) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def status() -> dict:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return {}
+    return ray_tpu.get(controller.get_status.remote(), timeout=30)
+
+
+def delete(name: str) -> None:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+
+
+def shutdown() -> None:
+    global _proxy_handle, _proxy_port
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    if _proxy_handle is not None:
+        try:
+            ray_tpu.kill(_proxy_handle)
+        except Exception:
+            pass
+    _proxy_handle = None
+    _proxy_port = None

@@ -1,0 +1,138 @@
+"""@serve.batch — dynamic request batching.
+
+Role-equivalent of python/ray/serve/batching.py :: @serve.batch
+(max_batch_size, batch_wait_timeout_s), with the TPU-first addition from
+SURVEY §2.6/§7.0.5: optional `bucket_sizes` — the flushed batch is padded
+up to the nearest bucket by repeating the last item, so a jitted XLA model
+sees only a fixed set of batch shapes (one compile per bucket, no
+recompile storms). The wrapper returns per-item results with padding
+stripped.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+from typing import Any, Callable, Optional, Sequence
+
+
+class _BatchQueue:
+    def __init__(
+        self,
+        fn: Callable,
+        max_batch_size: int,
+        batch_wait_timeout_s: float,
+        bucket_sizes: Optional[Sequence[int]],
+    ):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self.bucket_sizes = sorted(bucket_sizes) if bucket_sizes else None
+        if self.bucket_sizes and self.bucket_sizes[-1] < max_batch_size:
+            raise ValueError(
+                "largest bucket must be >= max_batch_size "
+                f"({self.bucket_sizes[-1]} < {max_batch_size})"
+            )
+        self.queue: list[tuple[Any, asyncio.Future]] = []
+        self._flusher: asyncio.Task | None = None
+        self._lock = asyncio.Lock()
+
+    def _pad(self, items: list) -> tuple[list, int]:
+        real = len(items)
+        if self.bucket_sizes:
+            bucket = next(
+                (b for b in self.bucket_sizes if b >= real), self.bucket_sizes[-1]
+            )
+            items = items + [items[-1]] * (bucket - real)
+        return items, real
+
+    async def submit(self, item: Any) -> Any:
+        future: asyncio.Future = asyncio.get_running_loop().create_future()
+        async with self._lock:
+            self.queue.append((item, future))
+            if len(self.queue) >= self.max_batch_size:
+                self._take_and_flush()
+            elif self._flusher is None or self._flusher.done():
+                self._flusher = asyncio.get_running_loop().create_task(
+                    self._flush_after_timeout()
+                )
+        return await future
+
+    def _take_and_flush(self) -> None:
+        batch = self.queue[: self.max_batch_size]
+        del self.queue[: self.max_batch_size]
+        asyncio.get_running_loop().create_task(self._run_batch(batch))
+
+    async def _flush_after_timeout(self) -> None:
+        await asyncio.sleep(self.batch_wait_timeout_s)
+        async with self._lock:
+            if self.queue:
+                self._take_and_flush()
+
+    async def _run_batch(self, batch: list[tuple[Any, asyncio.Future]]) -> None:
+        items = [item for item, _ in batch]
+        futures = [future for _, future in batch]
+        padded, real = self._pad(items)
+        try:
+            result = self.fn(padded)
+            if inspect.iscoroutine(result):
+                result = await result
+            results = list(result)[:real]
+            if len(results) != real:
+                raise ValueError(
+                    f"batched fn returned {len(results)} results for "
+                    f"{real} requests"
+                )
+            for future, value in zip(futures, results):
+                if not future.done():
+                    future.set_result(value)
+        except Exception as exc:
+            for future in futures:
+                if not future.done():
+                    future.set_exception(exc)
+
+
+def batch(
+    _fn: Callable | None = None,
+    *,
+    max_batch_size: int = 10,
+    batch_wait_timeout_s: float = 0.01,
+    bucket_sizes: Optional[Sequence[int]] = None,
+):
+    """Decorator: async def fn(self, items: list) -> list, called per item."""
+
+    def decorator(fn: Callable):
+        queues: dict[int, _BatchQueue] = {}
+
+        def _queue_for(bound_args: tuple) -> _BatchQueue:
+            # One queue per bound instance (methods) / per function.
+            key = id(bound_args[0]) if bound_args else 0
+            if key not in queues:
+                if bound_args:
+                    target = functools.partial(fn, bound_args[0])
+                else:
+                    target = fn
+                queues[key] = _BatchQueue(
+                    target, max_batch_size, batch_wait_timeout_s, bucket_sizes
+                )
+            return queues[key]
+
+        is_method = "self" in inspect.signature(fn).parameters
+
+        if is_method:
+            @functools.wraps(fn)
+            async def method_wrapper(self, item):
+                return await _queue_for((self,)).submit(item)
+
+            return method_wrapper
+
+        @functools.wraps(fn)
+        async def fn_wrapper(item):
+            return await _queue_for(()).submit(item)
+
+        return fn_wrapper
+
+    if _fn is not None:
+        return decorator(_fn)
+    return decorator

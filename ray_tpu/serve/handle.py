@@ -1,0 +1,215 @@
+"""DeploymentHandle + Router — client-side load-balanced calls.
+
+Role-equivalents of python/ray/serve/handle.py :: DeploymentHandle /
+DeploymentResponse and _private/router.py + replica_scheduler/
+pow_2_scheduler.py :: PowerOfTwoChoicesReplicaScheduler (SURVEY §2.6):
+the handle keeps a router that tracks the deployment's live replicas
+(refreshed from the controller), picks between two random replicas by
+queue length (locally-tracked ongoing counts + max_ongoing_requests
+backpressure), and returns futures (DeploymentResponse) that compose
+between deployments.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.common import CONTROLLER_NAME, RequestMetadata
+
+
+class DeploymentResponse:
+    """Future for one deployment call; .result() blocks, passing the
+    response into another handle call chains through the object store."""
+
+    def __init__(self, ref, router: "Router", replica_name: str):
+        self._ref = ref
+        self._router = router
+        self._replica_name = replica_name
+        self._done = False
+
+    def result(self, timeout: Optional[float] = 60.0) -> Any:
+        try:
+            value = ray_tpu.get(self._ref, timeout=timeout)
+            return value
+        finally:
+            self._mark_done()
+
+    def _mark_done(self):
+        if not self._done:
+            self._done = True
+            self._router.on_request_done(self._replica_name)
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class Router:
+    """Pow-2 replica choice with cached membership + local queue counts."""
+
+    REFRESH_INTERVAL_S = 1.0
+
+    def __init__(self, deployment: str, app_name: str):
+        self.deployment = deployment
+        self.app_name = app_name
+        self._qualified = f"{app_name}_{deployment}"
+        self._replicas: list[str] = []  # actor names
+        self._handles: dict[str, Any] = {}
+        self._ongoing: dict[str, int] = {}
+        self._max_ongoing = 100
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_refresh < self.REFRESH_INTERVAL_S:
+            return
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        info = ray_tpu.get(
+            controller.get_deployment_replicas.remote(self._qualified), timeout=30
+        )
+        with self._lock:
+            self._last_refresh = now
+            self._replicas = info["actor_names"]
+            self._max_ongoing = info.get("max_ongoing_requests", 100)
+            for name in self._replicas:
+                self._ongoing.setdefault(name, 0)
+
+    def _replica_handle(self, actor_name: str):
+        handle = self._handles.get(actor_name)
+        if handle is None:
+            handle = ray_tpu.get_actor(actor_name)
+            self._handles[actor_name] = handle
+        return handle
+
+    def choose_replica(self) -> str:
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._refresh()
+            with self._lock:
+                candidates = list(self._replicas)
+            if candidates:
+                if len(candidates) == 1:
+                    pick = candidates[0]
+                else:
+                    a, b = random.sample(candidates, 2)
+                    pick = a if self._ongoing.get(a, 0) <= self._ongoing.get(b, 0) else b
+                if self._ongoing.get(pick, 0) < self._max_ongoing:
+                    with self._lock:
+                        self._ongoing[pick] = self._ongoing.get(pick, 0) + 1
+                    return pick
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no available replica for {self._qualified} "
+                    f"(backpressure or scale-to-zero)"
+                )
+            self._last_refresh = 0.0  # force refresh next spin
+            time.sleep(0.05)
+
+    def on_request_done(self, actor_name: str) -> None:
+        with self._lock:
+            if actor_name in self._ongoing and self._ongoing[actor_name] > 0:
+                self._ongoing[actor_name] -= 1
+
+    def drop_replica(self, actor_name: str) -> None:
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r != actor_name]
+            self._handles.pop(actor_name, None)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment: str, app_name: str = "default"):
+        self.deployment_name = deployment
+        self.app_name = app_name
+        self._router: Optional[Router] = None
+        self._method_name = "__call__"
+        self._model_id = ""
+
+    def _get_router(self) -> Router:
+        if self._router is None:
+            self._router = Router(self.deployment_name, self.app_name)
+        return self._router
+
+    def options(self, *, method_name: str | None = None,
+                multiplexed_model_id: str | None = None) -> "DeploymentHandle":
+        clone = DeploymentHandle(self.deployment_name, self.app_name)
+        clone._method_name = method_name or self._method_name
+        clone._model_id = multiplexed_model_id or self._model_id
+        return clone
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = self._get_router()
+        meta = RequestMetadata(
+            method_name=self._method_name, multiplexed_model_id=self._model_id
+        )
+        # Compose: upstream DeploymentResponses pass as object refs so the
+        # downstream replica reads the value without driver round-trips.
+        args = tuple(
+            a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+            for a in args
+        )
+        last_exc: Exception | None = None
+        for _ in range(3):
+            replica_name = router.choose_replica()
+            replica = router._replica_handle(replica_name)
+            try:
+                ref = replica.handle_request.remote(
+                    {
+                        "request_id": meta.request_id,
+                        "method_name": meta.method_name,
+                        "multiplexed_model_id": meta.multiplexed_model_id,
+                    },
+                    args,
+                    kwargs,
+                )
+                return DeploymentResponse(ref, router, replica_name)
+            except Exception as exc:  # replica died between refresh and call
+                last_exc = exc
+                router.on_request_done(replica_name)
+                router.drop_replica(replica_name)
+        raise RuntimeError(
+            f"could not dispatch to {self.deployment_name}: {last_exc}"
+        )
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self.deployment_name, self.app_name,
+                                  self._method_name, self._model_id))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self.app_name}/{self.deployment_name})"
+
+
+def _rebuild_handle(deployment, app_name, method_name, model_id):
+    handle = DeploymentHandle(deployment, app_name)
+    handle._method_name = method_name
+    handle._model_id = model_id
+    return handle
+
+
+class _HandlePlaceholder:
+    """Marks a bound sub-deployment inside init args; replicas resolve it
+    to a live DeploymentHandle at construction time."""
+
+    def __init__(self, deployment: str, app_name: str):
+        self.deployment = deployment
+        self.app_name = app_name
+
+
+def _resolve_handle_placeholders(obj: Any) -> Any:
+    if isinstance(obj, _HandlePlaceholder):
+        return DeploymentHandle(obj.deployment, obj.app_name)
+    if isinstance(obj, tuple):
+        return tuple(_resolve_handle_placeholders(x) for x in obj)
+    if isinstance(obj, list):
+        return [_resolve_handle_placeholders(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _resolve_handle_placeholders(v) for k, v in obj.items()}
+    return obj

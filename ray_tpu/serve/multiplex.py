@@ -1,0 +1,67 @@
+"""@serve.multiplexed — per-replica LRU of loaded models.
+
+Role-equivalent of python/ray/serve/multiplex.py (SURVEY §2.6): a replica
+lazily loads up to N models keyed by the request's multiplexed_model_id;
+least-recently-used models are evicted (calling their __del__/unload). The
+router steers by model id when possible via DeploymentHandle.options(
+multiplexed_model_id=...).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import functools
+import inspect
+from typing import Callable
+
+from ray_tpu.serve._private.replica import get_current_request_metadata
+
+
+def get_multiplexed_model_id() -> str:
+    meta = get_current_request_metadata()
+    if meta is None:
+        return ""
+    return meta.get("multiplexed_model_id", "")
+
+
+def multiplexed(
+    _fn: Callable | None = None, *, max_num_models_per_replica: int = 3
+):
+    """Decorator on `async def load(self, model_id) -> model`."""
+
+    def decorator(load_fn: Callable):
+        caches: dict[int, "collections.OrderedDict"] = {}
+        locks: dict[int, asyncio.Lock] = {}
+
+        @functools.wraps(load_fn)
+        async def wrapper(*args):
+            # args = (self, model_id) for methods, (model_id,) for functions
+            key = id(args[0]) if len(args) > 1 else 0
+            model_id = args[-1]
+            cache = caches.setdefault(key, collections.OrderedDict())
+            lock = locks.setdefault(key, asyncio.Lock())
+            async with lock:
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+                model = load_fn(*args)
+                if inspect.iscoroutine(model):
+                    model = await model
+                cache[model_id] = model
+                while len(cache) > max_num_models_per_replica:
+                    _, evicted = cache.popitem(last=False)
+                    unload = getattr(evicted, "unload", None) or getattr(
+                        evicted, "__serve_unload__", None
+                    )
+                    if unload is not None:
+                        result = unload()
+                        if inspect.iscoroutine(result):
+                            await result
+                return model
+
+        return wrapper
+
+    if _fn is not None:
+        return decorator(_fn)
+    return decorator

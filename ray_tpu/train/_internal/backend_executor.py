@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import tempfile
 import time
 from typing import Any, Callable, Optional
 
@@ -162,6 +163,10 @@ class BackendExecutor:
                         shutil.copytree(
                             os.path.join(src_shards, proc_dir), dst
                         )
+            # Rank temp dir is merged — reclaim /tmp (multi-GB models would
+            # otherwise leak a checkpoint per report round per rank).
+            if ckpt.path.startswith(tempfile.gettempdir()):
+                shutil.rmtree(ckpt.path, ignore_errors=True)
         return base
 
     def shutdown(self) -> None:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import tempfile
 from typing import Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -67,6 +68,9 @@ class StorageContext:
             if os.path.isdir(dest):
                 shutil.rmtree(dest)
             shutil.copytree(checkpoint.path, dest)
+            # The merged rank-0 temp dir has been persisted — reclaim /tmp.
+            if checkpoint.path.startswith(tempfile.gettempdir()):
+                shutil.rmtree(checkpoint.path, ignore_errors=True)
         clean_metrics = {
             k: v for k, v in metrics.items()
             if isinstance(v, (int, float, str, bool))

@@ -14,6 +14,7 @@ import contextlib
 import json
 import os
 import pickle
+import re
 import shutil
 import tempfile
 import uuid
@@ -174,8 +175,11 @@ def load_pytree(directory: str, shardings: Any | None = None) -> Any:
         filled = np.zeros(meta["shape"], dtype=bool) if meta["shape"] else None
         for pd in proc_dirs:
             pdir = os.path.join(shards_root, pd)
+            shard_re = re.compile(re.escape(key) + r"\.s\d+\.npy$")
             for fname in os.listdir(pdir):
-                if not (fname.startswith(key + ".s") and fname.endswith(".npy")):
+                # Exact-key match: plain prefix tests would let a leaf named
+                # "w.step" feed shards into leaf "w".
+                if not shard_re.fullmatch(fname):
                     continue
                 data = np.load(os.path.join(pdir, fname))
                 with open(os.path.join(pdir, fname[:-4] + ".idx.json")) as f:

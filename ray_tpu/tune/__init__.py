@@ -1,0 +1,51 @@
+"""ray_tpu.tune — hyperparameter search (Ray Tune-equivalent).
+
+Entry points mirror ray.tune: Tuner(...).fit() → ResultGrid, tune.run(...),
+search-space constructors (uniform/choice/grid_search/...), schedulers
+(ASHA/HyperBand/PBT/median-stopping), searchers (grid/random, Optuna
+adapter), function trainables with tune.report(), class Trainables, and
+experiment resume via Tuner.restore(). SURVEY §2.5.
+"""
+
+from ray_tpu.tune.result_grid import ResultGrid, TrialResult
+from ray_tpu.tune.search.sample import (
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import (
+    Trainable,
+    get_checkpoint,
+    report,
+    with_parameters,
+    wrap_function,
+)
+from ray_tpu.tune.tuner import TuneConfig, Tuner, run
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "run",
+    "ResultGrid",
+    "TrialResult",
+    "Trainable",
+    "report",
+    "get_checkpoint",
+    "with_parameters",
+    "wrap_function",
+    "uniform",
+    "loguniform",
+    "quniform",
+    "randint",
+    "lograndint",
+    "choice",
+    "randn",
+    "sample_from",
+    "grid_search",
+]

@@ -1,0 +1,9 @@
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator, generate_variants
+from ray_tpu.tune.search.searcher import ConcurrencyLimiter, Searcher
+
+__all__ = [
+    "Searcher",
+    "ConcurrencyLimiter",
+    "BasicVariantGenerator",
+    "generate_variants",
+]

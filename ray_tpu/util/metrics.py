@@ -1,0 +1,204 @@
+"""User/library metrics — Counter/Gauge/Histogram.
+
+Role-equivalent of python/ray/util/metrics.py (SURVEY §5.5): metrics
+recorded anywhere in the cluster flow to the controller KV (namespace
+"metrics", merged per metric+tags) and are exported by the dashboard's
+/metrics endpoint in Prometheus text format — the role the per-node
+metrics agent + OpenCensus pipeline [N27] plays in the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Mapping, Optional, Sequence
+
+from ray_tpu._private import worker as worker_mod
+
+_FLUSH_INTERVAL_S = 2.0
+_local_lock = threading.Lock()
+_pending: dict[str, dict] = {}
+_flusher_started = False
+
+
+def _flush_loop() -> None:
+    while True:
+        time.sleep(_FLUSH_INTERVAL_S)
+        try:
+            flush()
+        except Exception:
+            pass
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    with _local_lock:
+        if not _flusher_started:
+            _flusher_started = True
+            threading.Thread(target=_flush_loop, daemon=True).start()
+
+
+def flush() -> None:
+    """Push pending metric points to the controller KV."""
+    with _local_lock:
+        points = dict(_pending)
+        _pending.clear()
+    if not points:
+        return
+    try:
+        ctx = worker_mod.get_global_context()
+    except Exception:
+        return
+    for key, point in points.items():
+        ctx.io.run(
+            ctx.controller.call(
+                "kv_put",
+                {
+                    "namespace": "metrics",
+                    "key": key,
+                    "value": json.dumps(point).encode(),
+                    "overwrite": True,
+                },
+            )
+        )
+
+
+def _record(kind: str, name: str, description: str, tags: Mapping[str, str],
+            value: float, buckets: Optional[Sequence[float]] = None) -> None:
+    tag_str = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    key = f"{name}{{{tag_str}}}"
+    with _local_lock:
+        point = _pending.get(key)
+        if point is None:
+            point = {
+                "kind": kind,
+                "name": name,
+                "description": description,
+                "tags": dict(tags),
+                "value": 0.0,
+                "count": 0,
+                "sum": 0.0,
+                "buckets": list(buckets) if buckets else None,
+                "bucket_counts": [0] * (len(buckets) + 1) if buckets else None,
+                "ts": time.time(),
+            }
+            _pending[key] = point
+        if kind == "counter":
+            point["value"] += value
+        elif kind == "gauge":
+            point["value"] = value
+        else:  # histogram
+            point["count"] += 1
+            point["sum"] += value
+            for i, bound in enumerate(point["buckets"]):
+                if value <= bound:
+                    point["bucket_counts"][i] += 1
+                    break
+            else:
+                point["bucket_counts"][-1] += 1
+        point["ts"] = time.time()
+    _ensure_flusher()
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        tag_keys: Sequence[str] = (),
+    ):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: dict[str, str] = {}
+
+    def set_default_tags(self, tags: Mapping[str, str]) -> "_Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: Optional[Mapping[str, str]]) -> dict:
+        return {**self._default_tags, **(tags or {})}
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Mapping[str, str] | None = None):
+        _record("counter", self._name, self._description, self._tags(tags), value)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Mapping[str, str] | None = None):
+        _record("gauge", self._name, self._description, self._tags(tags), value)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Sequence[float] = (0.01, 0.1, 1, 10),
+        tag_keys: Sequence[str] = (),
+    ):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = tuple(boundaries)
+
+    def observe(self, value: float, tags: Mapping[str, str] | None = None):
+        _record(
+            "histogram", self._name, self._description, self._tags(tags),
+            value, self._boundaries,
+        )
+
+
+def collect_prometheus_text() -> str:
+    """Render every recorded metric in Prometheus exposition format."""
+    try:
+        ctx = worker_mod.get_global_context()
+    except Exception:
+        return ""
+    keys = ctx.io.run(
+        ctx.controller.call("kv_keys", {"namespace": "metrics", "prefix": ""})
+    )
+    lines: list[str] = []
+    seen_headers: set[str] = set()
+    for key in sorted(keys):
+        resp = ctx.io.run(
+            ctx.controller.call("kv_get", {"namespace": "metrics", "key": key})
+        )
+        if resp.get("status") != "ok":
+            continue
+        point = json.loads(resp["value"])
+        name = "ray_tpu_" + point["name"]
+        if name not in seen_headers:
+            seen_headers.add(name)
+            lines.append(f"# HELP {name} {point['description']}")
+            lines.append(f"# TYPE {name} {point['kind']}")
+        tag_str = ",".join(
+            f'{k}="{v}"' for k, v in sorted(point["tags"].items())
+        )
+        label = f"{{{tag_str}}}" if tag_str else ""
+        if point["kind"] == "histogram":
+            cum = 0
+            for bound, count in zip(
+                point["buckets"], point["bucket_counts"]
+            ):
+                cum += count
+                sep = "," if tag_str else ""
+                lines.append(
+                    f'{name}_bucket{{{tag_str}{sep}le="{bound}"}} {cum}'
+                )
+            cum += point["bucket_counts"][-1]
+            sep = "," if tag_str else ""
+            lines.append(f'{name}_bucket{{{tag_str}{sep}le="+Inf"}} {cum}')
+            lines.append(f"{name}_count{label} {point['count']}")
+            lines.append(f"{name}_sum{label} {point['sum']}")
+        else:
+            lines.append(f"{name}{label} {point['value']}")
+    return "\n".join(lines) + ("\n" if lines else "")

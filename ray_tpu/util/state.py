@@ -1,0 +1,141 @@
+"""State API — cluster introspection.
+
+Role-equivalent of python/ray/util/state/ :: list_actors / list_tasks /
+list_nodes / list_placement_groups / list_workers / summarize_tasks
+(SURVEY §2.2, §5.5), backed by the controller's live tables + task-event
+ring buffer [N5]. Each list_* supports simple {key: value} filters and a
+limit, like the reference's predicate pushdown.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+def _call(method: str, payload: dict | None = None) -> Any:
+    ctx = worker_mod.get_global_context()
+    return ctx.io.run(ctx.controller.call(method, payload or {}))
+
+
+def _apply_filters(rows: list[dict], filters, limit: int) -> list[dict]:
+    if filters:
+        out = []
+        for row in rows:
+            ok = True
+            for key, value in dict(filters).items():
+                if row.get(key) != value:
+                    ok = False
+                    break
+            if ok:
+                out.append(row)
+        rows = out
+    return rows[:limit]
+
+
+def list_actors(
+    filters: dict | None = None, limit: int = 1000
+) -> list[dict]:
+    return _apply_filters(_call("list_actors"), filters, limit)
+
+
+def list_nodes(filters: dict | None = None, limit: int = 1000) -> list[dict]:
+    return _apply_filters(_call("list_nodes"), filters, limit)
+
+
+def list_placement_groups(
+    filters: dict | None = None, limit: int = 1000
+) -> list[dict]:
+    return _apply_filters(_call("list_placement_groups"), filters, limit)
+
+
+def list_workers(filters: dict | None = None, limit: int = 1000) -> list[dict]:
+    return _apply_filters(_call("list_workers"), filters, limit)
+
+
+def list_jobs(filters: dict | None = None, limit: int = 1000) -> list[dict]:
+    return _apply_filters(_call("list_jobs"), filters, limit)
+
+
+def list_tasks(filters: dict | None = None, limit: int = 1000) -> list[dict]:
+    """Latest state per task, reduced from the task-event log."""
+    events = _call("list_task_events", {"limit": 100_000})
+    latest: dict[str, dict] = {}
+    for event in events:
+        task_id = event.get("task_id")
+        if not task_id:
+            continue
+        row = latest.setdefault(
+            task_id,
+            {
+                "task_id": task_id,
+                "name": event.get("name"),
+                "state": None,
+                "node_id": event.get("node_id"),
+                "start_time": None,
+                "end_time": None,
+            },
+        )
+        state = event.get("state")
+        row["state"] = state
+        if event.get("name"):
+            row["name"] = event["name"]
+        ts = event.get("ts")
+        if state in ("RUNNING",) and ts:
+            row["start_time"] = ts
+        if state in ("FINISHED", "FAILED") and ts:
+            row["end_time"] = ts
+    return _apply_filters(list(latest.values()), filters, limit)
+
+
+def summarize_tasks() -> dict:
+    """ray summary tasks — counts by (name, state)."""
+    tasks = list_tasks(limit=100_000)
+    summary: dict[str, dict] = {}
+    for task in tasks:
+        name = task.get("name") or "unknown"
+        entry = summary.setdefault(name, {})
+        state = task.get("state") or "UNKNOWN"
+        entry[state] = entry.get(state, 0) + 1
+    return summary
+
+
+def summarize_actors() -> dict:
+    actors = list_actors(limit=100_000)
+    summary: dict[str, dict] = {}
+    for actor in actors:
+        name = actor.get("class_name") or "unknown"
+        entry = summary.setdefault(name, {})
+        state = actor.get("state") or "UNKNOWN"
+        entry[state] = entry.get(state, 0) + 1
+    return summary
+
+
+def list_objects(limit: int = 1000) -> list[dict]:
+    """Owner-side view of live objects in this process."""
+    ctx = worker_mod.get_global_context()
+    rows = []
+    for object_id, state in list(ctx._objects.items())[:limit]:
+        rows.append(
+            {
+                "object_id": object_id,
+                "status": state.status,
+                "size": getattr(state, "size", None),
+            }
+        )
+    return rows
+
+
+def get_actor(actor_id: str) -> Optional[dict]:
+    for row in list_actors(limit=100_000):
+        if row.get("actor_id") == actor_id:
+            return row
+    return None
+
+
+def get_node(node_id: str) -> Optional[dict]:
+    for row in list_nodes(limit=100_000):
+        if row.get("node_id") == node_id:
+            return row
+    return None

@@ -1,0 +1,29 @@
+"""Shared env setup for release benchmarks.
+
+force_cpu() pins the whole process tree (cluster workers inherit
+os.environ) to the virtual 8-device CPU mesh — the hostless twin
+(SURVEY §4.4). The single real TPU chip is reserved for bench.py and
+`--full` runs; concurrent worker processes must not grab it.
+"""
+
+import os
+
+
+def force_cpu(devices: int = 8) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p
+        for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    )
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass

@@ -1,0 +1,75 @@
+"""BASELINE config 2 — PPO CartPole-v1, single-node env runners.
+
+Reference-equivalent: rllib/tuned_examples/ppo/cartpole_ppo.py with
+--as-test (SURVEY §4.3): train until episode_return_mean ≥ target, report
+wall-clock and env-steps/s throughput.
+
+Prints one JSON line: {"env_steps_per_s": ..., "best_return": ...,
+"reached_target": ...}.
+"""
+
+import json
+import sys
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from bench_env import force_cpu
+
+force_cpu()
+
+import time
+
+
+def main(target_return: float = 150.0, max_iters: int = 20):
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.rllib import PPOConfig
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=2,
+            num_envs_per_env_runner=8,
+            rollout_fragment_length=64,
+        )
+        .training(
+            lr=3e-4,
+            train_batch_size=2048,
+            minibatch_size=256,
+            num_epochs=8,
+            entropy_coeff=0.01,
+            model={"fcnet_hiddens": (64, 64)},
+        )
+        .debugging(seed=0)
+        .build_algo()
+    )
+    best = -np.inf
+    start = time.perf_counter()
+    steps_before = 0
+    try:
+        for _ in range(max_iters):
+            result = algo.train()
+            steps_before = result["num_env_steps_sampled_lifetime"]
+            ret = result.get("episode_return_mean", np.nan)
+            if not np.isnan(ret):
+                best = max(best, ret)
+            if best >= target_return:
+                break
+        elapsed = time.perf_counter() - start
+        print(json.dumps(
+            {
+                "benchmark": "rllib_ppo_cartpole",
+                "env_steps_per_s": steps_before / elapsed,
+                "best_return": float(best),
+                "reached_target": bool(best >= target_return),
+                "wall_s": elapsed,
+            }
+        ))
+    finally:
+        algo.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Run the release/perf suite (release_tests.yaml) and collect results.
+
+Each benchmark runs in a fresh subprocess (own cluster) and prints one
+JSON line; this runner aggregates them into release_results.json.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+SCRIPTS = [
+    "release/train_fashion_mnist.py",
+    "release/rllib_ppo_cartpole.py",
+    "release/tune_asha_resnet.py",
+    "release/serve_bert_http.py",
+    "release/train_llama_lora.py",
+]
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = []
+    for script in SCRIPTS:
+        print(f"== {script}", file=sys.stderr)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, script)],
+            capture_output=True,
+            text=True,
+            timeout=3600,
+            cwd=repo,
+        )
+        line = next(
+            (l for l in reversed(proc.stdout.splitlines())
+             if l.startswith("{")),
+            None,
+        )
+        if proc.returncode != 0 or line is None:
+            results.append(
+                {
+                    "benchmark": script,
+                    "error": (proc.stderr or proc.stdout)[-2000:],
+                }
+            )
+        else:
+            results.append(json.loads(line))
+        print(json.dumps(results[-1]), file=sys.stderr)
+    out = os.path.join(repo, "release_results.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()

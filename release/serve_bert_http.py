@@ -1,0 +1,128 @@
+"""BASELINE config 4 — BERT-base-shaped HTTP inference with autoscaling.
+
+Reference-equivalent: release/serve_tests/ HTTP throughput benchmarks.
+A transformer encoder (BERT-base dims by default, tiny on CPU) behind the
+HTTP proxy with bucketed dynamic batching (XLA static shapes — one
+compile per bucket) and target-ongoing-requests autoscaling.
+
+Prints one JSON line: {"qps": ..., "p50_ms": ..., "replicas": ...}.
+"""
+
+import json
+import sys
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from bench_env import force_cpu
+
+force_cpu()
+
+import time
+
+
+def main(tiny: bool = True, seconds: float = 8.0, concurrency: int = 16):
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+
+    @serve.deployment(
+        max_ongoing_requests=64,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=2, target_ongoing_requests=8,
+            upscale_delay_s=1.0,
+        ),
+    )
+    class BertEncoder:
+        def __init__(self, tiny: bool):
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models.transformer import (
+                TransformerConfig, forward, init_params,
+            )
+
+            if tiny:
+                self.config = TransformerConfig.tiny()
+            else:  # BERT-base scale
+                self.config = TransformerConfig(
+                    vocab_size=30522, dim=768, n_layers=12, n_heads=12,
+                    n_kv_heads=12, hidden_dim=3072, max_seq=128,
+                    dtype=jnp.bfloat16,
+                )
+            self.params = init_params(self.config, jax.random.PRNGKey(0))
+            self._forward = jax.jit(
+                lambda params, tokens: forward(params, tokens, self.config)
+            )
+            # compile warmup for every batching bucket (static shapes)
+            self.seq = min(32, self.config.max_seq)
+            for bucket in (1, 4, 8):
+                tokens = jnp.zeros((bucket, self.seq), jnp.int32)
+                jax.block_until_ready(self._forward(self.params, tokens))
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.005,
+                     bucket_sizes=[1, 4, 8])
+        async def __call__(self, bodies):
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            tokens = np.zeros((len(bodies), self.seq), dtype=np.int32)
+            for i, body in enumerate(bodies):
+                ids = (body or {}).get("token_ids") or [101, 102]
+                tokens[i, : min(len(ids), self.seq)] = ids[: self.seq]
+            logits = jax.block_until_ready(
+                self._forward(self.params, jnp.asarray(tokens))
+            )
+            out = np.asarray(logits[:, 0, :8], dtype=np.float64)
+            return [{"embedding": row.tolist()} for row in out]
+
+    serve.start(http_port=8199)
+    serve.run(
+        BertEncoder.bind(tiny), name="bert", route_prefix="/bert",
+        http_port=8199,
+    )
+
+    import httpx
+
+    latencies: list[float] = []
+    payload = {"token_ids": [101, 2023, 2003, 1037, 3231, 102]}
+    deadline = time.perf_counter() + seconds
+
+    import concurrent.futures
+
+    def worker():
+        results = []
+        with httpx.Client(timeout=60) as client:
+            while time.perf_counter() < deadline:
+                start = time.perf_counter()
+                resp = client.post("http://127.0.0.1:8199/bert", json=payload)
+                resp.raise_for_status()
+                results.append(time.perf_counter() - start)
+        return results
+
+    with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
+        futures = [pool.submit(worker) for _ in range(concurrency)]
+        for future in futures:
+            latencies.extend(future.result())
+
+    status = serve.status()
+    replicas = status["bert"]["deployments"]["BertEncoder"]["running_replicas"]
+    latencies.sort()
+    qps = len(latencies) / seconds
+    print(json.dumps(
+        {
+            "benchmark": "serve_bert_http",
+            "qps": qps,
+            "p50_ms": 1e3 * latencies[len(latencies) // 2],
+            "p99_ms": 1e3 * latencies[int(len(latencies) * 0.99)],
+            "replicas": replicas,
+            "requests": len(latencies),
+        }
+    ))
+    serve.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""BASELINE config 1 — Fashion-MNIST CNN, 2-worker data-parallel trainer.
+
+Reference-equivalent: release/train_tests/ TorchTrainer Fashion-MNIST
+example. Exercises the Train core loop, per-round reporting, and
+checkpointing. Data is synthetic with Fashion-MNIST shapes (28×28×1,
+10 classes) — this benchmark measures the framework, not the dataset.
+
+Prints one JSON line: {"img_per_s": ..., "final_loss": ...}.
+"""
+
+import json
+import sys
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from bench_env import force_cpu
+
+force_cpu()
+
+import sys
+import time
+
+
+def train_loop(config):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu import train
+    from ray_tpu.models.cnn import CNNConfig, cnn_loss, init_cnn
+
+    ctx = train.get_context()
+    cnn_config = CNNConfig()
+    params = init_cnn(cnn_config, jax.random.PRNGKey(0))
+    optimizer = optax.adam(config["lr"])
+    opt_state = optimizer.init(params)
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        (loss, acc), grads = jax.value_and_grad(cnn_loss, has_aux=True)(
+            params, images, labels, cnn_config
+        )
+        updates, opt_state = optimizer.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    rng = np.random.default_rng(rank)
+    batch = config["batch_size"]
+    images = rng.normal(size=(batch, 28, 28, 1)).astype(np.float32)
+    labels = rng.integers(0, 10, size=batch)
+
+    # warmup compile
+    params, opt_state, loss, acc = step(params, opt_state, images, labels)
+    start = time.perf_counter()
+    steps = config["steps"]
+    for _ in range(steps):
+        params, opt_state, loss, acc = step(params, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+    img_per_s = steps * batch * world / elapsed
+    train.report(
+        {"img_per_s": img_per_s, "loss": float(loss), "acc": float(acc)},
+        checkpoint=train.save_pytree_checkpoint(params, extra={"step": steps}),
+    )
+
+
+def main():
+    import ray_tpu
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"lr": 1e-3, "batch_size": 64, "steps": 30},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="fmnist_bench"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    print(json.dumps(
+        {
+            "benchmark": "train_fashion_mnist",
+            "img_per_s": result.metrics["img_per_s"],
+            "final_loss": result.metrics["loss"],
+        }
+    ))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Autoscaler e2e — real autoscaler loop, fake provider launching
+in-process nodes (reference: test_autoscaler_fake_multinode.py)."""
+
+import time
+
+import ray_tpu
+
+def test_autoscaler_fake_provider():
+    """Reference: test_autoscaler_fake_multinode.py — real autoscaler loop,
+    fake nodes (in-process raylets) on one machine."""
+    from ray_tpu.autoscaler import (
+        AutoscalerConfig, FakeNodeProvider, NodeTypeConfig, StandardAutoscaler,
+    )
+    from ray_tpu.cluster_utils import Cluster
+
+    assert not ray_tpu.is_initialized()
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"resources": {"CPU": 1}}
+    )
+    ray_tpu.init(address=cluster.address)
+    try:
+        provider = FakeNodeProvider(cluster)
+        autoscaler = StandardAutoscaler(
+            AutoscalerConfig(
+                node_types=[NodeTypeConfig("cpu2", {"CPU": 2}, max_workers=3)],
+                idle_timeout_s=3600,
+                update_interval_s=0.25,
+            ),
+            provider,
+        )
+        autoscaler.start()
+
+        # Demand exceeding the head node's 1 CPU → autoscaler adds a node.
+        @ray_tpu.remote
+        def hold(seconds):
+            time.sleep(seconds)
+            return "done"
+
+        refs = [
+            hold.options(num_cpus=2).remote(3) for _ in range(2)
+        ]  # needs 4 CPUs; head has 1
+        out = ray_tpu.get(refs, timeout=120)
+        assert out == ["done", "done"]
+        assert len(provider.non_terminated_nodes()) >= 1
+        autoscaler.stop()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+def test_autoscaler_fake_provider():
+    """Reference: test_autoscaler_fake_multinode.py — real autoscaler loop,
+    fake nodes (in-process raylets) on one machine."""
+    from ray_tpu.autoscaler import (
+        AutoscalerConfig, FakeNodeProvider, NodeTypeConfig, StandardAutoscaler,
+    )
+    from ray_tpu.cluster_utils import Cluster
+
+    assert not ray_tpu.is_initialized()
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"resources": {"CPU": 1}}
+    )
+    ray_tpu.init(address=cluster.address)
+    try:
+        provider = FakeNodeProvider(cluster)
+        autoscaler = StandardAutoscaler(
+            AutoscalerConfig(
+                node_types=[NodeTypeConfig("cpu2", {"CPU": 2}, max_workers=3)],
+                idle_timeout_s=3600,
+                update_interval_s=0.25,
+            ),
+            provider,
+        )
+        autoscaler.start()
+
+        # Demand exceeding the head node's 1 CPU → autoscaler adds a node.
+        @ray_tpu.remote
+        def hold(seconds):
+            time.sleep(seconds)
+            return "done"
+
+        refs = [
+            hold.options(num_cpus=2).remote(3) for _ in range(2)
+        ]  # needs 4 CPUs; head has 1
+        out = ray_tpu.get(refs, timeout=120)
+        assert out == ["done", "done"]
+        assert len(provider.non_terminated_nodes()) >= 1
+        autoscaler.stop()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -150,3 +150,28 @@ def test_runtime_context(ray_start_shared):
     ctx = ray_tpu.get_runtime_context()
     assert ctx["is_driver"]
     assert ctx["job_id"].startswith("job-")
+
+
+def test_closure_captured_object_ref(ray_start_shared):
+    """Regression: functions/classes closing over an ObjectRef must
+    unpickle on workers (loads_function needs a ref resolver)."""
+    import numpy as np
+
+    import ray_tpu
+
+    ref = ray_tpu.put(np.arange(5))
+
+    @ray_tpu.remote
+    def reads_closure():
+        return int(ray_tpu.get(ref).sum())
+
+    assert ray_tpu.get(reads_closure.remote(), timeout=120) == 10
+
+    @ray_tpu.remote
+    class ClosureActor:
+        def total(self):
+            return int(ray_tpu.get(ref).sum())
+
+    actor = ClosureActor.remote()
+    assert ray_tpu.get(actor.total.remote(), timeout=120) == 10
+    ray_tpu.kill(actor)

@@ -1,0 +1,89 @@
+"""Compiled-graph (aDAG-equiv) tests — linear chains, fan-in joins,
+pipelining, and error propagation (SURVEY §2.2)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@ray_tpu.remote
+class Stage:
+    def __init__(self, offset):
+        self.offset = offset
+
+    def add(self, x):
+        return x + self.offset
+
+    def slow_add(self, x):
+        time.sleep(0.3)
+        return x + self.offset
+
+    def join(self, a, b):
+        return a + b
+
+    def boom(self, x):
+        raise RuntimeError("stage exploded")
+
+
+def test_interpreted_dag(ray_start_shared):
+    a, b = Stage.remote(1), Stage.remote(10)
+    with InputNode() as inp:
+        x = a.add.bind(inp)
+        out = b.add.bind(x)
+    assert out.execute(5) == 16
+
+
+def test_compiled_linear_chain(ray_start_shared):
+    a, b, c = Stage.remote(1), Stage.remote(10), Stage.remote(100)
+    with InputNode() as inp:
+        out = c.add.bind(b.add.bind(a.add.bind(inp)))
+    dag = out.experimental_compile()
+    assert dag.execute(0).get(timeout=60) == 111
+    # Repeated executes reuse the channels.
+    results = [dag.execute(i) for i in range(5)]
+    assert [r.get(timeout=60) for r in results] == [111 + i for i in range(5)]
+
+
+def test_compiled_fan_in_join(ray_start_shared):
+    a, b, j = Stage.remote(1), Stage.remote(2), Stage.remote(0)
+    with InputNode() as inp:
+        out = j.join.bind(a.add.bind(inp), b.add.bind(inp))
+    dag = out.experimental_compile()
+    assert dag.execute(10).get(timeout=60) == 23  # (10+1) + (10+2)
+
+
+def test_compiled_pipeline_overlaps(ray_start_shared):
+    """Two slow stages; pipelined executes take ~(n+1)*t, not 2n*t."""
+    a, b = Stage.remote(0), Stage.remote(0)
+    with InputNode() as inp:
+        out = b.slow_add.bind(a.slow_add.bind(inp))
+    dag = out.experimental_compile()
+    n = 4
+    start = time.perf_counter()
+    refs = [dag.execute(i) for i in range(n)]
+    values = [r.get(timeout=60) for r in refs]
+    elapsed = time.perf_counter() - start
+    assert values == list(range(n))
+    sequential = 2 * n * 0.3
+    assert elapsed < sequential * 0.85, (
+        f"no pipelining: {elapsed:.2f}s vs sequential {sequential:.2f}s"
+    )
+
+
+def test_compiled_dag_error_propagates(ray_start_shared):
+    a, b = Stage.remote(1), Stage.remote(0)
+    with InputNode() as inp:
+        out = b.boom.bind(a.add.bind(inp))
+    dag = out.experimental_compile()
+    with pytest.raises(Exception, match="stage exploded"):
+        dag.execute(1).get(timeout=60)
+
+
+def test_compiled_same_actor_rejected(ray_start_shared):
+    a = Stage.remote(1)
+    with InputNode() as inp:
+        with pytest.raises(ValueError):
+            a.add.bind(a.add.bind(inp))

@@ -1,0 +1,168 @@
+"""Platform-services tests: state API, metrics, dashboard REST, job
+submission, autoscaler (pure bin-pack math + fake provider e2e). Mirrors
+reference patterns from SURVEY §4.2/§4.4."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+# ---------- pure autoscaler math ----------
+
+def test_bin_pack_unmet_demand():
+    from ray_tpu.autoscaler import NodeTypeConfig, bin_pack_unmet_demand
+
+    types = [
+        NodeTypeConfig("cpu4", {"CPU": 4}),
+        NodeTypeConfig("tpu_v4_8", {"CPU": 8, "TPU": 4}),
+    ]
+    # Demand fits on existing nodes → nothing to launch.
+    assert bin_pack_unmet_demand([{"CPU": 1}], [{"CPU": 2}], types) == {}
+    # CPU demand overflow → one cpu4 node.
+    plan = bin_pack_unmet_demand(
+        [{"CPU": 2}, {"CPU": 2}, {"CPU": 2}], [{"CPU": 2}], types
+    )
+    assert plan == {"cpu4": 1}
+    # TPU demand → TPU node type even though cpu4 is listed first.
+    plan = bin_pack_unmet_demand([{"TPU": 4}], [{"CPU": 64}], types)
+    assert plan == {"tpu_v4_8": 1}
+    # Bin-packing consolidates multiple small demands into one node.
+    plan = bin_pack_unmet_demand(
+        [{"CPU": 1}] * 4, [], types
+    )
+    assert plan == {"cpu4": 1}
+    # Infeasible demand is dropped, not launched.
+    assert bin_pack_unmet_demand([{"GPU": 1}], [], types) == {}
+
+
+# ---------- state API ----------
+
+def test_state_api_lists(ray_start_shared):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return "ok"
+
+    actor = Marker.options(name="state-api-marker").remote()
+    ray_tpu.get(actor.ping.remote())
+
+    actors = state.list_actors()
+    assert any(a.get("name") == "state-api-marker" for a in actors)
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and nodes[0]["alive"]
+    workers = state.list_workers()
+    assert len(workers) >= 1
+    summary = state.summarize_actors()
+    assert sum(sum(v.values()) for v in summary.values()) == len(actors)
+    ray_tpu.kill(actor)
+
+
+def test_state_api_tasks(ray_start_shared):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def traced_task():
+        return 1
+
+    ray_tpu.get([traced_task.remote() for _ in range(3)])
+    time.sleep(1.0)  # task events flush asynchronously
+    tasks = state.list_tasks()
+    named = [t for t in tasks if t.get("name") and "traced_task" in str(t["name"])]
+    assert named, f"no traced_task in {tasks[:5]}"
+    summary = state.summarize_tasks()
+    assert any("traced_task" in name for name in summary)
+
+
+# ---------- metrics ----------
+
+def test_metrics_prometheus_export(ray_start_shared):
+    from ray_tpu.util import metrics
+
+    counter = metrics.Counter("test_requests", "test counter", ("path",))
+    counter.inc(3, {"path": "/a"})
+    gauge = metrics.Gauge("test_depth", "queue depth")
+    gauge.set(7)
+    hist = metrics.Histogram(
+        "test_latency", "latency", boundaries=(0.1, 1.0)
+    )
+    hist.observe(0.05)
+    hist.observe(5.0)
+    metrics.flush()
+    text = metrics.collect_prometheus_text()
+    assert 'ray_tpu_test_requests{path="/a"} 3' in text
+    assert "ray_tpu_test_depth 7" in text
+    assert 'ray_tpu_test_latency_bucket{le="0.1"} 1' in text
+    assert "ray_tpu_test_latency_count 2" in text
+    assert "# TYPE ray_tpu_test_requests counter" in text
+
+
+# ---------- dashboard ----------
+
+def test_dashboard_endpoints(ray_start_shared):
+    import httpx
+
+    from ray_tpu.dashboard import start_dashboard
+
+    start_dashboard(port=8266)
+    base = "http://127.0.0.1:8266"
+    index = httpx.get(base + "/", timeout=30)
+    assert "ray_tpu dashboard" in index.text
+    cluster = httpx.get(base + "/api/cluster", timeout=30).json()
+    assert cluster["total"].get("CPU", 0) > 0
+    nodes = httpx.get(base + "/api/nodes", timeout=30).json()
+    assert nodes and nodes[0]["alive"]
+    actors = httpx.get(base + "/api/actors", timeout=30).json()
+    assert isinstance(actors, list)
+    metrics_text = httpx.get(base + "/metrics", timeout=30).text
+    assert isinstance(metrics_text, str)
+
+
+# ---------- job submission ----------
+
+def test_job_submission_end_to_end(ray_start_shared, tmp_path):
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+
+    client = JobSubmissionClient()
+    script = tmp_path / "job_script.py"
+    script.write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, '/root/repo')\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(address='auto')\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+        "print('job result:', ray_tpu.get(f.remote(21)))\n"
+        "ray_tpu.shutdown()\n"
+    )
+    job_id = client.submit_job(entrypoint=f"python {script}")
+    status = client.wait_until_finished(job_id, timeout=180)
+    logs = client.get_job_logs(job_id)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "job result: 42" in logs
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+
+
+def test_job_submission_failure_and_stop(ray_start_shared):
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+
+    client = JobSubmissionClient()
+    bad = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(bad, timeout=60) == JobStatus.FAILED
+
+    slow = client.submit_job(entrypoint="sleep 60")
+    time.sleep(1.0)
+    assert client.stop_job(slow)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if client.get_job_status(slow) == JobStatus.STOPPED:
+            break
+        time.sleep(0.3)
+    assert client.get_job_status(slow) == JobStatus.STOPPED

@@ -1,0 +1,299 @@
+"""Serve tests — mirrors python/ray/serve/tests strategy (SURVEY §4.3):
+autoscaling policy tested pure, batching tested in-process, deployments
+end-to-end against a real controller + replicas + HTTP proxy."""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve._private.autoscaling_policy import (
+    AutoscalingState,
+    calculate_desired_num_replicas,
+)
+from ray_tpu.serve._private.common import AutoscalingConfig
+
+
+# ---------- pure policy math ----------
+
+def test_autoscaling_desired_replicas():
+    cfg = AutoscalingConfig(
+        min_replicas=1, max_replicas=10, target_ongoing_requests=2.0
+    )
+    assert calculate_desired_num_replicas(cfg, 0.0, 1) == 1  # min clamp
+    assert calculate_desired_num_replicas(cfg, 8.0, 2) == 4  # 8/2 target
+    assert calculate_desired_num_replicas(cfg, 100.0, 2) == 10  # max clamp
+    assert calculate_desired_num_replicas(cfg, 2.0, 4) == 1  # scale down
+    # from zero
+    assert calculate_desired_num_replicas(cfg, 0.0, 0) == 1
+
+
+def test_autoscaling_delays():
+    cfg = AutoscalingConfig(
+        min_replicas=1,
+        max_replicas=10,
+        target_ongoing_requests=1.0,
+        upscale_delay_s=5.0,
+        downscale_delay_s=30.0,
+    )
+    state = AutoscalingState(cfg)
+    # Overload at t=0: proposal registered but not applied until delay passes.
+    assert state.decide(10.0, 1, now=0.0) == 1
+    assert state.decide(10.0, 1, now=2.0) == 1
+    assert state.decide(10.0, 1, now=5.1) == 10
+    # Underload: longer delay.
+    state2 = AutoscalingState(cfg)
+    assert state2.decide(0.0, 4, now=0.0) == 4
+    assert state2.decide(0.0, 4, now=10.0) == 4
+    assert state2.decide(0.0, 4, now=31.0) == 1
+    # Changing proposal resets the clock.
+    cfg_wide = AutoscalingConfig(
+        min_replicas=1,
+        max_replicas=100,
+        target_ongoing_requests=1.0,
+        upscale_delay_s=5.0,
+        downscale_delay_s=30.0,
+    )
+    state3 = AutoscalingState(cfg_wide)
+    assert state3.decide(10.0, 1, now=0.0) == 1
+    assert state3.decide(20.0, 1, now=4.0) == 1  # new proposal (20 != 10)
+    assert state3.decide(20.0, 1, now=8.0) == 1  # only 4s since reset
+    assert state3.decide(20.0, 1, now=9.5) == 20
+
+
+# ---------- batching (pure asyncio) ----------
+
+def test_batch_collects_and_pads():
+    from ray_tpu.serve.batching import batch
+
+    seen_sizes = []
+
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.02, bucket_sizes=[4, 8])
+    async def handler(items):
+        seen_sizes.append(len(items))
+        return [i * 2 for i in items]
+
+    async def main():
+        results = await asyncio.gather(*[handler(i) for i in range(6)])
+        return results
+
+    results = asyncio.run(main())
+    assert results == [i * 2 for i in range(6)]
+    # 6 requests → one full batch of 4, then 2 padded up to bucket 4.
+    assert all(s in (4, 8) for s in seen_sizes)
+
+
+def test_batch_error_propagates():
+    from ray_tpu.serve.batching import batch
+
+    @batch(max_batch_size=2, batch_wait_timeout_s=0.01)
+    async def handler(items):
+        raise ValueError("boom")
+
+    async def main():
+        with pytest.raises(ValueError):
+            await handler(1)
+
+    asyncio.run(main())
+
+
+# ---------- end-to-end ----------
+
+@pytest.fixture(scope="module")
+def serve_instance(ray_start_shared):
+    yield
+    serve.shutdown()
+
+
+def test_basic_deployment(serve_instance):
+    @serve.deployment(num_replicas=2)
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Doubler.bind(), name="doubler", route_prefix="/double")
+    assert handle.remote(21).result() == 42
+    results = [handle.remote(i).result() for i in range(10)]
+    assert results == [i * 2 for i in range(10)]
+    status = serve.status()
+    assert status["doubler"]["status"] == "RUNNING"
+    assert status["doubler"]["deployments"]["Doubler"]["running_replicas"] == 2
+
+
+def test_function_deployment(serve_instance):
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    handle = serve.run(square.bind(), name="square", route_prefix="/square")
+    assert handle.remote(7).result() == 49
+
+
+def test_composition(serve_instance):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result()
+            return y * 10
+
+    app = Model.bind(Preprocess.bind())
+    handle = serve.run(app, name="composed", route_prefix="/composed")
+    assert handle.remote(4).result() == 50
+
+
+def test_method_calls_and_init_args(serve_instance):
+    @serve.deployment
+    class Calculator:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def add(self, x):
+            return x + self.offset
+
+        def sub(self, x):
+            return x - self.offset
+
+    handle = serve.run(Calculator.bind(100), name="calc", route_prefix="/calc")
+    assert handle.add.remote(1).result() == 101
+    assert handle.sub.remote(1).result() == -99
+
+
+def test_user_config_reconfigure(serve_instance):
+    @serve.deployment(user_config={"threshold": 1})
+    class Thresholder:
+        def __init__(self):
+            self.threshold = 0
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, x):
+            return x >= self.threshold
+
+    handle = serve.run(Thresholder.bind(), name="thresh", route_prefix="/thresh")
+    assert handle.remote(1).result() is True
+    # Redeploy with new user_config: reconfigures in place (same version).
+    app2 = Thresholder.options(user_config={"threshold": 5}).bind()
+    handle = serve.run(app2, name="thresh", route_prefix="/thresh")
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if handle.remote(3).result() is False:
+            break
+        time.sleep(0.2)
+    assert handle.remote(3).result() is False
+    assert handle.remote(7).result() is True
+
+
+def test_http_proxy(serve_instance):
+    import httpx
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, body):
+            if isinstance(body, dict) and "value" in body:
+                return {"echo": body["value"]}
+            return {"echo": body}
+
+    serve.start(http_port=8123)
+    serve.run(Echo.bind(), name="echo", route_prefix="/echo", http_port=8123)
+    resp = httpx.get("http://127.0.0.1:8123/-/healthz", timeout=30)
+    assert resp.text == "ok"
+    resp = httpx.post(
+        "http://127.0.0.1:8123/echo", json={"value": "hi"}, timeout=60
+    )
+    assert resp.status_code == 200, resp.text
+    assert resp.json() == {"echo": "hi"}
+    routes = httpx.get("http://127.0.0.1:8123/-/routes", timeout=30).json()
+    assert "/echo" in routes
+
+
+def test_serve_batch_in_deployment(serve_instance):
+    @serve.deployment
+    class BatchedModel:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        async def __call__(self, items):
+            return [i + 1000 for i in items]
+
+    handle = serve.run(BatchedModel.bind(), name="batched", route_prefix="/batched")
+    responses = [handle.remote(i) for i in range(12)]
+    values = [r.result() for r in responses]
+    assert values == [i + 1000 for i in range(12)]
+
+
+def test_multiplexed_deployment(serve_instance):
+    @serve.deployment
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": int(model_id[-1])}
+
+        async def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id() or "m1"
+            model = await self.get_model(model_id)
+            return x * model["scale"]
+
+    handle = serve.run(MultiModel.bind(), name="mux", route_prefix="/mux")
+    h2 = handle.options(multiplexed_model_id="m2")
+    h3 = handle.options(multiplexed_model_id="m3")
+    assert h2.remote(10).result() == 20
+    assert h3.remote(10).result() == 30
+    assert h2.remote(5).result() == 10  # cached
+
+
+def test_replica_failure_recovery(serve_instance):
+    @serve.deployment(num_replicas=1, health_check_period_s=0.5)
+    class Fragile:
+        def __call__(self, x):
+            return x
+
+        def die(self, _):
+            import os
+
+            os._exit(1)
+
+    handle = serve.run(Fragile.bind(), name="fragile", route_prefix="/fragile")
+    assert handle.remote(1).result() == 1
+    try:
+        handle.die.remote(0).result(timeout=10)
+    except Exception:
+        pass
+    # Controller notices the dead replica and replaces it.
+    deadline = time.time() + 60
+    ok = False
+    while time.time() < deadline:
+        try:
+            fresh = serve.get_app_handle("fragile")
+            if fresh.remote(5).result(timeout=10) == 5:
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert ok, "replica was not replaced after death"
+
+
+def test_delete_application(serve_instance):
+    @serve.deployment
+    def noop(x):
+        return x
+
+    serve.run(noop.bind(), name="temp", route_prefix="/temp")
+    assert "temp" in serve.status()
+    serve.delete("temp")
+    deadline = time.time() + 20
+    while time.time() < deadline and "temp" in serve.status():
+        time.sleep(0.2)
+    assert "temp" not in serve.status()

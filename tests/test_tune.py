@@ -1,0 +1,369 @@
+"""Tune tests — mirrors the reference's python/ray/tune/tests strategy
+(SURVEY §4.3): scheduler math driven pure with fabricated results, small
+deterministic trainables end-to-end, and experiment restore."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.schedulers.trial_scheduler import TrialScheduler
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.searcher import ConcurrencyLimiter
+from ray_tpu.tune.tuner import TuneConfig, Tuner
+
+
+# ---------- pure search-space / searcher math (no cluster) ----------
+
+def test_grid_search_cross_product():
+    gen = BasicVariantGenerator(
+        {"a": tune.grid_search([1, 2, 3]), "b": tune.grid_search(["x", "y"])}
+    )
+    assert gen.total_samples == 6
+    configs = [gen.suggest(str(i)) for i in range(6)]
+    assert all(c is not None for c in configs)
+    assert gen.suggest("7") is None
+    assert {(c["a"], c["b"]) for c in configs} == {
+        (a, b) for a in (1, 2, 3) for b in ("x", "y")
+    }
+
+
+def test_random_sampling_reproducible():
+    space = {"lr": tune.loguniform(1e-5, 1e-1), "units": tune.randint(8, 128)}
+    a = BasicVariantGenerator(space, num_samples=5, random_state=42)
+    b = BasicVariantGenerator(space, num_samples=5, random_state=42)
+    for i in range(5):
+        ca, cb = a.suggest(str(i)), b.suggest(str(i))
+        assert ca == cb
+        assert 1e-5 <= ca["lr"] <= 1e-1
+        assert 8 <= ca["units"] < 128
+
+
+def test_nested_space_and_sample_from():
+    space = {
+        "model": {"depth": tune.choice([2, 4])},
+        "double_depth": tune.sample_from(lambda spec: spec.config["model"]["depth"] * 2),
+    }
+    gen = BasicVariantGenerator(space, num_samples=3, random_state=0)
+    for i in range(3):
+        config = gen.suggest(str(i))
+        assert config["double_depth"] == config["model"]["depth"] * 2
+
+
+def test_searcher_state_roundtrip():
+    space = {"x": tune.uniform(0, 1)}
+    gen = BasicVariantGenerator(space, num_samples=10, random_state=7)
+    first3 = [gen.suggest(str(i)) for i in range(3)]
+    state = gen.save()
+    fresh = BasicVariantGenerator(space, num_samples=10, random_state=7)
+    fresh.restore(state)
+    assert fresh.suggest("3") == gen.suggest("3")
+    assert first3[0] != first3[1]
+
+
+def test_concurrency_limiter():
+    gen = ConcurrencyLimiter(
+        BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=10),
+        max_concurrent=2,
+    )
+    assert gen.suggest("a") is not None
+    assert gen.suggest("b") is not None
+    assert gen.suggest("c") is None  # at cap
+    gen.on_trial_complete("a")
+    assert gen.suggest("c") is not None
+
+
+# ---------- pure scheduler math (fabricated results, mock trials) ----------
+
+class _FakeTrial:
+    def __init__(self, trial_id):
+        self.trial_id = trial_id
+        self.status = "RUNNING"
+        self.config = {"lr": 0.1}
+
+    def is_finished(self):
+        return False
+
+
+class _FakeController:
+    def __init__(self, trials):
+        self.live_trials = trials
+        self.transplants = []
+
+    def transplant_trial(self, trial, donor, new_config):
+        self.transplants.append((trial.trial_id, donor.trial_id, new_config))
+
+
+def test_asha_stops_bottom_trials():
+    sched = ASHAScheduler(
+        metric="score", mode="max", grace_period=1, max_t=100, reduction_factor=2
+    )
+    trials = [_FakeTrial(f"t{i}") for i in range(8)]
+    ctl = _FakeController(trials)
+    for t in trials:
+        sched.on_trial_add(ctl, t)
+    # At iteration 1, trials report descending scores 7..0: late low scorers
+    # fall below the rung cutoff (top 1/η of recorded values) and must stop.
+    decisions = {}
+    for i, t in enumerate(trials):
+        decisions[t.trial_id] = sched.on_trial_result(
+            ctl, t, {"training_iteration": 1, "score": float(7 - i)}
+        )
+    # First reporter has no cutoff; the worst late reporters are stopped.
+    assert decisions["t0"] == TrialScheduler.CONTINUE
+    stopped = [tid for tid, d in decisions.items() if d == TrialScheduler.STOP]
+    assert stopped, "ASHA should early-stop bottom-half trials"
+    # A top performer at a later rung continues.
+    assert (
+        sched.on_trial_result(
+            ctl, trials[7], {"training_iteration": 2, "score": 100.0}
+        )
+        == TrialScheduler.CONTINUE
+    )
+    # Reaching max_t always stops.
+    assert (
+        sched.on_trial_result(
+            ctl, trials[7], {"training_iteration": 100, "score": 100.0}
+        )
+        == TrialScheduler.STOP
+    )
+
+
+def test_asha_mode_min():
+    sched = ASHAScheduler(
+        metric="loss", mode="min", grace_period=1, max_t=10, reduction_factor=2
+    )
+    trials = [_FakeTrial(f"t{i}") for i in range(4)]
+    ctl = _FakeController(trials)
+    for t in trials:
+        sched.on_trial_add(ctl, t)
+    for i, t in enumerate(trials[:3]):
+        sched.on_trial_result(ctl, t, {"training_iteration": 1, "loss": float(i)})
+    # loss=99 is the worst → stop; loss=0 region continues.
+    assert (
+        sched.on_trial_result(
+            ctl, trials[3], {"training_iteration": 1, "loss": 99.0}
+        )
+        == TrialScheduler.STOP
+    )
+
+
+def test_median_stopping_rule():
+    sched = MedianStoppingRule(
+        metric="score", mode="max", grace_period=0, min_samples_required=2
+    )
+    trials = [_FakeTrial(f"t{i}") for i in range(4)]
+    ctl = _FakeController(trials)
+    for step in (1, 2):
+        for t, base in zip(trials[:3], (10.0, 10.0, 10.0)):
+            assert (
+                sched.on_trial_result(
+                    ctl, t, {"training_iteration": step, "score": base * step}
+                )
+                == TrialScheduler.CONTINUE
+            )
+    # A trial far below the median of running means gets stopped.
+    assert (
+        sched.on_trial_result(
+            ctl, trials[3], {"training_iteration": 2, "score": 0.1}
+        )
+        == TrialScheduler.STOP
+    )
+
+
+def test_pbt_exploits_bottom_quantile():
+    sched = PopulationBasedTraining(
+        metric="score",
+        mode="max",
+        perturbation_interval=2,
+        hyperparam_mutations={"lr": tune.uniform(0.001, 1.0)},
+        quantile_fraction=0.25,
+        seed=0,
+    )
+    trials = [_FakeTrial(f"t{i}") for i in range(8)]
+    ctl = _FakeController(trials)
+    for t in trials:
+        sched.on_trial_add(ctl, t)
+    # Everyone reports at t=2; scores ascend so t0 is bottom, t7 top.
+    for i, t in enumerate(trials):
+        sched.on_trial_result(ctl, t, {"training_iteration": 2, "score": float(i)})
+    # Bottom trial reports again past the interval → transplant happened.
+    sched.on_trial_result(ctl, trials[0], {"training_iteration": 4, "score": 0.0})
+    assert ctl.transplants
+    loser, donor, new_config = ctl.transplants[0]
+    assert loser == "t0"
+    assert donor in {"t6", "t7"}
+    assert "lr" in new_config
+
+
+def test_pbt_explore_perturbs_numeric():
+    sched = PopulationBasedTraining(
+        metric="score",
+        mode="max",
+        hyperparam_mutations={"lr": tune.uniform(0.0, 1.0)},
+        resample_probability=0.0,
+        seed=1,
+    )
+    out = sched.explore({"lr": 0.1})
+    assert out["lr"] == pytest.approx(0.1 * 1.2) or out["lr"] == pytest.approx(0.1 * 0.8)
+
+
+# ---------- end-to-end on a live cluster ----------
+
+def _trainable(config):
+    score = 0.0
+    for _ in range(5):
+        score += config["slope"]
+        tune.report({"score": score})
+
+
+def test_tuner_grid_end_to_end(ray_start_shared, tmp_path):
+    tuner = Tuner(
+        _trainable,
+        param_space={"slope": tune.grid_search([1.0, 2.0, 3.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=ray_tpu.train.RunConfig(
+            name="grid_e2e", storage_path=str(tmp_path)
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 3
+    best = results.get_best_result()
+    assert best.config["slope"] == 3.0
+    assert best.metrics["score"] == pytest.approx(15.0)
+    df = results.get_dataframe()
+    assert len(df) == 3
+
+
+def test_tuner_function_checkpoint_and_restore(ray_start_shared, tmp_path):
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt["step"] if ckpt else 0
+        for step in range(start, 3):
+            tune.report({"step_done": step + 1}, checkpoint={"step": step + 1})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="step_done", mode="max"),
+        run_config=ray_tpu.train.RunConfig(name="ckpt_e2e", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    assert all(r.metrics["step_done"] == 3 for r in results)
+    # experiment state was persisted and is restorable
+    exp_dir = os.path.join(str(tmp_path), "ckpt_e2e")
+    assert Tuner.can_restore(exp_dir)
+    restored = Tuner.restore(exp_dir, trainable)
+    results2 = restored.fit()
+    assert len(results2) == 2  # trials came back, already terminated
+
+
+def test_tuner_trial_failure_retry(ray_start_shared, tmp_path):
+    def flaky(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt["step"] if ckpt else 0
+        for step in range(start, 4):
+            if step == 2 and not ckpt:
+                raise RuntimeError("boom")
+            tune.report({"step_done": step + 1}, checkpoint={"step": step + 1})
+
+    tuner = Tuner(
+        flaky,
+        param_space={"x": 1},
+        tune_config=TuneConfig(metric="step_done", mode="max"),
+        run_config=ray_tpu.train.RunConfig(
+            name="flaky_e2e",
+            storage_path=str(tmp_path),
+            failure_config=ray_tpu.train.FailureConfig(max_failures=2),
+        ),
+    )
+    results = tuner.fit()
+    assert results.num_errors == 0
+    assert results[0].metrics["step_done"] == 4
+
+
+def test_tuner_asha_end_to_end(ray_start_shared, tmp_path):
+    def trainable(config):
+        for step in range(1, 11):
+            tune.report({"score": config["quality"] * step})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([0.1, 0.2, 1.0, 2.0])},
+        tune_config=TuneConfig(
+            metric="score",
+            mode="max",
+            scheduler=ASHAScheduler(
+                metric="score", mode="max", grace_period=2, max_t=10
+            ),
+        ),
+        run_config=ray_tpu.train.RunConfig(name="asha_e2e", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.config["quality"] == 2.0
+
+
+def test_tune_class_api(ray_start_shared, tmp_path):
+    class Counter(tune.Trainable):
+        def setup(self, config):
+            self.count = 0
+            self.step_size = config["step_size"]
+
+        def step(self):
+            self.count += self.step_size
+            return {"count": self.count, "done": self.count >= 10 * self.step_size}
+
+        def save_checkpoint(self):
+            return {"count": self.count}
+
+        def load_checkpoint(self, checkpoint):
+            self.count = checkpoint["count"]
+
+    results = tune.run(
+        Counter,
+        config={"step_size": tune.grid_search([1, 5])},
+        metric="count",
+        mode="max",
+        storage_path=str(tmp_path),
+        name="class_api",
+    )
+    assert len(results) == 2
+    assert results.get_best_result().config["step_size"] == 5
+
+
+def test_tuner_wraps_trainer(ray_start_shared, tmp_path):
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        from ray_tpu.train import report
+
+        for i in range(2):
+            report({"loss": 1.0 / config.get("lr_scale", 1.0) / (i + 1)})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"lr_scale": 1.0},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="inner", storage_path=str(tmp_path / "inner")),
+    )
+    tuner = Tuner(
+        trainer,
+        param_space={
+            "train_loop_config": {"lr_scale": tune.grid_search([1.0, 4.0])}
+        },
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=ray_tpu.train.RunConfig(
+            name="trainer_sweep", storage_path=str(tmp_path)
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    assert results.get_best_result().config["train_loop_config"]["lr_scale"] == 4.0
