@@ -8,6 +8,7 @@ through the object store, so the shuffle is fully distributed.
 
 from __future__ import annotations
 
+import zlib
 from typing import Any, Optional
 
 import numpy as np
@@ -16,6 +17,20 @@ import pyarrow.compute as pc
 
 import ray_tpu
 from ray_tpu.data.block import BlockAccessor
+
+
+def _stable_hash(v) -> int:
+    """Deterministic across processes. The builtin hash() is per-process
+    salted for str/bytes, and _split_block runs in different workers —
+    the same groupby key would land in different partitions, silently
+    producing duplicate keys with partial aggregates."""
+    if isinstance(v, bytes):
+        data = v
+    elif isinstance(v, str):
+        data = v.encode()
+    else:
+        data = repr(v).encode()
+    return zlib.crc32(data)
 
 
 @ray_tpu.remote
@@ -39,7 +54,7 @@ def _split_block(block, num_parts: int, mode: str, key, seed) -> list:
             assignment = (num_parts - 1) - assignment
     elif mode == "hash":
         col = table.column(key).to_pandas()
-        assignment = col.map(lambda v: hash(v) % num_parts).to_numpy()
+        assignment = col.map(lambda v: _stable_hash(v) % num_parts).to_numpy()
     else:
         raise ValueError(mode)
     parts = []

@@ -55,6 +55,11 @@ class TransformerConfig:
     # "flash" | "reference" | callable(q,k,v,causal)->o supplied by
     # parallel/ (ring attention, ulysses).
     attention: str = "flash"
+    # Rematerialization policy for the layer scan: None (save everything),
+    # "dots" (save matmul outputs only), "full" (save nothing — recompute
+    # the whole layer in backward). Trades HBM for FLOPs (SURVEY §7.0 HBM
+    # bullet); pick per chip memory at bench/train-config level.
+    remat: str | None = None
 
     @property
     def head_dim(self) -> int:
@@ -190,9 +195,13 @@ def _attention_block(x, layer, config, cos_sin, positions, attention_fn):
 
 
 def _dense_mlp(h, layer):
-    gate = jax.nn.silu((h @ layer["w_gate"]).astype(jnp.float32))
-    up = (h @ layer["w_up"]).astype(jnp.float32)
-    return (gate * up).astype(h.dtype) @ layer["w_down"]
+    # silu math in f32 for accuracy but residuals stored in the model dtype
+    # (bf16): halves the dominant activation-memory term vs keeping the
+    # f32 intermediates live for backward.
+    gate = (h @ layer["w_gate"]).astype(h.dtype)
+    up = (h @ layer["w_up"]).astype(h.dtype)
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype)
+    return (act * up) @ layer["w_down"]
 
 
 def _moe_mlp(h, layer, config: TransformerConfig):
@@ -265,6 +274,18 @@ def forward(
         else:
             x = x + _dense_mlp(h, layer).astype(x.dtype)
         return x, None
+
+    if config.remat == "full":
+        layer_step = jax.checkpoint(
+            layer_step, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    elif config.remat == "dots":
+        layer_step = jax.checkpoint(
+            layer_step,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    elif config.remat is not None:
+        raise ValueError(f"unknown remat policy {config.remat!r}")
 
     x, _ = jax.lax.scan(layer_step, x, params["layers"])
     x = rmsnorm_reference(x, params["final_norm"])

@@ -1,13 +1,27 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
 The hot op of the flagship models (SURVEY §2.9 SP row: the reference has no
 native attention kernels at all — attention arrives via user engines; here it
 is in-tree). Blocked online-softmax attention:
 
-  grid = (batch*heads, q_blocks, kv_blocks)   # last dim sequential on TPU
-  VMEM scratch carries the running max/sum/accumulator across kv steps.
+  forward:  grid = (batch*heads, q_blocks, kv_blocks)   # kv sequential
+            VMEM scratch carries running max/sum/accumulator across kv steps;
+            emits O and the logsumexp (LSE) residual.
+  backward: two kernels (the standard flash-v2 split):
+              dq:  grid = (batch*heads, q_blocks, kv_blocks)  # kv sequential
+              dkv: grid = (batch*heads, kv_blocks, q_blocks)  # q  sequential
+            Both recompute P = exp(S - LSE) blockwise from (q, k) — O(S²)
+            probabilities are never materialized in HBM, so long sequences
+            train in memory linear in S.
 
-On non-TPU backends the same kernel runs in interpreter mode (the CPU twin,
+MXU discipline: matmul operands stay in the input dtype (bfloat16 on TPU —
+the MXU's native multiply) with float32 accumulation via
+preferred_element_type; only softmax/statistics math runs in f32 vectors.
+
+Causal block skipping: grid steps whose (q_block, kv_block) tile is entirely
+masked skip all compute (≈2× for causal training).
+
+On non-TPU backends the same kernels run in interpreter mode (the CPU twin,
 SURVEY §4.4), so tests exercise the identical code path the TPU compiles.
 """
 
@@ -22,11 +36,52 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal,
-    block_q, block_k, num_kv_blocks, precision, causal_offset
+def _mxu(x, precision):
+    """Operand dtype for MXU dots: keep bf16 native; honor explicit
+    precision requests (tests use Precision.HIGHEST with f32 inputs)."""
+    if precision is None and x.dtype == jnp.bfloat16:
+        return x
+    return x.astype(jnp.float32)
+
+
+def _tile_needed(causal, causal_offset, q_index, kv_index, block_q, block_k):
+    """False only for tiles that the causal mask zeroes entirely."""
+    if not causal:
+        return True
+    return causal_offset + (q_index + 1) * block_q - 1 >= kv_index * block_k
+
+
+def _masked_scores(q_ref, k_ref, q_index, kv_index, *, scale, causal,
+                   block_q, block_k, precision, causal_offset):
+    """scale * Q K^T with the causal mask applied — shared by all three
+    kernels so forward and backward can never desynchronize."""
+    q = _mxu(q_ref[0], precision)                # [block_q, d]
+    k = _mxu(k_ref[0], precision)                # [block_k, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    ) * scale                                    # [block_q, block_k] f32
+    if causal:
+        # causal_offset = seq_k - seq_q aligns queries to the END of the
+        # key sequence (decode convention; matches attention_reference's
+        # tril(..., seq_k - seq_q)).
+        q_pos = (
+            causal_offset + q_index * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        )
+        k_pos = kv_index * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    return s, q, k
+
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale,
+    causal, block_q, block_k, num_kv_blocks, precision, causal_offset
 ):
     kv_index = pl.program_id(2)
+    q_index = pl.program_id(1)
 
     @pl.when(kv_index == 0)
     def _init():
@@ -34,44 +89,128 @@ def _flash_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32)            # [block_q, d]
-    k = k_ref[0].astype(jnp.float32)            # [block_k, d]
-    v = v_ref[0].astype(jnp.float32)            # [block_k, d]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-        precision=precision,
-    ) * scale                                    # [block_q, block_k]
+    # Entirely-masked tiles contribute nothing: skip their compute.
+    needed = _tile_needed(causal, causal_offset, q_index, kv_index,
+                          block_q, block_k)
 
-    if causal:
-        q_index = pl.program_id(1)
-        # causal_offset = seq_k - seq_q aligns queries to the END of the key
-        # sequence (decode convention; matches attention_reference's
-        # tril(..., seq_k - seq_q)).
-        q_pos = causal_offset + q_index * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
+    @pl.when(needed)
+    def _compute():
+        s, _, _ = _masked_scores(
+            q_ref, k_ref, q_index, kv_index, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, precision=precision,
+            causal_offset=causal_offset,
         )
-        k_pos = kv_index * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
-    m_prev = m_scr[:]                            # [block_q, 1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)                       # [block_q, block_k]
-    correction = jnp.exp(m_prev - m_new)         # [block_q, 1]
-    l_new = correction * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-        precision=precision,
-    )
-    m_scr[:] = m_new
-    l_scr[:] = l_new
+        m_prev = m_scr[:]                        # [block_q, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                   # [block_q, block_k] f32
+        correction = jnp.exp(m_prev - m_new)     # [block_q, 1]
+        l_scr[:] = correction * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0]                             # [block_k, d]
+        acc_scr[:] = acc_scr[:] * correction + jax.lax.dot_general(
+            _mxu(p.astype(v.dtype), precision), _mxu(v, precision),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        m_scr[:] = m_new
 
     @pl.when(kv_index == num_kv_blocks - 1)
     def _finalize():
-        denom = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
+
+
+def _flash_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
+    scale, causal, block_q, block_k, num_kv_blocks, precision, causal_offset
+):
+    kv_index = pl.program_id(2)
+    q_index = pl.program_id(1)
+
+    @pl.when(kv_index == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = _tile_needed(causal, causal_offset, q_index, kv_index,
+                          block_q, block_k)
+
+    @pl.when(needed)
+    def _compute():
+        s, _, k = _masked_scores(
+            q_ref, k_ref, q_index, kv_index, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, precision=precision,
+            causal_offset=causal_offset,
+        )
+        lse = lse_ref[0]
+        p = jnp.exp(s - lse)                     # [block_q, block_k] f32
+        do = do_ref[0]
+        dp = jax.lax.dot_general(
+            _mxu(do, precision), _mxu(v_ref[0], precision),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )                                        # [block_q, block_k]
+        delta = delta_ref[0]
+        ds = p * (dp - delta) * scale            # f32
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            _mxu(ds.astype(do.dtype), precision), k,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    @pl.when(kv_index == num_kv_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, scale, causal, block_q, block_k, num_q_blocks,
+    precision, causal_offset
+):
+    q_index = pl.program_id(2)
+    kv_index = pl.program_id(1)
+
+    @pl.when(q_index == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = _tile_needed(causal, causal_offset, q_index, kv_index,
+                          block_q, block_k)
+
+    @pl.when(needed)
+    def _compute():
+        s, q, _ = _masked_scores(
+            q_ref, k_ref, q_index, kv_index, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, precision=precision,
+            causal_offset=causal_offset,
+        )
+        lse = lse_ref[0]
+        p = jnp.exp(s - lse)
+        do = do_ref[0]
+        pt = _mxu(p.astype(do.dtype), precision)  # [block_q, block_k]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            pt, _mxu(do, precision), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )                                        # [block_k, d]
+        dp = jax.lax.dot_general(
+            _mxu(do, precision), _mxu(v_ref[0], precision),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        delta = delta_ref[0]
+        ds = (p * (dp - delta) * scale).astype(do.dtype)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            _mxu(ds, precision), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )                                        # [block_k, d]
+
+    @pl.when(q_index == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _should_interpret() -> bool:
@@ -85,18 +224,20 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: bool | None = None,
     precision: jax.lax.Precision | None = None,
 ) -> jax.Array:
     """q,k,v: [batch, heads, seq, head_dim] (kv heads may be fewer: GQA is
     handled by the caller repeating kv heads). Returns same shape as q.
 
-    Differentiable: forward is the Pallas kernel; backward recomputes
-    attention in plain jax (flash-style recompute trades FLOPs for the O(S²)
-    probs it never stored). precision=None keeps the MXU's fast bf16
-    multiply; tests pass Precision.HIGHEST for tight reference comparison.
+    Fully differentiable with Pallas kernels on BOTH passes: the forward
+    saves (q, k, v, out, lse) and the backward recomputes P blockwise —
+    attention memory stays O(seq), never O(seq²).
+
+    precision=None keeps the MXU's fast bf16 multiply for bf16 inputs;
+    tests pass Precision.HIGHEST for tight reference comparison.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -106,38 +247,55 @@ def flash_attention(
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_vjp(q, k, v, causal, scale, block_q, block_k, interpret, precision):
-    return _flash_forward(
+    out, _ = _flash_forward(
         q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
         interpret=interpret, precision=precision,
     )
+    return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret, precision):
-    out = _flash_forward(
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   precision):
+    out, lse = _flash_forward(
         q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
         interpret=interpret, precision=precision,
     )
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, precision,
                    residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(
-            q_, k_, v_, causal=causal, scale=scale
-        ),
-        q, k, v,
+    q, k, v, out, lse = residuals
+    return _flash_backward(
+        q, k, v, out, lse, g, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=interpret, precision=precision,
     )
-    return vjp(g.astype(q.dtype))
 
 
 _flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _block_sizes(seq_q, seq_k, block_q, block_k):
+    # Shrink to the largest power-of-two block that divides the sequence so
+    # callers never trip over the default block size (e.g. seq=768 with the
+    # 512 default halves to 256).
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    while block_q > 1 and seq_q % block_q:
+        block_q //= 2
+    while block_k > 1 and seq_k % block_k:
+        block_k //= 2
+    assert seq_q % block_q == 0 and seq_k % block_k == 0, (
+        f"seq lengths ({seq_q},{seq_k}) must divide blocks ({block_q},{block_k})"
+    )
+    return block_q, block_k
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret", "precision"),
+    static_argnames=(
+        "causal", "scale", "block_q", "block_k", "interpret", "precision"
+    ),
 )
 def _flash_forward(
     q: jax.Array,
@@ -146,21 +304,17 @@ def _flash_forward(
     *,
     causal: bool = True,
     scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: bool | None = None,
     precision: jax.lax.Precision | None = None,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
     batch, heads, seq_q, dim = q.shape
     _, kv_heads, seq_k, _ = k.shape
     assert kv_heads == heads, "repeat kv heads before calling (GQA)"
     if scale is None:
         scale = dim ** -0.5
-    block_q = min(block_q, seq_q)
-    block_k = min(block_k, seq_k)
-    assert seq_q % block_q == 0 and seq_k % block_k == 0, (
-        f"seq lengths ({seq_q},{seq_k}) must divide blocks ({block_q},{block_k})"
-    )
+    block_q, block_k = _block_sizes(seq_q, seq_k, block_q, block_k)
     if interpret is None:
         interpret = _should_interpret()
 
@@ -172,7 +326,7 @@ def _flash_forward(
     num_kv_blocks = seq_k // block_k
 
     kernel = functools.partial(
-        _flash_kernel,
+        _flash_fwd_kernel,
         scale=scale,
         causal=causal,
         block_q=block_q,
@@ -183,7 +337,7 @@ def _flash_forward(
     )
     from jax.experimental.pallas import tpu as pltpu
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, num_q_blocks, num_kv_blocks),
         in_specs=[
@@ -191,8 +345,14 @@ def _flash_forward(
             pl.BlockSpec((1, block_k, dim), lambda i, j, kv: (i, kv, 0)),
             pl.BlockSpec((1, block_k, dim), lambda i, j, kv: (i, kv, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dim), lambda i, j, kv: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, seq_q, dim), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, dim), lambda i, j, kv: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kv: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, dim), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),    # running max
             pltpu.VMEM((block_q, 1), jnp.float32),    # running sum
@@ -200,7 +360,109 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(batch, heads, seq_q, dim)
+    return out.reshape(batch, heads, seq_q, dim), lse.reshape(
+        batch, heads, seq_q
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "causal", "scale", "block_q", "block_k", "interpret", "precision"
+    ),
+)
+def _flash_backward(
+    q, k, v, out, lse, g, *, causal, scale, block_q, block_k, interpret,
+    precision
+):
+    batch, heads, seq_q, dim = q.shape
+    seq_k = k.shape[2]
+    block_q, block_k = _block_sizes(seq_q, seq_k, block_q, block_k)
+    if interpret is None:
+        interpret = _should_interpret()
+
+    bh = batch * heads
+    qr = q.reshape(bh, seq_q, dim)
+    kr = k.reshape(bh, seq_k, dim)
+    vr = v.reshape(bh, seq_k, dim)
+    dor = g.astype(q.dtype).reshape(bh, seq_q, dim)
+    lser = lse.reshape(bh, seq_q, 1)
+    # delta_i = rowsum(dO_i ⊙ O_i): tiny elementwise pass, XLA fuses it.
+    delta = jnp.sum(
+        dor.astype(jnp.float32) * out.reshape(bh, seq_q, dim).astype(
+            jnp.float32
+        ),
+        axis=-1,
+        keepdims=True,
+    )
+    num_q_blocks = seq_q // block_q
+    num_kv_blocks = seq_k // block_k
+    causal_offset = seq_k - seq_q
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    dq_kernel = functools.partial(
+        _flash_dq_kernel,
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        num_kv_blocks=num_kv_blocks, precision=precision,
+        causal_offset=causal_offset,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, num_q_blocks, num_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dim), lambda i, j, kv: (i, j, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda i, j, kv: (i, kv, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda i, j, kv: (i, kv, 0)),
+            pl.BlockSpec((1, block_q, dim), lambda i, j, kv: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kv: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kv: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dim), lambda i, j, kv: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, dim), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dim), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_dkv_kernel,
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        num_q_blocks=num_q_blocks, precision=precision,
+        causal_offset=causal_offset,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, num_kv_blocks, num_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dim), lambda i, j, qi: (i, qi, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda i, j, qi: (i, j, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda i, j, qi: (i, j, 0)),
+            pl.BlockSpec((1, block_q, dim), lambda i, j, qi: (i, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, qi: (i, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, qi: (i, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, dim), lambda i, j, qi: (i, j, 0)),
+            pl.BlockSpec((1, block_k, dim), lambda i, j, qi: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_k, dim), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_k, dim), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, dim), jnp.float32),
+            pltpu.VMEM((block_k, dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    shape = (batch, heads, seq_q, dim)
+    kshape = (batch, heads, seq_k, dim)
+    return (
+        dq.reshape(shape),
+        dk.reshape(kshape).astype(k.dtype),
+        dv.reshape(kshape).astype(v.dtype),
+    )
 
 
 def attention_reference(

@@ -72,7 +72,13 @@ class DQNLearner(Learner):
         td_error = q - target
         weights = batch.get("weights", jnp.ones_like(q))
         loss = jnp.mean(weights * td_error**2)
-        return loss, {"td_error_mean": jnp.mean(jnp.abs(td_error))}
+        return loss, {
+            "td_error_mean": jnp.mean(jnp.abs(td_error)),
+            # per-sample |TD| — prioritized replay needs individual
+            # priorities, not the batch mean (a constant priority
+            # degenerates PER to biased uniform sampling).
+            "td_abs": jnp.abs(td_error),
+        }
 
     def update(self, batch: SampleBatch) -> dict:
         device_batch = {k: jnp.asarray(v) for k, v in batch.items()
@@ -81,7 +87,10 @@ class DQNLearner(Learner):
         self.params, self.opt_state, metrics = self._step(
             self.params, self.opt_state, device_batch
         )
-        return {k: float(v) for k, v in metrics.items()}
+        td_abs = np.asarray(metrics.pop("td_abs"))
+        out = {k: float(v) for k, v in metrics.items()}
+        out["td_abs"] = td_abs
+        return out
 
     def sync_target(self) -> None:
         self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
@@ -138,11 +147,13 @@ class DQN(Algorithm):
         for _ in range(config.updates_per_iteration):
             batch = self.replay.sample(config.train_batch_size)
             update_metrics = learner.update(batch)
-            if config.prioritized_replay and "batch_indexes" in batch:
-                self.replay.update_priorities(
-                    batch["batch_indexes"],
-                    np.full(len(batch), update_metrics["td_error_mean"]),
-                )
+            td_abs = update_metrics.pop("td_abs", None)
+            if (
+                config.prioritized_replay
+                and "batch_indexes" in batch
+                and td_abs is not None
+            ):
+                self.replay.update_priorities(batch["batch_indexes"], td_abs)
         metrics.update(update_metrics)
         # 3. target sync + weight broadcast
         if self._steps_since_target_sync >= config.target_network_update_freq:

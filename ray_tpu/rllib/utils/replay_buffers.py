@@ -79,6 +79,9 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         return batch
 
     def update_priorities(self, idx: np.ndarray, td_errors: np.ndarray) -> None:
-        prios = (np.abs(td_errors) + 1e-6) ** self.alpha
-        self._priorities[np.asarray(idx)] = prios
-        self._max_priority = max(self._max_priority, float(prios.max()))
+        # _max_priority stays in RAW priority units; **alpha is applied
+        # exactly once when writing _priorities (also in _on_add, which
+        # exponentiates _max_priority itself).
+        raw = np.abs(td_errors) + 1e-6
+        self._priorities[np.asarray(idx)] = raw ** self.alpha
+        self._max_priority = max(self._max_priority, float(raw.max()))

@@ -54,7 +54,10 @@ class ServeController:
         self._app_status: dict[str, str] = {}
         self._applied_user_config: dict[str, Any] = {}
         self._stopped = False
-        self._last_health_check = 0.0
+        # Keyed by qualified deployment name: a single controller-wide
+        # timestamp would let the first deployment in iteration order
+        # starve every other deployment's health checks.
+        self._last_health_check: dict = {}
         self._restore_checkpoint()
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._thread.start()
@@ -104,6 +107,7 @@ class ServeController:
             for qname in self._app_deployments.get(app_name, []):
                 if qname not in new_names:
                     self._deployments.pop(qname, None)
+                    self._last_health_check.pop(qname, None)
             self._app_deployments[app_name] = new_names
             self._app_status[app_name] = "DEPLOYING"
             if route_prefix is not None and deployments:
@@ -116,6 +120,7 @@ class ServeController:
         with self._lock:
             for qname in self._app_deployments.pop(app_name, []):
                 self._deployments.pop(qname, None)
+                self._last_health_check.pop(qname, None)
             self._routes = {
                 r: d for r, d in self._routes.items()
                 if not d.startswith(app_name + "_")
@@ -129,6 +134,7 @@ class ServeController:
             self._deployments.clear()
             self._routes.clear()
             self._app_deployments.clear()
+            self._last_health_check.clear()
         # reconcile loop will drain replicas; mark stop after one pass
         time.sleep(2 * RECONCILE_PERIOD_S)
         self._stopped = True
@@ -321,9 +327,10 @@ class ServeController:
 
     def _health_check(self, qname, info, replicas: list[ReplicaInfo]) -> None:
         now = time.monotonic()
-        if now - self._last_health_check < info.config.health_check_period_s:
+        last = self._last_health_check.get(qname, 0.0)
+        if now - last < info.config.health_check_period_s:
             return
-        self._last_health_check = now
+        self._last_health_check[qname] = now
         for rep in [r for r in replicas if r.state == "RUNNING"]:
             actor = self._actor_handles.get(rep.actor_name)
             if actor is None:

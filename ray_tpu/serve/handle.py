@@ -44,6 +44,11 @@ class DeploymentResponse:
             self._router.on_request_done(self._replica_name)
 
     def _to_object_ref(self):
+        # Composed calls hand the ref downstream and never call
+        # .result(); release the router's ongoing slot now or the
+        # replica's count leaks permanently (router would declare
+        # 'no available replica' after max_ongoing composed calls).
+        self._mark_done()
         return self._ref
 
 

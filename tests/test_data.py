@@ -245,3 +245,16 @@ def test_train_ingest_integration(ray_start_shared, tmp_path):
     )
     # Both workers together saw every row exactly once.
     assert result.metrics["total"] <= sum(range(64))
+
+
+def test_groupby_string_keys_cross_process(ray_start_shared):
+    """Regression: groupby partitioning must use a deterministic hash —
+    builtin hash() is per-process salted for str, so the same key could
+    land in different partitions from different map workers, yielding
+    duplicate keys with partial aggregates."""
+    rows = [{"k": f"key-{i % 5}", "v": 1.0} for i in range(40)]
+    # Enough blocks that _split_block runs in multiple worker processes.
+    ds = rd.from_items(rows, parallelism=8).groupby("k").sum("v")
+    out = {r["k"]: r["sum(v)"] for r in ds.take_all()}
+    assert len(out) == 5, out
+    assert all(v == 8.0 for v in out.values()), out

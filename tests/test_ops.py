@@ -86,3 +86,79 @@ def test_rope_rotation_properties():
     full = apply_rope(placed, cos, sin)
     assert jnp.allclose(shifted[0, :, 0], full[0, :, 3], atol=1e-5)
     assert jnp.allclose(shifted[0, :, 1], full[0, :, 7], atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_backward_matches_reference(causal):
+    key = jax.random.PRNGKey(7)
+    batch, heads, seq, dim = 2, 2, 256, 64
+    q, k, v = (
+        jax.random.normal(jax.random.fold_in(key, i), (batch, heads, seq, dim))
+        for i in range(3)
+    )
+
+    def flash_loss(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=causal, block_q=64, block_k=64,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return jnp.sum(o * jnp.cos(o))
+
+    def ref_loss(q, k, v):
+        o = attention_reference(q, k, v, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    gq, gk, gv = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, r, name in ((gq, rq, "dq"), (gk, rk, "dk"), (gv, rv, "dv")):
+        err = float(jnp.max(jnp.abs(g - r)))
+        assert err < 2e-4, (name, err)
+
+
+def test_flash_attention_backward_rectangular():
+    key = jax.random.PRNGKey(8)
+    q = jax.random.normal(key, (1, 2, 64, 32))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (1, 2, 128, 32))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (1, 2, 128, 32))
+
+    def flash_loss(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, block_q=32, block_k=32,
+                precision=jax.lax.Precision.HIGHEST,
+            ) ** 2
+        )
+
+    def ref_loss(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    grads = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    refs = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, r in zip(grads, refs):
+        assert float(jnp.max(jnp.abs(g - r))) < 2e-4
+
+
+def test_flash_attention_backward_bf16():
+    key = jax.random.PRNGKey(9)
+    q, k, v = (
+        jax.random.normal(
+            jax.random.fold_in(key, i), (1, 2, 128, 64), jnp.bfloat16
+        )
+        for i in range(3)
+    )
+
+    def flash_loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def ref_loss(q, k, v):
+        o = attention_reference(q, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    grads = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    refs = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, r in zip(grads, refs):
+        err = float(
+            jnp.max(jnp.abs(g.astype(jnp.float32) - r.astype(jnp.float32)))
+        )
+        assert err < 0.15, err

@@ -332,3 +332,41 @@ def test_dqn_cartpole_learns(ray_start_shared):
         assert best >= 60.0, f"DQN failed to learn: best={best}"
     finally:
         algo.stop()
+
+
+def test_prioritized_replay_alpha_units():
+    """Regression: _max_priority is kept in RAW units; **alpha applies
+    exactly once. With alpha=0.5 a fresh item after update_priorities
+    must get priority max_raw**alpha, not (max_raw**alpha)**alpha."""
+    from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(capacity=8, alpha=0.5, seed=0)
+    buf.add(SampleBatch({OBS: np.zeros((4, 1))}))
+    buf.update_priorities(np.array([0]), np.array([99.0]))
+    buf.add(SampleBatch({OBS: np.ones((1, 1))}))  # lands at idx 4
+    raw_max = 99.0 + 1e-6
+    assert buf._priorities[4] == pytest.approx(raw_max ** 0.5, rel=1e-6)
+
+
+def test_dqn_per_sample_td_priorities():
+    """Learner.update must surface per-sample |TD| (not just the mean)
+    so prioritized replay gets individual priorities."""
+    import gymnasium as gym
+    from ray_tpu.rllib.algorithms.dqn.dqn import DQNConfig, DQNLearner
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+    env = gym.make("CartPole-v1")
+    module = RLModuleSpec(model_config={"fcnet_hiddens": (16,)}).build(
+        env.observation_space, env.action_space
+    )
+    learner = DQNLearner(module, {"lr": 1e-3, "gamma": 0.99})
+    batch = SampleBatch({
+        OBS: np.random.randn(5, 4).astype(np.float32),
+        ACTIONS: np.zeros(5, dtype=np.int64),
+        REWARDS: np.arange(5, dtype=np.float32),
+        NEXT_OBS: np.random.randn(5, 4).astype(np.float32),
+        TERMINATEDS: np.zeros(5, dtype=np.float32),
+    })
+    out = learner.update(batch)
+    assert out["td_abs"].shape == (5,)
+    assert float(np.std(out["td_abs"])) > 0.0
