@@ -17,6 +17,7 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private import worker as _worker
 from ray_tpu._private.worker import (
     available_resources,
+    cancel,
     cluster_resources,
     get,
     init,
@@ -98,6 +99,7 @@ __all__ = [
     "get",
     "put",
     "wait",
+    "cancel",
     "kill",
     "nodes",
     "timeline",

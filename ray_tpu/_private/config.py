@@ -73,6 +73,9 @@ class RayTpuConfig:
     # --- task events / state API (reference: RAY_task_events_max_num_*) ---
     task_events_max_buffer: int = _env("task_events_max_buffer", 10000)
 
+    # --- control-plane persistence (reference: redis_store_client [N7]) ---
+    controller_snapshot_period_s: float = _env("controller_snapshot_period_s", 0.5)
+
     # --- pubsub / rpc ---
     rpc_connect_timeout_s: float = _env("rpc_connect_timeout_s", 30.0)
     rpc_retry_initial_backoff_s: float = _env("rpc_retry_initial_backoff_s", 0.1)

@@ -14,8 +14,11 @@ Role-equivalent of the reference GCS server
   * TaskEvents       — gcs_task_manager.cc (state API feed) [N5]
 
 Runs as its own process (``python -m ray_tpu._private.controller``).
-State is in-memory with optional JSON snapshot persistence (the reference's
-in_memory_store_client vs redis_store_client distinction [N7]).
+State is in-memory with periodic JSON snapshot persistence to the session
+dir and restore-on-restart (the reference's redis_store_client-backed GCS
+fault tolerance [N7]/§5.3): agents and workers reconnect with backoff and
+re-register, so named/detached actors, PGs, KV and jobs survive a
+controller crash.
 """
 
 from __future__ import annotations
@@ -36,6 +39,32 @@ from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection, spawn_t
 
 ACTOR_STATES = ("PENDING", "ALIVE", "RESTARTING", "DEAD")
 PG_STATES = ("PENDING", "CREATED", "REMOVED", "RESCHEDULING")
+
+
+def _jsonify(obj):
+    """JSON-compatible deep copy; bytes become {"__b64__": ...} (actor
+    specs carry pickled creation args, KV values are bytes)."""
+    import base64
+
+    if isinstance(obj, bytes):
+        return {"__b64__": base64.b64encode(obj).decode()}
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    return obj
+
+
+def _dejsonify(obj):
+    import base64
+
+    if isinstance(obj, dict):
+        if set(obj) == {"__b64__"}:
+            return base64.b64decode(obj["__b64__"])
+        return {k: _dejsonify(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_dejsonify(v) for v in obj]
+    return obj
 
 
 class NodeInfo:
@@ -134,13 +163,165 @@ class Controller:
         # Queued-but-unplaceable resource demands, for the autoscaler [N4].
         self.pending_demands: dict[str, dict] = {}
         self._rr = itertools.count()
+        # Persistence (role-equivalent of the reference's
+        # redis_store_client-backed GCS tables [N7]: restart the control
+        # plane and the cluster survives). Snapshots are JSON (bytes
+        # base64-wrapped) written atomically by _snapshot_loop.
+        self.snapshot_path = os.path.join(session_dir, "controller_state.json")
+        self._dirty = False
+        self._restored = self._load_snapshot()
 
     # ------------------------------------------------------------------
     async def start(self, host: str, port: int) -> int:
         self.server.route_object(self)
         bound = await self.server.start(host, port)
         spawn_task(self._health_check_loop())
+        spawn_task(self._snapshot_loop())
+        if self._restored:
+            spawn_task(self._post_restore_reconcile())
+        else:
+            for actor in self.actors.values():
+                if actor.state in ("PENDING", "RESTARTING"):
+                    spawn_task(self._schedule_actor(actor))
+            for pg in self.pgs.values():
+                if pg.state in ("PENDING", "RESCHEDULING"):
+                    spawn_task(self._schedule_pg(pg))
         return bound
+
+    async def _post_restore_reconcile(self) -> None:
+        """After a restart: give agents a grace period to re-register (they
+        re-attach still-live actors and report their bundle reservations),
+        THEN resume interrupted scheduling and fail actors stranded on
+        nodes that never came back."""
+        cfg = global_config()
+        grace = max(
+            2.0,
+            2 * cfg.health_check_period_ms / 1000.0,
+        )
+        await asyncio.sleep(grace)
+        for actor in list(self.actors.values()):
+            if actor.state in ("PENDING", "RESTARTING"):
+                spawn_task(self._schedule_actor(actor))
+            elif actor.state == "ALIVE" and actor.node_id not in self.nodes:
+                # Node never re-registered after the restart window.
+                await self._handle_actor_failure(
+                    actor, f"node {actor.node_id} lost across controller restart"
+                )
+        for pg in self.pgs.values():
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                spawn_task(self._schedule_pg(pg))
+            elif pg.state == "CREATED" and any(
+                n is not None and n not in self.nodes for n in pg.bundle_nodes
+            ):
+                pg.state = "RESCHEDULING"
+                pg.ready_event.clear()
+                for i, nid in enumerate(pg.bundle_nodes):
+                    if nid is not None and nid not in self.nodes:
+                        pg.bundle_nodes[i] = None
+                self._mark_dirty()
+                spawn_task(self._schedule_pg(pg))
+
+    # ------------------------------------------------------------------
+    # persistence [N7]
+    # ------------------------------------------------------------------
+    def _mark_dirty(self) -> None:
+        self._dirty = True
+
+    def _save_snapshot(self) -> None:
+        state = {
+            "actors": {
+                aid: {
+                    "spec": a.spec,
+                    "state": a.state,
+                    "address": list(a.address) if a.address else None,
+                    "node_id": a.node_id,
+                    "worker_id": a.worker_id,
+                    "restarts_remaining": a.restarts_remaining,
+                    "death_cause": a.death_cause,
+                }
+                for aid, a in self.actors.items()
+            },
+            "named_actors": [
+                [ns, name, aid] for (ns, name), aid in self.named_actors.items()
+            ],
+            "pgs": {
+                pid: {
+                    "bundles": p.bundles,
+                    "strategy": p.strategy,
+                    "name": p.name,
+                    "job_id": p.job_id,
+                    "state": p.state,
+                    "bundle_nodes": p.bundle_nodes,
+                }
+                for pid, p in self.pgs.items()
+            },
+            "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
+            "jobs": self.jobs,
+        }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_jsonify(state), f)
+        os.replace(tmp, self.snapshot_path)
+
+    def _load_snapshot(self) -> bool:
+        if not os.path.exists(self.snapshot_path):
+            return False
+        try:
+            with open(self.snapshot_path) as f:
+                state = _dejsonify(json.load(f))
+        except Exception as exc:
+            print(
+                f"[controller] snapshot load failed: {exc}",
+                file=sys.stderr, flush=True,
+            )
+            return False
+        for aid, rec in state.get("actors", {}).items():
+            actor = ActorInfo(rec["spec"])
+            actor.state = rec["state"]
+            actor.address = tuple(rec["address"]) if rec["address"] else None
+            actor.node_id = rec["node_id"]
+            actor.worker_id = rec["worker_id"]
+            actor.restarts_remaining = rec["restarts_remaining"]
+            actor.death_cause = rec["death_cause"]
+            if actor.state in ("ALIVE", "DEAD"):
+                actor.ready_event.set()
+            self.actors[aid] = actor
+        for ns, name, aid in state.get("named_actors", []):
+            self.named_actors[(ns, name)] = aid
+        for pid, rec in state.get("pgs", {}).items():
+            pg = PlacementGroupInfo(
+                pid, rec["bundles"], rec["strategy"], rec["name"], rec["job_id"]
+            )
+            pg.state = rec["state"]
+            pg.bundle_nodes = rec["bundle_nodes"]
+            if pg.state == "CREATED":
+                pg.ready_event.set()
+            self.pgs[pid] = pg
+        for ns, kvs in state.get("kv", {}).items():
+            self.kv[ns].update(kvs)
+        self.jobs.update(state.get("jobs", {}))
+        print(
+            f"[controller] restored snapshot: {len(self.actors)} actors, "
+            f"{len(self.pgs)} pgs, {sum(len(v) for v in self.kv.values())} kv keys",
+            file=sys.stderr, flush=True,
+        )
+        return True
+
+    async def _snapshot_loop(self) -> None:
+        period = global_config().controller_snapshot_period_s
+        while True:
+            await asyncio.sleep(period)
+            if not self._dirty:
+                continue
+            self._dirty = False
+            try:
+                self._save_snapshot()
+            except Exception as exc:
+                self._dirty = True  # retry next tick; don't lose the state
+                print(
+                    f"[controller] snapshot write failed: {exc}",
+                    file=sys.stderr, flush=True,
+                )
 
     async def _node_client(self, node: NodeInfo) -> RpcClient:
         if node.client is None or not node.client.connected:
@@ -178,9 +359,64 @@ class Controller:
         node = NodeInfo(payload)
         self.nodes[node.node_id] = node
         conn.context["node_id"] = node.node_id
+        # Post-restart reconciliation: the agent reports the actors it
+        # still hosts. Restored ALIVE actors missing from the report died
+        # while the controller was down; reported actors whose snapshot
+        # predates their ALIVE transition are re-attached in place (never
+        # double-scheduled).
+        live_entries = payload.get("live_actors") or []
+        live = {e["actor_id"] if isinstance(e, dict) else e for e in live_entries}
+        for entry in live_entries:
+            if not isinstance(entry, dict):
+                continue
+            actor = self.actors.get(entry["actor_id"])
+            if actor is not None and actor.state in ("PENDING", "RESTARTING"):
+                actor.node_id = node.node_id
+                actor.worker_id = entry.get("worker_id")
+                if entry.get("addr"):
+                    actor.address = tuple(entry["addr"])
+                actor.state = "ALIVE"
+                actor.ready_event.set()
+                self._mark_dirty()
+        for actor in list(self.actors.values()):
+            if (
+                actor.node_id == node.node_id
+                and actor.state == "ALIVE"
+                and actor.actor_id not in live
+            ):
+                await self._handle_actor_failure(
+                    actor, "worker died during controller restart"
+                )
+        # Release phase-1 bundle reservations the agent still holds for
+        # placement groups this incarnation no longer accounts to it
+        # (2PC prepare leaked across a controller crash).
+        stale: list[int | str] = []
+        for entry in payload.get("held_bundles") or []:
+            pg_id, index = entry["pg_id"], entry["index"]
+            pg = self.pgs.get(pg_id)
+            if (
+                pg is None
+                or pg.state == "REMOVED"
+                or index >= len(pg.bundle_nodes)
+                or pg.bundle_nodes[index] != node.node_id
+            ):
+                stale.append(entry)
+        if stale:
+            spawn_task(self._release_stale_bundles(node, stale))
         await self.publish("node_added", node.snapshot())
         await self._retry_pending()
         return {"status": "ok"}
+
+    async def _release_stale_bundles(self, node: NodeInfo, stale: list) -> None:
+        try:
+            client = await self._node_client(node)
+            for entry in stale:
+                await client.call(
+                    "release_bundle",
+                    {"pg_id": entry["pg_id"], "bundle_index": entry["index"]},
+                )
+        except Exception:
+            pass
 
     async def rpc_heartbeat(self, conn, payload) -> dict:
         node = self.nodes.get(payload["node_id"])
@@ -253,6 +489,7 @@ class Controller:
                     "state": "RUNNING",
                 },
             )
+            self._mark_dirty()
         return {"status": "ok"}
 
     async def _on_driver_exit(self, job_id: str) -> None:
@@ -260,6 +497,7 @@ class Controller:
         if job:
             job["state"] = "FINISHED"
             job["end_time"] = time.time()
+            self._mark_dirty()
         # Kill non-detached actors of the job.
         for actor in list(self.actors.values()):
             if actor.job_id == job_id and not actor.detached and actor.state != "DEAD":
@@ -282,6 +520,7 @@ class Controller:
         if not overwrite and payload["key"] in self.kv[ns]:
             return {"status": "exists"}
         self.kv[ns][payload["key"]] = payload["value"]
+        self._mark_dirty()
         return {"status": "ok"}
 
     async def rpc_kv_get(self, conn, payload) -> dict:
@@ -292,6 +531,8 @@ class Controller:
     async def rpc_kv_del(self, conn, payload) -> dict:
         ns = payload.get("namespace", "default")
         existed = self.kv[ns].pop(payload["key"], None) is not None
+        if existed:
+            self._mark_dirty()
         return {"status": "ok", "existed": existed}
 
     async def rpc_kv_keys(self, conn, payload) -> list:
@@ -434,6 +675,12 @@ class Controller:
     # ------------------------------------------------------------------
     async def rpc_create_actor(self, conn, payload) -> dict:
         spec = payload
+        # Idempotent by actor_id: an auto-reconnect client may re-send a
+        # request the previous controller incarnation (or a dropped reply)
+        # already applied — never double-schedule.
+        existing = self.actors.get(spec["actor_id"])
+        if existing is not None:
+            return {"status": "ok", "actor_id": existing.actor_id}
         actor = ActorInfo(spec)
         if actor.name:
             key = (spec.get("namespace", "default"), actor.name)
@@ -441,6 +688,7 @@ class Controller:
                 return {"status": "name_exists", "actor_id": self.named_actors[key]}
             self.named_actors[key] = actor.actor_id
         self.actors[actor.actor_id] = actor
+        self._mark_dirty()
         spawn_task(self._schedule_actor(actor))
         return {"status": "ok", "actor_id": actor.actor_id}
 
@@ -475,6 +723,7 @@ class Controller:
                         actor.address = tuple(resp["worker_addr"])
                         actor.state = "ALIVE"
                         actor.ready_event.set()
+                        self._mark_dirty()
                         await self.publish("actor_state", actor.snapshot())
                         return
                     print(
@@ -492,6 +741,7 @@ class Controller:
                 actor.state = "DEAD"
                 actor.death_cause = "unschedulable: no feasible node"
                 actor.ready_event.set()
+                self._mark_dirty()
                 await self.publish("actor_state", actor.snapshot())
                 return
             await asyncio.sleep(0.2)
@@ -505,6 +755,7 @@ class Controller:
             actor.state = "RESTARTING"
             actor.address = None
             actor.ready_event.clear()
+            self._mark_dirty()
             await self.publish("actor_state", actor.snapshot())
             spawn_task(self._schedule_actor(actor))
         else:
@@ -515,6 +766,7 @@ class Controller:
                 self.named_actors.pop(
                     (actor.spec.get("namespace", "default"), actor.name), None
                 )
+            self._mark_dirty()
             await self.publish("actor_state", actor.snapshot())
 
     async def rpc_worker_died(self, conn, payload) -> dict:
@@ -590,6 +842,7 @@ class Controller:
                 self.named_actors.pop(
                     (actor.spec.get("namespace", "default"), actor.name), None
                 )
+            self._mark_dirty()
             await self.publish("actor_state", actor.snapshot())
 
     async def rpc_list_actors(self, conn, payload) -> list:
@@ -599,6 +852,8 @@ class Controller:
     # placement groups (2-phase commit across agents) [N3]
     # ------------------------------------------------------------------
     async def rpc_create_placement_group(self, conn, payload) -> dict:
+        if payload["pg_id"] in self.pgs:  # idempotent re-send (see create_actor)
+            return {"status": "ok", "pg_id": payload["pg_id"]}
         pg = PlacementGroupInfo(
             payload["pg_id"],
             payload["bundles"],
@@ -607,6 +862,7 @@ class Controller:
             payload.get("job_id", ""),
         )
         self.pgs[pg.pg_id] = pg
+        self._mark_dirty()
         spawn_task(self._schedule_pg(pg))
         return {"status": "ok", "pg_id": pg.pg_id}
 
@@ -734,6 +990,7 @@ class Controller:
                 if ok:
                     pg.state = "CREATED"
                     pg.ready_event.set()
+                    self._mark_dirty()
                     await self.publish("pg_state", pg.snapshot())
                     return
                 # Rollback phase-1 reservations (committed ones included).
@@ -767,6 +1024,7 @@ class Controller:
 
     async def _remove_pg(self, pg: PlacementGroupInfo) -> None:
         pg.state = "REMOVED"
+        self._mark_dirty()
         for index, node_id in enumerate(pg.bundle_nodes):
             node = self.nodes.get(node_id or "")
             if node is None or not node.alive:

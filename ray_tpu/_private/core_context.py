@@ -63,13 +63,14 @@ class LeasedWorker:
 
 
 class PendingTask:
-    __slots__ = ("spec", "attempts", "return_ids", "arg_refs")
+    __slots__ = ("spec", "attempts", "return_ids", "arg_refs", "done")
 
     def __init__(self, spec, return_ids, arg_refs):
         self.spec = spec
         self.attempts = 0
         self.return_ids = return_ids
         self.arg_refs = arg_refs
+        self.done = False
 
 
 def _resources_key(resources: dict, runtime_env_hash: str) -> str:
@@ -113,6 +114,12 @@ class CoreContext:
         self._put_counter = 0
         self._counter_lock = threading.Lock()
 
+        # cancellation (reference: CoreWorker::CancelTask [N18] +
+        # task_manager.cc cancelled-task bookkeeping)
+        self._cancelled_tasks: set[str] = set()
+        self._running_tasks: dict[str, RpcClient] = {}  # task_id -> worker client
+        self._task_records: dict[str, PendingTask] = {}
+
         # lease cache: resources_key -> list[LeasedWorker]
         self._idle_leases: dict[str, list[LeasedWorker]] = {}
         self._task_queues: dict[str, asyncio.Queue] = {}
@@ -127,6 +134,7 @@ class CoreContext:
         self._actor_seq_lock = threading.Lock()
 
         self.controller: RpcClient | None = None
+        self._subscribed_channels: set[str] = set()
         self.agent: RpcClient | None = None
         self.core_server = RpcServer(name=f"core-{self.worker_id[:12]}")
         self.address: tuple | None = None
@@ -146,10 +154,17 @@ class CoreContext:
         self.core_server.route_object(self)
         port = await self.core_server.start()
         self.address = ("127.0.0.1", port)
-        self.controller = RpcClient(self.controller_addr, name="to-controller")
+        self.controller = RpcClient(
+            self.controller_addr, name="to-controller", auto_reconnect=True
+        )
         await self.controller.connect()
         self.agent = RpcClient(self.agent_addr, name="to-agent")
         await self.agent.connect()
+        # Replayed after a controller restart (gcs_client reconnect role).
+        self.controller.on_reconnect = self._controller_handshake
+        await self._controller_handshake()
+
+    async def _controller_handshake(self) -> None:
         await self.controller.call(
             "register_client",
             {
@@ -160,6 +175,16 @@ class CoreContext:
                 "is_driver": self.is_driver,
             },
         )
+        if self._subscribed_channels:
+            await self.controller.call(
+                "subscribe", {"channels": sorted(self._subscribed_channels)}
+            )
+
+    async def subscribe_channels(self, channels: list[str]) -> None:
+        """Subscribe to controller pubsub channels; re-subscribed
+        automatically after a controller restart."""
+        self._subscribed_channels.update(channels)
+        await self.controller.call("subscribe", {"channels": channels})
 
     @property
     def store(self) -> ObjectStoreClient:
@@ -573,6 +598,7 @@ class CoreContext:
             "retry_exceptions": retry_exceptions,
         }
         record = PendingTask(spec, return_ids, arg_ref_ids)
+        self._task_records[task_id] = record
         refs = []
         for rid in return_ids:
             state = ObjectState()
@@ -643,13 +669,28 @@ class CoreContext:
                 except asyncio.QueueEmpty:
                     return
                 spec = record.spec
+                task_id = spec["task_id"]
+                if record.done or task_id in self._cancelled_tasks:
+                    # cancel() already failed the returns while we queued.
+                    continue
                 record.attempts += 1
+                self._running_tasks[task_id] = worker.client
                 try:
                     reply = await worker.client.call("push_task", spec)
                 except (ConnectionLost, RpcError, OSError) as exc:
                     # Worker died mid-task: drop the lease, maybe retry.
                     await self._release_lease(worker, reusable=False)
                     worker = None
+                    if task_id in self._cancelled_tasks:
+                        # force=True cancellation kills the worker; surface
+                        # the reference's WorkerCrashedError, never retry.
+                        self._finish_record(
+                            record,
+                            error=exceptions.WorkerCrashedError(
+                                f"task {spec['name']} force-cancelled"
+                            ),
+                        )
+                        continue
                     if record.attempts <= spec["max_retries"]:
                         queue.put_nowait(record)
                         continue
@@ -661,10 +702,21 @@ class CoreContext:
                         ),
                     )
                     continue
+                finally:
+                    self._running_tasks.pop(task_id, None)
+                if reply.get("status") == "cancelled":
+                    self._finish_record(
+                        record,
+                        error=exceptions.TaskCancelledError(
+                            f"task {spec['name']} was cancelled"
+                        ),
+                    )
+                    continue
                 if (
                     reply.get("status") == "error"
                     and spec["retry_exceptions"]
                     and record.attempts <= spec["max_retries"]
+                    and task_id not in self._cancelled_tasks
                 ):
                     queue.put_nowait(record)
                     continue
@@ -680,6 +732,12 @@ class CoreContext:
         reply: dict | None = None,
         error: Exception | None = None,
     ) -> None:
+        if record.done:
+            return
+        record.done = True
+        task_id = record.spec.get("task_id")
+        self._task_records.pop(task_id, None)
+        self._cancelled_tasks.discard(task_id)
         if error is not None:
             self._fail_returns(record, error)
         else:
@@ -693,6 +751,45 @@ class CoreContext:
                     self._submitted_refs[rid] = count
         for rid in record.arg_refs:
             self._maybe_free(rid)
+
+    def cancel(self, ref, force: bool = False) -> None:
+        """Best-effort task cancellation (reference: CoreWorker::CancelTask;
+        semantics of python/ray/tests/test_cancel.py): a queued task is
+        dequeued and its refs fail with TaskCancelledError; a running task
+        gets KeyboardInterrupt raised in its executing thread (force=False)
+        or its worker process SIGKILLed (force=True, refs fail with
+        WorkerCrashedError); a finished task is a no-op."""
+        self.io.run(self._cancel_async(ref.id, force))
+
+    async def _cancel_async(self, obj_id: str, force: bool) -> None:
+        oid = ObjectID(obj_id)
+        task_id = oid.creating_task_id()
+        # for_put ids also embed a task id; only task RETURNS ("-rN") are
+        # cancellable (reference: ray.cancel rejects ray.put refs).
+        if task_id is None or not obj_id.rsplit("-", 1)[-1].startswith("r"):
+            raise ValueError("only task-return refs can be cancelled")
+        state = self._objects.get(obj_id)
+        if state is not None and state.status != PENDING:
+            return  # already finished: no-op
+        self._cancelled_tasks.add(task_id)
+        client = self._running_tasks.get(task_id)
+        if client is not None:
+            try:
+                await client.call(
+                    "cancel_task", {"task_id": task_id, "force": force},
+                    timeout=5,
+                )
+            except Exception:
+                pass  # worker died (force) or finished concurrently
+            return
+        record = self._task_records.get(task_id)
+        if record is not None:
+            self._finish_record(
+                record,
+                error=exceptions.TaskCancelledError(
+                    f"task {record.spec['name']} was cancelled before it started"
+                ),
+            )
 
     async def _acquire_lease(self, spec: dict) -> LeasedWorker:
         key = _resources_key(spec["resources"], repr(spec["runtime_env"]))
@@ -832,6 +929,7 @@ class CoreContext:
             "retry_exceptions": False,
         }
         record = PendingTask(spec, return_ids, arg_ref_ids)
+        self._task_records[task_id] = record
         refs = []
         for rid in return_ids:
             self._objects[rid] = ObjectState()
@@ -847,8 +945,26 @@ class CoreContext:
             while True:
                 attempts += 1
                 try:
+                    if record.done or spec["task_id"] in self._cancelled_tasks:
+                        # cancelled while waiting for the actor to come up;
+                        # cancel() already failed the returns.
+                        return
                     client = await self._actor_client(actor_id)
-                    reply = await client.call("push_actor_task", spec)
+                    self._running_tasks[spec["task_id"]] = client
+                    try:
+                        reply = await client.call("push_actor_task", spec)
+                    finally:
+                        self._running_tasks.pop(spec["task_id"], None)
+                    if reply.get("status") == "cancelled":
+                        self._fail_returns(
+                            record,
+                            exceptions.TaskCancelledError(
+                                f"actor task {spec['name']} was cancelled"
+                            ),
+                        )
+                        return
+                    if record.done:
+                        return  # cancel() finished the record while in flight
                     self._apply_reply(record, reply)
                     return
                 except exceptions.ActorUnavailableError:

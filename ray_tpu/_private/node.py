@@ -43,9 +43,18 @@ def _child_env(extra: dict | None = None) -> dict:
 
 
 def new_session_dir() -> str:
+    import uuid
+
     base = os.path.join(tempfile.gettempdir(), "raytpu")
     os.makedirs(base, exist_ok=True)
-    session = os.path.join(base, f"session_{int(time.time())}_{os.getpid()}")
+    # Random suffix: second+pid alone collide when one process creates two
+    # clusters within a second (e.g. back-to-back pytest fixtures), which
+    # would hand the new cluster the old cluster's stale controller.addr
+    # and persisted snapshot.
+    session = os.path.join(
+        base,
+        f"session_{int(time.time())}_{os.getpid()}_{uuid.uuid4().hex[:6]}",
+    )
     os.makedirs(session, exist_ok=True)
     os.makedirs(os.path.join(session, "logs"), exist_ok=True)
     return session
@@ -86,11 +95,17 @@ class ProcessHandle:
         return self.proc.poll() is None
 
 
-def start_controller(session_dir: str) -> tuple[ProcessHandle, tuple]:
+def start_controller(session_dir: str, port: int = 0) -> tuple[ProcessHandle, tuple]:
+    # Drop any stale address file so _wait_for_file can't return the
+    # previous controller's port before the new process binds.
+    try:
+        os.remove(os.path.join(session_dir, "controller.addr"))
+    except FileNotFoundError:
+        pass
     log = open(os.path.join(session_dir, "logs", "controller.out"), "ab")
     proc = subprocess.Popen(
         [sys.executable, "-u", "-m", "ray_tpu._private.controller",
-         "--session-dir", session_dir],
+         "--session-dir", session_dir, "--port", str(port)],
         stdout=log,
         stderr=subprocess.STDOUT,
         env=_child_env(),
@@ -164,6 +179,22 @@ class LocalCluster:
         self.head_agent_addr = addr
         self.head_store_info = store
         self.head_node_id = node_id
+
+    def kill_controller(self) -> None:
+        """SIGKILL the control plane (GCS fault-tolerance testing)."""
+        if self.controller_handle is not None:
+            self.controller_handle.kill()
+            self.controller_handle = None
+
+    def restart_controller(self) -> None:
+        """Start a fresh controller process on the SAME address; it restores
+        state from the session's snapshot and agents/drivers reconnect."""
+        assert self.controller_addr is not None, "cluster never started"
+        if self.controller_handle is not None:
+            self.kill_controller()
+        self.controller_handle, self.controller_addr = start_controller(
+            self.session_dir, port=self.controller_addr[1]
+        )
 
     def add_node(
         self, resources: dict | None = None, store_capacity: int = 0

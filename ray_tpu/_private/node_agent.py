@@ -171,8 +171,18 @@ class NodeAgent:
         self.server.route_object(self)
         bound = await self.server.start("127.0.0.1", port)
         self.address = ("127.0.0.1", bound)
-        self.controller = RpcClient(self.controller_addr, name="agent-to-controller")
+        self.controller = RpcClient(
+            self.controller_addr, name="agent-to-controller", auto_reconnect=True
+        )
         await self.controller.connect()
+        # Survive controller restarts: replay registration on reconnect
+        # (reference: raylet re-registers through gcs_client reconnect).
+        self.controller.on_reconnect = self._register_with_controller
+        await self._register_with_controller()
+        spawn_task(self._heartbeat_loop())
+        return self.address
+
+    async def _register_with_controller(self) -> None:
         await self.controller.call(
             "register_node",
             {
@@ -181,10 +191,26 @@ class NodeAgent:
                 "resources": self.resources_total,
                 "labels": self.labels,
                 "store_info": self.store_info(),
+                # For post-restart reconciliation: actors this node still
+                # hosts (a restored ALIVE actor missing here is dead; one
+                # the snapshot caught pre-ALIVE is re-attached from this).
+                "live_actors": [
+                    {
+                        "actor_id": w.actor_id,
+                        "worker_id": w.worker_id,
+                        "addr": list(w.address) if w.address else None,
+                    }
+                    for w in self.workers.values()
+                    if w.actor_id
+                ],
+                # 2PC reservations held here — lets a restarted controller
+                # release prepares its dead predecessor never committed.
+                "held_bundles": [
+                    {"pg_id": key[0], "index": key[1]}
+                    for key in self.bundles
+                ],
             },
         )
-        spawn_task(self._heartbeat_loop())
-        return self.address
 
     def store_info(self) -> dict:
         return {
@@ -206,19 +232,21 @@ class NodeAgent:
         while True:
             await asyncio.sleep(cfg.health_check_period_ms / 1000.0)
             try:
-                await self.controller.call(
+                resp = await self.controller.call(
                     "heartbeat",
                     {
                         "node_id": self.node_id,
                         "resources_available": self.resources_available,
                     },
                 )
+                if resp.get("status") == "unknown_node":
+                    # Controller restarted without a snapshot of us (or
+                    # snapshot predates this node): re-register.
+                    await self._register_with_controller()
             except Exception:
-                # Controller unreachable: keep trying (reconnect w/ backoff).
-                try:
-                    await self.controller.connect()
-                except Exception:
-                    await asyncio.sleep(1.0)
+                # Controller unreachable: auto_reconnect redials on the
+                # next call; brief pause avoids a hot loop.
+                await asyncio.sleep(1.0)
 
     # ------------------------------------------------------------------
     # resource accounting

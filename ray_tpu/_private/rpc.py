@@ -179,11 +179,26 @@ class RpcServer:
 
 
 class RpcClient:
-    """Async RPC client with reconnect/backoff and push subscription."""
+    """Async RPC client with reconnect/backoff and push subscription.
 
-    def __init__(self, address: tuple[str, int] | str, name: str = "client"):
+    With ``auto_reconnect=True`` a call on a dropped connection first
+    redials (exponential backoff) and then runs ``on_reconnect`` — the
+    hook re-plays registration/subscription handshakes, which is how
+    agents and workers survive a controller restart (role-equivalent of
+    the reference's gcs_client reconnect, SURVEY §5.3)."""
+
+    def __init__(
+        self,
+        address: tuple[str, int] | str,
+        name: str = "client",
+        auto_reconnect: bool = False,
+    ):
         self.address = address
         self.name = name
+        self.auto_reconnect = auto_reconnect
+        self.on_reconnect: Callable[[], Awaitable[None]] | None = None
+        self._reconnect_lock: asyncio.Lock | None = None
+        self._closed = False
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._pending: dict[int, asyncio.Future] = {}
@@ -192,6 +207,20 @@ class RpcClient:
         self._recv_task: asyncio.Task | None = None
         self._push_handlers: dict[str, Callable[[Any], Awaitable[None] | None]] = {}
         self.connected = False
+
+    async def _ensure_connected(self) -> None:
+        if self.connected or self._closed:
+            return
+        if self._reconnect_lock is None:
+            self._reconnect_lock = asyncio.Lock()
+        async with self._reconnect_lock:
+            if self.connected or self._closed:
+                return
+            await self.connect(retry=True)
+            if self.on_reconnect is not None:
+                # Replay the session handshake (connected is already True,
+                # so the hook's own calls go straight through).
+                await self.on_reconnect()
 
     def on_push(self, channel: str, handler: Callable[[Any], Any]) -> None:
         self._push_handlers[channel] = handler
@@ -254,20 +283,41 @@ class RpcClient:
             self._pending.clear()
 
     async def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
-        if not self.connected:
-            raise ConnectionLost(f"{self.name}: not connected")
+        # Auto-reconnect clients retry ONCE after a connection loss: the
+        # first call racing a server restart may be written to the dying
+        # socket and surface ConnectionLost even though the new server is
+        # already up.
+        for attempt in (0, 1):
+            if not self.connected:
+                if self.auto_reconnect and not self._closed:
+                    await self._ensure_connected()
+                else:
+                    raise ConnectionLost(f"{self.name}: not connected")
+            try:
+                return await self._call_once(method, payload, timeout)
+            except ConnectionLost:
+                if not self.auto_reconnect or self._closed or attempt:
+                    raise
+
+    async def _call_once(self, method: str, payload: Any, timeout: float | None) -> Any:
         msgid = next(self._msgids)
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msgid] = future
         assert self._writer is not None and self._write_lock is not None
-        async with self._write_lock:
-            self._writer.write(_pack(REQ, msgid, method, payload))
-            await self._writer.drain()
+        try:
+            async with self._write_lock:
+                self._writer.write(_pack(REQ, msgid, method, payload))
+                await self._writer.drain()
+        except (ConnectionError, OSError) as exc:
+            self._pending.pop(msgid, None)
+            self.connected = False
+            raise ConnectionLost(f"{self.name}: send failed: {exc}")
         if timeout is None:
             return await future
         return await asyncio.wait_for(future, timeout)
 
     async def close(self) -> None:
+        self._closed = True
         self.connected = False
         if self._recv_task is not None:
             self._recv_task.cancel()

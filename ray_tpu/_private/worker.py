@@ -169,7 +169,7 @@ def _subscribe_logs(ctx: CoreContext, job_id: str) -> None:
         print(f"(pid={message.get('pid')}) {message.get('line')}", file=stream)
 
     ctx.controller.on_push("logs", on_log)
-    ctx.io.run(ctx.controller.call("subscribe", {"channels": ["logs", "error"]}))
+    ctx.io.run(ctx.subscribe_channels(["logs", "error"]))
 
 
 def shutdown() -> None:
@@ -229,8 +229,11 @@ def kill(actor, *, no_restart: bool = True) -> None:
 
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    # v0: cooperative cancellation is not yet implemented; document parity gap.
-    raise NotImplementedError("task cancellation lands with the C++ core worker")
+    """Cancel the task that creates ``ref`` (reference: ray.cancel /
+    test_cancel.py semantics). Queued tasks fail with TaskCancelledError;
+    running tasks get KeyboardInterrupt (force=False) or their worker
+    SIGKILLed (force=True -> WorkerCrashedError); finished tasks no-op."""
+    get_global_context().cancel(ref, force=force)
 
 
 def nodes() -> list[dict]:

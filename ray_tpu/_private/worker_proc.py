@@ -17,6 +17,7 @@ import inspect
 import json
 import os
 import sys
+import queue
 import threading
 import traceback
 from typing import Any
@@ -50,6 +51,19 @@ class WorkerRuntime:
         self._order: dict[str, dict] = {}
         self._fn_cache: dict[str, Any] = {}
         self._task_event_lock = threading.Lock()
+        # Cancellation state (reference: task_receiver.cc cancel path +
+        # the ray.cancel KeyboardInterrupt convention). Normal tasks run on
+        # the MAIN thread so SIGINT interrupts even blocking C calls
+        # (time.sleep etc.) — exactly how the reference worker does it;
+        # executor threads (sync actor tasks) get best-effort async-exc.
+        self._running_exec: dict = {}      # task_id -> thread ident
+        self._running_async: dict = {}     # task_id -> coroutine future
+        self._cancelled_pending: set = set()
+        self._main_work: "queue.Queue" = queue.Queue()
+        self._main_ident: int | None = None
+        self._main_executing = False
+        self._main_current_task: str | None = None
+        self._cancel_target: str | None = None
         self._task_events_last_flush = 0.0
         # compiled-graph state: dag_id → stage spec; (dag_id, seq) → buffers
         self._dag_stages: dict[str, dict] = {}
@@ -62,7 +76,7 @@ class WorkerRuntime:
         ctx = self.ctx
         for method in (
             "push_task", "push_actor_task", "create_actor", "exit",
-            "dag_register", "dag_push", "dag_pop",
+            "cancel_task", "dag_register", "dag_push", "dag_pop",
         ):
             ctx.core_server.route(method, getattr(self, f"rpc_{method}"))
         ctx.connect()
@@ -77,6 +91,40 @@ class WorkerRuntime:
             "register_worker",
             {"worker_id": self.ctx.worker_id, "address": list(self.ctx.address)},
         )
+
+    def run_main_loop(self) -> None:
+        """Main-thread task execution loop. Normal tasks run here so that
+        a cancellation SIGINT raises KeyboardInterrupt inside whatever the
+        task is doing — including blocking C calls."""
+        import signal as _signal
+
+        self._main_ident = threading.get_ident()
+        _signal.signal(_signal.SIGINT, self._on_sigint)
+        while True:
+            fn, fut = self._main_work.get()
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as exc:  # noqa: BLE001 - ferry to waiter
+                fut.set_exception(exc)
+
+    def _on_sigint(self, signum, frame) -> None:
+        # Only deliver while the TARGETED task is executing: a SIGINT that
+        # lands after the target finished (and another task started) must
+        # not cancel the wrong task — nor kill the idle worker loop.
+        if (
+            self._main_executing
+            and self._cancel_target is not None
+            and self._main_current_task == self._cancel_target
+        ):
+            self._cancel_target = None
+            raise KeyboardInterrupt
+
+    async def _run_on_main(self, fn) -> dict:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._main_work.put((fn, fut))
+        return await asyncio.wrap_future(fut)
 
     def _async_exec_loop(self) -> asyncio.AbstractEventLoop:
         if self._async_loop is None:
@@ -158,25 +206,54 @@ class WorkerRuntime:
 
     def _execute(self, spec: dict, fn: Any, is_method: bool) -> dict:
         name = spec.get("name", "task")
+        task_id = spec.get("task_id")
+        if task_id in self._cancelled_pending:
+            # Cancelled while queued at this worker (e.g. behind an actor's
+            # ordered/concurrency queue).
+            self._cancelled_pending.discard(task_id)
+            self._record_task_event(spec, "CANCELLED")
+            return {"status": "cancelled"}
         self._record_task_event(spec, "RUNNING")
+        on_main = threading.get_ident() == self._main_ident
+        self._running_exec[task_id] = threading.get_ident()
+        if on_main:
+            self._main_current_task = task_id
+            self._main_executing = True
         try:
             args, kwargs = self._resolve_args(spec["args"])
             if inspect.iscoroutinefunction(fn):
                 loop = self._async_exec_loop()
-                value = asyncio.run_coroutine_threadsafe(
+                cfut = asyncio.run_coroutine_threadsafe(
                     fn(*args, **kwargs), loop
-                ).result()
+                )
+                self._running_async[task_id] = cfut
+                try:
+                    value = cfut.result()
+                finally:
+                    self._running_async.pop(task_id, None)
             else:
                 value = fn(*args, **kwargs)
             num_returns = spec.get("num_returns", 1)
             values = [value] if num_returns == 1 else list(value)
             self._record_task_event(spec, "FINISHED")
             return {"status": "ok", "returns": self._package_returns(spec, values)}
+        except (KeyboardInterrupt, concurrent.futures.CancelledError,
+                asyncio.CancelledError):
+            # KeyboardInterrupt: raised by rpc_cancel_task via SIGINT /
+            # async-exc (ray.cancel convention — the task sees it).
+            # CancelledError: an async task's coroutine was cancelled.
+            self._record_task_event(spec, "CANCELLED")
+            return {"status": "cancelled"}
         except Exception:
             self._record_task_event(spec, "FAILED")
             err = exceptions.TaskError(name, traceback.format_exc())
             payload, _ = serialization.serialize(err)
             return {"status": "error", "error": payload}
+        finally:
+            if on_main:
+                self._main_executing = False
+                self._main_current_task = None
+            self._running_exec.pop(task_id, None)
 
     def _record_task_event(self, spec: dict, state: str) -> None:
         """Task lifecycle events feed the state API + `ray_tpu timeline`
@@ -223,9 +300,8 @@ class WorkerRuntime:
     # ------------------------------------------------------------------
     async def rpc_push_task(self, conn, spec) -> dict:
         fn = await self._load_callable(spec["function_id"])
-        loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
-            self.executor, self._execute, spec, fn, False
+        return await self._run_on_main(
+            lambda: self._execute(spec, fn, False)
         )
 
     async def rpc_create_actor(self, conn, payload) -> dict:
@@ -382,6 +458,42 @@ class WorkerRuntime:
         raw, _ = serialization.serialize(result)
         return {"status": "ok", "value": raw}
 
+    async def rpc_cancel_task(self, conn, payload) -> dict:
+        """Cancel a task on this worker (reference: CoreWorker::CancelTask →
+        task_receiver). force=True kills the process (owner surfaces
+        WorkerCrashedError). force=False: main-thread task → SIGINT
+        (interrupts blocking C calls, reference semantics); async task →
+        cancel its coroutine; sync actor-executor task → best-effort
+        async-exc (reference parity: only async actor tasks are reliably
+        interruptible); not-yet-started → marked so it returns cancelled
+        when dequeued."""
+        if payload.get("force"):
+            import signal as _signal
+
+            os.kill(os.getpid(), _signal.SIGKILL)
+            return {"status": "ok"}  # unreachable
+        task_id = payload.get("task_id")
+        cfut = self._running_async.get(task_id)
+        if cfut is not None:
+            cfut.cancel()
+            return {"status": "ok"}
+        ident = self._running_exec.get(task_id)
+        if ident is None:
+            self._cancelled_pending.add(task_id)
+            return {"status": "not_running"}
+        if ident == self._main_ident:
+            import signal as _signal
+
+            self._cancel_target = task_id
+            os.kill(os.getpid(), _signal.SIGINT)
+            return {"status": "ok"}
+        import ctypes
+
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(ident), ctypes.py_object(KeyboardInterrupt)
+        )
+        return {"status": "ok"}
+
     async def rpc_exit(self, conn, payload) -> dict:
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)
         return {"status": "ok"}
@@ -390,8 +502,9 @@ class WorkerRuntime:
 def main() -> None:
     runtime = WorkerRuntime()
     runtime.start()
-    # Park the main thread; all work happens on the io/executor threads.
-    threading.Event().wait()
+    # The main thread is the normal-task execution lane (cancellation via
+    # SIGINT lands here); RPC/io stay on their own threads.
+    runtime.run_main_loop()
 
 
 if __name__ == "__main__":

@@ -52,6 +52,16 @@ class Cluster:
         if handle is not None:
             handle.kill()
 
+    def kill_controller(self) -> None:
+        """SIGKILL the controller process (control-plane fault injection,
+        reference: test_gcs_fault_tolerance.py patterns)."""
+        self._cluster.kill_controller()
+
+    def restart_controller(self) -> None:
+        """Restart the controller on the same address; it reloads the
+        persisted snapshot and the cluster reconnects."""
+        self._cluster.restart_controller()
+
     def wait_for_nodes(self, expected: int | None = None, timeout: float = 30.0) -> None:
         import ray_tpu
 

@@ -29,6 +29,11 @@ class TaskError(RayTpuError):
         return (TaskError, (self.task_name, self.remote_traceback))
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel() (reference:
+    ray.exceptions.TaskCancelledError, python/ray/tests/test_cancel.py)."""
+
+
 class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died (e.g. OOM-killed, segfault)."""
 
