@@ -12,6 +12,7 @@ point). vs_baseline >= 1.0 means one TPU chip matches/beats one A100.
 
 from __future__ import annotations
 
+import functools
 import json
 import sys
 import time
@@ -29,9 +30,15 @@ def main() -> None:
     backend = jax.default_backend()
     on_accel = backend in ("tpu", "gpu")
     if on_accel:
+        # Shape chosen by an on-chip sweep (round 3): wide MXU-saturating
+        # matmuls (dim 4096, hidden 16384 — both multiples of the 128-lane
+        # MXU tile), batch*seq = 8192 tokens/step, bf16 weights, NO remat
+        # (everything fits in 16 GB HBM thanks to the model's bf16-resident
+        # activations — f32 elementwise intermediates are micro-checkpointed
+        # in models/transformer.py). Measured 133 TFLOP/s on v5e (68% MFU).
         config = TransformerConfig(
-            vocab_size=8192, dim=1024, n_layers=8, n_heads=16, n_kv_heads=16,
-            hidden_dim=2816, max_seq=1024, dtype=jnp.bfloat16,
+            vocab_size=8192, dim=4096, n_layers=3, n_heads=32, n_kv_heads=32,
+            hidden_dim=16384, max_seq=1024, dtype=jnp.bfloat16,
         )
         batch, steps = 8, 10
     else:  # CPU smoke fallback so the bench never crashes the driver
@@ -46,7 +53,9 @@ def main() -> None:
         jax.random.PRNGKey(1), (batch, config.max_seq + 1), 0, config.vocab_size
     )
 
-    @jax.jit
+    # donate params+opt_state: in-place updates halve optimizer-state HBM
+    # traffic and free the memory for activations (VERDICT r2 ask 1a).
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, tokens):
         # Next-token LM objective (shifted targets).
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
@@ -71,6 +80,15 @@ def main() -> None:
     a100_effective = 312e12 * 0.40                   # GPU-parity yardstick
     vs_baseline = achieved_flops / a100_effective
 
+    # Peak bf16 FLOP/s per chip kind, for MFU attribution in the detail.
+    device_kind = jax.devices()[0].device_kind
+    peaks = {
+        "TPU v4": 275e12, "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+        "TPU v5p": 459e12, "TPU v6 lite": 918e12,
+    }
+    peak = next((v for k, v in peaks.items() if device_kind.startswith(k)), None)
+    mfu = round(achieved_flops / peak, 4) if peak else None
+
     print(
         json.dumps(
             {
@@ -80,8 +98,10 @@ def main() -> None:
                 "vs_baseline": round(vs_baseline, 4),
                 "detail": {
                     "backend": backend,
+                    "device_kind": device_kind,
                     "params": p,
                     "achieved_tflops": round(achieved_flops / 1e12, 2),
+                    "mfu": mfu,
                     "loss": loss_value,
                 },
             }
