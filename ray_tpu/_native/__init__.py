@@ -15,7 +15,10 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(os.path.dirname(_HERE))
-_SRC_DIRS = [os.path.join(_REPO, "src", "object_store")]
+_SRC_DIRS = [
+    os.path.join(_REPO, "src", "object_store"),
+    os.path.join(_REPO, "src", "rpc"),
+]
 _LIB_PATH = os.path.join(_HERE, "libraytpu.so")
 
 _lock = threading.Lock()
@@ -63,5 +66,50 @@ def load() -> ctypes.CDLL:
                 ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
             ]
             lib.raytpu_store_stop.argtypes = [ctypes.c_void_p]
+            # --- rpc transport (src/rpc/transport.cc) ---
+            lib.rt_engine_new.restype = ctypes.c_void_p
+            lib.rt_engine_stop.argtypes = [ctypes.c_void_p]
+            lib.rt_notify_fd.argtypes = [ctypes.c_void_p]
+            lib.rt_notify_fd.restype = ctypes.c_int
+            lib.rt_connect_tcp.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.rt_connect_tcp.restype = ctypes.c_long
+            lib.rt_connect_unix.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_connect_unix.restype = ctypes.c_long
+            lib.rt_listen_tcp.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.rt_listen_tcp.restype = ctypes.c_long
+            lib.rt_listen_unix.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_listen_unix.restype = ctypes.c_long
+            lib.rt_next_msgid.argtypes = [ctypes.c_void_p, ctypes.c_long]
+            lib.rt_next_msgid.restype = ctypes.c_uint32
+            lib.rt_send.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_uint8,
+                ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_char_p, ctypes.c_uint32,
+            ]
+            lib.rt_send.restype = ctypes.c_int
+            lib.rt_close_conn.argtypes = [ctypes.c_void_p, ctypes.c_long]
+            lib.rt_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.rt_next.restype = ctypes.c_int
+            lib.rt_msg_free.argtypes = [ctypes.c_void_p]
             _lib = lib
     return _lib
+
+
+class RtMsgView(ctypes.Structure):
+    """Mirror of rt_msg_view in src/rpc/transport.cc."""
+
+    _fields_ = [
+        ("conn", ctypes.c_long),
+        ("kind", ctypes.c_uint8),
+        ("msgid", ctypes.c_uint32),
+        ("method", ctypes.c_void_p),
+        ("mlen", ctypes.c_uint32),
+        ("payload", ctypes.c_void_p),
+        ("plen", ctypes.c_uint32),
+        ("opaque", ctypes.c_void_p),
+    ]

@@ -65,6 +65,13 @@ class RayTpuConfig:
     actor_ready_timeout_s: float = _env("actor_ready_timeout_s", 150.0)
     worker_startup_batch: int = _env("worker_startup_batch", 4)
 
+    # How long a task dispatcher keeps its worker lease warm after its
+    # queue drains, waiting for the next same-shape task (reference:
+    # normal_task_submitter lease reuse + raylet idle lease grace). Without
+    # this every back-to-back sync task pays the full 3-RPC lease chain
+    # (controller request_lease + agent lease_worker + dial).
+    worker_lease_grace_s: float = _env("worker_lease_grace_s", 0.25)
+
     # --- tasks / fault tolerance ---
     task_max_retries_default: int = _env("task_max_retries_default", 3)
     actor_max_restarts_default: int = _env("actor_max_restarts_default", 0)

@@ -332,18 +332,20 @@ class CoreContext:
             put_index = self._put_counter
         task_scope = TaskID(f"tsk-{self.worker_id}")
         object_id = ObjectID.for_put(task_scope, put_index)
-        payload, contained = serialization.serialize(value)
+        parts, total, contained = serialization.serialize_parts(value)
         self._register_contained_borrows(contained)
         state = ObjectState()
         cfg = global_config()
-        if len(payload) <= cfg.max_direct_call_object_size:
+        if total <= cfg.max_direct_call_object_size:
             state.status = INLINE
-            state.data = payload
-            state.size = len(payload)
+            state.data = b"".join(
+                bytes(p) if isinstance(p, memoryview) else p for p in parts
+            )
+            state.size = total
         else:
-            self._store_put_local(object_id, payload)
+            self._store_put_parts(object_id, parts, total)
             state.status = SHM
-            state.size = len(payload)
+            state.size = total
             state.locations = [self._local_location()]
         self.io.run(self._finish_state(object_id, state))
         return self.new_object_ref(object_id)
@@ -355,6 +357,23 @@ class CoreContext:
     def _store_put_local(self, object_id: str, payload: bytes) -> None:
         try:
             self.store.put(object_id, payload)
+            self.store.pin(object_id)
+        except FileExistsError:
+            pass
+        except ObjectStoreFull as exc:
+            raise exceptions.ObjectStoreFullError(str(exc)) from None
+
+    def _store_put_parts(self, object_id: str, parts: list, total: int) -> None:
+        """Scatter-gather write: stream serialized parts straight into the
+        arena allocation (single copy; plasma create/seal discipline)."""
+        try:
+            view = self.store.create(object_id, total)
+            offset = 0
+            for part in parts:
+                n = part.nbytes if isinstance(part, memoryview) else len(part)
+                view[offset : offset + n] = part
+                offset += n
+            self.store.seal(object_id)
             self.store.pin(object_id)
         except FileExistsError:
             pass
@@ -668,25 +687,39 @@ class CoreContext:
         lease_failures = 0
         try:
             while True:
-                if queue.empty():
-                    return
-                if worker is None:
-                    # Acquire before popping so a blocked acquire never holds
-                    # a task hostage — other dispatchers keep draining.
-                    spec_peek = queue._queue[0].spec  # safe: single loop
+                try:
+                    record = queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    if worker is None:
+                        return
+                    # Keep the lease warm for a grace period: the next
+                    # same-shape task (e.g. a sync submit loop) reuses this
+                    # worker with zero lease RPCs (normal_task_submitter.cc
+                    # lease-reuse role; the raylet's idle lease grace).
                     try:
-                        worker = await self._acquire_lease(spec_peek)
+                        record = await asyncio.wait_for(
+                            queue.get(), global_config().worker_lease_grace_s
+                        )
+                    except (asyncio.TimeoutError, TimeoutError):
+                        return
+                while worker is None:
+                    try:
+                        worker = await self._acquire_lease(record.spec)
                         lease_failures = 0
                     except Exception as exc:
                         lease_failures += 1
+                        if self._active_dispatchers.get(key, 1) > 1:
+                            # Excess dispatcher (more dispatchers than the
+                            # cluster has capacity): hand the task back and
+                            # exit; the lease-holding dispatchers drain the
+                            # queue without this one pinning a record
+                            # through retry backoff.
+                            queue.put_nowait(record)
+                            return
                         if lease_failures >= 5:
-                            # Can't get capacity: fail one task and keep trying
+                            # Can't get capacity: fail this task and move on
                             # so an infeasible queue eventually drains with
                             # errors rather than hanging forever.
-                            try:
-                                record = queue.get_nowait()
-                            except asyncio.QueueEmpty:
-                                return
                             self._finish_record(
                                 record,
                                 error=exceptions.WorkerCrashedError(
@@ -695,13 +728,11 @@ class CoreContext:
                                 ),
                             )
                             lease_failures = 0
-                            continue
+                            record = None
+                            break
                         await asyncio.sleep(min(0.2 * lease_failures, 2.0))
-                        continue
-                try:
-                    record = queue.get_nowait()
-                except asyncio.QueueEmpty:
-                    return
+                if record is None:
+                    continue
                 spec = record.spec
                 task_id = spec["task_id"]
                 if record.done or task_id in self._cancelled_tasks:
