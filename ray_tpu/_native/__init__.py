@@ -100,6 +100,38 @@ def load() -> ctypes.CDLL:
     return _lib
 
 
+_pylib: "ctypes.PyDLL | None" = None
+
+
+def load_nogilrelease() -> ctypes.PyDLL:
+    """The same library loaded via PyDLL: calls KEEP the GIL.
+
+    For microsecond-scale non-blocking entry points (rt_send on a
+    non-blocking fd, rt_next, rt_next_msgid, rt_msg_free) the GIL
+    release+reacquire of a normal CDLL call costs more than the call
+    itself under thread contention (~150 us measured on a 1-core host vs
+    ~10 us of actual work). Never use this handle for anything that can
+    block."""
+    global _pylib
+    with _lock:
+        if _pylib is None:
+            path = build()
+            lib = ctypes.PyDLL(path)
+            lib.rt_send.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_uint8,
+                ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_char_p, ctypes.c_uint32,
+            ]
+            lib.rt_send.restype = ctypes.c_int
+            lib.rt_next_msgid.argtypes = [ctypes.c_void_p, ctypes.c_long]
+            lib.rt_next_msgid.restype = ctypes.c_uint32
+            lib.rt_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.rt_next.restype = ctypes.c_int
+            lib.rt_msg_free.argtypes = [ctypes.c_void_p]
+            _pylib = lib
+    return _pylib
+
+
 class RtMsgView(ctypes.Structure):
     """Mirror of rt_msg_view in src/rpc/transport.cc."""
 

@@ -71,6 +71,10 @@ class RayTpuConfig:
     # this every back-to-back sync task pays the full 3-RPC lease chain
     # (controller request_lease + agent lease_worker + dial).
     worker_lease_grace_s: float = _env("worker_lease_grace_s", 0.25)
+    # In-flight tasks a dispatcher pipelines through one leased worker
+    # before awaiting replies (reference: normal_task_submitter pipelining).
+    # Amortizes per-task wakeups/syscalls; 1 = strict request-reply.
+    worker_pipeline_depth: int = _env("worker_pipeline_depth", 4)
 
     # --- tasks / fault tolerance ---
     task_max_retries_default: int = _env("task_max_retries_default", 3)

@@ -80,6 +80,32 @@ def detect_tpu_resources() -> dict:
     return {}
 
 
+def _gc_stale_arenas() -> None:
+    """Unlink arena files left by SIGKILLed agents (their Stop() never
+    ran). A stale arena pins real tmpfs memory, and on this class of host
+    growing resident shm measurably slows page supply for everyone.
+    Filename layout: /dev/shm/raytpu-<agent_pid>-<node_suffix>."""
+    try:
+        for name in os.listdir("/dev/shm"):
+            if not name.startswith("raytpu-"):
+                continue
+            parts = name.split("-")
+            if len(parts) < 3 or not parts[1].isdigit():
+                continue
+            pid = int(parts[1])
+            try:
+                os.kill(pid, 0)  # alive? leave it
+            except ProcessLookupError:
+                try:
+                    os.unlink(os.path.join("/dev/shm", name))
+                except OSError:
+                    pass
+            except PermissionError:
+                pass
+    except OSError:
+        pass
+
+
 class WorkerProcess:
     def __init__(
         self,
@@ -142,6 +168,7 @@ class NodeAgent:
         suffix = node_id[-8:]
         self.store_socket = os.path.join(session_dir, f"store-{suffix}.sock")
         self.store_shm = f"/dev/shm/raytpu-{os.getpid()}-{suffix}"
+        _gc_stale_arenas()
         self.spill_dir = os.path.join(session_dir, f"spill-{suffix}")
         self.store_server: ObjectStoreServer | None = None
         self._store_client: ObjectStoreClient | None = None

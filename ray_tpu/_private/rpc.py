@@ -164,6 +164,10 @@ class _NativeEngine:
         from ray_tpu import _native
 
         self.lib = _native.load()
+        # GIL-keeping handle for the microsecond-scale non-blocking calls
+        # (send/next/msgid/free): avoids a GIL release+reacquire per
+        # message, which dominates the call cost under thread contention.
+        self.pylib = _native.load_nogilrelease()
         self.RtMsgView = _native.RtMsgView
         self.handle = self.lib.rt_engine_new()
         self.loop = loop
@@ -183,9 +187,17 @@ class _NativeEngine:
             self.lib.rt_engine_stop(self.handle)
             self.handle = None
 
+    # Above this, use the GIL-releasing handle: the inline write of a big
+    # frame (and any wait on the connection's write mutex behind it) must
+    # not stall every Python thread.
+    _PYLIB_MAX_PAYLOAD = 64 * 1024
+
     def send(self, conn: int, kind: int, msgid: int, method: bytes,
              payload: bytes) -> int:
-        return self.lib.rt_send(
+        lib = (
+            self.pylib if len(payload) < self._PYLIB_MAX_PAYLOAD else self.lib
+        )
+        return lib.rt_send(
             self.handle, conn, kind, msgid, method, len(method), payload,
             len(payload),
         )
@@ -199,7 +211,7 @@ class _NativeEngine:
             os.read(self.notify_fd, 8)
         except (BlockingIOError, OSError):
             pass
-        lib = self.lib
+        lib = self.pylib
         while True:
             view = self.RtMsgView()
             if not lib.rt_next(self.handle, ctypes.byref(view)):
@@ -583,7 +595,7 @@ class NativeRpcClient(_ClientCallMixin):
         engine, conn = self._engine, self._conn_id
         if engine is None or conn is None:
             raise ConnectionLost(f"{self.name}: not connected")
-        msgid = engine.lib.rt_next_msgid(engine.handle, conn)
+        msgid = engine.pylib.rt_next_msgid(engine.handle, conn)
         if msgid == 0:
             self.connected = False
             raise ConnectionLost(f"{self.name}: connection gone")

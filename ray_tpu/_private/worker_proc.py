@@ -164,13 +164,18 @@ class WorkerRuntime:
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
-    def _resolve_args(self, payload) -> tuple[tuple, dict]:
+    def _deserialize_args(self, payload) -> tuple[tuple, dict]:
+        """Deserialize an args payload, registering borrows for contained
+        ObjectRefs (shared by the sync and async resolution paths)."""
         def resolver(ref_id, owner_address):
             ref = ObjectRef(ref_id, owner_address, runtime=self.ctx)
             self.ctx._note_borrow(ref_id, owner_address)
             return ref
 
-        args, kwargs = serialization.deserialize(payload, resolver, zero_copy=False)
+        return serialization.deserialize(payload, resolver, zero_copy=False)
+
+    def _resolve_args(self, payload) -> tuple[tuple, dict]:
+        args, kwargs = self._deserialize_args(payload)
         # Top-level ObjectRef args are resolved to values before invocation
         # (reference semantics; nested refs stay refs).
         args = tuple(
@@ -204,7 +209,13 @@ class WorkerRuntime:
                 )
         return out
 
-    def _execute(self, spec: dict, fn: Any, is_method: bool) -> dict:
+    def _execute(
+        self,
+        spec: dict,
+        fn: Any,
+        is_method: bool,
+        preresolved: tuple | None = None,
+    ) -> dict:
         name = spec.get("name", "task")
         task_id = spec.get("task_id")
         if task_id in self._cancelled_pending:
@@ -220,7 +231,10 @@ class WorkerRuntime:
             self._main_current_task = task_id
             self._main_executing = True
         try:
-            args, kwargs = self._resolve_args(spec["args"])
+            if preresolved is not None:
+                args, kwargs = preresolved
+            else:
+                args, kwargs = self._resolve_args(spec["args"])
             if inspect.iscoroutinefunction(fn):
                 loop = self._async_exec_loop()
                 cfut = asyncio.run_coroutine_threadsafe(
@@ -300,9 +314,40 @@ class WorkerRuntime:
     # ------------------------------------------------------------------
     async def rpc_push_task(self, conn, spec) -> dict:
         fn = await self._load_callable(spec["function_id"])
+        # Resolve argument dependencies on the io loop BEFORE taking the
+        # main execution lane (reference: dependency resolution precedes
+        # execution — dependency_resolver.cc / raylet arg gating). With
+        # pipelined pushes, a task blocking on an upstream ref while
+        # HOLDING the main lane would deadlock against that upstream task
+        # queued behind it on this very worker.
+        try:
+            preresolved = await self._resolve_args_async(spec["args"])
+        except Exception:
+            self._record_task_event(spec, "FAILED")
+            err = exceptions.TaskError(
+                spec.get("name", "task"), traceback.format_exc()
+            )
+            payload, _ = serialization.serialize(err)
+            return {"status": "error", "error": payload}
         return await self._run_on_main(
-            lambda: self._execute(spec, fn, False)
+            lambda: self._execute(spec, fn, False, preresolved)
         )
+
+    async def _resolve_args_async(self, payload) -> tuple[tuple, dict]:
+        """Async twin of _resolve_args: awaits top-level ObjectRef args on
+        the io loop instead of blocking an execution lane."""
+        args, kwargs = self._deserialize_args(payload)
+        args = tuple(
+            [
+                (await self.ctx._get_one(a)) if isinstance(a, ObjectRef) else a
+                for a in args
+            ]
+        )
+        kwargs = {
+            k: (await self.ctx._get_one(v)) if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+        return args, kwargs
 
     async def rpc_create_actor(self, conn, payload) -> dict:
         spec = payload["spec"]

@@ -17,10 +17,12 @@
 // Threading model:
 //   * one engine thread per process runs epoll: reads, frame parsing,
 //     accepts, deferred writes.
-//   * any Python thread may call rt_send(): it appends to the connection's
-//     write queue and, when the queue was empty, writes inline from the
-//     caller (latency fast path); leftovers are flushed by the engine
-//     thread via EPOLLOUT.
+//   * any Python thread may call rt_send(): connection lookup takes the
+//     engine map mutex briefly; the write itself runs under the
+//     connection's own write mutex (senders never contend with the engine
+//     thread's read/parse work). When the queue was empty the frame is
+//     written inline from the caller (latency fast path); leftovers are
+//     flushed by the engine thread via EPOLLOUT.
 //   * decoded messages go to a single inbox (mutex + deque); the Python
 //     side waits on an eventfd and drains with rt_next()/rt_msg_free().
 
@@ -64,15 +66,21 @@ struct Msg {
 
 struct Conn {
   long id = 0;
-  int fd = -1;
   bool listener = false;
   bool unix_listener = false;
   std::string unix_path;  // for unlink on close (listeners)
+
+  // Read state: touched ONLY by the engine thread.
   std::vector<uint8_t> rbuf;
-  size_t rstart = 0;  // parse cursor into rbuf
+  size_t rstart = 0;
+
+  // Write state + fd validity: guarded by wmu.
+  std::mutex wmu;
+  int fd = -1;
   std::deque<std::vector<uint8_t>> wq;
   size_t woff = 0;
   bool closed = false;
+
   std::atomic<uint32_t> next_msgid{0};
 };
 
@@ -195,11 +203,10 @@ class Engine {
   }
 
   uint32_t NextMsgid(long conn_id) {
-    std::lock_guard<std::mutex> lock(mu_);
-    auto it = conns_.find(conn_id);
-    if (it == conns_.end()) return 0;
-    uint32_t id = ++it->second->next_msgid;
-    if (id == 0) id = ++it->second->next_msgid;  // skip 0 (reserved)
+    auto conn = Lookup(conn_id);
+    if (!conn) return 0;
+    uint32_t id = ++conn->next_msgid;
+    if (id == 0) id = ++conn->next_msgid;  // skip 0 (reserved)
     return id;
   }
 
@@ -207,6 +214,8 @@ class Engine {
   int Send(long conn_id, uint8_t kind, uint32_t msgid, const uint8_t *method,
            uint32_t mlen, const uint8_t *payload, uint32_t plen) {
     if (mlen > 0xFFFF) return -EINVAL;
+    auto conn = Lookup(conn_id);
+    if (!conn) return -ENOTCONN;
     uint32_t body = 1 + 1 + 4 + 2 + mlen + plen;
     std::vector<uint8_t> frame(4 + body);
     uint8_t *p = frame.data();
@@ -219,47 +228,42 @@ class Engine {
     if (mlen) memcpy(p + 12, method, mlen);
     if (plen) memcpy(p + 12 + mlen, payload, plen);
 
-    std::unique_lock<std::mutex> lock(mu_);
-    auto it = conns_.find(conn_id);
-    if (it == conns_.end() || it->second->closed) return -ENOTCONN;
-    Conn &c = *it->second;
-    if (c.wq.empty()) {
-      // Fast path: write inline from the caller thread.
-      ssize_t n = ::send(c.fd, frame.data(), frame.size(), MSG_NOSIGNAL);
-      if (n == ssize_t(frame.size())) return 0;
-      if (n < 0) {
-        if (errno != EAGAIN && errno != EWOULDBLOCK) {
-          MarkClosedLocked(c);
-          return -ECONNRESET;
+    bool need_arm = false;
+    {
+      std::lock_guard<std::mutex> wlock(conn->wmu);
+      if (conn->closed || conn->fd < 0) return -ENOTCONN;
+      if (conn->wq.empty()) {
+        // Fast path: write inline from the caller thread.
+        ssize_t n = ::send(conn->fd, frame.data(), frame.size(), MSG_NOSIGNAL);
+        if (n == ssize_t(frame.size())) return 0;
+        if (n < 0) {
+          if (errno != EAGAIN && errno != EWOULDBLOCK) {
+            RequestClose(conn_id);
+            return -ECONNRESET;
+          }
+          n = 0;
         }
-        n = 0;
+        conn->woff = 0;
+        frame.erase(frame.begin(), frame.begin() + n);
+        conn->wq.push_back(std::move(frame));
+        need_arm = true;
+      } else {
+        conn->wq.push_back(std::move(frame));
+        need_arm = true;  // engine may have just disarmed EPOLLOUT — re-arm
       }
-      c.woff = 0;
-      frame.erase(frame.begin(), frame.begin() + n);
-      c.wq.push_back(std::move(frame));
-      lock.unlock();
-      Wake();  // engine thread arms EPOLLOUT
-      return 0;
     }
-    c.wq.push_back(std::move(frame));
-    lock.unlock();
-    // The engine may have just drained + disarmed EPOLLOUT between our
-    // wq-empty check and this append; a wake re-arms it (idempotent).
-    Wake();
+    if (need_arm) {
+      std::lock_guard<std::mutex> lock(mu_);
+      pending_arm_.push_back(conn_id);
+      Wake();
+    }
     return 0;
   }
 
-  void CloseConn(long conn_id) {
-    std::lock_guard<std::mutex> lock(mu_);
-    auto it = conns_.find(conn_id);
-    if (it == conns_.end()) return;
-    it->second->closed = true;
-    pending_close_.push_back(conn_id);
-    Wake();
-  }
+  void CloseConn(long conn_id) { RequestClose(conn_id); }
 
-  // Dequeue one message. Returns the Msg* (caller frees via FreeMsg) or
-  // nullptr when empty.
+  // Dequeue one message. Returns the Msg* (caller frees via rt_msg_free)
+  // or nullptr when empty.
   Msg *Next() {
     std::lock_guard<std::mutex> lock(mu_);
     if (inbox_.empty()) return nullptr;
@@ -269,6 +273,13 @@ class Engine {
   }
 
  private:
+  std::shared_ptr<Conn> Lookup(long conn_id) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = conns_.find(conn_id);
+    if (it == conns_.end()) return nullptr;
+    return it->second;
+  }
+
   void Wake() {
     uint64_t one = 1;
     ssize_t rc = write(wakefd_, &one, 8);
@@ -281,16 +292,21 @@ class Engine {
     (void)rc;
   }
 
+  void RequestClose(long conn_id) {
+    std::lock_guard<std::mutex> lock(mu_);
+    pending_close_.push_back(conn_id);
+    Wake();
+  }
+
   long Register(int fd, bool listener) {
     SetNonblock(fd);
     std::lock_guard<std::mutex> lock(mu_);
     long id = next_id_++;
-    auto conn = std::make_unique<Conn>();
+    auto conn = std::make_shared<Conn>();
     conn->id = id;
     conn->fd = fd;
     conn->listener = listener;
-    fd2id_[fd] = id;
-    conns_[id] = std::move(conn);
+    conns_[id] = conn;
     epoll_event ev{};
     ev.events = EPOLLIN;
     ev.data.u64 = uint64_t(id);
@@ -303,14 +319,7 @@ class Engine {
     fcntl(fd, F_SETFL, flags | O_NONBLOCK);
   }
 
-  void MarkClosedLocked(Conn &c) {
-    if (!c.closed) {
-      c.closed = true;
-      pending_close_.push_back(c.id);
-      Wake();
-    }
-  }
-
+  // wmu must be held (or conn exclusively owned).
   void CloseFd(Conn &c) {
     if (c.fd >= 0) {
       epoll_ctl(epfd_, EPOLL_CTL_DEL, c.fd, nullptr);
@@ -318,6 +327,7 @@ class Engine {
       if (c.unix_listener) ::unlink(c.unix_path.c_str());
       c.fd = -1;
     }
+    c.closed = true;
   }
 
   void Loop() {
@@ -346,66 +356,57 @@ class Engine {
   }
 
   void ProcessDeferred(bool *notified) {
-    std::vector<long> to_close;
-    std::vector<std::pair<int, long>> arm_write;
+    std::vector<long> to_close, to_arm;
     {
       std::lock_guard<std::mutex> lock(mu_);
       to_close.swap(pending_close_);
-      for (auto &kv : conns_) {
-        Conn &c = *kv.second;
-        if (!c.closed && !c.wq.empty())
-          arm_write.push_back({c.fd, c.id});
-      }
+      to_arm.swap(pending_arm_);
     }
-    for (auto &fw : arm_write) {
-      epoll_event ev{};
-      ev.events = EPOLLIN | EPOLLOUT;
-      ev.data.u64 = uint64_t(fw.second);
-      epoll_ctl(epfd_, EPOLL_CTL_MOD, fw.first, &ev);
+    for (long id : to_arm) {
+      auto conn = Lookup(id);
+      if (!conn) continue;
+      std::lock_guard<std::mutex> wlock(conn->wmu);
+      if (conn->fd >= 0 && !conn->wq.empty()) {
+        epoll_event ev{};
+        ev.events = EPOLLIN | EPOLLOUT;
+        ev.data.u64 = uint64_t(id);
+        epoll_ctl(epfd_, EPOLL_CTL_MOD, conn->fd, &ev);
+      }
     }
     for (long id : to_close) FinishClose(id, notified);
   }
 
   void FinishClose(long id, bool *notified) {
-    std::unique_ptr<Conn> conn;
+    std::shared_ptr<Conn> conn;
     {
       std::lock_guard<std::mutex> lock(mu_);
       auto it = conns_.find(id);
       if (it == conns_.end()) return;
-      conn = std::move(it->second);
+      conn = it->second;
       conns_.erase(it);
-      fd2id_.erase(conn->fd);
       auto *m = new Msg();
       m->conn = id;
       m->kind = kClosed;
       inbox_.push_back(m);
       *notified = true;
     }
+    std::lock_guard<std::mutex> wlock(conn->wmu);
     CloseFd(*conn);
   }
 
   void HandleEvent(long id, uint32_t evmask, bool *notified) {
-    int fd = -1;
-    bool listener = false;
-    {
-      std::lock_guard<std::mutex> lock(mu_);
-      auto it = conns_.find(id);
-      if (it == conns_.end() || it->second->closed) return;
-      fd = it->second->fd;
-      listener = it->second->listener;
-    }
-    if (listener) {
-      if (evmask & EPOLLIN) Accept(id, fd, notified);
+    auto conn = Lookup(id);
+    if (!conn) return;
+    if (conn->listener) {
+      if (evmask & EPOLLIN) Accept(id, conn->fd, notified);
       return;
     }
     if (evmask & (EPOLLHUP | EPOLLERR)) {
-      std::lock_guard<std::mutex> lock(mu_);
-      auto it = conns_.find(id);
-      if (it != conns_.end()) MarkClosedLocked(*it->second);
+      RequestClose(id);
       return;
     }
-    if (evmask & EPOLLOUT) FlushWrites(id);
-    if (evmask & EPOLLIN) ReadFrom(id, fd, notified);
+    if (evmask & EPOLLOUT) FlushWrites(*conn);
+    if (evmask & EPOLLIN) ReadFrom(*conn, notified);
   }
 
   void Accept(long listener_id, int lfd, bool *notified) {
@@ -418,11 +419,10 @@ class Engine {
       {
         std::lock_guard<std::mutex> lock(mu_);
         id = next_id_++;
-        auto conn = std::make_unique<Conn>();
+        auto conn = std::make_shared<Conn>();
         conn->id = id;
         conn->fd = cfd;
-        fd2id_[cfd] = id;
-        conns_[id] = std::move(conn);
+        conns_[id] = conn;
         auto *m = new Msg();
         m->conn = id;
         m->kind = kAccepted;
@@ -437,46 +437,50 @@ class Engine {
     }
   }
 
-  void FlushWrites(long id) {
-    std::lock_guard<std::mutex> lock(mu_);
-    auto it = conns_.find(id);
-    if (it == conns_.end() || it->second->closed) return;
-    Conn &c = *it->second;
+  void FlushWrites(Conn &c) {
+    std::lock_guard<std::mutex> wlock(c.wmu);
+    if (c.closed || c.fd < 0) return;
+    // Bound the work done per wmu acquisition: senders (which may hold
+    // the GIL for small frames) block on wmu, so a long backlog drain
+    // here must not turn into a long stall for them. EPOLLOUT stays
+    // armed, the next loop iteration continues the drain.
+    size_t budget = 1 << 20;
     while (!c.wq.empty()) {
       auto &front = c.wq.front();
-      ssize_t n =
-          ::send(c.fd, front.data() + c.woff, front.size() - c.woff,
-                 MSG_NOSIGNAL);
+      ssize_t n = ::send(c.fd, front.data() + c.woff, front.size() - c.woff,
+                         MSG_NOSIGNAL);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-        MarkClosedLocked(c);
+        RequestClose(c.id);
         return;
       }
       c.woff += size_t(n);
       if (c.woff < front.size()) return;
       c.wq.pop_front();
       c.woff = 0;
+      if (size_t(n) >= budget) return;  // keep EPOLLOUT armed, resume next tick
+      budget -= size_t(n);
     }
     // Queue drained: stop watching EPOLLOUT.
     epoll_event ev{};
     ev.events = EPOLLIN;
-    ev.data.u64 = uint64_t(id);
+    ev.data.u64 = uint64_t(c.id);
     epoll_ctl(epfd_, EPOLL_CTL_MOD, c.fd, &ev);
   }
 
-  void ReadFrom(long id, int fd, bool *notified) {
+  void ReadFrom(Conn &c, bool *notified) {
+    // Engine thread is the only reader: rbuf needs no lock.
     uint8_t buf[65536];
     std::vector<Msg *> decoded;
     bool dead = false;
     while (true) {
-      ssize_t n = read(fd, buf, sizeof(buf));
+      ssize_t n = read(c.fd, buf, sizeof(buf));
       if (n > 0) {
-        std::lock_guard<std::mutex> lock(mu_);
-        auto it = conns_.find(id);
-        if (it == conns_.end() || it->second->closed) return;
-        Conn &c = *it->second;
         c.rbuf.insert(c.rbuf.end(), buf, buf + n);
-        ParseFrames(c, decoded);
+        if (!ParseFrames(c, decoded)) {
+          dead = true;  // malformed stream
+          break;
+        }
         if (size_t(n) < sizeof(buf)) break;  // likely drained
         continue;
       }
@@ -494,25 +498,18 @@ class Engine {
       for (auto *m : decoded) inbox_.push_back(m);
       *notified = true;
     }
-    if (dead) {
-      std::lock_guard<std::mutex> lock(mu_);
-      auto it = conns_.find(id);
-      if (it != conns_.end()) MarkClosedLocked(*it->second);
-    }
+    if (dead) RequestClose(c.id);
   }
 
-  // mu_ held. Extracts complete frames from c.rbuf into out.
-  void ParseFrames(Conn &c, std::vector<Msg *> &out) {
+  // Engine thread only. Returns false on a malformed stream.
+  bool ParseFrames(Conn &c, std::vector<Msg *> &out) {
     while (true) {
       size_t avail = c.rbuf.size() - c.rstart;
       if (avail < 4) break;
       const uint8_t *p = c.rbuf.data() + c.rstart;
       uint32_t body;
       memcpy(&body, p, 4);
-      if (body < 8 || body > kMaxFrame) {  // malformed: kill connection
-        MarkClosedLocked(c);
-        return;
-      }
+      if (body < 8 || body > kMaxFrame) return false;
       if (avail < 4 + size_t(body)) break;
       const uint8_t *f = p + 4;
       // f[0]=ver f[1]=kind f[2..5]=msgid f[6..7]=mlen
@@ -521,10 +518,7 @@ class Engine {
       memcpy(&msgid, f + 2, 4);
       uint16_t mlen;
       memcpy(&mlen, f + 6, 2);
-      if (size_t(8 + mlen) > body) {
-        MarkClosedLocked(c);
-        return;
-      }
+      if (size_t(8 + mlen) > body) return false;
       auto *m = new Msg();
       m->conn = c.id;
       m->kind = kind;
@@ -539,6 +533,7 @@ class Engine {
       c.rbuf.erase(c.rbuf.begin(), c.rbuf.begin() + c.rstart);
       c.rstart = 0;
     }
+    return true;
   }
 
   int epfd_ = -1;
@@ -546,11 +541,11 @@ class Engine {
   int notifyfd_ = -1;
   std::thread thread_;
   std::atomic<bool> running_{false};
-  std::mutex mu_;
-  std::unordered_map<long, std::unique_ptr<Conn>> conns_;
-  std::unordered_map<int, long> fd2id_;
+  std::mutex mu_;  // conns_ map, inbox_, pending_* lists
+  std::unordered_map<long, std::shared_ptr<Conn>> conns_;
   std::deque<Msg *> inbox_;
   std::vector<long> pending_close_;
+  std::vector<long> pending_arm_;
   long next_id_ = 1;
 };
 
