@@ -275,13 +275,13 @@ class XlaGroup(BaseGroup):
                 "actor groups"
             )
         # One device per process carries that rank's contribution.
-        if world_size > 1:  # pragma: no cover - needs real multi-host
+        if world_size > 1:
             per_process = {}
             for device in jax.devices():
                 per_process.setdefault(device.process_index, device)
             self._rank_devices = [per_process[i] for i in range(world_size)]
 
-    def _cross_rank(self, array, reducer):  # pragma: no cover - multi-host
+    def _cross_rank(self, array, reducer):
         import jax
         import jax.numpy as jnp
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -317,15 +317,13 @@ class XlaGroup(BaseGroup):
     def allgather(self, array):
         if self.world_size == 1:
             return [np.asarray(array)]
-        stacked = self._cross_rank(  # pragma: no cover - multi-host
-            array, lambda a: a
-        )
+        stacked = self._cross_rank(array, lambda a: a)
         return list(stacked)
 
     def broadcast(self, array, src_rank: int = 0):
         if self.world_size == 1:
             return np.asarray(array)
-        return self.allgather(array)[src_rank]  # pragma: no cover
+        return self.allgather(array)[src_rank]
 
     def reducescatter(self, array, op: str = SUM):
         reduced = self.allreduce(array, op=op)
@@ -349,6 +347,96 @@ class XlaGroup(BaseGroup):
 
 
 # ---------------------------------------------------------------------------
+# hierarchical backend (two tiers: in-jit ICI reduce, then DCN ring)
+# ---------------------------------------------------------------------------
+class HierarchicalGroup(BaseGroup):
+    """Two-tier collectives (SURVEY §5.8 "reduce within the slice, then
+    across"): tier 1 reduces this host's device shards in ONE jit via
+    shard_map+psum over the local jax mesh (the ICI tier — XLA fuses and
+    keeps it on-chip); tier 2 reduces the per-host partials across gang
+    members over the framework's RPC ring (the DCN tier). Unlike the "xla"
+    backend this needs NO global jax.distributed runtime — each host runs
+    its own jax, so it is the multi-SLICE shape where ICI does not span
+    hosts and traffic must cross the data-center network.
+    """
+
+    _TIER1 = {"sum": "psum", "max": "pmax", "min": "pmin"}
+
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        # The DCN tier rides the ring group's controller-KV rendezvous + p2p.
+        self._ring = RingGroup(world_size, rank, group_name + "@dcn")
+
+    def _local_reduce(self, per_device_arrays: list, op: str) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        if op not in self._TIER1:
+            raise ValueError(
+                f"hierarchical backend supports ops {sorted(self._TIER1)}"
+            )
+        devices = jax.local_devices()[: len(per_device_arrays)]
+        if len(devices) < len(per_device_arrays):
+            raise ValueError(
+                f"{len(per_device_arrays)} shards for {len(devices)} local devices"
+            )
+        mesh = Mesh(np.array(devices), ("local",))
+        shape = np.asarray(per_device_arrays[0]).shape
+        shards = [
+            jax.device_put(jnp.asarray(a)[None], d)
+            for a, d in zip(per_device_arrays, devices)
+        ]
+        stacked = jax.make_array_from_single_device_arrays(
+            (len(devices), *shape), NamedSharding(mesh, P("local")), shards
+        )
+        prim = getattr(jax.lax, self._TIER1[op])
+        reduced = jax.jit(
+            jax.shard_map(
+                # each device's block is (1, *shape): reduce over the mesh
+                # axis, then drop the block dim.
+                lambda x: prim(x, "local")[0],
+                mesh=mesh,
+                in_specs=P("local"),
+                out_specs=P(),
+            )
+        )(stacked)
+        return np.asarray(reduced)
+
+    def allreduce_sharded(self, per_device_arrays: list, op: str = SUM) -> np.ndarray:
+        """Reduce one shard per local device across ALL hosts' devices:
+        tier-1 in-jit psum over the local mesh, tier-2 ring across hosts."""
+        partial = self._local_reduce(per_device_arrays, op)
+        return self._ring.allreduce(partial, op=op, tag="__hier")
+
+    # Host-level (single array per rank) collectives delegate to the ring:
+    # the hierarchy only matters when device shards are in play.
+    def allreduce(self, array, op: str = SUM):
+        return self._ring.allreduce(np.asarray(array), op=op)
+
+    def allgather(self, array):
+        return self._ring.allgather(np.asarray(array))
+
+    def reducescatter(self, array, op: str = SUM):
+        return self._ring.reducescatter(np.asarray(array), op=op)
+
+    def broadcast(self, array, src_rank: int = 0):
+        return self._ring.broadcast(np.asarray(array), src_rank=src_rank)
+
+    def barrier(self):
+        self._ring.barrier()
+
+    def send(self, array, dst_rank: int, tag: str = ""):
+        self._ring.send(array, dst_rank, tag=tag)
+
+    def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0):
+        return self._ring.recv(src_rank, tag=tag, timeout=timeout)
+
+    def destroy(self):
+        self._ring.destroy()
+
+
+# ---------------------------------------------------------------------------
 # public API (reference signatures)
 # ---------------------------------------------------------------------------
 def init_collective_group(
@@ -363,8 +451,12 @@ def init_collective_group(
         _groups[group_name] = RingGroup(world_size, rank, group_name)
     elif backend == "xla":
         _groups[group_name] = XlaGroup(world_size, rank, group_name)
+    elif backend in ("hier", "hierarchical"):
+        _groups[group_name] = HierarchicalGroup(world_size, rank, group_name)
     else:
-        raise ValueError(f"unknown backend {backend!r} (use 'ring' or 'xla')")
+        raise ValueError(
+            f"unknown backend {backend!r} (use 'ring', 'xla', or 'hier')"
+        )
 
 
 def get_group(group_name: str = "default") -> BaseGroup:

@@ -107,6 +107,18 @@ class WorkerGang:
     ):
         self.num_workers = num_workers
         self.group_name = group_name or f"gang-{os.urandom(4).hex()}"
+        if coordinator == "auto":
+            # Single-host twin convenience: allocate a free port for the
+            # jax.distributed coordinator. Real multi-host deployments pass
+            # "<rank0-host>:<port>" explicitly (the coordinator must be
+            # reachable from every gang member's node).
+            import socket as _socket
+
+            probe = _socket.socket()
+            probe.bind(("127.0.0.1", 0))
+            coordinator = f"127.0.0.1:{probe.getsockname()[1]}"
+            probe.close()
+        self.coordinator = coordinator
         resources = dict(resources_per_worker or {"CPU": 1})
         bundles = [dict(resources) for _ in range(num_workers)]
         self.pg = placement_group(bundles, strategy=placement_strategy)
@@ -121,7 +133,8 @@ class WorkerGang:
                     placement_group=self.pg, placement_group_bundle_index=i
                 ),
             ).remote(
-                i, num_workers, self.group_name, backend, env_vars, coordinator
+                i, num_workers, self.group_name, backend, env_vars,
+                self.coordinator,
             )
             for i in range(num_workers)
         ]
