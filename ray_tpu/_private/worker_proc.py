@@ -45,6 +45,8 @@ class WorkerRuntime:
             max_workers=1, thread_name_prefix="exec"
         )
         self._async_loop: asyncio.AbstractEventLoop | None = None
+        self._async_sem: "asyncio.Semaphore | None" = None
+        self._actor_concurrency = 1
         self.actor_instance: Any = None
         self.actor_spec: dict | None = None
         # per-caller ordered queues (actor_scheduling_queue.cc)
@@ -354,6 +356,8 @@ class WorkerRuntime:
         try:
             cls = await self._load_callable(spec["class_id"])
             concurrency = spec.get("max_concurrency", 1)
+            self._actor_concurrency = concurrency
+            self._async_sem = None  # built lazily on the io loop
             if concurrency > 1:
                 self.executor = concurrent.futures.ThreadPoolExecutor(
                     max_workers=concurrency, thread_name_prefix="exec"
@@ -415,10 +419,55 @@ class WorkerRuntime:
                 AttributeError(f"actor has no method {method_name!r}")
             )
             return {"status": "error", "error": payload}
+        if inspect.iscoroutinefunction(method):
+            # Async actor methods run as coroutines on the dedicated actor
+            # loop (reference async-actor semantics): awaiting them here
+            # costs no executor thread, so long-poll style methods scale to
+            # hundreds of concurrent waiters. Concurrency is bounded by the
+            # same max_concurrency as sync methods, via a semaphore.
+            return await self._execute_async_actor(spec, method)
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             self.executor, self._execute, spec, method, True
         )
+
+    async def _execute_async_actor(self, spec: dict, method) -> dict:
+        name = spec.get("name", "task")
+        task_id = spec.get("task_id")
+        if task_id in self._cancelled_pending:
+            self._cancelled_pending.discard(task_id)
+            self._record_task_event(spec, "CANCELLED")
+            return {"status": "cancelled"}
+        if self._async_sem is None:
+            self._async_sem = asyncio.Semaphore(self._actor_concurrency)
+        async with self._async_sem:
+            self._record_task_event(spec, "RUNNING")
+            try:
+                args, kwargs = await self._resolve_args_async(spec["args"])
+                cfut = asyncio.run_coroutine_threadsafe(
+                    method(*args, **kwargs), self._async_exec_loop()
+                )
+                self._running_async[task_id] = cfut
+                try:
+                    value = await asyncio.wrap_future(cfut)
+                finally:
+                    self._running_async.pop(task_id, None)
+                num_returns = spec.get("num_returns", 1)
+                values = [value] if num_returns == 1 else list(value)
+                self._record_task_event(spec, "FINISHED")
+                return {
+                    "status": "ok",
+                    "returns": self._package_returns(spec, values),
+                }
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                self._record_task_event(spec, "CANCELLED")
+                return {"status": "cancelled"}
+            except Exception:
+                self._record_task_event(spec, "FAILED")
+                err = exceptions.TaskError(name, traceback.format_exc())
+                payload, _ = serialization.serialize(err)
+                return {"status": "error", "error": payload}
 
     # ------------------------------------------------------------------
     # compiled-graph (aDAG) channels [SURVEY §2.2 "Compiled graphs"]

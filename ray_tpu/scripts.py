@@ -105,6 +105,21 @@ def cmd_microbenchmark(args) -> None:
     perf_main()
 
 
+def cmd_serve(args) -> None:
+    """`ray_tpu serve {deploy,status,shutdown}` (reference: serve CLI)."""
+    _connect(args)
+    from ray_tpu import serve
+
+    if args.serve_cmd == "deploy":
+        deployed = serve.run_from_config(args.config)
+        print(json.dumps({"deployed": deployed}))
+    elif args.serve_cmd == "status":
+        print(json.dumps(serve.status(), indent=2, default=str))
+    elif args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+
+
 def cmd_job(args) -> None:
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -178,6 +193,16 @@ def main(argv=None) -> None:
     jl = jsub.add_parser("list")
     jl.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("serve", help="deploy/inspect serve applications")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    sd = ssub.add_parser("deploy", help="apply a YAML deploy config")
+    sd.add_argument("config")
+    sd.add_argument("--address", default=None)
+    for name in ("status", "shutdown"):
+        sp = ssub.add_parser(name)
+        sp.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -14,12 +14,17 @@ from ray_tpu.serve.api import (
     get_app_handle,
     get_deployment_handle,
     run,
+    run_from_config,
     shutdown,
     start,
     status,
 )
 from ray_tpu.serve.batching import batch
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    ResponseStream,
+)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve._private.common import AutoscalingConfig, DeploymentConfig
 
@@ -36,6 +41,8 @@ __all__ = [
     "get_deployment_handle",
     "DeploymentHandle",
     "DeploymentResponse",
+    "ResponseStream",
+    "run_from_config",
     "batch",
     "multiplexed",
     "get_multiplexed_model_id",

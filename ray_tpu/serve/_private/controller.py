@@ -17,6 +17,7 @@ import pickle
 import threading
 import time
 import traceback
+import uuid
 from typing import Any, Optional
 
 import ray_tpu
@@ -54,6 +55,15 @@ class ServeController:
         self._app_status: dict[str, str] = {}
         self._applied_user_config: dict[str, Any] = {}
         self._stopped = False
+        # Long-poll push state (reference: _private/long_poll.py host):
+        # proxies/routers block in poll_update() until the membership
+        # version advances instead of polling get_routes every second.
+        self._config_version = 0
+        self._config_cond = threading.Condition(self._lock)
+        self._last_snapshot: dict | None = None
+        # Instance token: a restarted controller restarts versions at 0;
+        # subscribers detect the epoch change and resync from scratch.
+        self._instance = uuid.uuid4().hex
         # Keyed by qualified deployment name: a single controller-wide
         # timestamp would let the first deployment in iteration order
         # starve every other deployment's health checks.
@@ -113,6 +123,7 @@ class ServeController:
             if route_prefix is not None and deployments:
                 ingress = deployments[-1]
                 self._routes[route_prefix] = f"{app_name}_{ingress['name']}"
+            self._bump_version_locked()
         self._save_checkpoint()
         return "ok"
 
@@ -126,6 +137,7 @@ class ServeController:
                 if not d.startswith(app_name + "_")
             }
             self._app_status.pop(app_name, None)
+            self._bump_version_locked()
         self._save_checkpoint()
         return "ok"
 
@@ -161,6 +173,68 @@ class ServeController:
     def get_routes(self) -> dict:
         with self._lock:
             return dict(self._routes)
+
+    # ------------------------------------------------------------------
+    # long-poll push (reference: long_poll.py LongPollHost)
+    # ------------------------------------------------------------------
+    def _bump_version_locked(self) -> None:
+        self._config_version += 1
+        self._last_snapshot = None  # recompute lazily at next poll
+        self._config_cond.notify_all()
+
+    def _membership_snapshot(self) -> dict:
+        with self._lock:
+            replicas = {}
+            for qname, info in self._deployments.items():
+                running = sorted(
+                    r.actor_name
+                    for r in self._replicas.get(qname, [])
+                    if r.state == "RUNNING"
+                )
+                replicas[qname] = {
+                    "actor_names": running,
+                    "max_ongoing_requests": info.config.max_ongoing_requests,
+                }
+            return {"routes": dict(self._routes), "replicas": replicas}
+
+    def _publish_if_changed(self) -> None:
+        """End of each reconcile pass: if membership changed (replica went
+        RUNNING/DEAD, routes changed), advance the version and wake every
+        blocked poll_update."""
+        snapshot = self._membership_snapshot()
+        with self._config_cond:
+            if snapshot != self._last_snapshot:
+                self._config_version += 1
+                self._last_snapshot = snapshot
+                self._config_cond.notify_all()
+
+    async def poll_update(
+        self, last_version: int = -1, timeout_s: float = 10.0
+    ) -> dict:
+        """Block until the membership version advances past last_version
+        (or timeout); returns the fresh snapshot. Proxies and routers call
+        this in a loop — push semantics over an actor call. async so each
+        blocked subscriber is a coroutine on the actor's async lane, NOT a
+        pinned concurrency slot (N subscribers would otherwise starve the
+        control plane)."""
+        import asyncio
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and not self._stopped:
+            with self._lock:
+                if self._config_version > last_version:
+                    break
+            await asyncio.sleep(0.05)
+        with self._config_cond:
+            snapshot = self._last_snapshot
+            if snapshot is None:
+                snapshot = self._membership_snapshot()
+                self._last_snapshot = snapshot
+            return {
+                "version": self._config_version,
+                "instance": self._instance,
+                **snapshot,
+            }
 
     def get_status(self) -> dict:
         with self._lock:
@@ -259,6 +333,7 @@ class ServeController:
                     self._stop_replica(rep)
                     replicas.remove(rep)
             self._health_check(qname, info, replicas)
+        self._publish_if_changed()
 
     def _start_replica(self, qname: str, info: DeploymentInfo) -> ReplicaInfo | None:
         replica_id = new_replica_id(qname)

@@ -1,0 +1,123 @@
+"""Per-process long-poll subscriber for Serve membership updates.
+
+Role-equivalent of python/ray/serve/_private/long_poll.py ::
+LongPollClient. One background thread per process sits in
+ServeController.poll_update (which blocks server-side until the membership
+version advances); routers and proxies read the locally-cached snapshot —
+zero RPCs on the request path, push-latency route/replica updates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.serve._private.common import CONTROLLER_NAME
+
+_singleton: Optional["UpdateSubscriber"] = None
+_singleton_lock = threading.Lock()
+
+
+def get_subscriber() -> "UpdateSubscriber":
+    global _singleton
+    with _singleton_lock:
+        if _singleton is None:
+            _singleton = UpdateSubscriber()
+        return _singleton
+
+
+def reset_subscriber() -> None:
+    """Drop the cached subscriber (serve.shutdown / tests)."""
+    global _singleton
+    with _singleton_lock:
+        sub, _singleton = _singleton, None
+    if sub is not None:
+        sub.stop()
+
+
+class UpdateSubscriber:
+    POLL_TIMEOUT_S = 10.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snapshot: dict | None = None
+        self._version = -1
+        self._instance: str | None = None
+        self._have_snapshot = threading.Event()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-longpoll", daemon=True
+        )
+        self._thread.start()
+
+    # -- readers --------------------------------------------------------
+    def wait_ready(self, timeout: float = 30.0) -> bool:
+        return self._have_snapshot.wait(timeout)
+
+    def get_routes(self) -> dict:
+        self.wait_ready()
+        with self._lock:
+            return dict((self._snapshot or {}).get("routes", {}))
+
+    def get_replicas(self, qualified_name: str) -> dict:
+        self.wait_ready()
+        with self._lock:
+            replicas = (self._snapshot or {}).get("replicas", {})
+            return dict(
+                replicas.get(
+                    qualified_name,
+                    {"actor_names": [], "max_ongoing_requests": 100},
+                )
+            )
+
+    def force_refresh(self) -> None:
+        """Synchronous snapshot fetch for callers that cannot wait for the
+        next push (e.g. a router spinning on scale-from-zero)."""
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            update = ray_tpu.get(
+                controller.poll_update.remote(-1, 0.0), timeout=30
+            )
+            self._apply(update)
+        except Exception:
+            pass
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    # -- internals ------------------------------------------------------
+    def _apply(self, update: dict) -> None:
+        with self._lock:
+            instance = update.get("instance")
+            if instance != self._instance:
+                # Controller restarted: its version counter reset — resync.
+                self._instance = instance
+                self._version = -1
+            if update["version"] >= self._version:
+                self._version = update["version"]
+                self._snapshot = {
+                    "routes": update.get("routes", {}),
+                    "replicas": update.get("replicas", {}),
+                }
+        self._have_snapshot.set()
+
+    def _loop(self) -> None:
+        backoff = 0.1
+        while not self._stopped:
+            try:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                update = ray_tpu.get(
+                    controller.poll_update.remote(
+                        self._version, self.POLL_TIMEOUT_S
+                    ),
+                    timeout=self.POLL_TIMEOUT_S + 30,
+                )
+                self._apply(update)
+                backoff = 0.1
+            except Exception:
+                # Controller missing/restarting: back off, keep serving the
+                # stale snapshot (router falls back to force_refresh()).
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)

@@ -59,12 +59,11 @@ class HTTPProxy:
 
     # -- request path ---------------------------------------------------
     def _refresh_routes(self) -> None:
-        now = time.monotonic()
-        if now - self._last_refresh < self.ROUTE_REFRESH_S and self._routes:
-            return
-        controller = ray_tpu.get_actor(CONTROLLER_NAME)
-        self._routes = ray_tpu.get(controller.get_routes.remote(), timeout=30)
-        self._last_refresh = now
+        # Routes arrive by long-poll push (no per-request controller RPC).
+        from ray_tpu.serve._private.long_poll import get_subscriber
+
+        self._routes = get_subscriber().get_routes()
+        self._last_refresh = time.monotonic()
 
     def _match(self, path: str) -> tuple[str, str] | None:
         """Longest-prefix route match → (route, qualified deployment)."""
@@ -106,6 +105,10 @@ class HTTPProxy:
             )
         except Exception as exc:
             return web.Response(status=500, text=f"{type(exc).__name__}: {exc}")
+        from ray_tpu.serve.handle import ResponseStream
+
+        if isinstance(result, ResponseStream):
+            return await self._stream_response(request, result)
         if isinstance(result, bytes):
             return web.Response(body=result)
         if isinstance(result, str):
@@ -114,6 +117,51 @@ class HTTPProxy:
             return web.json_response(result)
         except TypeError:
             return web.Response(text=str(result))
+
+    async def _stream_response(self, request, stream):
+        """Streaming deployment → SSE (Accept: text/event-stream) or
+        chunked newline-delimited body: the LLM token-stream ingress path
+        (reference: proxy StreamingResponse support, SURVEY §3.4)."""
+        from aiohttp import web
+
+        sse = "text/event-stream" in request.headers.get("Accept", "")
+        response = web.StreamResponse(
+            headers={
+                "Content-Type": (
+                    "text/event-stream" if sse else "application/octet-stream"
+                ),
+                "Cache-Control": "no-cache",
+            }
+        )
+        response.enable_chunked_encoding()
+        await response.prepare(request)
+        try:
+            while True:
+                # One thread hop per replica RPC, not per item.
+                batch = await asyncio.to_thread(stream.next_batch)
+                if not batch:
+                    break
+                for item in batch:
+                    if isinstance(item, bytes):
+                        text = item.decode("utf-8", "replace")
+                    elif isinstance(item, str):
+                        text = item
+                    else:
+                        try:
+                            text = json.dumps(item)
+                        except TypeError:
+                            text = str(item)
+                    if sse:
+                        await response.write(f"data: {text}\n\n".encode())
+                    else:
+                        await response.write((text + "\n").encode())
+        except BaseException:
+            # Client disconnect, encode error, anything: release the
+            # replica-side stream and the router's ongoing slot.
+            await asyncio.to_thread(stream.cancel)
+            raise
+        await response.write_eof()
+        return response
 
     def _call_deployment(self, app_name: str, dep_name: str, body: Any) -> Any:
         from ray_tpu.serve.handle import DeploymentHandle

@@ -46,6 +46,8 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._latencies: list[float] = []
+        self._streams: dict[str, tuple] = {}
+        self._stream_counter = 0
         init_args = _resolve_handle_placeholders(init_args)
         init_kwargs = _resolve_handle_placeholders(init_kwargs)
         if isinstance(cls_or_fn, type):
@@ -59,6 +61,13 @@ class Replica:
 
     # -- request path ---------------------------------------------------
     async def handle_request(self, meta: dict, args: tuple, kwargs: dict) -> Any:
+        for arg in args:
+            if isinstance(arg, dict) and "__serve_stream__" in arg:
+                raise TypeError(
+                    "a streaming deployment response cannot be composed "
+                    "into a downstream call — iterate the stream in the "
+                    "caller and pass materialized values"
+                )
         self._ongoing += 1
         self._total += 1
         start = time.perf_counter()
@@ -71,6 +80,16 @@ class Replica:
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result
+            if inspect.isgenerator(result) or inspect.isasyncgen(result):
+                # Streaming response (LLM token streams etc., reference:
+                # generator deployments + StreamingResponse): register the
+                # generator and hand back a stream marker; the caller pulls
+                # chunks via stream_next() (batched per RPC). The ongoing
+                # gauge stays raised until the stream finishes — a live
+                # token stream IS an ongoing request for autoscaling.
+                stream_id = self._open_stream(result)
+                self._ongoing += 1  # released by _finish_stream
+                return {"__serve_stream__": stream_id}
             return result
         finally:
             _request_context.reset(token)
@@ -78,6 +97,94 @@ class Replica:
             self._latencies.append(time.perf_counter() - start)
             if len(self._latencies) > 1000:
                 del self._latencies[:500]
+
+    # -- streaming ------------------------------------------------------
+    STREAM_IDLE_TTL_S = 120.0
+
+    def _open_stream(self, gen) -> str:
+        stream_id = f"stream-{self.replica_id}-{self._stream_counter}"
+        self._stream_counter += 1
+        queue: asyncio.Queue = asyncio.Queue(maxsize=256)
+        task = asyncio.get_running_loop().create_task(self._pump(gen, queue))
+        self._streams[stream_id] = {
+            "queue": queue, "task": task, "last_access": time.monotonic(),
+        }
+        self._reap_idle_streams()
+        return stream_id
+
+    def _finish_stream(self, stream_id: str) -> None:
+        entry = self._streams.pop(stream_id, None)
+        if entry is not None:
+            entry["task"].cancel()
+            self._ongoing -= 1
+
+    def _reap_idle_streams(self) -> None:
+        """Abandoned streams (client crashed / never iterated) must not pin
+        the generator + queue + ongoing slot forever."""
+        now = time.monotonic()
+        for sid, entry in list(self._streams.items()):
+            if now - entry["last_access"] > self.STREAM_IDLE_TTL_S:
+                self._finish_stream(sid)
+
+    async def _pump(self, gen, queue: asyncio.Queue) -> None:
+        """Drains the user generator into the stream queue. Sentinel dicts
+        terminate: {'done': True} or {'error': repr}."""
+        try:
+            if inspect.isasyncgen(gen):
+                async for item in gen:
+                    await queue.put({"item": item})
+            else:
+                for item in gen:
+                    await queue.put({"item": item})
+                    await asyncio.sleep(0)  # let consumers interleave
+            await queue.put({"done": True})
+        except Exception as exc:
+            await queue.put({"error": f"{type(exc).__name__}: {exc}"})
+
+    async def stream_next(
+        self, stream_id: str, max_items: int = 64, timeout_s: float = 30.0
+    ) -> dict:
+        """Pop at least one event (blocking up to timeout_s), then drain up
+        to max_items without waiting — batching amortizes the per-chunk
+        RPC."""
+        entry = self._streams.get(stream_id)
+        if entry is None:
+            return {"items": [], "done": True, "error": "unknown stream"}
+        entry["last_access"] = time.monotonic()
+        queue = entry["queue"]
+        items: list = []
+        done = False
+        error = None
+        try:
+            event = await asyncio.wait_for(queue.get(), timeout_s)
+        except asyncio.TimeoutError:
+            entry["last_access"] = time.monotonic()
+            return {"items": [], "done": False}
+        while True:
+            if "item" in event:
+                items.append(event["item"])
+            else:
+                done = True
+                error = event.get("error")
+                break
+            if len(items) >= max_items:
+                break
+            try:
+                event = queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+        if done:
+            self._finish_stream(stream_id)
+        else:
+            entry["last_access"] = time.monotonic()
+        out = {"items": items, "done": done}
+        if error:
+            out["error"] = error
+        return out
+
+    def stream_cancel(self, stream_id: str) -> str:
+        self._finish_stream(stream_id)
+        return "ok"
 
     # -- control plane --------------------------------------------------
     def reconfigure(self, user_config: Any) -> str:

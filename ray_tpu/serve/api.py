@@ -172,7 +172,13 @@ def _get_or_create_controller():
     try:
         return (
             ray_tpu.remote(ServeController)
-            .options(name=CONTROLLER_NAME, lifetime="detached", max_concurrency=16)
+            .options(
+                name=CONTROLLER_NAME, lifetime="detached",
+                # High: every serve process keeps one async poll_update
+                # parked here (coroutine-cheap since async actor methods
+                # don't hold executor threads).
+                max_concurrency=256,
+            )
             .remote()
         )
     except ValueError:
@@ -235,6 +241,19 @@ def run(
     return DeploymentHandle(target.deployment.name, name)
 
 
+def run_from_config(path_or_schema) -> dict:
+    """Deploy applications from a YAML file / dict / ServeDeploySchema
+    (reference: `serve deploy config.yaml` + serve.run on a built app)."""
+    from ray_tpu.serve import schema as schema_mod
+
+    schema = path_or_schema
+    if isinstance(schema, str):
+        schema = schema_mod.ServeDeploySchema.from_yaml(schema)
+    elif isinstance(schema, dict):
+        schema = schema_mod.ServeDeploySchema.from_dict(schema)
+    return schema_mod.deploy_from_config(schema)
+
+
 def get_app_handle(name: str = DEFAULT_APP_NAME) -> DeploymentHandle:
     controller = ray_tpu.get_actor(CONTROLLER_NAME)
     status = ray_tpu.get(controller.get_status.remote(), timeout=30)
@@ -270,6 +289,9 @@ def delete(name: str) -> None:
 
 def shutdown() -> None:
     global _proxy_handle, _proxy_port
+    from ray_tpu.serve._private.long_poll import reset_subscriber
+
+    reset_subscriber()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:

@@ -34,9 +34,17 @@ class DeploymentResponse:
     def result(self, timeout: Optional[float] = 60.0) -> Any:
         try:
             value = ray_tpu.get(self._ref, timeout=timeout)
-            return value
-        finally:
+        except Exception:
             self._mark_done()
+            raise
+        if isinstance(value, dict) and "__serve_stream__" in value:
+            # Streaming deployment (generator handler): hand back an
+            # iterator that pulls batched chunks from the replica. The
+            # router's ongoing slot stays held until the stream ends —
+            # a live token stream IS an ongoing request.
+            return ResponseStream(self, value["__serve_stream__"])
+        self._mark_done()
+        return value
 
     def _mark_done(self):
         if not self._done:
@@ -50,6 +58,89 @@ class DeploymentResponse:
         # 'no available replica' after max_ongoing composed calls).
         self._mark_done()
         return self._ref
+
+
+class ResponseStream:
+    """Iterator over a streaming deployment response (token streams).
+
+    Pulls batched chunks via the replica's stream_next actor method;
+    releases the router's ongoing slot when the stream finishes.
+    Role-equivalent of the reference's DeploymentResponseGenerator.
+    """
+
+    def __init__(self, response: "DeploymentResponse", stream_id: str):
+        self._response = response
+        self._stream_id = stream_id
+        self._buffer: list = []
+        self._done = False
+        self._error: str | None = None
+        self._timeout_s = 60.0
+
+    def __iter__(self):
+        return self
+
+    def _exhausted(self):
+        # Buffered items always drain before a trailing error surfaces.
+        if self._error is not None:
+            error, self._error = self._error, None
+            raise RuntimeError(f"streaming deployment failed: {error}")
+        raise StopIteration
+
+    def _fill(self) -> None:
+        """Pull chunks from the replica until the buffer is non-empty or
+        the stream ends."""
+        router = self._response._router
+        replica = router._replica_handle(self._response._replica_name)
+        deadline = time.monotonic() + self._timeout_s
+        while not self._buffer and not self._done:
+            chunk = ray_tpu.get(
+                replica.stream_next.remote(self._stream_id),
+                timeout=self._timeout_s + 30,
+            )
+            self._buffer.extend(chunk.get("items", []))
+            if chunk.get("done"):
+                self._done = True
+                self._error = chunk.get("error")
+                self._response._mark_done()
+            elif time.monotonic() > deadline and not self._buffer:
+                self.cancel()
+                raise TimeoutError("stream stalled")
+
+    def __next__(self):
+        if self._buffer:
+            return self._buffer.pop(0)
+        if self._done:
+            self._exhausted()
+        self._fill()
+        if self._buffer:
+            return self._buffer.pop(0)
+        self._exhausted()
+
+    def next_batch(self) -> list:
+        """All currently-buffered items (pulling one replica chunk when
+        empty); [] means end-of-stream. One blocking call per replica RPC —
+        batch consumers (the HTTP proxy) avoid a thread hop per item."""
+        if not self._buffer and not self._done:
+            self._fill()
+        if self._buffer:
+            batch, self._buffer = self._buffer, []
+            return batch
+        if self._error is not None:
+            self._exhausted()
+        return []
+
+    def cancel(self) -> None:
+        if not self._done:
+            self._done = True
+            router = self._response._router
+            try:
+                replica = router._replica_handle(self._response._replica_name)
+                ray_tpu.get(
+                    replica.stream_cancel.remote(self._stream_id), timeout=30
+                )
+            except Exception:
+                pass
+            self._response._mark_done()
 
 
 class Router:
@@ -69,15 +160,17 @@ class Router:
         self._lock = threading.Lock()
 
     def _refresh(self, force: bool = False) -> None:
-        now = time.monotonic()
-        if not force and now - self._last_refresh < self.REFRESH_INTERVAL_S:
-            return
-        controller = ray_tpu.get_actor(CONTROLLER_NAME)
-        info = ray_tpu.get(
-            controller.get_deployment_replicas.remote(self._qualified), timeout=30
-        )
+        """Membership comes from the process-wide long-poll subscriber
+        (push, no RPC); force=True short-circuits with a direct snapshot
+        fetch (scale-from-zero spin)."""
+        from ray_tpu.serve._private.long_poll import get_subscriber
+
+        subscriber = get_subscriber()
+        if force:
+            subscriber.force_refresh()
+        info = subscriber.get_replicas(self._qualified)
         with self._lock:
-            self._last_refresh = now
+            self._last_refresh = time.monotonic()
             self._replicas = info["actor_names"]
             self._max_ongoing = info.get("max_ongoing_requests", 100)
             for name in self._replicas:
@@ -111,8 +204,8 @@ class Router:
                     f"no available replica for {self._qualified} "
                     f"(backpressure or scale-to-zero)"
                 )
-            self._last_refresh = 0.0  # force refresh next spin
             time.sleep(0.05)
+            self._refresh(force=True)
 
     def on_request_done(self, actor_name: str) -> None:
         with self._lock:

@@ -107,6 +107,33 @@ def main(tiny: bool = True, seconds: float = 8.0, concurrency: int = 16):
         for future in futures:
             latencies.extend(future.result())
 
+    # Token-streaming endpoint (the LLM serving path): a generator
+    # deployment streamed end-to-end through the HTTP proxy as SSE.
+    @serve.deployment
+    class TokenStreamer:
+        def __call__(self, body):
+            n = int((body or {}).get("n", 8))
+            for i in range(n):
+                yield {"token": f"t{i}"}
+
+    serve.run(
+        TokenStreamer.bind(), name="stream", route_prefix="/stream",
+        http_port=8199,
+    )
+    stream_tokens = 0
+    stream_start = time.perf_counter()
+    with httpx.Client(timeout=60) as client:
+        with client.stream(
+            "POST", "http://127.0.0.1:8199/stream", json={"n": 32},
+            headers={"Accept": "text/event-stream"},
+        ) as resp:
+            assert resp.status_code == 200
+            for line in resp.iter_lines():
+                if line.startswith("data: "):
+                    stream_tokens += 1
+    stream_s = time.perf_counter() - stream_start
+    assert stream_tokens == 32, f"expected 32 streamed tokens, got {stream_tokens}"
+
     status = serve.status()
     replicas = status["bert"]["deployments"]["BertEncoder"]["running_replicas"]
     latencies.sort()
@@ -119,6 +146,7 @@ def main(tiny: bool = True, seconds: float = 8.0, concurrency: int = 16):
             "p99_ms": 1e3 * latencies[int(len(latencies) * 0.99)],
             "replicas": replicas,
             "requests": len(latencies),
+            "stream_tokens_per_s": round(stream_tokens / stream_s, 1),
         }
     ))
     serve.shutdown()

@@ -1,0 +1,17 @@
+"""Import target for the YAML deploy schema test."""
+from ray_tpu import serve
+
+
+@serve.deployment
+class Greeter:
+    def __init__(self):
+        self.greeting = "hello"
+
+    def reconfigure(self, config):
+        self.greeting = config.get("greeting", self.greeting)
+
+    def __call__(self, name):
+        return f"{self.greeting} {name}"
+
+
+app = Greeter.bind()

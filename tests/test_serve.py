@@ -297,3 +297,117 @@ def test_delete_application(serve_instance):
     while time.time() < deadline and "temp" in serve.status():
         time.sleep(0.2)
     assert "temp" not in serve.status()
+
+
+# ---------- round 3: streaming / long-poll / YAML schema ----------
+
+def test_streaming_handle_and_http(serve_instance):
+    """Generator deployment streams through the handle (ResponseStream)
+    AND through the HTTP proxy (chunked + SSE) — the LLM token path."""
+    import httpx
+
+    @serve.deployment
+    class TokenStreamer:
+        def __call__(self, body):
+            n = body["n"] if isinstance(body, dict) else int(body)
+            for i in range(n):
+                yield f"tok{i}"
+
+    serve.start(http_port=8153)
+    handle = serve.run(
+        TokenStreamer.bind(), name="streamer", route_prefix="/stream",
+        http_port=8153,
+    )
+    # handle path: result() returns an iterator over the chunks
+    stream = handle.remote({"n": 5}).result()
+    assert isinstance(stream, serve.ResponseStream)
+    assert list(stream) == [f"tok{i}" for i in range(5)]
+
+    # chunked HTTP path (newline-delimited)
+    with httpx.stream(
+        "POST", "http://127.0.0.1:8153/stream", json={"n": 4}, timeout=60
+    ) as resp:
+        assert resp.status_code == 200
+        body = "".join(resp.iter_text())
+    assert body.splitlines() == [f"tok{i}" for i in range(4)]
+
+    # SSE path
+    with httpx.stream(
+        "POST", "http://127.0.0.1:8153/stream", json={"n": 3},
+        headers={"Accept": "text/event-stream"}, timeout=60,
+    ) as resp:
+        assert resp.headers["content-type"].startswith("text/event-stream")
+        events = [
+            line[len("data: "):]
+            for line in "".join(resp.iter_text()).splitlines()
+            if line.startswith("data: ")
+        ]
+    assert events == [f"tok{i}" for i in range(3)]
+
+
+def test_streaming_error_propagates(serve_instance):
+    @serve.deployment
+    class Boomer:
+        def __call__(self, body):
+            yield "first"
+            raise ValueError("mid-stream bang")
+
+    handle = serve.run(Boomer.bind(), name="boomer", route_prefix="/boom")
+    stream = handle.remote({}).result()
+    items = []
+    with pytest.raises(RuntimeError, match="mid-stream bang"):
+        for item in stream:
+            items.append(item)
+    assert items == ["first"]
+
+
+def test_long_poll_pushes_route_updates(serve_instance):
+    """Membership changes arrive by push: a new app's routes show up in
+    the subscriber without any explicit polling by the consumer."""
+    from ray_tpu.serve._private.long_poll import get_subscriber
+
+    @serve.deployment
+    def pong(_):
+        return "pong"
+
+    serve.run(pong.bind(), name="pushed", route_prefix="/pushed")
+    sub = get_subscriber()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        routes = sub.get_routes()
+        if "/pushed" in routes and sub.get_replicas(routes["/pushed"])[
+            "actor_names"
+        ]:
+            break
+        time.sleep(0.1)
+    assert "/pushed" in sub.get_routes()
+    qualified = sub.get_routes()["/pushed"]
+    assert sub.get_replicas(qualified)["actor_names"]
+
+
+def test_yaml_deploy_schema(serve_instance, tmp_path):
+    """A YAML config deploys an app by import path with per-deployment
+    overrides (num_replicas), end to end through serve.run_from_config."""
+    config = tmp_path / "serve.yaml"
+    config.write_text(
+        """
+http_options:
+  host: 127.0.0.1
+  port: 8163
+applications:
+  - name: yamlapp
+    route_prefix: /yaml
+    import_path: tests.serve_yaml_app:app
+    deployments:
+      - name: Greeter
+        num_replicas: 2
+        user_config: {greeting: "hola"}
+"""
+    )
+    deployed = serve.run_from_config(str(config))
+    assert deployed == {"yamlapp": "Greeter"}
+    status = serve.status()
+    assert status["yamlapp"]["status"] == "RUNNING"
+    assert status["yamlapp"]["deployments"]["Greeter"]["running_replicas"] == 2
+    handle = serve.get_app_handle("yamlapp")
+    assert handle.remote("world").result() == "hola world"
