@@ -395,7 +395,10 @@ class WorkerRuntime:
         while seq > state["expected"]:
             event = state["waiters"].setdefault(seq, asyncio.Event())
             try:
-                await asyncio.wait_for(event.wait(), timeout=5.0)
+                # Generous: this releases ONLY when an earlier submission
+                # died with a previous actor incarnation; a short timeout
+                # misfires as out-of-order execution on a loaded host.
+                await asyncio.wait_for(event.wait(), timeout=30.0)
             except asyncio.TimeoutError:
                 state["expected"] = seq
                 break

@@ -8,6 +8,7 @@ into train gangs). SURVEY §2.7.
 
 from ray_tpu.data.block import BlockAccessor, BlockMetadata, DataContext
 from ray_tpu.data.dataset import Dataset, GroupedData, from_block_refs
+from ray_tpu.data.datasource import Datasink, Datasource, ReadTask
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (
     from_arrow,
@@ -19,6 +20,7 @@ from ray_tpu.data.read_api import (
     range,
     range_tensor,
     read_csv,
+    read_datasource,
     read_images,
     read_json,
     read_numpy,
@@ -51,6 +53,10 @@ __all__ = [
     "read_images",
     "read_text",
     "read_tfrecords",
+    "read_datasource",
+    "Datasource",
+    "Datasink",
+    "ReadTask",
     "Count",
     "Sum",
     "Min",

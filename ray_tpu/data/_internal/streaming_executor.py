@@ -37,15 +37,31 @@ from ray_tpu.data._internal.shuffle import (
 )
 
 
-@ray_tpu.remote
+@ray_tpu.remote(num_returns=2)
 def _run_read_task(read_task) -> Any:
+    cpu0 = time.thread_time()
     blocks = list(read_task())
-    return BlockAccessor.concat(blocks)
+    out = BlockAccessor.concat(blocks)
+    accessor = BlockAccessor.for_block(out)
+    meta = {
+        "rows": accessor.num_rows(),
+        "bytes": accessor.size_bytes(),
+        "cpu_s": time.thread_time() - cpu0,
+    }
+    return out, meta
 
 
-@ray_tpu.remote
+@ray_tpu.remote(num_returns=2)
 def _map_task(ops: list, block) -> Any:
-    return make_fused_fn(ops)(block)
+    cpu0 = time.thread_time()
+    out = make_fused_fn(ops)(block)
+    accessor = BlockAccessor.for_block(out)
+    meta = {
+        "rows": accessor.num_rows(),
+        "bytes": accessor.size_bytes(),
+        "cpu_s": time.thread_time() - cpu0,
+    }
+    return out, meta
 
 
 @ray_tpu.remote
@@ -60,14 +76,49 @@ def _slice_block(block, start: int, end: int):
 
 @ray_tpu.remote
 class _MapActor:
-    """Actor-pool worker: constructs stateful UDFs once, maps blocks."""
+    """Actor-pool worker: constructs stateful UDFs once, maps blocks.
+    Accumulates its own execution stats (collected once at stage end —
+    zero per-block overhead, unlike the task path's per-task metadata)."""
 
     def __init__(self, ops: list):
         self._ops = ops
         self._fused = make_fused_fn(ops, instantiate_udfs(ops))
+        self._rows = 0
+        self._bytes = 0
+        self._cpu_s = 0.0
+        self._tasks = 0
 
     def map(self, block) -> Any:
-        return self._fused(block)
+        cpu0 = time.thread_time()
+        out = self._fused(block)
+        accessor = BlockAccessor.for_block(out)
+        self._rows += accessor.num_rows()
+        self._bytes += accessor.size_bytes()
+        self._cpu_s += time.thread_time() - cpu0
+        self._tasks += 1
+        return out
+
+    def get_exec_stats(self) -> dict:
+        return {
+            "rows": self._rows, "bytes": self._bytes,
+            "cpu_s": self._cpu_s, "tasks": self._tasks,
+        }
+
+
+def _collect_metas(stats: "_StageStats", meta_refs: list) -> None:
+    """Fold completed per-task metadata into stage stats; one bounded wait
+    total — tasks whose meta is not ready (early-stopped stream) are
+    skipped, not waited for."""
+    if not meta_refs:
+        return
+    try:
+        ready, _ = ray_tpu.wait(
+            meta_refs, num_returns=len(meta_refs), timeout=1.0
+        )
+        for ref in ready:
+            stats.add_meta(ray_tpu.get(ref))
+    except Exception:
+        pass
 
 
 class _StageStats:
@@ -76,6 +127,15 @@ class _StageStats:
         self.wall_s = 0.0
         self.blocks_out = 0
         self.rows_out = 0
+        self.bytes_out = 0
+        self.cpu_s = 0.0
+        self.tasks = 0
+
+    def add_meta(self, meta: dict) -> None:
+        self.rows_out += meta.get("rows", 0)
+        self.bytes_out += meta.get("bytes", 0)
+        self.cpu_s += meta.get("cpu_s", 0.0)
+        self.tasks += meta.get("tasks", 1)
 
 
 class StreamingExecutor:
@@ -121,19 +181,27 @@ class StreamingExecutor:
         assert isinstance(op, Read)
         window = self.ctx.streaming_max_inflight_tasks
         pending: list = []
+        meta_refs: list = []
         tasks = list(op.read_tasks)
         idx = 0
-        while idx < len(tasks) or pending:
-            while idx < len(tasks) and len(pending) < window:
-                pending.append(_run_read_task.remote(tasks[idx]))
-                idx += 1
-            ready, pending_rest = ray_tpu.wait(pending, num_returns=1)
-            pending = list(pending_rest)
-            for ref in ready:
-                stats.blocks_out += 1
-                stats.wall_s += time.perf_counter() - start
-                yield ref
-                start = time.perf_counter()
+        try:
+            while idx < len(tasks) or pending:
+                while idx < len(tasks) and len(pending) < window:
+                    block_ref, meta_ref = _run_read_task.remote(tasks[idx])
+                    meta_refs.append(meta_ref)
+                    pending.append(block_ref)
+                    idx += 1
+                ready, pending_rest = ray_tpu.wait(pending, num_returns=1)
+                pending = list(pending_rest)
+                for ref in ready:
+                    stats.blocks_out += 1
+                    stats.wall_s += time.perf_counter() - start
+                    yield ref
+                    start = time.perf_counter()
+        finally:
+            # Batched: one get at stream end, never a blocking RPC in the
+            # per-block hot loop.
+            _collect_metas(stats, meta_refs)
 
     def _run_map(
         self, stage: MapStage, stream: Iterator, stats: _StageStats
@@ -143,25 +211,31 @@ class StreamingExecutor:
             return
         window = self.ctx.streaming_max_inflight_tasks
         pending: list = []
+        meta_refs: list = []
         start = time.perf_counter()
         exhausted = False
-        while not exhausted or pending:
-            while not exhausted and len(pending) < window:
-                try:
-                    block_ref = next(stream)
-                except StopIteration:
-                    exhausted = True
+        try:
+            while not exhausted or pending:
+                while not exhausted and len(pending) < window:
+                    try:
+                        block_ref = next(stream)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    out_ref, meta_ref = _map_task.remote(stage.ops, block_ref)
+                    meta_refs.append(meta_ref)
+                    pending.append(out_ref)
+                if not pending:
                     break
-                pending.append(_map_task.remote(stage.ops, block_ref))
-            if not pending:
-                break
-            ready, pending_rest = ray_tpu.wait(pending, num_returns=1)
-            pending = list(pending_rest)
-            for ref in ready:
-                stats.blocks_out += 1
-                stats.wall_s += time.perf_counter() - start
-                yield ref
-                start = time.perf_counter()
+                ready, pending_rest = ray_tpu.wait(pending, num_returns=1)
+                pending = list(pending_rest)
+                for ref in ready:
+                    stats.blocks_out += 1
+                    stats.wall_s += time.perf_counter() - start
+                    yield ref
+                    start = time.perf_counter()
+        finally:
+            _collect_metas(stats, meta_refs)
 
     def _run_map_actors(
         self, stage: MapStage, stream: Iterator, stats: _StageStats
@@ -173,6 +247,7 @@ class StreamingExecutor:
         load = [0] * len(actors)
         start = time.perf_counter()
         exhausted = False
+        completed = False
         try:
             while not exhausted or pending:
                 while not exhausted and min(load) < per_actor_inflight:
@@ -201,8 +276,21 @@ class StreamingExecutor:
                     stats.wall_s += time.perf_counter() - start
                     yield ref
                     start = time.perf_counter()
+            completed = True
         finally:
             for actor in actors:
+                if completed:
+                    # Only on normal exhaustion: an early-stopped stream
+                    # (e.g. a downstream limit) must not block teardown
+                    # behind busy actors just to collect stats.
+                    try:
+                        stats.add_meta(
+                            ray_tpu.get(
+                                actor.get_exec_stats.remote(), timeout=10
+                            )
+                        )
+                    except Exception:
+                        pass
                 try:
                     ray_tpu.kill(actor)
                 except Exception:

@@ -142,21 +142,30 @@ class Dataset:
         if self._materialized_refs is None:
             executor = StreamingExecutor(plan_stages(self._plan))
             self._materialized_refs = executor.execute_to_refs()
-            for s in executor.stage_stats:
-                self._stats.record_stage(s.name, s.wall_s, s.blocks_out, s.rows_out)
+            self._stats.replace_stages(executor.stage_stats)
         return self._materialized_refs
 
     def _streaming_refs(self) -> Iterator:
         if self._materialized_refs is not None:
             return iter(self._materialized_refs)
-        return StreamingExecutor(plan_stages(self._plan)).execute()
+        executor = StreamingExecutor(plan_stages(self._plan))
+
+        def run() -> Iterator:
+            try:
+                yield from executor.execute()
+            finally:
+                # The consumed run's operator stats feed ds.stats() — a
+                # streamed dataset must not re-execute just to report.
+                self._stats.replace_stages(executor.stage_stats)
+
+        return run()
 
     def materialize(self) -> "Dataset":
         self._refs()
         return self
 
     def iterator(self) -> DataIterator:
-        return DataIterator(self._streaming_refs)
+        return DataIterator(self._streaming_refs, stats=self._stats)
 
     def iter_batches(self, **kwargs) -> Iterator:
         return self.iterator().iter_batches(**kwargs)
@@ -224,7 +233,10 @@ class Dataset:
         return BlockAccessor.concat(ray_tpu.get(self._refs()))
 
     def stats(self) -> str:
-        self._refs()
+        # Execute only if nothing has run yet — a consumed streaming run
+        # already recorded its operator stats.
+        if not self._stats.stages and self._materialized_refs is None:
+            self._refs()
         return self._stats.summary_string()
 
     # aggregates
@@ -292,6 +304,52 @@ class Dataset:
             for i, block_ref in enumerate(self._refs())
         ]
         ray_tpu.get(refs)
+
+    def write_tfrecords(self, path: str) -> None:
+        """One TFRecord file of tf.Example protos per block (in-tree codec,
+        no TensorFlow)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+
+        @ray_tpu.remote
+        def _write_block(block, out_path: str) -> str:
+            from ray_tpu.data._internal.tfrecord import (
+                encode_example, write_records,
+            )
+
+            accessor = BlockAccessor.for_block(block)
+            write_records(
+                out_path,
+                (encode_example(row) for row in accessor.iter_rows()),
+            )
+            return out_path
+
+        refs = [
+            _write_block.remote(block_ref, f"{path}/part-{i:05d}.tfrecord")
+            for i, block_ref in enumerate(self._refs())
+        ]
+        ray_tpu.get(refs)
+
+    def write_datasink(self, datasink) -> None:
+        """Write through a custom Datasink plugin (reference:
+        Dataset.write_datasink + datasink.py lifecycle)."""
+        datasink.on_write_start()
+        try:
+            @ray_tpu.remote
+            def _write_task(sink, task_index: int, *blocks):
+                tables = [BlockAccessor.for_block(b).block for b in blocks]
+                return sink.write(tables, {"task_index": task_index})
+
+            refs = [
+                _write_task.remote(datasink, i, block_ref)
+                for i, block_ref in enumerate(self._refs())
+            ]
+            results = ray_tpu.get(refs)
+        except Exception as exc:
+            datasink.on_write_failed(exc)
+            raise
+        datasink.on_write_complete(results)
 
     def __repr__(self):
         return f"Dataset(plan={self._plan.describe()})"

@@ -18,10 +18,13 @@ from ray_tpu.data._internal.map_fn import batch_blocks, format_batch
 
 
 class DataIterator:
-    def __init__(self, ref_iter_factory, owner_name: str = "dataset"):
+    def __init__(self, ref_iter_factory, owner_name: str = "dataset",
+                 stats=None):
         """ref_iter_factory: () -> iterator of block refs (fresh each epoch)."""
         self._factory = ref_iter_factory
         self._owner_name = owner_name
+        self._stats = stats
+        self._fetch_wait_s = 0.0
 
     def _block_iter(self, prefetch_blocks: int) -> Iterator:
         """Fetch blocks with a prefetch thread (depth = prefetch_blocks+1)."""
@@ -40,15 +43,55 @@ class DataIterator:
 
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()
+        import time as _time
+
         while True:
+            t0 = _time.perf_counter()
             item = q.get()
+            # Time truly blocked on producers (vs local batching/format).
+            self._fetch_wait_s += _time.perf_counter() - t0
             if item is _DONE:
                 return
             if isinstance(item, BaseException):
                 raise item
             yield item
 
-    def iter_batches(
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        """Yields formatted batches; when the owning Dataset tracks stats,
+        records wait-on-producer vs in-user-code time (the "is my input
+        pipeline the bottleneck" split of ds.stats())."""
+        inner = self._iter_batches_impl(**kwargs)
+        if self._stats is None:
+            yield from inner
+            return
+        import time as _time
+
+        produce_s = user_s = 0.0
+        batches = 0
+        last_yield_end = None
+        self._fetch_wait_s = 0.0
+        try:
+            while True:
+                resume = _time.perf_counter()
+                if last_yield_end is not None:
+                    user_s += resume - last_yield_end
+                try:
+                    batch = next(inner)
+                except StopIteration:
+                    break
+                produce_s += _time.perf_counter() - resume
+                batches += 1
+                yield batch
+                last_yield_end = _time.perf_counter()
+        finally:
+            # Split production time into blocked-on-producers (block fetch
+            # wait, measured in _block_iter) vs local batching/formatting.
+            wait_s = min(self._fetch_wait_s, produce_s)
+            self._stats.record_iter(
+                wait_s, user_s, batches, local_s=produce_s - wait_s
+            )
+
+    def _iter_batches_impl(
         self,
         *,
         batch_size: Optional[int] = 256,

@@ -125,9 +125,55 @@ def read_text(paths, *, parallelism: int = -1) -> Dataset:
 
 
 def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
-    raise NotImplementedError(
-        "tfrecords need tensorflow, which is not in this image; "
-        "convert to parquet or use read_numpy"
+    """Read TFRecord files of tf.Example protos — no TensorFlow needed:
+    both wire formats are decoded by the in-tree codec
+    (_internal/tfrecord.py). Reference: read_api.py::read_tfrecords."""
+    import pyarrow as pa
+
+    from ray_tpu.data._internal.tfrecord import decode_example, read_records
+
+    def reader(path: str):
+        rows = [decode_example(rec) for rec in read_records(path)]
+        if not rows:
+            return pa.table({})
+        # Union of feature names across all records (sparse/optional
+        # features are normal in tf.Example data); missing -> null.
+        names: list[str] = []
+        for row in rows:
+            for n in row:
+                if n not in names:
+                    names.append(n)
+        columns = {}
+        for n in names:
+            values = [r.get(n) for r in rows]
+            # A column mixing unwrapped scalars and multi-element lists
+            # must be normalized to lists for a consistent Arrow type.
+            if any(isinstance(v, list) for v in values):
+                values = [
+                    v if isinstance(v, list) or v is None else [v]
+                    for v in values
+                ]
+            columns[n] = values
+        return pa.table(columns)
+
+    return _file_dataset(paths, parallelism, reader, "ReadTFRecords")
+
+
+def read_datasource(
+    datasource, *, parallelism: int = -1, **_unused
+) -> Dataset:
+    """Read from a custom Datasource plugin (reference:
+    read_api.py::read_datasource + datasource.py protocol)."""
+    if parallelism <= 0:
+        parallelism = DataContext.get_current().read_op_min_num_blocks
+    read_tasks = datasource.get_read_tasks(parallelism)
+    if not read_tasks:
+        return from_items([])
+    return Dataset(
+        LogicalPlan(
+            [Read(read_tasks=list(read_tasks),
+                  name=f"Read{datasource.get_name()}")]
+        )
     )
 
 

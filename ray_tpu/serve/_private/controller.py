@@ -61,6 +61,7 @@ class ServeController:
         self._config_version = 0
         self._config_cond = threading.Condition(self._lock)
         self._last_snapshot: dict | None = None
+        self._pollers: set = set()  # (loop, asyncio.Event) of parked polls
         # Instance token: a restarted controller restarts versions at 0;
         # subscribers detect the epoch change and resync from scratch.
         self._instance = uuid.uuid4().hex
@@ -180,7 +181,17 @@ class ServeController:
     def _bump_version_locked(self) -> None:
         self._config_version += 1
         self._last_snapshot = None  # recompute lazily at next poll
-        self._config_cond.notify_all()
+        self._notify_pollers()
+
+    def _notify_pollers(self) -> None:
+        """Wake every parked poll_update coroutine (they wait on per-call
+        asyncio.Events; version bumps come from controller threads, so the
+        wake crosses into each poller's loop threadsafely)."""
+        for loop, event in list(self._pollers):
+            try:
+                loop.call_soon_threadsafe(event.set)
+            except Exception:
+                pass
 
     def _membership_snapshot(self) -> dict:
         with self._lock:
@@ -206,7 +217,7 @@ class ServeController:
             if snapshot != self._last_snapshot:
                 self._config_version += 1
                 self._last_snapshot = snapshot
-                self._config_cond.notify_all()
+                self._notify_pollers()
 
     async def poll_update(
         self, last_version: int = -1, timeout_s: float = 10.0
@@ -219,12 +230,21 @@ class ServeController:
         control plane)."""
         import asyncio
 
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline and not self._stopped:
-            with self._lock:
-                if self._config_version > last_version:
-                    break
-            await asyncio.sleep(0.05)
+        loop = asyncio.get_running_loop()
+        event = asyncio.Event()
+        entry = (loop, event)
+        with self._lock:
+            ready = self._config_version > last_version or self._stopped
+            if not ready:
+                self._pollers.add(entry)
+        if not ready:
+            try:
+                await asyncio.wait_for(event.wait(), timeout_s)
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                with self._lock:
+                    self._pollers.discard(entry)
         with self._config_cond:
             snapshot = self._last_snapshot
             if snapshot is None:

@@ -196,6 +196,9 @@ class Replica:
             self._callable.reconfigure(user_config)
 
     async def check_health(self) -> str:
+        # Periodic controller health checks double as the reaper tick for
+        # abandoned streams (no reliance on further streaming traffic).
+        self._reap_idle_streams()
         if not self._is_function and hasattr(self._callable, "check_health"):
             result = self._callable.check_health()
             if inspect.iscoroutine(result):
