@@ -26,6 +26,7 @@ from typing import Any, Callable, Sequence
 from ray_tpu import exceptions
 from ray_tpu._private import serialization
 from ray_tpu._private.config import global_config
+from ray_tpu.util import tracing
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFull
@@ -137,6 +138,11 @@ class CoreContext:
         self._actor_addr_cache: dict[str, tuple] = {}
         self._actor_seq: dict[str, int] = {}
         self._actor_seq_lock = threading.Lock()
+        # Per-actor in-order send gates (io-loop state): actor push frames
+        # must hit the wire in seq order even when earlier submissions are
+        # still resolving the actor's address (reference
+        # actor_task_submitter.cc sends in order, replies pipeline freely).
+        self._actor_send_gate: dict[str, dict] = {}
 
         self.controller: RpcClient | None = None
         self._subscribed_channels: set[str] = set()
@@ -421,37 +427,72 @@ class CoreContext:
             ) from None
         return values[0] if single else values
 
+    @staticmethod
+    def _conn_debug(client) -> tuple | str:
+        """Native-engine wq state of a client's conn (hang forensics)."""
+        import ctypes
+
+        engine = getattr(client, "_engine", None)
+        conn = getattr(client, "_conn_id", None)
+        if engine is None or conn is None:
+            return "no-native-conn"
+        out = (ctypes.c_longlong * 6)()
+        rc = engine.lib.rt_conn_debug(engine.handle, conn, out)
+        if rc != 0:
+            return "conn-unknown-to-engine"
+        return {
+            "wq_len": out[0], "woff": out[1], "fd": out[2],
+            "closed": out[3], "bytes_queued": out[4],
+            "unparsed_rbuf": out[5], "conn_id": conn,
+        }
+
     def _dump_hang_state(self, waiting_ids: list) -> None:
         """RAY_TPU_debug_hang=1: print submitter state when a get times
-        out — first tool to reach for on a silent stall."""
+        out — first tool to reach for on a silent stall. Also appended to
+        /tmp/raytpu_hang.log (pytest captures stderr of a test that never
+        finishes, which is exactly when this fires)."""
         import sys
 
-        print("=== get() timeout: submitter state ===", file=sys.stderr)
-        print(f"waiting on: {waiting_ids}", file=sys.stderr)
-        print(
-            "records:",
-            {
-                k: (v.done, v.attempts, v.spec.get("name"))
-                for k, v in self._task_records.items()
-            },
-            file=sys.stderr,
-        )
-        print("dispatchers:", dict(self._active_dispatchers), file=sys.stderr)
-        print("hints:", dict(self._lease_capacity_hint), file=sys.stderr)
-        print(
-            "queues:",
-            {k: q.qsize() for k, q in self._task_queues.items()},
-            file=sys.stderr,
-        )
-        print("running:", list(self._running_tasks), file=sys.stderr)
-        print(
-            "waiting states:",
-            {
-                i: getattr(self._objects.get(i), "status", "?")
-                for i in waiting_ids
-            },
-            file=sys.stderr,
-        )
+        lines = [
+            "=== blocked get/wait: submitter state ===",
+            f"waiting on: {waiting_ids}",
+            "records: "
+            + repr(
+                {
+                    k: (v.done, v.attempts, v.spec.get("name"))
+                    for k, v in self._task_records.items()
+                }
+            ),
+            "dispatchers: " + repr(dict(self._active_dispatchers)),
+            "hints: " + repr(dict(self._lease_capacity_hint)),
+            "queues: "
+            + repr({k: q.qsize() for k, q in self._task_queues.items()}),
+            "running: "
+            + repr(
+                {
+                    t: (
+                        getattr(c, "address", "?"),
+                        getattr(c, "connected", "?"),
+                        self._conn_debug(c),
+                    )
+                    for t, c in self._running_tasks.items()
+                }
+            ),
+            "waiting states: "
+            + repr(
+                {
+                    i: getattr(self._objects.get(i), "status", "?")
+                    for i in waiting_ids
+                }
+            ),
+        ]
+        text = "\n".join(lines)
+        print(text, file=sys.stderr)
+        try:
+            with open("/tmp/raytpu_hang.log", "a") as fh:
+                fh.write(text + "\n\n")
+        except OSError:
+            pass
 
     def as_future(self, ref: ObjectRef) -> concurrent.futures.Future:
         return asyncio.run_coroutine_threadsafe(self._get_one(ref), self.io.loop)
@@ -602,6 +643,16 @@ class CoreContext:
     ) -> tuple[list[ObjectRef], list[ObjectRef]]:
         if num_returns > len(refs):
             raise ValueError("num_returns > len(refs)")
+        if timeout is None and os.environ.get("RAY_TPU_debug_hang"):
+            # Debug mode: an unbounded wait that exceeds 120s dumps the
+            # submitter state once, then resumes waiting (same first-tool
+            # role as the get() dump above).
+            ready, not_ready = self.io.run(
+                self._wait_async(list(refs), num_returns, 120.0)
+            )
+            if len(ready) >= num_returns:
+                return ready, not_ready
+            self._dump_hang_state([r.id for r in refs])
         return self.io.run(self._wait_async(list(refs), num_returns, timeout))
 
     async def _wait_async(self, refs, num_returns, timeout):
@@ -690,6 +741,11 @@ class CoreContext:
             ),
             "retry_exceptions": retry_exceptions,
         }
+        if tracing.enabled():
+            # Submit span: its context rides in the spec so the worker's
+            # execute span becomes this one's child (SURVEY §5.1).
+            with tracing.span(f"submit {name}", task_id=task_id):
+                spec["trace_ctx"] = tracing.inject()
         record = PendingTask(spec, return_ids, arg_ref_ids)
         self._task_records[task_id] = record
         refs = []
@@ -1139,6 +1195,9 @@ class CoreContext:
             "max_retries": max_task_retries,
             "retry_exceptions": False,
         }
+        if tracing.enabled():
+            with tracing.span(f"submit {spec['name']}", task_id=task_id):
+                spec["trace_ctx"] = tracing.inject()
         record = PendingTask(spec, return_ids, arg_ref_ids)
         self._task_records[task_id] = record
         refs = []
@@ -1151,6 +1210,31 @@ class CoreContext:
     async def _run_actor_task(self, record: PendingTask) -> None:
         spec = record.spec
         actor_id = spec["actor_id"]
+        seq = spec["seq"]
+        # In-order send gate: seq N may not write its push frame before
+        # N-1 has written (or failed) — otherwise a caller racing actor
+        # startup can have seq 2 observe ALIVE first and baseline the
+        # receiver's expected counter past 0/1. Replies are NOT serialized:
+        # the gate opens from the client's on_sent hook, so later calls
+        # pipeline behind the write, not behind the round-trip.
+        gate = self._actor_send_gate.setdefault(
+            actor_id, {"next": 0, "waiters": {}}
+        )
+        while gate["next"] < seq:
+            event = gate["waiters"].setdefault(seq, asyncio.Event())
+            await event.wait()
+        released = False
+
+        def _release_gate() -> None:
+            nonlocal released
+            if released:
+                return
+            released = True
+            gate["next"] = max(gate["next"], seq + 1)
+            waiter = gate["waiters"].pop(gate["next"], None)
+            if waiter is not None:
+                waiter.set()
+
         attempts = 0
         try:
             while True:
@@ -1163,7 +1247,9 @@ class CoreContext:
                     client = await self._actor_client(actor_id)
                     self._running_tasks[spec["task_id"]] = client
                     try:
-                        reply = await client.call("push_actor_task", spec)
+                        reply = await client.call(
+                            "push_actor_task", spec, on_sent=_release_gate
+                        )
                     finally:
                         self._running_tasks.pop(spec["task_id"], None)
                     if reply.get("status") == "cancelled":
@@ -1213,6 +1299,17 @@ class CoreContext:
                     self._fail_returns(record, exc)
                     return
         finally:
+            # A task that never reached the wire (cancelled, actor dead,
+            # address resolution failed) must still open the gate or every
+            # later seq to this actor deadlocks behind it.
+            _release_gate()
+            # Settle the record: actor tasks bypass _finish_record (their
+            # arg-ref release lives below), so without this every actor
+            # call leaked a PendingTask in _task_records for the driver's
+            # lifetime (observed: hundreds of undone records per module).
+            record.done = True
+            self._task_records.pop(spec["task_id"], None)
+            self._cancelled_tasks.discard(spec["task_id"])
             with self._refs_lock:
                 for rid in record.arg_refs:
                     count = self._submitted_refs.get(rid, 0) - 1

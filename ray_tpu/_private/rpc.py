@@ -38,6 +38,7 @@ import itertools
 import os
 import struct
 import threading
+import time
 import traceback
 from typing import Any, Awaitable, Callable
 
@@ -129,6 +130,18 @@ def native_available() -> bool:
     return _NATIVE_OK
 
 
+def _rpc_debug(message: str) -> None:
+    """RAY_TPU_debug_rpc=1: append transport-level events (accepts, drops,
+    closes) to /tmp/raytpu_rpc_debug.log — forensics for lost-frame bugs."""
+    if not os.environ.get("RAY_TPU_debug_rpc"):
+        return
+    try:
+        with open("/tmp/raytpu_rpc_debug.log", "a") as fh:
+            fh.write(f"{os.getpid()} {time.time():.3f} {message}\n")
+    except OSError:
+        pass
+
+
 # ---------------------------------------------------------------------------
 # Native engine (one per event loop)
 # ---------------------------------------------------------------------------
@@ -177,8 +190,10 @@ class _NativeEngine:
         # listener conn_id -> NativeRpcServer
         self.listeners: dict[int, "NativeRpcServer"] = {}
         loop.add_reader(self.notify_fd, self._drain)
+        _rpc_debug(f"engine-created eng={id(self):x} loop={id(loop):x} notify_fd={self.notify_fd}")
 
     def stop(self) -> None:
+        _rpc_debug(f"engine-stopped eng={id(self):x}")
         try:
             self.loop.remove_reader(self.notify_fd)
         except Exception:
@@ -232,15 +247,36 @@ class _NativeEngine:
                 server = self.listeners.get(msgid)
                 if server is not None:
                     server._on_accept(conn)
+                    _rpc_debug(f"accept conn={conn} listener={msgid}")
                 else:
+                    _rpc_debug(f"accept-NO-LISTENER conn={conn} l={msgid}")
                     self.close_conn(conn)
                 continue
             owner = self.owners.get(conn)
             if owner is not None:
+                if kind == REQ:
+                    _rpc_debug(
+                        f"recv-req conn={conn} msgid={msgid} m={method} "
+                        f"eng={id(self):x}"
+                    )
                 owner._on_native_msg(kind, msgid, method, raw)
             elif kind != CLOSED:
-                # Message for an already-forgotten conn: drop.
-                pass
+                # A REQ/REP for a conn with no owner means the peer still
+                # believes this connection is alive — dropping silently
+                # would black-hole its calls forever (each side keeps an
+                # ESTABLISHED socket and waits). Close the conn so the peer
+                # observes ConnectionLost and retries/redials.
+                import sys as _sys
+
+                print(
+                    f"[raytpu-rpc] no owner for conn={conn} "
+                    f"kind={kind} method={method!r} — closing the conn",
+                    file=_sys.stderr,
+                )
+                _rpc_debug(
+                    f"DROP+close conn={conn} kind={kind} method={method!r}"
+                )
+                self.close_conn(conn)
 
 
 # ---------------------------------------------------------------------------
@@ -271,9 +307,14 @@ class _ServerDispatchMixin:
                 raise RpcError(f"no handler for method {method!r} on {self.name}")
             result = await handler(conn, payload)
             await conn.send(REP, msgid, method, result)
-        except (ConnectionError, RuntimeError):
+        except ConnectionError:
+            # The reply could not be written — nothing more to tell the peer.
             conn.closed.set()
         except Exception:
+            # Handler raised (including RuntimeError): the caller MUST get an
+            # ERR reply. Swallowing handler errors here once black-holed
+            # every push_task whose _load_callable raised — the caller's
+            # future then waited forever on a healthy connection.
             try:
                 await conn.send(ERR, msgid, method, traceback.format_exc())
             except Exception:
@@ -305,6 +346,7 @@ class NativeServerConnection:
 
     def _on_native_msg(self, kind: int, msgid: int, method: str, raw: bytes) -> None:
         if kind == CLOSED:
+            _rpc_debug(f"server-conn-closed conn={self.conn_id}")
             self.engine.owners.pop(self.conn_id, None)
             self.closed.set()
             server = self._server
@@ -491,12 +533,19 @@ class _ClientCallMixin:
                 await self.on_reconnect()
 
     async def call(
-        self, method: str, payload: Any = None, timeout: float | None = None
+        self,
+        method: str,
+        payload: Any = None,
+        timeout: float | None = None,
+        on_sent: Callable[[], None] | None = None,
     ) -> Any:
         # Auto-reconnect clients retry ONCE after a connection loss: the
         # first call racing a server restart may be written to the dying
         # socket and surface ConnectionLost even though the new server is
-        # already up.
+        # already up. ``on_sent`` fires synchronously once the request
+        # frame is on the wire — callers that must order their writes
+        # (actor sequence numbers) release the next writer from it while
+        # still awaiting this reply concurrently.
         for attempt in (0, 1):
             if not self.connected:
                 if self.auto_reconnect and not self._closed:
@@ -504,7 +553,7 @@ class _ClientCallMixin:
                 else:
                     raise ConnectionLost(f"{self.name}: not connected")
             try:
-                return await self._call_once(method, payload, timeout)
+                return await self._call_once(method, payload, timeout, on_sent)
             except ConnectionLost:
                 if not self.auto_reconnect or self._closed or attempt:
                     raise
@@ -568,6 +617,7 @@ class NativeRpcClient(_ClientCallMixin):
                 self._conn_id = conn
                 engine.owners[conn] = self
                 self.connected = True
+                _rpc_debug(f"dial ok conn={conn} addr={self.address} name={self.name} eng={id(engine):x}")
                 return
             last_err = -conn
             await asyncio.sleep(backoff)
@@ -578,6 +628,7 @@ class NativeRpcClient(_ClientCallMixin):
 
     def _on_native_msg(self, kind: int, msgid: int, method: str, raw: bytes) -> None:
         if kind == CLOSED:
+            _rpc_debug(f"client-conn-closed conn={self._conn_id} addr={self.address}")
             self.connected = False
             if self._engine is not None:
                 self._engine.owners.pop(self._conn_id, None)
@@ -590,7 +641,8 @@ class NativeRpcClient(_ClientCallMixin):
         self._resolve(kind, msgid, _decode_payload(raw))
 
     async def _call_once(
-        self, method: str, payload: Any, timeout: float | None
+        self, method: str, payload: Any, timeout: float | None,
+        on_sent: Callable[[], None] | None = None,
     ) -> Any:
         engine, conn = self._engine, self._conn_id
         if engine is None or conn is None:
@@ -603,10 +655,15 @@ class NativeRpcClient(_ClientCallMixin):
         self._pending[msgid] = future
         rc = engine.send(conn, REQ, msgid, method.encode(),
                          _encode_payload(payload))
+        _rpc_debug(
+            f"send conn={conn} msgid={msgid} m={method} rc={rc} eng={id(engine):x}"
+        )
         if rc != 0:
             self._pending.pop(msgid, None)
             self.connected = False
             raise ConnectionLost(f"{self.name}: send failed ({rc})")
+        if on_sent is not None:
+            on_sent()
         if timeout is None:
             return await future
         return await asyncio.wait_for(future, timeout)
@@ -682,7 +739,8 @@ class AsyncioRpcClient(_ClientCallMixin):
             self._fail_pending()
 
     async def _call_once(
-        self, method: str, payload: Any, timeout: float | None
+        self, method: str, payload: Any, timeout: float | None,
+        on_sent: Callable[[], None] | None = None,
     ) -> Any:
         msgid = next(self._msgids)
         future: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -696,6 +754,8 @@ class AsyncioRpcClient(_ClientCallMixin):
             self._pending.pop(msgid, None)
             self.connected = False
             raise ConnectionLost(f"{self.name}: send failed: {exc}")
+        if on_sent is not None:
+            on_sent()
         if timeout is None:
             return await future
         return await asyncio.wait_for(future, timeout)

@@ -91,6 +91,7 @@ class ActorClass:
         }
         self._options.update(default_options)
         self._class_id: str | None = None
+        self._exported_for: str | None = None  # job id of the exporting cluster
         self._export_lock = threading.Lock()
         self.__name__ = cls.__name__
 
@@ -103,6 +104,7 @@ class ActorClass:
     def options(self, **options) -> "ActorClass":
         clone = ActorClass(self._cls, **{**self._options, **options})
         clone._class_id = self._class_id
+        clone._exported_for = self._exported_for
         return clone
 
     def __getstate__(self):
@@ -113,15 +115,21 @@ class ActorClass:
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._export_lock = threading.Lock()
+        if "_exported_for" not in self.__dict__:
+            self._exported_for = None
 
     def _ensure_exported(self) -> str:
-        if self._class_id is not None:
+        # Per-cluster export (see RemoteFunction._ensure_exported): a
+        # module-level actor class outlives shutdown()/init() cycles and
+        # must re-export into each new cluster's empty function table.
+        ctx = worker.get_global_context()
+        cluster_key = ctx.job_id
+        if self._class_id is not None and self._exported_for == cluster_key:
             return self._class_id
         with self._export_lock:
-            if self._class_id is None:
+            if self._class_id is None or self._exported_for != cluster_key:
                 raw = serialization.dumps_function(self._cls)
                 class_id = "cls-" + hashlib.sha1(raw).hexdigest()[:20]
-                ctx = worker.get_global_context()
                 ctx.io.run(
                     ctx.controller.call(
                         "kv_put",
@@ -134,6 +142,7 @@ class ActorClass:
                     )
                 )
                 self._class_id = class_id
+                self._exported_for = cluster_key
         return self._class_id
 
     def _public_methods(self) -> list[str]:

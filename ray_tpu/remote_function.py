@@ -29,6 +29,7 @@ class RemoteFunction:
         }
         self._options.update(default_options)
         self._function_id: str | None = None
+        self._exported_for: str | None = None  # job id of the exporting cluster
         self._export_lock = threading.Lock()
         self.__name__ = getattr(fn, "__name__", "remote_fn")
         self.__doc__ = getattr(fn, "__doc__", None)
@@ -42,6 +43,7 @@ class RemoteFunction:
     def options(self, **options) -> "RemoteFunction":
         clone = RemoteFunction(self._fn, **{**self._options, **options})
         clone._function_id = self._function_id
+        clone._exported_for = self._exported_for
         return clone
 
     def __getstate__(self):
@@ -52,15 +54,23 @@ class RemoteFunction:
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._export_lock = threading.Lock()
+        if "_exported_for" not in self.__dict__:
+            self._exported_for = None
 
     def _ensure_exported(self) -> str:
-        if self._function_id is not None:
+        # The export is per-CLUSTER: a module-level @remote function
+        # outlives ray_tpu.shutdown()/init() cycles (test modules, repeated
+        # drivers), and the next cluster's controller KV starts empty. A
+        # plain "already exported" boolean made workers' function-table
+        # lookups miss forever on the second cluster.
+        ctx = worker.get_global_context()
+        cluster_key = ctx.job_id
+        if self._function_id is not None and self._exported_for == cluster_key:
             return self._function_id
         with self._export_lock:
-            if self._function_id is None:
+            if self._function_id is None or self._exported_for != cluster_key:
                 raw = serialization.dumps_function(self._fn)
                 function_id = "fn-" + hashlib.sha1(raw).hexdigest()[:20]
-                ctx = worker.get_global_context()
                 ctx.io.run(
                     ctx.controller.call(
                         "kv_put",
@@ -73,6 +83,7 @@ class RemoteFunction:
                     )
                 )
                 self._function_id = function_id
+                self._exported_for = cluster_key
         return self._function_id
 
     def remote(self, *args, **kwargs):

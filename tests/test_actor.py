@@ -46,6 +46,20 @@ def test_actor_call_ordering(ray_start_shared):
     assert results == [sum(range(1, k + 1)) for k in range(1, 11)]
 
 
+def test_actor_call_ordering_races_startup(ray_start_shared):
+    # Regression: calls submitted while the actor is still PENDING used to
+    # race address resolution — whichever submission observed ALIVE first
+    # pushed first, baselining the receiver's expected-seq past earlier
+    # calls. The sender-side send gate must keep seq order through startup.
+    for _ in range(5):
+        counter = Counter.remote()
+        n = 20
+        results = ray_tpu.get(
+            [counter.increment.remote(i) for i in range(1, n + 1)], timeout=60
+        )
+        assert results == [sum(range(1, k + 1)) for k in range(1, n + 1)]
+
+
 def test_actor_constructor_args(ray_start_shared):
     counter = Counter.remote(start=100)
     assert ray_tpu.get(counter.read.remote(), timeout=60) == 100

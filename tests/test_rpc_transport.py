@@ -1,0 +1,77 @@
+"""Transport-layer regression tests (native engine + dispatch semantics).
+
+Root-caused in round 3: a module-level @remote function reused across two
+clusters was never re-exported into the second cluster's function table,
+and the worker's resulting RuntimeError was silently swallowed by the
+server dispatch path — the driver's push waited forever on a healthy
+connection. These tests pin both halves of that failure.
+"""
+
+import asyncio
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.rpc import RpcClient, RpcError, RpcServer
+
+
+# Module-level remote function/actor: survives shutdown()/init() cycles
+# exactly like the data/rllib library internals do.
+@ray_tpu.remote
+def _module_level_double(x):
+    return x * 2
+
+
+@ray_tpu.remote
+class _ModuleLevelCounter:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+        return self.n
+
+
+def test_handler_runtime_error_reaches_caller():
+    """A handler raising (incl. RuntimeError) must produce an ERR reply —
+    never a silent drop that strands the caller's future."""
+
+    async def main():
+        server = RpcServer(name="errsrv")
+
+        async def boom(conn, payload):
+            raise RuntimeError("kaboom from handler")
+
+        async def value_error(conn, payload):
+            raise ValueError("other error")
+
+        server.route("boom", boom)
+        server.route("value_error", value_error)
+        port = await server.start("127.0.0.1", 0)
+        client = RpcClient(("127.0.0.1", port), name="errcli")
+        await client.connect(retry=False)
+        for method in ("boom", "value_error"):
+            with pytest.raises(RpcError):
+                await asyncio.wait_for(client.call(method, {}), timeout=10)
+        await client.close()
+        await server.stop()
+
+    asyncio.run(main())
+
+
+def test_function_reexport_across_clusters():
+    """shutdown() then init(): the SAME module-level @remote function and
+    actor class must work against the fresh cluster's empty function
+    table (regression: stale _exported flag black-holed the second
+    cluster's tasks)."""
+    assert not ray_tpu.is_initialized()
+    for round_num in range(2):
+        ray_tpu.init(num_cpus=4)
+        try:
+            assert ray_tpu.get(
+                _module_level_double.remote(21), timeout=60
+            ) == 42
+            counter = _ModuleLevelCounter.remote()
+            assert ray_tpu.get(counter.bump.remote(), timeout=60) == 1
+        finally:
+            ray_tpu.shutdown()
