@@ -99,6 +99,27 @@ class RayTpuConfig:
     # --- metrics ---
     metrics_report_interval_ms: int = _env("metrics_report_interval_ms", 2000)
 
+    # --- runtime envs (reference: _private/runtime_env/* agent knobs) ---
+    # Extra args appended to every `pip install` a node agent runs while
+    # materializing a pip runtime env (e.g. "--no-index --find-links /wheels"
+    # for airgapped clusters).
+    runtime_env_pip_extra_args: str = _env("runtime_env_pip_extra_args", "")
+    # Total bytes of unreferenced materialized envs kept cached per node
+    # before LRU deletion (reference: RAY_RUNTIME_ENV_*_CACHE_SIZE_GB).
+    runtime_env_cache_size_mb: int = _env("runtime_env_cache_size_mb", 2048)
+    runtime_env_setup_timeout_s: float = _env(
+        "runtime_env_setup_timeout_s", 600.0
+    )
+
+    # --- tracing (reference: RAY_TRACING_ENABLED / OTel hook, SURVEY §5.1) ---
+    tracing_enabled: bool = _env("tracing_enabled", False)
+
+    # --- event export (reference: RayEvent export files, N28) ---
+    event_export_enabled: bool = _env("event_export_enabled", True)
+    event_export_max_bytes: int = _env(
+        "event_export_max_bytes", 16 * 1024 * 1024
+    )
+
     # --- TPU topology ---
     # Override autodetected slice topology, e.g. "v4-32". Empty = detect.
     tpu_slice_override: str = _env("tpu_slice_override", "")

@@ -33,6 +33,7 @@ from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import WorkerID
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreServer
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection, spawn_task
+from ray_tpu._private.runtime_env import RuntimeEnvManager
 
 
 def detect_tpu_resources() -> dict:
@@ -183,6 +184,7 @@ class NodeAgent:
 
         self.workers: dict[str, WorkerProcess] = {}
         self.idle_workers: dict[str, list[WorkerProcess]] = {}
+        self.runtime_envs = RuntimeEnvManager(session_dir)
         self.leases: dict[str, Lease] = {}
         self.bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> {resources, available, committed}
         self._resource_waiters: list[asyncio.Future] = []
@@ -293,14 +295,20 @@ class NodeAgent:
         return True
 
     def _give_back(self, resources: dict, bundle_key: tuple | None) -> None:
-        pool = (
-            self.bundles[bundle_key]["available"]
-            if bundle_key is not None and bundle_key in self.bundles
-            else self.resources_available
-        )
-        for k, v in resources.items():
-            if v > 0:
-                pool[k] = pool.get(k, 0.0) + v
+        if bundle_key is not None:
+            bundle = self.bundles.get(bundle_key)
+            # Bundle already released (PG teardown raced this worker/lease
+            # death): release_bundle returned the bundle's FULL allocation
+            # to the node pool, so crediting the node again here would
+            # double-count — two later bundles could then commit onto one
+            # slot (observed as a 4-worker gang on 3 one-slot nodes).
+            pool = None if bundle is None else bundle["available"]
+        else:
+            pool = self.resources_available
+        if pool is not None:
+            for k, v in resources.items():
+                if v > 0:
+                    pool[k] = pool.get(k, 0.0) + v
         for waiter in self._resource_waiters:
             if not waiter.done():
                 waiter.set_result(None)
@@ -340,8 +348,16 @@ class NodeAgent:
     ) -> WorkerProcess:
         worker_id = WorkerID.random()
         env = dict(os.environ)
-        env_vars = (runtime_env or {}).get("env_vars") or {}
-        env.update({str(k): str(v) for k, v in env_vars.items()})
+        # Materialize pip/py_modules/working_dir through the runtime-env
+        # manager (URI cache + per-job refcount, reference runtime_env
+        # agent role) before the worker exists.
+        env_ctx = await self.runtime_envs.setup(runtime_env, job_id)
+        env.update(env_ctx.env_vars)
+        if env_ctx.python_paths:
+            existing_pp = env.get("PYTHONPATH", "")
+            env["PYTHONPATH"] = os.pathsep.join(
+                env_ctx.python_paths + ([existing_pp] if existing_pp else [])
+            )
         env.update(
             {
                 "RAYTPU_WORKER_ID": worker_id,
@@ -353,14 +369,13 @@ class NodeAgent:
                 "RAYTPU_SESSION_DIR": self.session_dir,
             }
         )
-        working_dir = (runtime_env or {}).get("working_dir")
         proc = await asyncio.create_subprocess_exec(
             sys.executable,
             "-u",
             "-m",
             "ray_tpu._private.worker_proc",
             env=env,
-            cwd=working_dir or None,
+            cwd=env_ctx.working_dir or None,
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.PIPE,
         )
@@ -421,6 +436,12 @@ class NodeAgent:
         pool = self.idle_workers.get(worker.env_hash)
         if pool and worker in pool:
             pool.remove(worker)
+        if worker.job_id and not any(
+            w.job_id == worker.job_id for w in self.workers.values()
+        ):
+            # Last worker of the job on this node: drop its runtime-env
+            # references so unreferenced envs become GC-eligible.
+            self.runtime_envs.release_job(worker.job_id)
         # Release any lease resources still held.
         for lease in [l for l in self.leases.values() if l.worker is worker]:
             self.leases.pop(lease.lease_id, None)
@@ -647,6 +668,44 @@ class NodeAgent:
 
     async def rpc_store_stats(self, conn, payload) -> dict:
         return self.store.stats()
+
+    async def rpc_runtime_env_info(self, conn, payload) -> dict:
+        return self.runtime_envs.cache_info()
+
+    async def _forward_to_worker(
+        self, worker_id: str, method: str, payload: dict
+    ) -> dict:
+        """One-shot RPC into a worker this node hosts (reporter-agent role:
+        the dashboard reaches workers through their node agent)."""
+        worker = self.workers.get(worker_id or "")
+        if worker is None or worker.address is None:
+            return {"status": "error", "error": "unknown worker"}
+        client = RpcClient(tuple(worker.address), name=f"{method}-fwd")
+        try:
+            await client.connect(retry=False)
+            return await client.call(method, payload, timeout=30.0)
+        except Exception as exc:
+            return {"status": "error", "error": str(exc)}
+        finally:
+            await client.close()
+
+    async def rpc_profile_worker(self, conn, payload) -> dict:
+        """XLA profiler start/stop on one of this node's workers
+        (SURVEY §5.1 TPU-equiv of py-spy/profiler triggers)."""
+        return await self._forward_to_worker(
+            payload.get("worker_id", ""),
+            "profiler",
+            {
+                "action": payload.get("action"),
+                "log_dir": payload.get("log_dir"),
+            },
+        )
+
+    async def rpc_stack_trace_worker(self, conn, payload) -> dict:
+        """Live thread stacks of a worker (dashboard 'Stack Trace' role)."""
+        return await self._forward_to_worker(
+            payload.get("worker_id", ""), "stack_trace", {}
+        )
 
     async def rpc_node_info(self, conn, payload) -> dict:
         return {
