@@ -2,24 +2,35 @@
 
 New-API-stack architecture only (SURVEY §2.8): RLModule (jax nets),
 Learner/LearnerGroup (jitted XLA updates, DP grad-allreduce), EnvRunner
-actors (CPU gymnasium vector envs), SampleBatch, GAE/vtrace in jax, and
-PPO / IMPALA / DQN algorithms with fluent AlgorithmConfigs.
+actors (CPU gymnasium vector envs), ConnectorV2 pipelines, SampleBatch /
+MultiAgentBatch, GAE/vtrace in jax, and PPO / IMPALA / DQN / SAC
+algorithms (single- and multi-agent) with fluent AlgorithmConfigs.
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.bc.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig
-from ray_tpu.rllib.core.learner import Learner, LearnerGroup
+from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig
+from ray_tpu.rllib.core.learner import (
+    Learner, LearnerGroup, MultiAgentLearnerGroup,
+)
+from ray_tpu.rllib.core.multi_rl_module import MultiRLModule, MultiRLModuleSpec
 from ray_tpu.rllib.core.rl_module import MLPModule, RLModule, RLModuleSpec
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
-from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentCartPole, MultiAgentEnv
+from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
+from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
-    "IMPALAConfig", "DQN", "DQNConfig", "Learner", "LearnerGroup",
-    "RLModule", "RLModuleSpec", "MLPModule", "SingleAgentEnvRunner",
-    "EnvRunnerGroup", "SampleBatch",
+    "IMPALAConfig", "DQN", "DQNConfig", "BC", "BCConfig", "SAC", "SACConfig", "Learner",
+    "LearnerGroup", "MultiAgentLearnerGroup", "MultiRLModule",
+    "MultiRLModuleSpec", "RLModule", "RLModuleSpec", "MLPModule",
+    "SingleAgentEnvRunner", "EnvRunnerGroup", "MultiAgentEnv",
+    "MultiAgentCartPole", "MultiAgentEnvRunner", "SampleBatch",
+    "MultiAgentBatch",
 ]

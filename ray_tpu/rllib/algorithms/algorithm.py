@@ -32,6 +32,13 @@ class Algorithm:
         self.iteration = 0
         self._total_env_steps = 0
         self._start = time.time()
+        if config.is_multi_agent:
+            self._init_multi_agent(config)
+        else:
+            self._init_single_agent(config)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def _init_single_agent(self, config: AlgorithmConfig) -> None:
         spec = config.rl_module_spec or RLModuleSpec(
             model_config=dict(config.model)
         )
@@ -40,12 +47,27 @@ class Algorithm:
         ) else config.env(config.env_config)
         self.observation_space = probe_env.observation_space
         self.action_space = probe_env.action_space
+        # A shape-changing env→module connector (framestack, …) means the
+        # module trains on the pipeline's output space, not the env's.
+        self.module_observation_space = self.observation_space
+        if config.env_to_module_connector is not None:
+            probe_pipe = config.env_to_module_connector()
+            probe_out = np.asarray(
+                probe_pipe(np.asarray(self.observation_space.sample())[None])
+            )
+            if tuple(probe_out.shape[1:]) != tuple(
+                self.observation_space.shape or ()
+            ):
+                self.module_observation_space = gym.spaces.Box(
+                    -np.inf, np.inf, shape=probe_out.shape[1:],
+                    dtype=np.float32,
+                )
         probe_env.close()
 
         self.learner_group = LearnerGroup(
             self.learner_class,
             spec,
-            self.observation_space,
+            self.module_observation_space,
             self.action_space,
             self._learner_config(),
             num_learners=config.num_learners,
@@ -57,8 +79,77 @@ class Algorithm:
             num_envs_per_runner=config.num_envs_per_env_runner,
             rollout_fragment_length=config.rollout_fragment_length,
             seed=config.seed,
+            env_to_module=config.env_to_module_connector,
+            module_to_env=config.module_to_env_connector,
         )
-        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def _init_multi_agent(self, config: AlgorithmConfig) -> None:
+        from ray_tpu.rllib.core.learner import MultiAgentLearnerGroup
+        from ray_tpu.rllib.core.multi_rl_module import MultiRLModuleSpec
+        from ray_tpu.rllib.env.multi_agent_env_runner import (
+            MultiAgentEnvRunner,
+        )
+
+        if isinstance(config.env, str):
+            raise ValueError(
+                "multi-agent config.env must be a MultiAgentEnv class or "
+                "factory, not a gym id"
+            )
+        probe = config.env(config.env_config)
+        obs_spaces: dict = {}
+        act_spaces: dict = {}
+        for agent in probe.possible_agents:
+            mid = config.policy_mapping_fn(agent)
+            if mid not in config.policies:
+                raise ValueError(
+                    f"policy_mapping_fn({agent!r}) → {mid!r} which is not in "
+                    f"config.policies {sorted(config.policies)}"
+                )
+            obs_spaces.setdefault(mid, probe.get_observation_space(agent))
+            act_spaces.setdefault(mid, probe.get_action_space(agent))
+        probe.close()
+        # module ids with no agent mapped to them would have no spaces
+        missing = set(config.policies) - set(obs_spaces)
+        if missing:
+            raise ValueError(f"no agent maps to policies {sorted(missing)}")
+        self.observation_space = obs_spaces
+        self.action_space = act_spaces
+        self.module_observation_space = obs_spaces
+
+        multi_spec = MultiRLModuleSpec(
+            {
+                mid: (
+                    spec
+                    or RLModuleSpec(model_config=dict(config.model))
+                )
+                for mid, spec in config.policies.items()
+            }
+        )
+        self._multi_spec = multi_spec
+        self.learner_group = MultiAgentLearnerGroup(
+            self.learner_class,
+            multi_spec,
+            obs_spaces,
+            act_spaces,
+            self._learner_config(),
+        )
+        env_cls, env_config = config.env, dict(config.env_config)
+
+        def creator():
+            return env_cls(env_config)
+
+        self.env_runner_group = EnvRunnerGroup(
+            creator,
+            multi_spec,
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=1,
+            rollout_fragment_length=config.rollout_fragment_length,
+            seed=config.seed,
+            env_to_module=config.env_to_module_connector,
+            module_to_env=config.module_to_env_connector,
+            runner_class=MultiAgentEnvRunner,
+            runner_kwargs={"policy_mapping_fn": config.policy_mapping_fn},
+        )
 
     def _env_creator(self):
         config = self.config
@@ -108,6 +199,8 @@ class Algorithm:
     def evaluate(self) -> dict:
         """Greedy episodes on a fresh env (evaluation duck-type of the
         reference's evaluation workers)."""
+        if self.config.is_multi_agent:
+            return self._evaluate_multi_agent()
         env = (
             gym.make(self.config.env, **self.config.env_config)
             if isinstance(self.config.env, str)
@@ -116,22 +209,84 @@ class Algorithm:
         spec = self.config.rl_module_spec or RLModuleSpec(
             model_config=dict(self.config.model)
         )
-        module = spec.build(self.observation_space, self.action_space)
+        # Params are shaped for the CONNECTOR's output space; evaluation
+        # must run observations through the same pipeline the runners use.
+        module = spec.build(
+            getattr(self, "module_observation_space", self.observation_space),
+            self.action_space,
+        )
+        from ray_tpu.rllib.connectors import default_env_to_module
+
         import jax
 
         params = self.learner_group.get_weights()
         fwd = jax.jit(module.forward_inference)
         returns = []
         for _ in range(self.config.evaluation_duration):
+            # Fresh pipeline per episode: stateful connectors (framestack)
+            # must not carry history across episode boundaries.
+            pipeline = (
+                self.config.env_to_module_connector()
+                if self.config.env_to_module_connector
+                else default_env_to_module()
+            )
             obs, _ = env.reset()
             total, done = 0.0, False
             while not done:
-                action = np.asarray(fwd(params, obs[None]))[0]
+                module_obs = pipeline(np.asarray(obs)[None])
+                action = np.asarray(fwd(params, module_obs))[0]
                 obs, reward, term, trunc, _ = env.step(
                     action.item() if action.shape == () else action
                 )
                 total += reward
                 done = term or trunc
+            returns.append(total)
+        env.close()
+        return {
+            "episode_return_mean": float(np.mean(returns)),
+            "num_episodes": len(returns),
+        }
+
+    def _evaluate_multi_agent(self) -> dict:
+        import jax
+
+        env = self.config.env(self.config.env_config)
+        modules = {
+            mid: self._multi_spec.module_specs[mid].build(
+                self.observation_space[mid], self.action_space[mid]
+            )
+            for mid in self.config.policies
+        }
+        fwd = {
+            mid: jax.jit(m.forward_inference) for mid, m in modules.items()
+        }
+        params = self.learner_group.get_weights()
+        mapping = self.config.policy_mapping_fn
+        returns = []
+        for _ in range(self.config.evaluation_duration):
+            obs, _ = env.reset()
+            total, done = 0.0, False
+            while not done and obs:
+                actions = {}
+                for agent, o in obs.items():
+                    mid = mapping(agent)
+                    a = np.asarray(
+                        fwd[mid](
+                            params[mid],
+                            np.asarray(o, dtype=np.float32).reshape(1, -1),
+                        )
+                    )[0]
+                    actions[agent] = a.item() if a.shape == () else a
+                obs, rewards, terms, truncs, _ = env.step(actions)
+                total += sum(rewards.values())
+                done = terms.get("__all__", False) or truncs.get(
+                    "__all__", False
+                )
+                obs = {
+                    a: o
+                    for a, o in obs.items()
+                    if not (terms.get(a, False) or truncs.get(a, False))
+                }
             returns.append(total)
         env.close()
         return {

@@ -12,6 +12,11 @@ import copy
 from typing import Any, Callable, Optional, Type
 
 
+def default_policy_mapping_fn(agent_id, *args, **kwargs) -> str:
+    """Single-module default: every agent maps to 'default_policy'."""
+    return "default_policy"
+
+
 class AlgorithmConfig:
     def __init__(self, algo_class: Optional[Type] = None):
         self.algo_class = algo_class
@@ -35,6 +40,16 @@ class AlgorithmConfig:
         # evaluation
         self.evaluation_interval: int = 0
         self.evaluation_duration: int = 5
+        # connectors (ConnectorV2 pipelines; factories so every runner /
+        # learner builds its own stateful instance)
+        self.env_to_module_connector: Optional[Callable] = None
+        self.module_to_env_connector: Optional[Callable] = None
+        self.learner_connector: Optional[Callable] = None
+        # multi-agent (reference: config.multi_agent(policies=...,
+        # policy_mapping_fn=...)). ``policies`` maps module_id → None
+        # (infer spaces from the env) or an RLModuleSpec.
+        self.policies: Optional[dict] = None
+        self.policy_mapping_fn: Callable = default_policy_mapping_fn
         # reproducibility
         self.seed: Optional[int] = None
         # RLModule override
@@ -55,6 +70,8 @@ class AlgorithmConfig:
         num_envs_per_env_runner: int | None = None,
         rollout_fragment_length: int | None = None,
         explore: bool | None = None,
+        env_to_module_connector: Callable | None = None,
+        module_to_env_connector: Callable | None = None,
     ):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -64,7 +81,30 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if explore is not None:
             self.explore = explore
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
+
+    def multi_agent(
+        self,
+        *,
+        policies: dict | None = None,
+        policy_mapping_fn: Callable | None = None,
+    ):
+        if policies is not None:
+            # Accept {"p0", "p1"} set/list or {"p0": spec_or_None} dict.
+            if isinstance(policies, (set, list, tuple)):
+                policies = {p: None for p in policies}
+            self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return self.policies is not None
 
     def training(self, **kwargs):
         for key, value in kwargs.items():

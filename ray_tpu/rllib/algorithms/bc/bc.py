@@ -1,0 +1,111 @@
+"""BC — behavior cloning (offline RL).
+
+Role-equivalent of rllib/algorithms/bc/ (SURVEY §2.8 offline-RL row):
+supervised imitation of a dataset policy — maximize log-likelihood of the
+dataset's actions under the module's action distribution; no environment
+interaction during training (the env is only probed for spaces and used
+by evaluate()). The jitted-learner discipline is identical to PPO's.
+"""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner, LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.offline.offline_data import OfflineData
+from ray_tpu.rllib.policy.sample_batch import ACTIONS, OBS, SampleBatch
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or BC)
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.updates_per_iteration: int = 100
+        # dataset / path / SampleBatch — see OfflineData
+        self.input_: object = None
+        self.num_env_runners = 0
+
+    def offline_data(self, *, input_=None):
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def validate(self) -> None:
+        super().validate()
+        if self.input_ is None:
+            raise ValueError("BC needs config.offline_data(input_=...)")
+
+
+class BCLearner(Learner):
+    def compute_loss(self, params, batch: dict):
+        logp, entropy, _vf = self.module.action_logp(
+            params, batch[OBS], batch[ACTIONS]
+        )
+        loss = -jnp.mean(logp)
+        return loss, {"bc_logp": jnp.mean(logp), "entropy": jnp.mean(entropy)}
+
+
+class _NullRunnerGroup:
+    """Offline algorithms have no rollout fleet; keep train()'s surface."""
+
+    runners: list = []
+
+    def sync_weights(self, params) -> None:
+        pass
+
+    def get_metrics(self) -> dict:
+        return {"episode_return_mean": np.nan, "episode_len_mean": np.nan,
+                "num_episodes": 0}
+
+    def stop(self) -> None:
+        pass
+
+
+class BC(Algorithm):
+    learner_class = BCLearner
+
+    def __init__(self, config: BCConfig):
+        # No Algorithm.__init__: offline training needs spaces + learner
+        # but no env-runner fleet.
+        import time as _time
+
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._start = _time.time()
+        spec = config.rl_module_spec or RLModuleSpec(
+            model_config=dict(config.model)
+        )
+        probe_env = gym.make(config.env, **config.env_config) if isinstance(
+            config.env, str
+        ) else config.env(config.env_config)
+        self.observation_space = probe_env.observation_space
+        self.action_space = probe_env.action_space
+        self.module_observation_space = self.observation_space
+        probe_env.close()
+        self.learner_group = LearnerGroup(
+            self.learner_class, spec, self.observation_space,
+            self.action_space, self._learner_config(), num_learners=0,
+        )
+        self.env_runner_group = _NullRunnerGroup()
+        self.offline_data = OfflineData(config.input_)
+        missing = {OBS, ACTIONS} - set(self.offline_data.columns)
+        if missing:
+            raise ValueError(f"offline dataset lacks columns: {missing}")
+
+    def training_step(self) -> dict:
+        learner = self.learner_group.local_learner
+        metrics: dict = {}
+        for _ in range(self.config.updates_per_iteration):
+            batch = self.offline_data.sample(self.config.train_batch_size)
+            metrics = learner.update(batch)
+        metrics["num_samples_trained"] = (
+            self.config.updates_per_iteration * self.config.train_batch_size
+        )
+        return metrics

@@ -83,13 +83,53 @@ class PPO(Algorithm):
                 model_config=dict(self.config.model)
             )
             self._vf_module = spec.build(
-                self.observation_space, self.action_space
+                getattr(self, "module_observation_space", self.observation_space),
+                self.action_space,
             )
             self._vf_jit = jax.jit(
                 lambda params, obs: self._vf_module.forward_train(params, obs)["vf"]
             )
         params = self.learner_group.get_weights()
         return lambda obs: self._vf_jit(params, jnp.asarray(obs))
+
+    def _value_fn_for(self, module_id: str):
+        """Per-module V(obs) in multi-agent mode."""
+        if not hasattr(self, "_vf_modules"):
+            self._vf_modules = {}
+            self._vf_jits = {}
+        if module_id not in self._vf_modules:
+            module = self._multi_spec.module_specs[module_id].build(
+                self.observation_space[module_id],
+                self.action_space[module_id],
+            )
+            self._vf_modules[module_id] = module
+            self._vf_jits[module_id] = jax.jit(
+                lambda params, obs, _m=module: _m.forward_train(params, obs)["vf"]
+            )
+        params = self.learner_group.get_weights()[module_id]
+        jit = self._vf_jits[module_id]
+        return lambda obs: jit(params, jnp.asarray(obs))
+
+    def _learner_pipeline(self):
+        """Learner connector pipeline: user stages + default GAE."""
+        if not hasattr(self, "_learner_conn"):
+            from ray_tpu.rllib.connectors import (
+                ConnectorPipelineV2, GeneralAdvantageEstimation,
+            )
+
+            stages = []
+            if self.config.learner_connector is not None:
+                user = self.config.learner_connector()
+                stages.extend(
+                    user.connectors if hasattr(user, "connectors") else [user]
+                )
+            stages.append(
+                GeneralAdvantageEstimation(
+                    gamma=self.config.gamma, lambda_=self.config.lambda_
+                )
+            )
+            self._learner_conn = ConnectorPipelineV2(stages)
+        return self._learner_conn
 
     def _learner_config(self) -> dict:
         cfg = super()._learner_config()
@@ -102,6 +142,8 @@ class PPO(Algorithm):
         return cfg
 
     def training_step(self) -> dict:
+        if self.config.is_multi_agent:
+            return self._training_step_multi_agent()
         config = self.config
         # 1. sample until train_batch_size env steps collected
         batches = []
@@ -112,13 +154,8 @@ class PPO(Algorithm):
             batches.append(fragment)
         batch = SampleBatch.concat_samples(batches)
         self._total_env_steps += len(batch)
-        # 2. GAE (bootstrap values from the current learner params)
-        batch = compute_gae(
-            batch,
-            gamma=config.gamma,
-            lambda_=config.lambda_,
-            value_fn=self._value_fn(),
-        )
+        # 2. learner connectors: GAE (bootstrap values from current params)
+        batch = self._learner_pipeline()(batch, value_fn=self._value_fn())
         # 3. minibatch SGD epochs
         rng = np.random.default_rng(self.iteration)
         metrics: dict = {}
@@ -129,3 +166,36 @@ class PPO(Algorithm):
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         metrics["num_env_steps_trained"] = len(batch)
         return metrics
+
+    def _training_step_multi_agent(self) -> dict:
+        from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch
+
+        config = self.config
+        batches = []
+        steps = 0
+        while steps < config.train_batch_size:
+            fragment = self.env_runner_group.sample()
+            steps += fragment.env_steps()
+            batches.append(fragment)
+        batch = MultiAgentBatch.concat_samples(batches)
+        self._total_env_steps += batch.env_steps()
+        # per-module GAE, then per-module minibatch SGD epochs
+        pipeline = self._learner_pipeline()
+        processed = {
+            mid: pipeline(sub, value_fn=self._value_fn_for(mid))
+            for mid, sub in batch.items()
+        }
+        rng = np.random.default_rng(self.iteration)
+        metrics: dict = {}
+        for _ in range(config.num_epochs):
+            for mid, sub in processed.items():
+                for mb in sub.minibatches(config.minibatch_size, rng):
+                    metrics[mid] = self.learner_group.update_module(mid, mb)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        flat = {
+            f"{mid}/{k}": v
+            for mid, m in metrics.items()
+            for k, v in m.items()
+        }
+        flat["num_env_steps_trained"] = batch.env_steps()
+        return flat

@@ -1,0 +1,269 @@
+"""SAC — soft actor-critic (continuous control, off-policy).
+
+Role-equivalent of rllib/algorithms/sac/sac.py + sac_torch_learner
+(SURVEY §2.8): squashed-gaussian actor, twin Q critics with polyak-averaged
+targets, automatic temperature tuning against a target entropy — the whole
+update (actor + critic + alpha + polyak) is ONE jitted XLA step with
+donated buffers, per the north star's jit-compiled learner discipline.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec, _mlp_apply, _mlp_init
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS, NEXT_OBS, OBS, REWARDS, SampleBatch, TERMINATEDS,
+)
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or SAC)
+        self.lr = 3e-4
+        self.train_batch_size = 256
+        self.replay_buffer_capacity: int = 100_000
+        self.num_steps_sampled_before_learning_starts: int = 1000
+        self.tau: float = 0.005  # polyak coefficient
+        self.target_entropy: float | str = "auto"  # auto → -act_dim
+        self.initial_alpha: float = 1.0
+        self.updates_per_iteration: int = 200
+        self.rollout_fragment_length = 25
+        self.num_envs_per_env_runner = 8
+        self.num_env_runners = 1
+
+
+class SACModule(RLModule):
+    """Squashed-gaussian policy + twin Q towers.
+
+    Actions leave the module already tanh-squashed and scaled into the
+    env's Box bounds, so the runner's ClipActions connector is a no-op and
+    replayed ACTIONS feed the critics unchanged.
+    """
+
+    def __init__(self, observation_space, action_space, model_config):
+        super().__init__(observation_space, action_space, model_config)
+        assert hasattr(action_space, "low"), "SAC requires a Box action space"
+        self.hiddens = tuple(model_config.get("fcnet_hiddens", (256, 256)))
+        self.obs_dim = int(np.prod(observation_space.shape))
+        self.act_dim = int(np.prod(action_space.shape))
+        low = np.asarray(action_space.low, dtype=np.float32).reshape(-1)
+        high = np.asarray(action_space.high, dtype=np.float32).reshape(-1)
+        self.center = jnp.asarray((high + low) / 2.0)
+        self.scale = jnp.asarray((high - low) / 2.0)
+        self.discrete = False
+
+    def init_params(self, rng) -> dict:
+        pi_rng, q1_rng, q2_rng = jax.random.split(rng, 3)
+        return {
+            "pi": _mlp_init(
+                pi_rng, (self.obs_dim, *self.hiddens, 2 * self.act_dim)
+            ),
+            "q1": _mlp_init(q1_rng, (self.obs_dim + self.act_dim, *self.hiddens, 1)),
+            "q2": _mlp_init(q2_rng, (self.obs_dim + self.act_dim, *self.hiddens, 1)),
+            "log_alpha": jnp.zeros(()),
+        }
+
+    # -- policy ----------------------------------------------------------
+    def _pi_dist(self, pi_params, obs):
+        obs = obs.reshape(obs.shape[0], -1)
+        out = _mlp_apply(pi_params, obs, activation=jax.nn.relu)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample_action(self, pi_params, obs, rng):
+        """→ (env-scaled actions, logp) with tanh-squash correction."""
+        mean, log_std = self._pi_dist(pi_params, obs)
+        std = jnp.exp(log_std)
+        u = mean + std * jax.random.normal(rng, mean.shape)
+        gauss_logp = -0.5 * jnp.sum(
+            ((u - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi),
+            axis=-1,
+        )
+        a = jnp.tanh(u)
+        # d tanh correction: log det Jacobian of the squash
+        logp = gauss_logp - jnp.sum(jnp.log(1.0 - a**2 + 1e-6), axis=-1)
+        return a * self.scale + self.center, logp
+
+    def q_values(self, q_params, obs, actions):
+        obs = obs.reshape(obs.shape[0], -1)
+        x = jnp.concatenate([obs, actions.reshape(obs.shape[0], -1)], axis=-1)
+        return _mlp_apply(q_params, x, activation=jax.nn.relu)[..., 0]
+
+    # -- RLModule surface (env runner hooks) -----------------------------
+    def forward_exploration(self, params, obs, rng):
+        actions, logp = self.sample_action(params["pi"], jnp.asarray(obs), rng)
+        return actions, logp, {"vf_preds": jnp.zeros(actions.shape[0])}
+
+    def forward_inference(self, params, obs):
+        mean, _ = self._pi_dist(params["pi"], jnp.asarray(obs))
+        return jnp.tanh(mean) * self.scale + self.center
+
+    def forward_train(self, params, obs) -> dict:
+        mean, log_std = self._pi_dist(params["pi"], jnp.asarray(obs))
+        return {"mean": mean, "log_std": log_std,
+                "vf": jnp.zeros(mean.shape[0])}
+
+
+class SACLearner(Learner):
+    """One jitted step: critic + actor + alpha losses, polyak targets."""
+
+    def __init__(self, module: SACModule, config: dict, seed: int = 0):
+        super().__init__(module, config, seed)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, {"q1": self.params["q1"], "q2": self.params["q2"]}
+        )
+        if config.get("initial_alpha") is not None:
+            self.params["log_alpha"] = jnp.asarray(
+                float(np.log(config["initial_alpha"]))
+            )
+            self.opt_state = self.optimizer.init(self.params)
+        target_entropy = config.get("target_entropy", "auto")
+        self._target_entropy = (
+            -float(module.act_dim)
+            if target_entropy in (None, "auto")
+            else float(target_entropy)
+        )
+        self._rng = jax.random.PRNGKey(seed * 7919 + 13)
+        self._sac_step = jax.jit(self._jit_sac_step, donate_argnums=(0, 1, 2))
+
+    def compute_loss(self, params, batch):  # pragma: no cover - unused path
+        raise NotImplementedError("SACLearner jits its own combined step")
+
+    def _jit_sac_step(self, params, target_params, opt_state, batch, rng):
+        module: SACModule = self.module
+        cfg = self.config
+        gamma = cfg.get("gamma", 0.99)
+        tau = cfg.get("tau", 0.005)
+        rng_actor, rng_next = jax.random.split(rng)
+        obs, actions = batch[OBS], batch[ACTIONS]
+        not_done = 1.0 - batch[TERMINATEDS].astype(jnp.float32)
+
+        def loss_fn(p):
+            alpha = jnp.exp(p["log_alpha"])
+            sg = jax.lax.stop_gradient
+            # -- critic target (no grads anywhere inside)
+            a_next, logp_next = module.sample_action(
+                sg(p["pi"]), batch[NEXT_OBS], rng_next
+            )
+            q_next = jnp.minimum(
+                module.q_values(target_params["q1"], batch[NEXT_OBS], a_next),
+                module.q_values(target_params["q2"], batch[NEXT_OBS], a_next),
+            )
+            target = sg(
+                batch[REWARDS]
+                + gamma * not_done * (q_next - sg(alpha) * logp_next)
+            )
+            q1 = module.q_values(p["q1"], obs, actions)
+            q2 = module.q_values(p["q2"], obs, actions)
+            critic_loss = jnp.mean((q1 - target) ** 2) + jnp.mean(
+                (q2 - target) ** 2
+            )
+            # -- actor (grads flow to pi only; critics frozen via sg)
+            a_pi, logp_pi = module.sample_action(p["pi"], obs, rng_actor)
+            q_pi = jnp.minimum(
+                module.q_values(sg(p["q1"]), obs, a_pi),
+                module.q_values(sg(p["q2"]), obs, a_pi),
+            )
+            actor_loss = jnp.mean(sg(alpha) * logp_pi - q_pi)
+            # -- temperature
+            alpha_loss = -jnp.mean(
+                p["log_alpha"] * sg(logp_pi + self._target_entropy)
+            )
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {
+                "critic_loss": critic_loss,
+                "actor_loss": actor_loss,
+                "alpha_loss": alpha_loss,
+                "alpha": alpha,
+                "entropy": -jnp.mean(logp_pi),
+                "q_mean": jnp.mean(q1),
+            }
+
+        (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda a, b: a + b, params, updates
+        )
+        new_targets = jax.tree_util.tree_map(
+            lambda t, o: (1.0 - tau) * t + tau * o,
+            target_params,
+            {"q1": params["q1"], "q2": params["q2"]},
+        )
+        metrics["total_loss"] = loss
+        return params, new_targets, opt_state, metrics
+
+    def update(self, batch: SampleBatch) -> dict:
+        device_batch = {
+            k: jnp.asarray(v)
+            for k, v in batch.items()
+            if k in (OBS, ACTIONS, REWARDS, NEXT_OBS, TERMINATEDS)
+        }
+        self._rng, key = jax.random.split(self._rng)
+        self.params, self.target_params, self.opt_state, metrics = (
+            self._sac_step(
+                self.params, self.target_params, self.opt_state,
+                device_batch, key,
+            )
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        return state
+
+    def set_state(self, state: dict) -> None:
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.device_put(state["target_params"])
+
+
+class SAC(Algorithm):
+    learner_class = SACLearner
+
+    def __init__(self, config: SACConfig):
+        if config.rl_module_spec is None:
+            config.rl_module_spec = RLModuleSpec(
+                SACModule, dict(config.model)
+            )
+        super().__init__(config)
+        self.replay = ReplayBuffer(
+            config.replay_buffer_capacity, seed=config.seed
+        )
+
+    def _learner_config(self) -> dict:
+        cfg = super()._learner_config()
+        cfg.update(
+            tau=self.config.tau,
+            target_entropy=self.config.target_entropy,
+            initial_alpha=self.config.initial_alpha,
+        )
+        return cfg
+
+    def training_step(self) -> dict:
+        config = self.config
+        fragment = self.env_runner_group.sample()
+        self._total_env_steps += len(fragment)
+        self.replay.add(fragment)
+        metrics: dict = {"buffer_size": len(self.replay)}
+        if len(self.replay) < config.num_steps_sampled_before_learning_starts:
+            return metrics
+        learner = self.learner_group.local_learner
+        assert learner is not None, "SAC uses a local learner (num_learners=0)"
+        for _ in range(config.updates_per_iteration):
+            batch = self.replay.sample(config.train_batch_size)
+            update_metrics = learner.update(batch)
+        metrics.update(update_metrics)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return metrics

@@ -68,15 +68,24 @@ class Learner:
         return params, opt_state
 
     # -- public ----------------------------------------------------------
+    @staticmethod
+    def _to_device(batch: SampleBatch) -> dict:
+        # Non-numeric bookkeeping columns (AGENT_ID strings, …) stay host-side.
+        return {
+            k: jnp.asarray(v)
+            for k, v in batch.items()
+            if np.asarray(v).dtype.kind in "biuf"
+        }
+
     def update(self, batch: SampleBatch) -> dict:
-        device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        device_batch = self._to_device(batch)
         self.params, self.opt_state, metrics = self._step(
             self.params, self.opt_state, device_batch
         )
         return {k: float(v) for k, v in metrics.items()}
 
     def compute_gradients(self, batch: SampleBatch):
-        device_batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        device_batch = self._to_device(batch)
         return self._grad_only(self.params, device_batch)
 
     def apply_gradients(self, grads) -> None:
@@ -228,3 +237,60 @@ class LearnerGroup:
                 ray_tpu.kill(actor)
             except Exception:
                 pass
+
+
+class MultiAgentLearnerGroup:
+    """One Learner per module id over a MultiRLModule.
+
+    Role-equivalent of the Learner's MultiRLModule support in the
+    reference (rllib/core/learner/learner.py multi-module update): each
+    module's update stays its own jitted XLA function; weights/state are
+    dicts keyed by module id, which is what MultiAgentEnvRunner expects
+    from sync_weights.
+    """
+
+    def __init__(
+        self,
+        learner_cls,
+        multi_spec,  # MultiRLModuleSpec
+        observation_spaces: dict,
+        action_spaces: dict,
+        config: dict,
+    ):
+        multi_module = multi_spec.build(observation_spaces, action_spaces)
+        self.learners: dict[str, Learner] = {
+            mid: learner_cls(module, config, seed=i)
+            for i, (mid, module) in enumerate(sorted(multi_module.items()))
+        }
+
+    @property
+    def module_ids(self):
+        return self.learners.keys()
+
+    def update(self, batch) -> dict:
+        """``batch``: MultiAgentBatch → {module_id: metrics}."""
+        return {
+            mid: self.learners[mid].update(sub)
+            for mid, sub in batch.items()
+            if len(sub)
+        }
+
+    def update_module(self, module_id: str, batch: SampleBatch) -> dict:
+        return self.learners[module_id].update(batch)
+
+    def get_weights(self) -> dict:
+        return {mid: l.get_weights() for mid, l in self.learners.items()}
+
+    def set_weights(self, params: dict) -> None:
+        for mid, p in params.items():
+            self.learners[mid].set_weights(p)
+
+    def get_state(self) -> dict:
+        return {mid: l.get_state() for mid, l in self.learners.items()}
+
+    def set_state(self, state: dict) -> None:
+        for mid, s in state.items():
+            self.learners[mid].set_state(s)
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,60 @@
+"""MultiRLModule — a dict of RLModules keyed by module id.
+
+Role-equivalent of rllib/core/rl_module/multi_rl_module.py ::
+MultiRLModule(Spec): holds one RLModule per policy/module id; params are a
+dict pytree {module_id: module_params}, so the whole multi-agent update
+stays one jit-friendly structure. Agent→module routing happens in the
+runner via ``policy_mapping_fn`` — the module itself is agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+
+
+class MultiRLModuleSpec:
+    """module_id → RLModuleSpec (or None for the default MLP catalog)."""
+
+    def __init__(self, module_specs: Mapping[str, RLModuleSpec | None]):
+        self.module_specs = {
+            mid: (spec or RLModuleSpec()) for mid, spec in module_specs.items()
+        }
+
+    def build(
+        self,
+        observation_spaces: Mapping[str, object],
+        action_spaces: Mapping[str, object],
+    ) -> "MultiRLModule":
+        modules = {
+            mid: spec.build(observation_spaces[mid], action_spaces[mid])
+            for mid, spec in self.module_specs.items()
+        }
+        return MultiRLModule(modules)
+
+
+class MultiRLModule:
+    def __init__(self, modules: Mapping[str, RLModule]):
+        self._modules = dict(modules)
+
+    def __getitem__(self, module_id: str) -> RLModule:
+        return self._modules[module_id]
+
+    def __contains__(self, module_id: str) -> bool:
+        return module_id in self._modules
+
+    def keys(self):
+        return self._modules.keys()
+
+    def items(self):
+        return self._modules.items()
+
+    def init_params(self, rng: jax.Array) -> dict:
+        keys = jax.random.split(rng, len(self._modules))
+        return {
+            mid: module.init_params(key)
+            for (mid, module), key in zip(sorted(self._modules.items()), keys)
+        }

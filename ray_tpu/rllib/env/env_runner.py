@@ -34,8 +34,14 @@ class SingleAgentEnvRunner:
         worker_index: int = 0,
         explore: bool = True,
         seed: Optional[int] = None,
+        env_to_module: Callable[[], Any] | None = None,
+        module_to_env: Callable[[], Any] | None = None,
     ):
         import gymnasium as gym
+
+        from ray_tpu.rllib.connectors import (
+            default_env_to_module, default_module_to_env,
+        )
 
         if isinstance(env_creator, str):
             env_id = env_creator
@@ -45,8 +51,26 @@ class SingleAgentEnvRunner:
         self.num_envs = num_envs
         self.rollout_fragment_length = rollout_fragment_length
         self.explore = explore
+        # Connector pipelines (ConnectorV2 role, SURVEY §2.8): factories so
+        # each runner actor owns its (possibly stateful) pipeline instance.
+        self._env_to_module = (
+            env_to_module() if env_to_module else default_env_to_module()
+        )
+        self._module_to_env = (
+            module_to_env() if module_to_env else default_module_to_env()
+        )
+        seed_val = None if seed is None else seed + worker_index
+        raw_obs, _ = self.env.reset(seed=seed_val)
+        self._obs = self._env_to_module(raw_obs)
+        # The module sees the CONNECTOR's output, not the env's raw space —
+        # a shape-changing pipeline (framestack, …) implies a wider input.
+        obs_space = self.env.single_observation_space
+        if tuple(self._obs.shape[1:]) != tuple(obs_space.shape or ()):
+            obs_space = gym.spaces.Box(
+                -np.inf, np.inf, shape=self._obs.shape[1:], dtype=np.float32
+            )
         self.module = module_spec.build(
-            self.env.single_observation_space, self.env.single_action_space
+            obs_space, self.env.single_action_space
         )
         self._params = None
         self._rng = jax.random.PRNGKey(
@@ -54,8 +78,6 @@ class SingleAgentEnvRunner:
         )
         self._fwd = jax.jit(self.module.forward_exploration)
         self._fwd_greedy = jax.jit(self.module.forward_inference)
-        seed_val = None if seed is None else seed + worker_index
-        self._obs, _ = self.env.reset(seed=seed_val)
         # Epsilon-greedy override (DQN-style): when set, actions are greedy
         # w.r.t. the module with prob 1-ε and uniform-random with prob ε —
         # applied BEFORE stepping the env so replay data stays consistent.
@@ -113,8 +135,17 @@ class SingleAgentEnvRunner:
                 logp = np.zeros(self.num_envs)
                 vf = np.zeros(self.num_envs)
             actions_np = np.asarray(actions)
-            env_actions = actions_np
-            next_obs, rewards, terms, truncs, _ = self.env.step(env_actions)
+            env_actions = self._module_to_env(
+                actions_np, action_space=self.env.single_action_space
+            )
+            raw_next, rewards, terms, truncs, _ = self.env.step(env_actions)
+            # Transform once per step: NEXT_OBS of step t is OBS of t+1,
+            # so stateful connectors (framestack, normalizers) see each
+            # observation exactly once. ``dones`` lets per-stream state
+            # (framestacks) reset at episode boundaries.
+            next_obs = self._env_to_module(
+                raw_next, dones=np.logical_or(terms, truncs)
+            )
             cols[OBS].append(self._obs)
             cols[ACTIONS].append(actions_np)
             cols[REWARDS].append(np.asarray(rewards, dtype=np.float32))

@@ -14,7 +14,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
-from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch
 
 
 class EnvRunnerGroup:
@@ -27,8 +27,12 @@ class EnvRunnerGroup:
         num_envs_per_runner: int = 1,
         rollout_fragment_length: int = 200,
         seed: Optional[int] = None,
+        env_to_module: Any = None,
+        module_to_env: Any = None,
+        runner_class: Any = None,
+        runner_kwargs: dict | None = None,
     ):
-        runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        runner_cls = ray_tpu.remote(runner_class or SingleAgentEnvRunner)
         self.num_env_runners = max(1, num_env_runners)
         self.runners = [
             runner_cls.options(num_cpus=1).remote(
@@ -38,6 +42,9 @@ class EnvRunnerGroup:
                 rollout_fragment_length=rollout_fragment_length,
                 worker_index=i,
                 seed=seed,
+                env_to_module=env_to_module,
+                module_to_env=module_to_env,
+                **(runner_kwargs or {}),
             )
             for i in range(self.num_env_runners)
         ]
@@ -55,6 +62,8 @@ class EnvRunnerGroup:
         batches = ray_tpu.get(
             [r.sample.remote() for r in self.runners], timeout=600
         )
+        if batches and isinstance(batches[0], MultiAgentBatch):
+            return MultiAgentBatch.concat_samples(batches)
         return SampleBatch.concat_samples(batches)
 
     # -- async pipeline (IMPALA path) -----------------------------------

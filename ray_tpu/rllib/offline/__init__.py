@@ -1,0 +1,3 @@
+from ray_tpu.rllib.offline.offline_data import OfflineData
+
+__all__ = ["OfflineData"]

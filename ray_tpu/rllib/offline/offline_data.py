@@ -1,0 +1,68 @@
+"""OfflineData — dataset-backed training input.
+
+Role-equivalent of rllib/offline/ :: OfflineData (and the legacy
+JsonReader) from SURVEY §2.8: experience comes from a ray_tpu.data
+Dataset (or a parquet/json path read through it) instead of env runners.
+Rows are per-timestep records with SampleBatch column names ("obs",
+"actions", optionally "rewards", "new_obs", "terminateds", "action_logp").
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class OfflineData:
+    def __init__(self, source: Any, shuffle_seed: int | None = 0):
+        self._batch = self._load(source)
+        self._rng = np.random.default_rng(shuffle_seed)
+        self._order = np.arange(len(self._batch))
+        self._cursor = len(self._batch)  # force shuffle on first sample
+
+    @staticmethod
+    def _load(source: Any) -> SampleBatch:
+        if isinstance(source, SampleBatch):
+            return source
+        if isinstance(source, dict):
+            return SampleBatch(source)
+        if isinstance(source, str):
+            from ray_tpu import data as rt_data
+
+            if source.endswith(".json") or source.endswith(".jsonl"):
+                dataset = rt_data.read_json(source)
+            else:
+                dataset = rt_data.read_parquet(source)
+            return OfflineData._rows_to_batch(dataset.take_all())
+        if hasattr(source, "take_all"):  # ray_tpu.data.Dataset
+            return OfflineData._rows_to_batch(source.take_all())
+        raise TypeError(f"unsupported offline input: {type(source)!r}")
+
+    @staticmethod
+    def _rows_to_batch(rows: list[dict]) -> SampleBatch:
+        if not rows:
+            raise ValueError("offline dataset is empty")
+        cols: dict[str, list] = {k: [] for k in rows[0]}
+        for row in rows:
+            for key, value in row.items():
+                cols[key].append(value)
+        return SampleBatch({k: np.asarray(v) for k, v in cols.items()})
+
+    def __len__(self) -> int:
+        return len(self._batch)
+
+    @property
+    def columns(self):
+        return self._batch.keys()
+
+    def sample(self, batch_size: int) -> SampleBatch:
+        """Epoch-shuffled minibatch (reshuffles when the epoch wraps)."""
+        if self._cursor + batch_size > len(self._order):
+            self._rng.shuffle(self._order)
+            self._cursor = 0
+        idx = self._order[self._cursor : self._cursor + batch_size]
+        self._cursor += batch_size
+        return SampleBatch({k: v[idx] for k, v in self._batch.items()})

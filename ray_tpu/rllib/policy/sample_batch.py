@@ -79,3 +79,51 @@ class SampleBatch(dict):
             out.append(self.slice(start, end))
             start = end
         return [b for b in out if len(b)]
+
+
+class MultiAgentBatch:
+    """Per-module SampleBatches + the env-step count they came from.
+
+    Role-equivalent of rllib/policy/sample_batch.py :: MultiAgentBatch:
+    ``policy_batches`` maps module_id → SampleBatch of that module's
+    agent-steps; ``env_steps`` counts underlying environment steps (one
+    env step can contribute a row to several modules).
+    """
+
+    def __init__(self, policy_batches: Mapping[str, SampleBatch], env_steps: int):
+        self.policy_batches: dict[str, SampleBatch] = dict(policy_batches)
+        self._env_steps = int(env_steps)
+
+    def env_steps(self) -> int:
+        return self._env_steps
+
+    def agent_steps(self) -> int:
+        return sum(len(b) for b in self.policy_batches.values())
+
+    def __len__(self) -> int:
+        return self._env_steps
+
+    def __getitem__(self, module_id: str) -> SampleBatch:
+        return self.policy_batches[module_id]
+
+    def __contains__(self, module_id: str) -> bool:
+        return module_id in self.policy_batches
+
+    def keys(self):
+        return self.policy_batches.keys()
+
+    def items(self):
+        return self.policy_batches.items()
+
+    @staticmethod
+    def concat_samples(batches: list["MultiAgentBatch"]) -> "MultiAgentBatch":
+        merged: dict[str, list[SampleBatch]] = {}
+        steps = 0
+        for batch in batches:
+            steps += batch.env_steps()
+            for mid, sub in batch.policy_batches.items():
+                merged.setdefault(mid, []).append(sub)
+        return MultiAgentBatch(
+            {m: SampleBatch.concat_samples(bs) for m, bs in merged.items()},
+            steps,
+        )

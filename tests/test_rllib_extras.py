@@ -1,0 +1,450 @@
+"""RLlib round-3 additions: ConnectorV2 pipelines, multi-agent
+(MultiRLModule + MultiAgentEnvRunner + PPO), and SAC.
+
+Mirrors the reference test strategy (SURVEY §4.3): pure connector unit
+tests, module/batch units, and short learning-threshold runs
+(MultiAgentCartPole for multi-agent PPO, Pendulum for SAC).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS, MultiAgentBatch, OBS, REWARDS, SampleBatch,
+)
+
+
+# ---------- connectors ----------
+
+def test_connector_pipeline_composes():
+    from ray_tpu.rllib.connectors import (
+        ConnectorPipelineV2, FlattenObservations, LambdaConnector,
+    )
+
+    pipe = ConnectorPipelineV2([FlattenObservations()])
+    pipe.append(LambdaConnector(lambda b, **kw: b * 2.0, name="double"))
+    out = pipe(np.ones((4, 2, 3)))
+    assert out.shape == (4, 6)
+    assert np.all(out == 2.0)
+    assert len(pipe) == 2
+    pipe.remove("double")
+    assert len(pipe) == 1
+
+
+def test_flatten_and_clip_connectors():
+    import gymnasium as gym
+
+    from ray_tpu.rllib.connectors import ClipActions, FlattenObservations
+
+    obs = FlattenObservations()(np.zeros((2, 3, 4)))
+    assert obs.shape == (2, 12) and obs.dtype == np.float32
+
+    space = gym.spaces.Box(low=-1.0, high=1.0, shape=(2,))
+    clipped = ClipActions()(np.array([[5.0, -5.0]]), action_space=space)
+    np.testing.assert_allclose(clipped, [[1.0, -1.0]])
+    # discrete: pass-through
+    assert ClipActions()(np.array([3]), action_space=gym.spaces.Discrete(4))[0] == 3
+
+
+def test_normalize_observations_runs_stats():
+    from ray_tpu.rllib.connectors import NormalizeObservations
+
+    conn = NormalizeObservations()
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        conn(rng.normal(loc=5.0, scale=2.0, size=(32, 3)))
+    out = conn(rng.normal(loc=5.0, scale=2.0, size=(1000, 3)))
+    assert abs(float(out.mean())) < 0.2
+    assert 0.7 < float(out.std()) < 1.3
+
+
+def test_frame_stack_connector():
+    from ray_tpu.rllib.connectors import FrameStack
+
+    conn = FrameStack(num_frames=3)
+    first = conn(np.ones((2, 4)))
+    assert first.shape == (2, 12)
+    # first call: two zero frames + the current one
+    assert np.all(first[:, :8] == 0) and np.all(first[:, 8:] == 1)
+
+
+def test_gae_connector_equivalent_to_direct():
+    from ray_tpu.rllib.connectors import GeneralAdvantageEstimation
+    from ray_tpu.rllib.policy.sample_batch import (
+        ADVANTAGES, EPS_ID, NEXT_OBS, TERMINATEDS, TRUNCATEDS, VF_PREDS,
+    )
+    from ray_tpu.rllib.utils.postprocessing import compute_gae
+
+    def make_batch():
+        return SampleBatch(
+            {
+                REWARDS: np.array([1.0, 1.0, 1.0], dtype=np.float32),
+                VF_PREDS: np.zeros(3, dtype=np.float32),
+                TERMINATEDS: np.array([False, False, True]),
+                TRUNCATEDS: np.array([False, False, False]),
+                NEXT_OBS: np.zeros((3, 1)),
+                EPS_ID: np.array([7, 7, 7]),
+            }
+        )
+
+    conn_out = GeneralAdvantageEstimation(gamma=0.9, lambda_=1.0,
+                                          standardize=False)(make_batch())
+    direct = compute_gae(make_batch(), gamma=0.9, lambda_=1.0,
+                         standardize=False)
+    np.testing.assert_allclose(conn_out[ADVANTAGES], direct[ADVANTAGES])
+
+
+def test_env_runner_custom_connector(ray_start_shared):
+    """A user env_to_module connector changes what the module sees."""
+    from ray_tpu.rllib import PPOConfig
+
+    from ray_tpu.rllib.connectors import (
+        ConnectorPipelineV2, FlattenObservations, FrameStack,
+    )
+
+    def stacked():
+        return ConnectorPipelineV2([FlattenObservations(), FrameStack(2)])
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=1,
+            num_envs_per_env_runner=2,
+            rollout_fragment_length=16,
+            env_to_module_connector=stacked,
+        )
+        .training(train_batch_size=32, minibatch_size=16, num_epochs=1,
+                  model={"fcnet_hiddens": (16,)})
+        .build_algo()
+    )
+    try:
+        # Module was built for 4-dim CartPole obs but sees 8-dim stacked —
+        # MLPModule flattens, so dims must match: rebuild check via sample.
+        batch = algo.env_runner_group.sample()
+        assert batch[OBS].shape[-1] == 8  # 2 stacked frames x 4 dims
+    finally:
+        algo.stop()
+
+
+# ---------- multi-agent units ----------
+
+def test_multi_agent_batch_ops():
+    a = MultiAgentBatch(
+        {"p0": SampleBatch({OBS: np.zeros((4, 2))}),
+         "p1": SampleBatch({OBS: np.zeros((2, 2))})},
+        env_steps=4,
+    )
+    b = MultiAgentBatch(
+        {"p0": SampleBatch({OBS: np.ones((3, 2))})}, env_steps=3
+    )
+    cat = MultiAgentBatch.concat_samples([a, b])
+    assert cat.env_steps() == 7
+    assert len(cat["p0"]) == 7
+    assert len(cat["p1"]) == 2
+    assert cat.agent_steps() == 9
+
+
+def test_multi_agent_cartpole_env():
+    from ray_tpu.rllib.env.multi_agent_env import MultiAgentCartPole
+
+    env = MultiAgentCartPole({"num_agents": 3})
+    obs, _ = env.reset(seed=0)
+    assert set(obs) == {"agent_0", "agent_1", "agent_2"}
+    obs, rewards, terms, truncs, _ = env.step(
+        {a: 0 for a in env.possible_agents}
+    )
+    assert set(rewards) == {"agent_0", "agent_1", "agent_2"}
+    assert "__all__" in terms
+    env.close()
+
+
+def test_multi_rl_module_builds_per_module_params():
+    import gymnasium as gym
+    import jax
+
+    from ray_tpu.rllib.core.multi_rl_module import MultiRLModuleSpec
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+    spec = MultiRLModuleSpec(
+        {"p0": RLModuleSpec(model_config={"fcnet_hiddens": (8,)}),
+         "p1": None}
+    )
+    space = gym.spaces.Box(-1, 1, (4,))
+    act = gym.spaces.Discrete(2)
+    module = spec.build({"p0": space, "p1": space}, {"p0": act, "p1": act})
+    params = module.init_params(jax.random.PRNGKey(0))
+    assert set(params) == {"p0", "p1"}
+    fwd = module["p0"].forward_train(
+        params["p0"], np.zeros((2, 4), dtype=np.float32)
+    )
+    assert fwd["logits"].shape == (2, 2)
+
+
+# ---------- multi-agent learning-threshold e2e ----------
+
+def _policy_for(agent_id, *args, **kwargs):
+    return "p0" if agent_id.endswith("0") else "p1"
+
+
+def test_multi_agent_ppo_cartpole_learns(ray_start_shared):
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.env.multi_agent_env import MultiAgentCartPole
+
+    algo = (
+        PPOConfig()
+        .environment(MultiAgentCartPole, env_config={"num_agents": 2})
+        .multi_agent(
+            policies={"p0", "p1"}, policy_mapping_fn=_policy_for
+        )
+        .env_runners(num_env_runners=2, rollout_fragment_length=128)
+        .training(
+            lr=3e-4,
+            train_batch_size=2048,
+            minibatch_size=256,
+            num_epochs=8,
+            entropy_coeff=0.01,
+            model={"fcnet_hiddens": (64, 64)},
+        )
+        .debugging(seed=0)
+        .build_algo()
+    )
+    try:
+        best = -np.inf
+        for _ in range(15):
+            result = algo.train()
+            ret = result["episode_return_mean"]
+            if not np.isnan(ret):
+                best = max(best, ret)
+            if best >= 150.0:  # sum of 2 agents ⇒ ~75 per agent
+                break
+        assert best >= 150.0, f"multi-agent PPO failed to learn: best={best}"
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_checkpoint_roundtrip(ray_start_shared, tmp_path):
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.env.multi_agent_env import MultiAgentCartPole
+
+    algo = (
+        PPOConfig()
+        .environment(MultiAgentCartPole, env_config={"num_agents": 2})
+        .multi_agent(policies={"p0", "p1"}, policy_mapping_fn=_policy_for)
+        .env_runners(num_env_runners=1, rollout_fragment_length=64)
+        .training(train_batch_size=128, minibatch_size=64, num_epochs=1,
+                  model={"fcnet_hiddens": (16,)})
+        .build_algo()
+    )
+    try:
+        algo.train()
+        path = algo.save(str(tmp_path / "ma_ckpt"))
+        weights_before = algo.learner_group.get_weights()
+        algo.train()
+        algo.restore(path)
+        weights_after = algo.learner_group.get_weights()
+        import jax
+
+        for mid in ("p0", "p1"):
+            for a, b in zip(
+                jax.tree_util.tree_leaves(weights_before[mid]),
+                jax.tree_util.tree_leaves(weights_after[mid]),
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        algo.stop()
+
+
+# ---------- SAC ----------
+
+def test_sac_module_action_bounds_and_logp():
+    import gymnasium as gym
+    import jax
+
+    from ray_tpu.rllib.algorithms.sac.sac import SACModule
+
+    space = gym.spaces.Box(low=-2.0, high=2.0, shape=(1,))
+    obs_space = gym.spaces.Box(-8, 8, (3,))
+    module = SACModule(obs_space, space, {"fcnet_hiddens": (16,)})
+    params = module.init_params(jax.random.PRNGKey(0))
+    obs = np.zeros((64, 3), dtype=np.float32)
+    actions, logp, _ = module.forward_exploration(
+        params, obs, jax.random.PRNGKey(1)
+    )
+    actions = np.asarray(actions)
+    assert actions.shape == (64, 1)
+    assert np.all(actions >= -2.0) and np.all(actions <= 2.0)
+    assert np.all(np.isfinite(np.asarray(logp)))
+    greedy = np.asarray(module.forward_inference(params, obs))
+    assert np.all(greedy >= -2.0) and np.all(greedy <= 2.0)
+
+
+def test_sac_learner_step_updates_targets():
+    import gymnasium as gym
+    import jax
+
+    from ray_tpu.rllib.algorithms.sac.sac import SACLearner, SACModule
+
+    space = gym.spaces.Box(low=-1.0, high=1.0, shape=(2,))
+    obs_space = gym.spaces.Box(-8, 8, (3,))
+    module = SACModule(obs_space, space, {"fcnet_hiddens": (16,)})
+    learner = SACLearner(module, {"lr": 3e-4, "tau": 0.5})
+    rng = np.random.default_rng(0)
+    batch = SampleBatch(
+        {
+            OBS: rng.normal(size=(32, 3)).astype(np.float32),
+            ACTIONS: rng.uniform(-1, 1, size=(32, 2)).astype(np.float32),
+            REWARDS: rng.normal(size=32).astype(np.float32),
+            "new_obs": rng.normal(size=(32, 3)).astype(np.float32),
+            "terminateds": np.zeros(32, dtype=np.float32),
+        }
+    )
+    targets_before = jax.device_get(learner.target_params)
+    metrics = learner.update(batch)
+    targets_after = jax.device_get(learner.target_params)
+    assert np.isfinite(metrics["total_loss"])
+    assert "alpha" in metrics and metrics["alpha"] > 0
+    # tau=0.5 polyak must move targets visibly after one step
+    moved = any(
+        not np.allclose(a, b)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(targets_before),
+            jax.tree_util.tree_leaves(targets_after),
+        )
+    )
+    assert moved
+
+
+def test_sac_pendulum_learns(ray_start_shared):
+    from ray_tpu.rllib import SACConfig
+
+    algo = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(
+            num_env_runners=1,
+            num_envs_per_env_runner=8,
+            rollout_fragment_length=25,
+        )
+        .training(
+            lr=3e-4,
+            train_batch_size=256,
+            num_steps_sampled_before_learning_starts=1000,
+            updates_per_iteration=200,
+            model={"fcnet_hiddens": (64, 64)},
+        )
+        .debugging(seed=0)
+        .build_algo()
+    )
+    try:
+        best = -np.inf
+        for i in range(60):
+            algo.train()
+            # The sampled-episode window (last 100, reference convention)
+            # fills too slowly on 200-step Pendulum episodes to reflect
+            # current skill — threshold on GREEDY evaluation instead.
+            if i >= 14 and (i - 14) % 5 == 0:
+                ret = algo.evaluate()["episode_return_mean"]
+                best = max(best, ret)
+                if best >= -750.0:
+                    break
+        # Random policy on Pendulum ≈ -1200..-1600; a learning SAC's greedy
+        # policy clears -750 well within the budget.
+        assert best >= -750.0, f"SAC failed to learn Pendulum: best={best}"
+    finally:
+        algo.stop()
+
+
+# ---------- offline RL: OfflineData + BC ----------
+
+def _cartpole_expert_rows(n_steps=4000, seed=0):
+    """Scripted near-expert CartPole policy (angle + angular velocity
+    sign): reaches ~150-200 reward — good enough to clone."""
+    import gymnasium as gym
+
+    env = gym.make("CartPole-v1")
+    rng = np.random.default_rng(seed)
+    rows = []
+    obs, _ = env.reset(seed=seed)
+    while len(rows) < n_steps:
+        action = int(obs[2] + 0.5 * obs[3] > 0)
+        if rng.random() < 0.05:  # tiny noise for coverage
+            action = 1 - action
+        rows.append({"obs": np.asarray(obs, np.float32), "actions": action})
+        obs, _, term, trunc, _ = env.step(action)
+        if term or trunc:
+            obs, _ = env.reset()
+    env.close()
+    return rows
+
+
+def test_offline_data_shuffled_epochs():
+    from ray_tpu.rllib.offline import OfflineData
+
+    data = OfflineData(
+        {"obs": np.arange(40).reshape(10, 4).astype(np.float32),
+         "actions": np.arange(10)}
+    )
+    assert len(data) == 10
+    seen = set()
+    for _ in range(5):
+        batch = data.sample(2)
+        assert len(batch) == 2
+        seen.update(batch["actions"].tolist())
+    assert seen == set(range(10))  # one full epoch covered exactly
+
+
+def test_offline_data_from_dataset_and_parquet(ray_start_shared, tmp_path):
+    from ray_tpu import data as rt_data
+    from ray_tpu.rllib.offline import OfflineData
+
+    rows = _cartpole_expert_rows(n_steps=100)
+    dataset = rt_data.from_items(rows)
+    offline = OfflineData(dataset)
+    assert len(offline) == 100
+    assert set(offline.columns) >= {"obs", "actions"}
+
+    path = str(tmp_path / "expert")
+    dataset.write_parquet(path)
+    offline2 = OfflineData(path)
+    assert len(offline2) == 100
+
+
+def test_bc_clones_expert(ray_start_shared):
+    from ray_tpu.rllib import BCConfig
+    from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+    rows = _cartpole_expert_rows(n_steps=4000)
+    batch = SampleBatch(
+        {"obs": np.stack([r["obs"] for r in rows]),
+         "actions": np.asarray([r["actions"] for r in rows])}
+    )
+    algo = (
+        BCConfig()
+        .environment("CartPole-v1")
+        .offline_data(input_=batch)
+        .training(lr=1e-3, train_batch_size=256, updates_per_iteration=150,
+                  model={"fcnet_hiddens": (64, 64)})
+        .build_algo()
+    )
+    try:
+        best = -np.inf
+        for _ in range(8):
+            result = algo.train()
+            assert np.isfinite(result["learner/total_loss"])
+            ret = algo.evaluate()["episode_return_mean"]
+            best = max(best, ret)
+            if best >= 120.0:
+                break
+        # Random CartPole ≈ 20; the cloned expert must clear 120.
+        assert best >= 120.0, f"BC failed to clone the expert: best={best}"
+    finally:
+        algo.stop()
+
+
+def test_bc_requires_input():
+    from ray_tpu.rllib import BCConfig
+
+    with pytest.raises(ValueError):
+        BCConfig().environment("CartPole-v1").build_algo()
