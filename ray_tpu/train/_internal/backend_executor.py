@@ -81,15 +81,15 @@ class BackendExecutor:
         train_fn: Callable,
         train_loop_config: dict,
         latest_checkpoint: Optional[Checkpoint],
-        dataset_shards_per_rank: list[dict],
+        dataset_shards_per_rank: list[dict] | Callable[[int], list[dict]],
     ) -> None:
         sc = self.scaling_config
-        self.gang = WorkerGang(
-            sc.total_workers,
-            resources_per_worker=sc.worker_resources(),
-            backend=self.backend,
-            placement_strategy=sc.placement_strategy,
-        )
+        self.gang = self._form_gang()
+        if callable(dataset_shards_per_rank):
+            # Elastic path: shards depend on the world size actually formed.
+            dataset_shards_per_rank = dataset_shards_per_rank(
+                self.gang.num_workers
+            )
         self.gang.run(
             _start_session_fn,
             train_fn=train_fn,
@@ -99,6 +99,51 @@ class BackendExecutor:
             latest_checkpoint=latest_checkpoint,
             dataset_shards_per_rank=dataset_shards_per_rank,
             mesh_axes=dict(sc.mesh_axes),
+        )
+
+    def _form_gang(self) -> WorkerGang:
+        """Form the gang at the target size, stepping down to min_workers.
+
+        Bounded elasticity (SURVEY §2.4 Train v2, §5.3): each size gets one
+        formation attempt with a bounded placement timeout; a cluster that
+        lost capacity re-forms at the largest world size it can still gang-
+        schedule. Fixed-size configs keep the old behavior (one attempt,
+        long timeout, hard failure).
+        """
+        from ray_tpu import exceptions
+
+        sc = self.scaling_config
+        if not sc.elastic:
+            return WorkerGang(
+                sc.total_workers,
+                resources_per_worker=sc.worker_resources(),
+                backend=self.backend,
+                placement_strategy=sc.placement_strategy,
+            )
+        last_exc: Exception | None = None
+        for size in range(sc.total_workers, sc.min_workers - 1, -1):
+            try:
+                gang = WorkerGang(
+                    size,
+                    resources_per_worker=sc.worker_resources(),
+                    backend=self.backend,
+                    placement_strategy=sc.placement_strategy,
+                    ready_timeout=sc.elastic_formation_timeout_s,
+                )
+                if size < sc.total_workers:
+                    print(
+                        f"[train] elastic step-down: formed gang at "
+                        f"world_size={size} (target {sc.total_workers})"
+                    )
+                return gang
+            except (
+                exceptions.PlacementGroupUnschedulableError,
+                exceptions.GangDiedError,
+            ) as exc:
+                last_exc = exc
+        raise TrainingFailedError(
+            f"could not form a gang at any size in "
+            f"[{sc.min_workers}, {sc.total_workers}]: {last_exc}"
         )
 
     def poll_round(self, timeout: float = 600.0) -> list[dict]:

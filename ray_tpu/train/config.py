@@ -39,6 +39,16 @@ class ScalingConfig:
     mesh_axes: Mapping[str, int] = field(default_factory=dict)
     resources_per_worker: Mapping[str, float] = field(default_factory=dict)
     placement_strategy: str = "SPREAD"
+    # Bounded elasticity (reference: Train v2 min/max workers, SURVEY
+    # §2.4): None ⇒ fixed world size. With min_workers set, a gang that
+    # cannot re-form at num_workers after a failure restarts at the
+    # largest feasible size ≥ min_workers — recovery is
+    # checkpoint → re-mesh → restore, never in-place (XLA meshes are
+    # static, SURVEY §5.3).
+    min_workers: int | None = None
+    # How long one formation attempt at a given size may wait before the
+    # executor steps down to the next smaller world size.
+    elastic_formation_timeout_s: float = 30.0
 
     def worker_resources(self) -> dict[str, float]:
         resources = {"CPU": 1.0, **dict(self.resources_per_worker)}
@@ -49,6 +59,21 @@ class ScalingConfig:
     @property
     def total_workers(self) -> int:
         return int(self.num_workers)
+
+    @property
+    def elastic(self) -> bool:
+        return (
+            self.min_workers is not None
+            and self.min_workers < self.num_workers
+        )
+
+    def __post_init__(self) -> None:
+        if self.min_workers is not None and not (
+            1 <= self.min_workers <= self.num_workers
+        ):
+            raise ValueError(
+                "min_workers must satisfy 1 <= min_workers <= num_workers"
+            )
 
 
 @dataclass

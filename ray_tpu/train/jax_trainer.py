@@ -116,8 +116,10 @@ class DataParallelTrainer:
                     self.train_loop_per_worker,
                     self.train_loop_config,
                     latest_ckpt,
-                    _split_datasets(
-                        self.datasets, self.scaling_config.total_workers
+                    # Split AFTER gang formation: an elastic restart may
+                    # come up at a smaller world size.
+                    lambda world_size: _split_datasets(
+                        self.datasets, world_size
                     ),
                 )
                 done, last_metrics, error = self._drive(

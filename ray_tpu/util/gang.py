@@ -122,7 +122,19 @@ class WorkerGang:
         resources = dict(resources_per_worker or {"CPU": 1})
         bundles = [dict(resources) for _ in range(num_workers)]
         self.pg = placement_group(bundles, strategy=placement_strategy)
-        self.pg.ready(timeout=ready_timeout)
+        try:
+            self.pg.ready(timeout=ready_timeout)
+        except Exception:
+            # A formation attempt that cannot place must not leave a
+            # PENDING PG behind: the controller would keep trying to place
+            # it (reserving bundles if capacity returns) and the orphan
+            # demand feeds the autoscaler (elastic step-down loops form
+            # gangs at several sizes in quick succession).
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            raise
         member_cls = ray_tpu.remote(_GangMember)
         cpu = resources.pop("CPU", 1)
         self.members = [

@@ -1,0 +1,101 @@
+"""Bounded elasticity tests (Train v2 min/max workers, SURVEY §2.4).
+
+Separate module: these use the function-scoped in-process Cluster fixture,
+which cannot coexist with test_train.py's module-scoped shared cluster.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+def _elastic_loop(config):
+    ctx = train.get_context()
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        state, _ = train.load_pytree_checkpoint(ckpt)
+        start = int(state["step"]) + 1
+    for step in range(start, config["steps"]):
+        checkpoint = None
+        if ctx.get_world_rank() == 0:
+            checkpoint = train.save_pytree_checkpoint({"step": step})
+        train.report(
+            {
+                "step": step,
+                "world_size": ctx.get_world_size(),
+                "resumed": start > 0,
+            },
+            checkpoint=checkpoint,
+        )
+
+
+class _KillNodeAt:
+    """Driver-side callback: removes a cluster node once training reaches
+    the trigger step — capacity is then 3 slots, so the gang can only
+    re-form at a smaller world size."""
+
+    def __init__(self, cluster, trigger_step):
+        self.cluster = cluster
+        self.trigger_step = trigger_step
+        self.victim = None
+        self.fired = False
+
+    def on_result(self, metrics):
+        if not self.fired and metrics.get("step", -1) >= self.trigger_step:
+            self.fired = True
+            self.cluster.remove_node(self.victim)
+
+
+def test_trainer_elastic_step_down(ray_start_cluster, tmp_path):
+    cluster = ray_start_cluster
+    nodes = [
+        cluster.add_node(resources={"trainslot": 1}, num_cpus=2)
+        for _ in range(4)
+    ]
+    cluster.wait_for_nodes(5)
+
+    killer = _KillNodeAt(cluster, trigger_step=1)
+    killer.victim = nodes[-1]
+    trainer = JaxTrainer(
+        _elastic_loop,
+        train_loop_config={"steps": 8},
+        scaling_config=ScalingConfig(
+            num_workers=4,
+            min_workers=2,
+            resources_per_worker={"CPU": 1, "trainslot": 1},
+            placement_strategy="PACK",
+            elastic_formation_timeout_s=10.0,
+        ),
+        run_config=RunConfig(
+            name="elastic",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2),
+            callbacks=[killer],
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    # Finished all steps, resumed from the checkpoint, at a SMALLER world
+    # size (4 → 3): checkpoint → re-mesh → restore, not in-place resize.
+    assert result.metrics["step"] == 7
+    assert result.metrics["resumed"] is True
+    assert result.metrics["world_size"] == 3
+    state, _ = train.load_pytree_checkpoint(result.checkpoint)
+    assert int(state["step"]) == 7
+
+
+def test_scaling_config_elastic_validation():
+    with pytest.raises(ValueError):
+        ScalingConfig(num_workers=2, min_workers=3)
+    sc = ScalingConfig(num_workers=4, min_workers=2)
+    assert sc.elastic
+    assert not ScalingConfig(num_workers=4).elastic
