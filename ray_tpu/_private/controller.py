@@ -34,6 +34,7 @@ import time
 from typing import Any
 
 from ray_tpu._private.config import global_config
+from ray_tpu._private.event_export import EventExporter
 from ray_tpu._private.ids import ActorID, PlacementGroupID
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection, spawn_task
 
@@ -162,6 +163,7 @@ class Controller:
         )
         # Queued-but-unplaceable resource demands, for the autoscaler [N4].
         self.pending_demands: dict[str, dict] = {}
+        self.events = EventExporter(session_dir)
         self._rr = itertools.count()
         # Persistence (role-equivalent of the reference's
         # redis_store_client-backed GCS tables [N7]: restart the control
@@ -339,6 +341,10 @@ class Controller:
         return {"status": "ok"}
 
     async def publish(self, channel: str, message: Any) -> None:
+        # Every lifecycle broadcast also lands in the structured export
+        # files (event.cc/N28 role): pubsub reaches connected subscribers,
+        # the export reaches external consumers after the fact.
+        self.events.emit(channel, message)
         dead = []
         for conn in self.subscribers.get(channel, set()):
             if conn.closed.is_set():
@@ -489,6 +495,7 @@ class Controller:
                     "state": "RUNNING",
                 },
             )
+            self.events.emit("job_started", {"job_id": job_id})
             self._mark_dirty()
         return {"status": "ok"}
 
@@ -1046,6 +1053,7 @@ class Controller:
     # ------------------------------------------------------------------
     async def rpc_report_task_events(self, conn, payload) -> dict:
         self.task_events.extend(payload["events"])
+        self.events.emit("task_events", payload["events"])
         return {"status": "ok"}
 
     async def rpc_list_task_events(self, conn, payload) -> list:

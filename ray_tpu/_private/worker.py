@@ -96,6 +96,12 @@ def init(
                 store_capacity=object_store_memory or 0,
             )
             _local_cluster = cluster
+            # Driver-side tracing/profile exports land in the session dir
+            # (workers inherit it via RAYTPU_SESSION_DIR at spawn).
+            os.environ.setdefault("RAYTPU_SESSION_DIR", cluster.session_dir)
+            from ray_tpu.util import tracing as _tracing
+
+            _tracing.configure(cluster.session_dir)
             controller_addr = cluster.controller_addr
             agent_addr = cluster.head_agent_addr
             store_info = cluster.head_store_info

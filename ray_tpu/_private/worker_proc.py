@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
 import inspect
 import json
 import os
@@ -25,6 +26,7 @@ from typing import Any
 from ray_tpu import exceptions
 from ray_tpu._private import serialization
 from ray_tpu._private.config import global_config
+from ray_tpu.util import tracing
 from ray_tpu._private.core_context import CoreContext
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.rpc import RpcClient
@@ -79,6 +81,7 @@ class WorkerRuntime:
         for method in (
             "push_task", "push_actor_task", "create_actor", "exit",
             "cancel_task", "dag_register", "dag_push", "dag_pop",
+            "profiler", "stack_trace", "engine_debug",
         ):
             ctx.core_server.route(method, getattr(self, f"rpc_{method}"))
         ctx.connect()
@@ -147,9 +150,15 @@ class WorkerRuntime:
         cached = self._fn_cache.get(function_id)
         if cached is not None:
             return cached
-        resp = await self.ctx.controller.call(
-            "kv_get", {"namespace": "funcs", "key": function_id}
-        )
+        # Brief retry: the owner's kv_put may still be in flight when the
+        # first task referencing the function reaches a fresh worker.
+        for attempt in range(10):
+            resp = await self.ctx.controller.call(
+                "kv_get", {"namespace": "funcs", "key": function_id}
+            )
+            if resp["status"] == "ok":
+                break
+            await asyncio.sleep(0.2)
         if resp["status"] != "ok":
             raise RuntimeError(f"function {function_id} not found in function table")
         # Functions/classes may close over ObjectRefs — resolve them the
@@ -232,6 +241,18 @@ class WorkerRuntime:
         if on_main:
             self._main_current_task = task_id
             self._main_executing = True
+        trace_scope = (
+            tracing.span(
+                f"execute {name}", parent=spec.get("trace_ctx"),
+                task_id=task_id, worker_id=self.ctx.worker_id,
+            )
+            if tracing.enabled() and spec.get("trace_ctx")
+            else contextlib.nullcontext()
+        )
+        with trace_scope:
+            return self._execute_inner(spec, fn, preresolved, name, task_id, on_main)
+
+    def _execute_inner(self, spec, fn, preresolved, name, task_id, on_main) -> dict:
         try:
             if preresolved is not None:
                 args, kwargs = preresolved
@@ -314,6 +335,98 @@ class WorkerRuntime:
     # ------------------------------------------------------------------
     # RPC handlers
     # ------------------------------------------------------------------
+    async def rpc_stack_trace(self, conn, payload) -> dict:
+        """Live stack dump of every thread in this worker (the reference's
+        dashboard 'Stack Trace' button shells out to py-spy on the worker
+        pid — reporter_agent.py; in-process frames need no subprocess)."""
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        stacks = {}
+        for ident, frame in frames.items():
+            label = f"{names.get(ident, 'unknown')}-{ident}"
+            stacks[label] = "".join(traceback.format_stack(frame))
+        # Parked coroutines are invisible in thread frames — dump the io
+        # loop's asyncio tasks too (where a wedged RPC handler actually is).
+        coros = {}
+        try:
+            for task in asyncio.all_tasks():
+                tb = task.get_stack(limit=6)
+                coros[task.get_name()] = [
+                    f"{f.f_code.co_filename}:{f.f_lineno} {f.f_code.co_name}"
+                    for f in tb
+                ]
+        except Exception:
+            pass
+        return {
+            "status": "ok",
+            "pid": os.getpid(),
+            "worker_id": self.ctx.worker_id,
+            "current_task": self._main_current_task,
+            "stacks": stacks,
+            "asyncio_tasks": coros,
+        }
+
+    async def rpc_engine_debug(self, conn, payload) -> dict:
+        """Native transport state of every conn this worker's engine owns
+        (hang forensics: wq/rbuf levels reveal lost-frame desyncs)."""
+        import ctypes
+
+        from ray_tpu._private.rpc import _NativeEngine
+
+        try:
+            engine = _NativeEngine.for_running_loop()
+        except Exception as exc:
+            return {"status": "error", "error": str(exc)}
+        ids = (ctypes.c_longlong * 256)()
+        n = engine.lib.rt_list_conns(engine.handle, ids, 256)
+        conns = {}
+        for i in range(n):
+            out = (ctypes.c_longlong * 6)()
+            if engine.lib.rt_conn_debug(engine.handle, ids[i], out) == 0:
+                conns[int(ids[i])] = {
+                    "wq_len": out[0], "woff": out[1], "fd": out[2],
+                    "closed": out[3], "bytes_queued": out[4],
+                    "unparsed_rbuf": out[5],
+                }
+        return {"status": "ok", "pid": os.getpid(), "conns": conns,
+                "owners": {c: type(o).__name__
+                           for c, o in engine.owners.items()}}
+
+    async def rpc_profiler(self, conn, payload) -> dict:
+        """XLA/TPU profiler capture (SURVEY §5.1 TPU-equiv): start/stop a
+        jax.profiler trace on this worker; the trace lands in a
+        TensorBoard/Perfetto-readable directory under the session dir."""
+        action = payload.get("action")
+        try:
+            import jax
+        except Exception as exc:  # pragma: no cover - jax is baked in
+            return {"status": "error", "error": f"jax unavailable: {exc}"}
+        if action == "start":
+            if getattr(self, "_profiling_dir", None):
+                return {"status": "error", "error": "profiler already running"}
+            log_dir = payload.get("log_dir") or os.path.join(
+                os.environ.get("RAYTPU_SESSION_DIR", "/tmp"),
+                "profiles",
+                f"worker-{self.ctx.worker_id[-12:]}",
+            )
+            os.makedirs(log_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(log_dir)
+            except Exception as exc:
+                return {"status": "error", "error": str(exc)}
+            self._profiling_dir = log_dir
+            return {"status": "ok", "log_dir": log_dir}
+        if action == "stop":
+            if not getattr(self, "_profiling_dir", None):
+                return {"status": "error", "error": "profiler not running"}
+            log_dir, self._profiling_dir = self._profiling_dir, None
+            try:
+                jax.profiler.stop_trace()
+            except Exception as exc:
+                return {"status": "error", "error": str(exc)}
+            return {"status": "ok", "log_dir": log_dir}
+        return {"status": "error", "error": f"unknown action {action!r}"}
+
     async def rpc_push_task(self, conn, spec) -> dict:
         fn = await self._load_callable(spec["function_id"])
         # Resolve argument dependencies on the io loop BEFORE taking the

@@ -78,6 +78,12 @@ class DashboardHead:
     def _serve(self) -> None:
         asyncio.run(self._amain())
 
+    def stop(self) -> None:
+        loop = getattr(self, "_loop", None)
+        if loop is not None and not loop.is_closed():
+            loop.call_soon_threadsafe(self._stop_event.set)
+        self._thread.join(timeout=10)
+
     async def _amain(self) -> None:
         from aiohttp import web
 
@@ -92,14 +98,25 @@ class DashboardHead:
         app.router.add_get("/api/logs", self._logs)
         app.router.add_get("/api/logs/{name}", self._log_file)
         app.router.add_get("/api/timeline", self._timeline)
+        app.router.add_get("/api/tracing", self._tracing)
+        app.router.add_get("/api/events", self._events)
+        app.router.add_get("/api/stacks", self._stacks)
+        app.router.add_post("/api/profile", self._profile)
         app.router.add_get("/metrics", self._metrics)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
         site = web.TCPSite(runner, self.host, self.port)
         await site.start()
+        # port=0 → kernel-assigned; expose the real one for tests/clients.
+        sockets = getattr(site._server, "sockets", None) or []
+        self.bound_port = (
+            sockets[0].getsockname()[1] if sockets else self.port
+        )
+        self._loop = asyncio.get_running_loop()
+        self._stop_event = asyncio.Event()
         self._started.set()
-        while True:
-            await asyncio.sleep(3600)
+        await self._stop_event.wait()
+        await runner.cleanup()
 
     async def _index(self, request):
         from aiohttp import web
@@ -178,6 +195,97 @@ class DashboardHead:
 
         text = await asyncio.to_thread(metrics_mod.collect_prometheus_text)
         return web.Response(text=text, content_type="text/plain")
+
+    async def _tracing(self, request):
+        from aiohttp import web
+
+        from ray_tpu.util import tracing as tracing_mod
+
+        if not self.session_dir:
+            return web.json_response([])
+        spans = await asyncio.to_thread(
+            tracing_mod.read_spans, self.session_dir
+        )
+        return web.json_response(spans)
+
+    async def _events(self, request):
+        from aiohttp import web
+
+        from ray_tpu._private.event_export import read_events
+
+        if not self.session_dir:
+            return web.json_response([])
+        events = await asyncio.to_thread(
+            read_events, self.session_dir, request.query.get("source")
+        )
+        return web.json_response(events[-int(request.query.get("limit", 500)):])
+
+    @staticmethod
+    def _call_node_agent(node_id: str | None, method: str, payload: dict) -> dict:
+        """Reporter-agent routing: reach a worker through ITS node's agent.
+        Without node_id, every agent is tried until one knows the worker
+        (worker ids are cluster-unique)."""
+        from ray_tpu._private.worker import get_global_context
+
+        ctx = get_global_context()
+        if node_id:
+            nodes = state_mod.list_nodes()
+            match = next((n for n in nodes if n["node_id"] == node_id), None)
+            if match is None:
+                return {"status": "error", "error": "unknown node"}
+            agents = [tuple(match["agent_addr"])]
+        else:
+            agents = [tuple(n["agent_addr"]) for n in state_mod.list_nodes()
+                      if n.get("alive", True)]
+        last = {"status": "error", "error": "no live node agents"}
+        for addr in agents:
+            try:
+                client = ctx.io.run(ctx._client_for(addr), timeout=15)
+                last = ctx.io.run(
+                    client.call(method, payload, timeout=15), timeout=20
+                )
+            except Exception as exc:
+                # One unreachable/wedged agent must not abort the scan —
+                # the worker may live on the next node.
+                last = {"status": "error", "error": str(exc)}
+                continue
+            if not (last.get("status") == "error"
+                    and last.get("error") == "unknown worker"):
+                return last
+        return last
+
+    async def _stacks(self, request):
+        """GET ?worker_id=[&node_id=] — live thread stacks via the worker's
+        node agent (reference reporter_agent.py py-spy role)."""
+        from aiohttp import web
+
+        worker_id = request.query.get("worker_id", "")
+        node_id = request.query.get("node_id") or None
+        return web.json_response(
+            await asyncio.to_thread(
+                self._call_node_agent, node_id, "stack_trace_worker",
+                {"worker_id": worker_id},
+            )
+        )
+
+    async def _profile(self, request):
+        """POST {node_id?, worker_id, action: start|stop} — trigger an XLA
+        profiler capture on a worker via its node agent (SURVEY §5.1)."""
+        from aiohttp import web
+
+        payload = await request.json()
+        return web.json_response(
+            await asyncio.to_thread(
+                self._call_node_agent,
+                payload.get("node_id"),
+                "profile_worker",
+                {
+                    "worker_id": payload.get("worker_id"),
+                    "action": payload.get("action"),
+                    "log_dir": payload.get("log_dir"),
+                },
+            )
+        )
 
 
 def start_dashboard(

@@ -151,6 +151,9 @@ class ServeController:
         # reconcile loop will drain replicas; mark stop after one pass
         time.sleep(2 * RECONCILE_PERIOD_S)
         self._stopped = True
+        # Wake parked poll_update subscribers so they observe the stop now
+        # instead of riding out their full long-poll timeout.
+        self._notify_pollers()
         return "ok"
 
     # ------------------------------------------------------------------

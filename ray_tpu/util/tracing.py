@@ -1,0 +1,154 @@
+"""Distributed task tracing — OpenTelemetry-style spans without the SDK.
+
+Role-equivalent of the reference's opt-in OTel integration
+(python/ray/util/tracing/tracing_helper.py, SURVEY §5.1): when
+``RAY_TPU_tracing_enabled=1``, task submission and execution are wrapped
+in spans whose context (trace_id, span_id) propagates inside the TaskSpec
+— a driver's submit span becomes the parent of the worker's execute span,
+across processes.
+
+The exporter is a per-process JSONL file under
+``<session_dir>/tracing/spans-<pid>.jsonl`` (the OTel span JSON shape:
+name, trace_id, span_id, parent_id, start/end unix-nanos, attributes).
+No opentelemetry dependency: the wire model is small enough to own, and
+an environment with the SDK installed can lift these records into any
+OTLP pipeline verbatim.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import glob
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+from ray_tpu._private.config import global_config
+
+_current: contextvars.ContextVar[tuple[str, str] | None] = contextvars.ContextVar(
+    "raytpu_trace_ctx", default=None
+)
+_lock = threading.Lock()
+_dir: str | None = None
+
+
+def enabled() -> bool:
+    return bool(getattr(global_config(), "tracing_enabled", False))
+
+
+def configure(session_dir: str | None) -> None:
+    """Set the export directory (driver: from init; workers: from env)."""
+    global _dir
+    if session_dir:
+        _dir = os.path.join(session_dir, "tracing")
+
+
+def _export_path() -> str | None:
+    base = _dir or (
+        os.path.join(os.environ["RAYTPU_SESSION_DIR"], "tracing")
+        if "RAYTPU_SESSION_DIR" in os.environ
+        else None
+    )
+    if base is None:
+        return None
+    os.makedirs(base, exist_ok=True)
+    return os.path.join(base, f"spans-{os.getpid()}.jsonl")
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None = None
+    start_ns: int = 0
+    end_ns: int = 0
+    attributes: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "attributes": self.attributes,
+        }
+
+
+def _record(span: Span) -> None:
+    path = _export_path()
+    if path is None:
+        return
+    line = json.dumps(span.to_json())
+    with _lock:
+        with open(path, "a") as fh:
+            fh.write(line + "\n")
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    parent: tuple[str, str] | dict | None = None,
+    **attributes: Any,
+) -> Iterator[Span | None]:
+    """Open a span. ``parent`` may be an injected dict from a TaskSpec, an
+    explicit (trace_id, span_id) tuple, or None (inherit the contextvar /
+    start a new trace)."""
+    if not enabled():
+        yield None
+        return
+    if isinstance(parent, dict):
+        parent_ctx = (parent["trace_id"], parent["span_id"])
+    elif parent is not None:
+        parent_ctx = parent
+    else:
+        parent_ctx = _current.get()
+    trace_id = parent_ctx[0] if parent_ctx else os.urandom(16).hex()
+    record = Span(
+        name=name,
+        trace_id=trace_id,
+        span_id=os.urandom(8).hex(),
+        parent_id=parent_ctx[1] if parent_ctx else None,
+        start_ns=time.time_ns(),
+        attributes=dict(attributes),
+    )
+    token = _current.set((trace_id, record.span_id))
+    try:
+        yield record
+    finally:
+        _current.reset(token)
+        record.end_ns = time.time_ns()
+        _record(record)
+
+
+def inject() -> dict | None:
+    """Current span context as a TaskSpec-embeddable dict."""
+    if not enabled():
+        return None
+    ctx = _current.get()
+    if ctx is None:
+        return None
+    return {"trace_id": ctx[0], "span_id": ctx[1]}
+
+
+def read_spans(session_dir: str) -> list[dict]:
+    """All spans exported under a session (tests + dashboard route)."""
+    out: list[dict] = []
+    for path in sorted(
+        glob.glob(os.path.join(session_dir, "tracing", "spans-*.jsonl"))
+    ):
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+        except OSError:
+            continue
+    return out
