@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Build + run the native test binary under ASAN and TSAN.
+#
+# Role-equivalent of the reference's bazel --config=asan / --config=tsan
+# CI pipelines over its C++ gtest suites (SURVEY §5.2): every native
+# component (epoll RPC engine, shm object store) gets exercised under both
+# sanitizers on every CI run. Usage: ci/sanitize.sh [address|thread|all]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SOURCES="src/object_store/store.cc src/rpc/transport.cc src/test/native_tests.cc"
+MODES="${1:-all}"
+[ "$MODES" = "all" ] && MODES="address thread"
+
+mkdir -p build
+for mode in $MODES; do
+  out="build/native_tests_${mode}"
+  echo "== building (${mode} sanitizer) =="
+  g++ -std=c++17 -g -O1 -fsanitize="${mode}" -fno-omit-frame-pointer \
+      -pthread ${SOURCES} -o "${out}"
+  echo "== running (${mode} sanitizer) =="
+  if [ "$mode" = "thread" ]; then
+    TSAN_OPTIONS="halt_on_error=1" "./${out}"
+  else
+    ASAN_OPTIONS="detect_leaks=1" "./${out}"
+  fi
+done
+echo "sanitizer suite: PASS"

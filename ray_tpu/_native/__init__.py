@@ -96,6 +96,16 @@ def load() -> ctypes.CDLL:
             lib.rt_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
             lib.rt_next.restype = ctypes.c_int
             lib.rt_msg_free.argtypes = [ctypes.c_void_p]
+            lib.rt_conn_debug.argtypes = [
+                ctypes.c_void_p, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_longlong),
+            ]
+            lib.rt_conn_debug.restype = ctypes.c_int
+            lib.rt_list_conns.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+                ctypes.c_int,
+            ]
+            lib.rt_list_conns.restype = ctypes.c_int
             _lib = lib
     return _lib
 

@@ -20,6 +20,11 @@ SCRIPTS = [
 
 def main():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # --smoke: CI-sized runs — each benchmark script honors
+    # RAY_TPU_RELEASE_SMOKE by shrinking its workload to a health check.
+    env = dict(os.environ)
+    if "--smoke" in sys.argv[1:]:
+        env["RAY_TPU_RELEASE_SMOKE"] = "1"
     results = []
     for script in SCRIPTS:
         print(f"== {script}", file=sys.stderr)
@@ -29,6 +34,7 @@ def main():
             text=True,
             timeout=3600,
             cwd=repo,
+            env=env,
         )
         line = next(
             (l for l in reversed(proc.stdout.splitlines())

@@ -663,7 +663,8 @@ class StoreServer {
   uint8_t *base_ = nullptr;
   int shm_fd_ = -1;
   int listen_fd_ = -1;
-  bool running_ = false;
+  // Written by Stop() (any thread), read by the poll + prefault loops.
+  std::atomic<bool> running_{false};
   std::thread thread_;
   std::unordered_map<int, Conn> conns_;
   std::unordered_map<std::string, ObjectEntry> objects_;

@@ -211,6 +211,34 @@ class Engine {
   }
 
   // Build + send a frame. Returns 0 on success, <0 on error.
+  int ConnDebug(long conn_id, long long *out) {
+    auto conn = Lookup(conn_id);
+    if (!conn) return -1;
+    std::lock_guard<std::mutex> wlock(conn->wmu);
+    out[0] = (long long)conn->wq.size();
+    out[1] = (long long)conn->woff;
+    out[2] = (long long)conn->fd;
+    out[3] = conn->closed ? 1 : 0;
+    long long bytes = 0;
+    for (auto &f : conn->wq) bytes += (long long)f.size();
+    out[4] = bytes;
+    // Unparsed inbound bytes: nonzero at idle means a framing desync —
+    // ParseFrames is waiting on a frame length that will never arrive.
+    out[5] = (long long)(conn->rbuf.size() - conn->rstart);
+    return 0;
+  }
+
+  // All live conn ids (debug).
+  int ListConns(long long *out, int cap) {
+    std::lock_guard<std::mutex> lock(mu_);
+    int n = 0;
+    for (auto &kv : conns_) {
+      if (n >= cap) break;
+      out[n++] = kv.first;
+    }
+    return n;
+  }
+
   int Send(long conn_id, uint8_t kind, uint32_t msgid, const uint8_t *method,
            uint32_t mlen, const uint8_t *payload, uint32_t plen) {
     if (mlen > 0xFFFF) return -EINVAL;
@@ -610,6 +638,18 @@ int rt_send(void *e, long conn, uint8_t kind, uint32_t msgid,
 
 void rt_close_conn(void *e, long conn) {
   static_cast<raytpu::rpc::Engine *>(e)->CloseConn(conn);
+}
+
+// Debug probe (hang forensics): out[0]=wq_len out[1]=woff out[2]=fd
+// out[3]=closed out[4]=bytes_queued out[5]=unparsed_rbuf_bytes.
+// Returns 0, or -1 if conn unknown. rbuf fields are read without the
+// engine-thread's ownership — debug-only, values may be torn.
+int rt_conn_debug(void *e, long conn, long long *out) {
+  return static_cast<raytpu::rpc::Engine *>(e)->ConnDebug(conn, out);
+}
+
+int rt_list_conns(void *e, long long *out, int cap) {
+  return static_cast<raytpu::rpc::Engine *>(e)->ListConns(out, cap);
 }
 
 int rt_next(void *e, rt_msg_view *out) {
