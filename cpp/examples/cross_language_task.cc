@@ -1,0 +1,60 @@
+// Example: drive a ray_tpu cluster from C++ (reference N32 role).
+//
+//   cross_language_task <controller_host> <controller_port>
+//
+// Exercises KV put/get, cluster state, and a cross-language task calling
+// a Python function by module-qualified name with msgpack args. Prints
+// one result line per capability; exits nonzero on any failure.
+
+#include <cstdio>
+#include <string>
+
+#include "raytpu/client.h"
+
+int main(int argc, char **argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <controller_host> <controller_port>\n",
+                 argv[0]);
+    return 2;
+  }
+  try {
+    raytpu::Client client;
+    client.Connect(argv[1], std::atoi(argv[2]));
+
+    client.KvPut("cpp-test", "greeting", "hello from c++");
+    std::string stored;
+    if (!client.KvGet("cpp-test", "greeting", &stored) ||
+        stored != "hello from c++") {
+      std::fprintf(stderr, "kv round-trip mismatch\n");
+      return 1;
+    }
+    std::printf("kv: %s\n", stored.c_str());
+
+    auto resources = client.ClusterResources();
+    std::printf("cluster CPU: %.1f\n", resources["CPU"]);
+
+    // math:hypot — any importable module-qualified function works.
+    raytpu::Value result = client.SubmitTask(
+        "math:hypot",
+        {raytpu::Value::number(3.0), raytpu::Value::number(4.0)});
+    std::printf("task math:hypot(3,4) = %.1f\n", result.d);
+    if (result.d != 5.0) {
+      std::fprintf(stderr, "unexpected task result\n");
+      return 1;
+    }
+
+    // Error propagation: a missing attribute must raise with a traceback.
+    try {
+      client.SubmitTask("math:not_a_function", {});
+      std::fprintf(stderr, "expected failure did not raise\n");
+      return 1;
+    } catch (const std::exception &err) {
+      std::printf("error propagation: ok\n");
+    }
+    std::printf("CPP CLIENT: ALL OK\n");
+    return 0;
+  } catch (const std::exception &err) {
+    std::fprintf(stderr, "FAILED: %s\n", err.what());
+    return 1;
+  }
+}

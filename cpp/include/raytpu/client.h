@@ -1,0 +1,103 @@
+// raytpu C++ client API — the C++ worker/driver surface (reference N32
+// role: cpp/ :: ray::Task(...).Remote(), re-scoped for the ray_tpu wire).
+//
+// Speaks wire format v1 (versioned envelope + msgpack payloads, see
+// ray_tpu/_private/rpc.py) over blocking TCP. Capabilities:
+//   * control-plane RPCs: KV put/get, cluster state queries
+//   * cross-language tasks: submit a module-qualified Python function
+//     ("pkg.module:attr") with plain msgpack args; the worker replies
+//     with msgpack values — no Python pickle anywhere on the path.
+//
+// Cross-language calling matches the reference's Java→Python convention
+// (function named by qualified name, simple-type args).
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace raytpu {
+
+// Minimal msgpack value model — exactly what the wire payloads need.
+struct Value {
+  enum class Type { Nil, Bool, Int, Double, Str, Bin, Array, Map };
+  Type type = Type::Nil;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string s;                 // Str and Bin share storage
+  std::vector<Value> array;
+  std::map<std::string, Value> map;  // string-keyed maps only
+
+  static Value nil();
+  static Value boolean(bool v);
+  static Value integer(int64_t v);
+  static Value number(double v);
+  static Value str(std::string v);
+  static Value bin(std::string v);
+  static Value arr(std::vector<Value> v);
+  static Value obj(std::map<std::string, Value> v);
+
+  bool is_nil() const { return type == Type::Nil; }
+  int64_t as_int(int64_t fallback = 0) const;
+  std::string as_str(const std::string &fallback = "") const;
+  const Value *get(const std::string &key) const;  // map lookup or nullptr
+};
+
+std::string msgpack_encode(const Value &value);
+// Throws std::runtime_error on malformed input.
+Value msgpack_decode(const std::string &raw);
+
+// One blocking connection speaking the framed RPC protocol.
+class Connection {
+ public:
+  Connection() = default;
+  ~Connection();
+  Connection(const Connection &) = delete;
+  Connection &operator=(const Connection &) = delete;
+
+  // Throws std::runtime_error on failure.
+  void Connect(const std::string &host, int port);
+  void Close();
+  bool connected() const { return fd_ >= 0; }
+
+  // Synchronous request/reply. Throws on transport error or ERR reply.
+  Value Call(const std::string &method, const Value &payload);
+
+ private:
+  int fd_ = -1;
+  uint32_t next_msgid_ = 1;
+};
+
+// High-level client: controller + on-demand agent/worker connections.
+class Client {
+ public:
+  // Controller address, e.g. ("127.0.0.1", 6380).
+  void Connect(const std::string &host, int port);
+
+  // Internal KV (GCS KV role).
+  void KvPut(const std::string &ns, const std::string &key,
+             const std::string &value);
+  // Returns false if the key is absent.
+  bool KvGet(const std::string &ns, const std::string &key,
+             std::string *value_out);
+
+  // {resource: total} for the cluster.
+  std::map<std::string, double> ClusterResources();
+
+  // Submit fn_ref ("pkg.module:attr") with msgpack args to a leased
+  // worker; blocks for the result. Throws std::runtime_error with the
+  // remote traceback on task failure.
+  Value SubmitTask(const std::string &fn_ref, const std::vector<Value> &args,
+                   double num_cpus = 1.0);
+
+ private:
+  Connection controller_;
+  std::string job_id_ = "job-cpp-client";
+  int task_counter_ = 0;
+};
+
+}  // namespace raytpu

@@ -1,0 +1,500 @@
+// raytpu C++ client implementation — see include/raytpu/client.h.
+
+#include "raytpu/client.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <stdexcept>
+
+namespace raytpu {
+
+// ---------------------------------------------------------------------------
+// Value helpers
+// ---------------------------------------------------------------------------
+Value Value::nil() { return Value{}; }
+Value Value::boolean(bool v) {
+  Value out; out.type = Type::Bool; out.b = v; return out;
+}
+Value Value::integer(int64_t v) {
+  Value out; out.type = Type::Int; out.i = v; return out;
+}
+Value Value::number(double v) {
+  Value out; out.type = Type::Double; out.d = v; return out;
+}
+Value Value::str(std::string v) {
+  Value out; out.type = Type::Str; out.s = std::move(v); return out;
+}
+Value Value::bin(std::string v) {
+  Value out; out.type = Type::Bin; out.s = std::move(v); return out;
+}
+Value Value::arr(std::vector<Value> v) {
+  Value out; out.type = Type::Array; out.array = std::move(v); return out;
+}
+Value Value::obj(std::map<std::string, Value> v) {
+  Value out; out.type = Type::Map; out.map = std::move(v); return out;
+}
+
+int64_t Value::as_int(int64_t fallback) const {
+  if (type == Type::Int) return i;
+  if (type == Type::Double) return int64_t(d);
+  return fallback;
+}
+
+std::string Value::as_str(const std::string &fallback) const {
+  if (type == Type::Str || type == Type::Bin) return s;
+  return fallback;
+}
+
+const Value *Value::get(const std::string &key) const {
+  if (type != Type::Map) return nullptr;
+  auto it = map.find(key);
+  return it == map.end() ? nullptr : &it->second;
+}
+
+// ---------------------------------------------------------------------------
+// msgpack encode (subset: the types our payloads use)
+// ---------------------------------------------------------------------------
+namespace {
+
+void put_u16(std::string &out, uint16_t v) {
+  out.push_back(char(v >> 8)); out.push_back(char(v));
+}
+void put_u32(std::string &out, uint32_t v) {
+  out.push_back(char(v >> 24)); out.push_back(char(v >> 16));
+  out.push_back(char(v >> 8)); out.push_back(char(v));
+}
+void put_u64(std::string &out, uint64_t v) {
+  for (int shift = 56; shift >= 0; shift -= 8) out.push_back(char(v >> shift));
+}
+
+void encode_into(const Value &value, std::string &out) {
+  switch (value.type) {
+    case Value::Type::Nil:
+      out.push_back(char(0xc0));
+      break;
+    case Value::Type::Bool:
+      out.push_back(char(value.b ? 0xc3 : 0xc2));
+      break;
+    case Value::Type::Int: {
+      int64_t v = value.i;
+      if (v >= 0 && v < 128) {
+        out.push_back(char(v));
+      } else if (v < 0 && v >= -32) {
+        out.push_back(char(0xe0 | (v + 32)));
+      } else {
+        out.push_back(char(0xd3));  // int64
+        put_u64(out, uint64_t(v));
+      }
+      break;
+    }
+    case Value::Type::Double: {
+      out.push_back(char(0xcb));
+      uint64_t bits;
+      std::memcpy(&bits, &value.d, 8);
+      put_u64(out, bits);
+      break;
+    }
+    case Value::Type::Str: {
+      size_t n = value.s.size();
+      if (n < 32) {
+        out.push_back(char(0xa0 | n));
+      } else if (n < 256) {
+        out.push_back(char(0xd9)); out.push_back(char(n));
+      } else if (n < 65536) {
+        out.push_back(char(0xda)); put_u16(out, uint16_t(n));
+      } else {
+        out.push_back(char(0xdb)); put_u32(out, uint32_t(n));
+      }
+      out += value.s;
+      break;
+    }
+    case Value::Type::Bin: {
+      size_t n = value.s.size();
+      if (n < 256) {
+        out.push_back(char(0xc4)); out.push_back(char(n));
+      } else if (n < 65536) {
+        out.push_back(char(0xc5)); put_u16(out, uint16_t(n));
+      } else {
+        out.push_back(char(0xc6)); put_u32(out, uint32_t(n));
+      }
+      out += value.s;
+      break;
+    }
+    case Value::Type::Array: {
+      size_t n = value.array.size();
+      if (n < 16) {
+        out.push_back(char(0x90 | n));
+      } else {
+        out.push_back(char(0xdc)); put_u16(out, uint16_t(n));
+      }
+      for (const auto &item : value.array) encode_into(item, out);
+      break;
+    }
+    case Value::Type::Map: {
+      size_t n = value.map.size();
+      if (n < 16) {
+        out.push_back(char(0x80 | n));
+      } else {
+        out.push_back(char(0xde)); put_u16(out, uint16_t(n));
+      }
+      for (const auto &kv : value.map) {
+        encode_into(Value::str(kv.first), out);
+        encode_into(kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// msgpack decode
+// ---------------------------------------------------------------------------
+struct Reader {
+  const uint8_t *data;
+  size_t size;
+  size_t pos = 0;
+
+  uint8_t u8() {
+    require(1);
+    return data[pos++];
+  }
+  uint16_t u16() { require(2); uint16_t v = (uint16_t(data[pos]) << 8) | data[pos + 1]; pos += 2; return v; }
+  uint32_t u32() {
+    require(4);
+    uint32_t v = 0;
+    for (int k = 0; k < 4; ++k) v = (v << 8) | data[pos + k];
+    pos += 4;
+    return v;
+  }
+  uint64_t u64() {
+    require(8);
+    uint64_t v = 0;
+    for (int k = 0; k < 8; ++k) v = (v << 8) | data[pos + k];
+    pos += 8;
+    return v;
+  }
+  std::string bytes(size_t n) {
+    require(n);
+    std::string out(reinterpret_cast<const char *>(data + pos), n);
+    pos += n;
+    return out;
+  }
+  void require(size_t n) {
+    if (pos + n > size) throw std::runtime_error("msgpack: truncated");
+  }
+};
+
+Value decode_value(Reader &r) {
+  uint8_t tag = r.u8();
+  if (tag < 0x80) return Value::integer(tag);             // positive fixint
+  if (tag >= 0xe0) return Value::integer(int8_t(tag));    // negative fixint
+  if ((tag & 0xf0) == 0x90) {                             // fixarray
+    std::vector<Value> items(tag & 0x0f);
+    for (auto &item : items) item = decode_value(r);
+    return Value::arr(std::move(items));
+  }
+  if ((tag & 0xf0) == 0x80) {                             // fixmap
+    std::map<std::string, Value> out;
+    for (int k = 0; k < (tag & 0x0f); ++k) {
+      Value key = decode_value(r);
+      out[key.as_str()] = decode_value(r);
+    }
+    return Value::obj(std::move(out));
+  }
+  if ((tag & 0xe0) == 0xa0) return Value::str(r.bytes(tag & 0x1f));  // fixstr
+  switch (tag) {
+    case 0xc0: return Value::nil();
+    case 0xc2: return Value::boolean(false);
+    case 0xc3: return Value::boolean(true);
+    case 0xc4: return Value::bin(r.bytes(r.u8()));
+    case 0xc5: return Value::bin(r.bytes(r.u16()));
+    case 0xc6: return Value::bin(r.bytes(r.u32()));
+    case 0xca: {  // float32
+      uint32_t bits = r.u32();
+      float f;
+      std::memcpy(&f, &bits, 4);
+      return Value::number(double(f));
+    }
+    case 0xcb: {  // float64
+      uint64_t bits = r.u64();
+      double d;
+      std::memcpy(&d, &bits, 8);
+      return Value::number(d);
+    }
+    case 0xcc: return Value::integer(r.u8());
+    case 0xcd: return Value::integer(r.u16());
+    case 0xce: return Value::integer(r.u32());
+    case 0xcf: return Value::integer(int64_t(r.u64()));
+    case 0xd0: return Value::integer(int8_t(r.u8()));
+    case 0xd1: return Value::integer(int16_t(r.u16()));
+    case 0xd2: return Value::integer(int32_t(r.u32()));
+    case 0xd3: return Value::integer(int64_t(r.u64()));
+    case 0xd9: return Value::str(r.bytes(r.u8()));
+    case 0xda: return Value::str(r.bytes(r.u16()));
+    case 0xdb: return Value::str(r.bytes(r.u32()));
+    case 0xdc: {
+      size_t n = r.u16();
+      std::vector<Value> items(n);
+      for (auto &item : items) item = decode_value(r);
+      return Value::arr(std::move(items));
+    }
+    case 0xde: {
+      size_t n = r.u16();
+      std::map<std::string, Value> out;
+      for (size_t k = 0; k < n; ++k) {
+        Value key = decode_value(r);
+        out[key.as_str()] = decode_value(r);
+      }
+      return Value::obj(std::move(out));
+    }
+    case 0xdf: {
+      size_t n = r.u32();
+      std::map<std::string, Value> out;
+      for (size_t k = 0; k < n; ++k) {
+        Value key = decode_value(r);
+        out[key.as_str()] = decode_value(r);
+      }
+      return Value::obj(std::move(out));
+    }
+    default:
+      throw std::runtime_error("msgpack: unsupported tag");
+  }
+}
+
+void write_all(int fd, const char *data, size_t n) {
+  while (n > 0) {
+    ssize_t written = ::send(fd, data, n, MSG_NOSIGNAL);
+    if (written <= 0) throw std::runtime_error("raytpu: send failed");
+    data += written;
+    n -= size_t(written);
+  }
+}
+
+void read_all(int fd, char *data, size_t n) {
+  while (n > 0) {
+    ssize_t got = ::read(fd, data, n);
+    if (got <= 0) throw std::runtime_error("raytpu: connection closed");
+    data += got;
+    n -= size_t(got);
+  }
+}
+
+}  // namespace
+
+std::string msgpack_encode(const Value &value) {
+  std::string out;
+  encode_into(value, out);
+  return out;
+}
+
+Value msgpack_decode(const std::string &raw) {
+  Reader r{reinterpret_cast<const uint8_t *>(raw.data()), raw.size()};
+  return decode_value(r);
+}
+
+// ---------------------------------------------------------------------------
+// Connection — wire format v1 framing
+// ---------------------------------------------------------------------------
+Connection::~Connection() { Close(); }
+
+void Connection::Connect(const std::string &host, int port) {
+  Close();
+  fd_ = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) throw std::runtime_error("raytpu: socket() failed");
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    Close();
+    throw std::runtime_error("raytpu: bad host " + host);
+  }
+  if (connect(fd_, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) != 0) {
+    Close();
+    throw std::runtime_error("raytpu: connect failed to " + host + ":" +
+                             std::to_string(port));
+  }
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+void Connection::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Value Connection::Call(const std::string &method, const Value &payload) {
+  if (fd_ < 0) throw std::runtime_error("raytpu: not connected");
+  constexpr uint8_t kVersion = 1, kReq = 0, kRep = 1, kErr = 2, kPush = 3;
+  std::string body;
+  uint32_t msgid = next_msgid_++;
+  body.push_back(char(kVersion));
+  body.push_back(char(kReq));
+  // msgid + method_len are little-endian on this wire (struct '<I','<H').
+  for (int shift = 0; shift < 32; shift += 8) body.push_back(char(msgid >> shift));
+  uint16_t mlen = uint16_t(method.size());
+  body.push_back(char(mlen & 0xff));
+  body.push_back(char(mlen >> 8));
+  body += method;
+  body += msgpack_encode(payload);
+  std::string frame;
+  uint32_t len = uint32_t(body.size());
+  for (int shift = 0; shift < 32; shift += 8) frame.push_back(char(len >> shift));
+  frame += body;
+  write_all(fd_, frame.data(), frame.size());
+
+  while (true) {
+    char head[4];
+    read_all(fd_, head, 4);
+    uint32_t rlen = 0;
+    for (int k = 3; k >= 0; --k) rlen = (rlen << 8) | uint8_t(head[k]);
+    std::string rbody(rlen, '\0');
+    read_all(fd_, rbody.data(), rlen);
+    if (rlen < 8) throw std::runtime_error("raytpu: short frame");
+    uint8_t kind = uint8_t(rbody[1]);
+    uint32_t rid = 0;
+    for (int k = 5; k >= 2; --k) rid = (rid << 8) | uint8_t(rbody[k]);
+    uint16_t rmlen = uint16_t(uint8_t(rbody[6])) |
+                     (uint16_t(uint8_t(rbody[7])) << 8);
+    std::string rpayload = rbody.substr(8 + rmlen);
+    if (kind == kPush) continue;  // unsolicited pubsub — ignore
+    if (rid != msgid) continue;   // stale reply (shouldn't happen: sync use)
+    Value decoded = rpayload.empty() ? Value::nil() : msgpack_decode(rpayload);
+    if (kind == kErr) {
+      throw std::runtime_error("raytpu remote error in " + method + ":\n" +
+                               decoded.as_str("<no traceback>"));
+    }
+    if (kind != kRep) throw std::runtime_error("raytpu: unexpected kind");
+    return decoded;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+void Client::Connect(const std::string &host, int port) {
+  controller_.Connect(host, port);
+}
+
+void Client::KvPut(const std::string &ns, const std::string &key,
+                   const std::string &value) {
+  controller_.Call("kv_put", Value::obj({
+      {"namespace", Value::str(ns)},
+      {"key", Value::str(key)},
+      {"value", Value::bin(value)},
+      {"overwrite", Value::boolean(true)},
+  }));
+}
+
+bool Client::KvGet(const std::string &ns, const std::string &key,
+                   std::string *value_out) {
+  Value reply = controller_.Call("kv_get", Value::obj({
+      {"namespace", Value::str(ns)},
+      {"key", Value::str(key)},
+  }));
+  const Value *status = reply.get("status");
+  if (status == nullptr || status->as_str() != "ok") return false;
+  const Value *value = reply.get("value");
+  if (value_out != nullptr && value != nullptr) *value_out = value->as_str();
+  return true;
+}
+
+std::map<std::string, double> Client::ClusterResources() {
+  Value reply = controller_.Call("cluster_resources", Value::obj({}));
+  std::map<std::string, double> out;
+  if (reply.type == Value::Type::Map) {
+    for (const auto &kv : reply.map) {
+      out[kv.first] = kv.second.type == Value::Type::Double
+                          ? kv.second.d
+                          : double(kv.second.as_int());
+    }
+  }
+  return out;
+}
+
+Value Client::SubmitTask(const std::string &fn_ref,
+                         const std::vector<Value> &args, double num_cpus) {
+  Value resources = Value::obj({{"CPU", Value::number(num_cpus)}});
+  Value lease_hint = controller_.Call("request_lease", Value::obj({
+      {"resources", resources},
+      {"job_id", Value::str(job_id_)},
+      {"submitter_node", Value::str("")},
+      {"scheduling_strategy", Value::nil()},
+  }));
+  if (lease_hint.get("status") == nullptr ||
+      lease_hint.get("status")->as_str() != "ok") {
+    throw std::runtime_error("raytpu: lease request failed: " +
+                             (lease_hint.get("status")
+                                  ? lease_hint.get("status")->as_str()
+                                  : "<no status>"));
+  }
+  const Value *agent_addr = lease_hint.get("agent_addr");
+  if (agent_addr == nullptr || agent_addr->array.size() != 2) {
+    throw std::runtime_error("raytpu: malformed agent_addr");
+  }
+  Connection agent;
+  agent.Connect(agent_addr->array[0].as_str(),
+                int(agent_addr->array[1].as_int()));
+  Value lease = agent.Call("lease_worker", Value::obj({
+      {"resources", resources},
+      {"runtime_env", Value::obj({})},
+      {"job_id", Value::str(job_id_)},
+      {"bundle", Value::nil()},
+  }));
+  if (lease.get("status") == nullptr ||
+      lease.get("status")->as_str() != "ok") {
+    throw std::runtime_error("raytpu: worker lease failed");
+  }
+  const Value *worker_addr = lease.get("worker_addr");
+  std::string lease_id = lease.get("lease_id")->as_str();
+  Connection worker;
+  worker.Connect(worker_addr->array[0].as_str(),
+                 int(worker_addr->array[1].as_int()));
+
+  std::string task_id =
+      "tsk-cpp-" + std::to_string(++task_counter_);
+  std::vector<Value> arg_list(args);
+  Value spec = Value::obj({
+      {"task_id", Value::str(task_id)},
+      {"job_id", Value::str(job_id_)},
+      {"cross_language", Value::boolean(true)},
+      {"function_ref", Value::str(fn_ref)},
+      {"name", Value::str(fn_ref)},
+      {"args", Value::bin(msgpack_encode(Value::arr(std::move(arg_list))))},
+      {"num_returns", Value::integer(1)},
+      {"resources", resources},
+      {"owner", Value::obj({{"worker_id", Value::str("cpp-client")},
+                            {"address", Value::arr({Value::str(""),
+                                                    Value::integer(0)})}})},
+      {"runtime_env", Value::obj({})},
+      {"max_retries", Value::integer(0)},
+      {"retry_exceptions", Value::boolean(false)},
+  });
+  Value reply = worker.Call("push_task", spec);
+  // Hand the lease back so the worker returns to the agent's idle pool.
+  try {
+    agent.Call("return_worker",
+               Value::obj({{"lease_id", Value::str(lease_id)}}));
+  } catch (const std::exception &) {
+  }
+  const Value *status = reply.get("status");
+  if (status == nullptr || status->as_str() != "ok") {
+    const Value *error_text = reply.get("error_text");
+    throw std::runtime_error(
+        "raytpu task failed: " +
+        (error_text ? error_text->as_str() : std::string("<no detail>")));
+  }
+  const Value *returns = reply.get("returns");
+  if (returns == nullptr || returns->array.empty()) return Value::nil();
+  const Value *data = returns->array[0].get("data");
+  if (data == nullptr) return Value::nil();
+  return msgpack_decode(data->s);
+}
+
+}  // namespace raytpu

@@ -428,6 +428,13 @@ class WorkerRuntime:
         return {"status": "error", "error": f"unknown action {action!r}"}
 
     async def rpc_push_task(self, conn, spec) -> dict:
+        if spec.get("cross_language"):
+            # Cross-language call (C++ worker API, reference N32 role /
+            # Ray's Java→Python convention): the function is named by a
+            # module-qualified ref ("pkg.module:attr"), args are plain
+            # msgpack values, and returns go back inline as msgpack so a
+            # non-Python caller can decode them.
+            return await self._run_cross_language(spec)
         fn = await self._load_callable(spec["function_id"])
         # Resolve argument dependencies on the io loop BEFORE taking the
         # main execution lane (reference: dependency resolution precedes
@@ -447,6 +454,50 @@ class WorkerRuntime:
         return await self._run_on_main(
             lambda: self._execute(spec, fn, False, preresolved)
         )
+
+    async def _run_cross_language(self, spec: dict) -> dict:
+        """Execute a cross-language task: import ``module:attr``, call with
+        msgpack args, reply with msgpack values (no pickle on the wire, so
+        any language speaking the wire format can drive it)."""
+        import importlib
+
+        import msgpack
+
+        name = spec.get("name", spec.get("function_ref", "xlang-task"))
+        try:
+            module_name, _, attr = spec["function_ref"].partition(":")
+            if not module_name or not attr:
+                raise ValueError(
+                    f"function_ref must be 'module:attr', got "
+                    f"{spec['function_ref']!r}"
+                )
+            module = importlib.import_module(module_name)
+            fn = module
+            for part in attr.split("."):
+                fn = getattr(fn, part)
+            args = msgpack.unpackb(spec["args"], raw=False) or []
+            self._record_task_event(spec, "RUNNING")
+            # Main execution lane, like every normal task: a 1-slot worker
+            # must not run a cross-language task concurrently with a
+            # Python task. (Cancellation of cross-language tasks is not
+            # supported yet — no _running_exec registration.)
+            value = await self._run_on_main(lambda: fn(*args))
+            num_returns = spec.get("num_returns", 1)
+            values = [value] if num_returns == 1 else list(value)
+            self._record_task_event(spec, "FINISHED")
+            return {
+                "status": "ok",
+                "returns": [
+                    {"kind": "msgpack", "data": msgpack.packb(v)}
+                    for v in values
+                ],
+            }
+        except Exception:
+            self._record_task_event(spec, "FAILED")
+            return {
+                "status": "error",
+                "error_text": f"{name}: {traceback.format_exc()}",
+            }
 
     async def _resolve_args_async(self, payload) -> tuple[tuple, dict]:
         """Async twin of _resolve_args: awaits top-level ObjectRef args on

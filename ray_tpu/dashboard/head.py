@@ -31,31 +31,84 @@ _INDEX_HTML = """<!doctype html>
  td, th { border: 1px solid #ccc; padding: 4px 10px; font-size: 0.85rem; }
  th { background: #f4f4f4; text-align: left; }
  code { background: #f4f4f4; padding: 1px 4px; }
+ nav a { margin-right: 1rem; }
+ .muted { color: #888; font-size: 0.8rem; }
 </style></head>
 <body>
 <h1>ray_tpu dashboard</h1>
+<nav>
+ <a href="#" onclick="view='overview';refresh();return false">overview</a>
+ <a href="#" onclick="view='tasks';refresh();return false">tasks</a>
+ <a href="#" onclick="view='jobs';refresh();return false">jobs</a>
+ <a href="#" onclick="view='events';refresh();return false">events</a>
+ <a href="/api/timeline">timeline</a>
+ <a href="/metrics">metrics</a>
+</nav>
 <div id="content">loading…</div>
 <script>
-async function refresh() {
-  const [cluster, nodes, actors] = await Promise.all([
+let view = 'overview';
+function esc(s) {
+  return String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;').replace(/>/g,'&gt;');
+}
+function table(headers, rows) {
+  let h = '<table><tr>' + headers.map(x => `<th>${esc(x)}</th>`).join('') + '</tr>';
+  for (const r of rows) h += '<tr>' + r.map(x => `<td>${x}</td>`).join('') + '</tr>';
+  return h + '</table>';
+}
+async function overview() {
+  const [cluster, nodes, actors, pgs] = await Promise.all([
     fetch('/api/cluster').then(r => r.json()),
     fetch('/api/nodes').then(r => r.json()),
     fetch('/api/actors').then(r => r.json()),
+    fetch('/api/placement_groups').then(r => r.json()),
   ]);
-  let html = '<h2>Cluster resources</h2><table><tr><th>resource</th><th>available</th><th>total</th></tr>';
-  for (const k of Object.keys(cluster.total)) {
-    html += `<tr><td>${k}</td><td>${cluster.available[k] ?? 0}</td><td>${cluster.total[k]}</td></tr>`;
-  }
-  html += '</table><h2>Nodes</h2><table><tr><th>node</th><th>alive</th><th>resources</th></tr>';
-  for (const n of nodes) {
-    html += `<tr><td><code>${n.node_id}</code></td><td>${n.alive}</td><td>${JSON.stringify(n.resources_total)}</td></tr>`;
-  }
-  html += '</table><h2>Actors</h2><table><tr><th>actor</th><th>class</th><th>state</th><th>node</th></tr>';
-  for (const a of actors) {
-    html += `<tr><td><code>${a.actor_id}</code></td><td>${a.class_name ?? ''}</td><td>${a.state}</td><td><code>${a.node_id ?? ''}</code></td></tr>`;
-  }
-  html += '</table>';
-  document.getElementById('content').innerHTML = html;
+  let html = '<h2>Cluster resources</h2>' + table(
+    ['resource', 'available', 'total'],
+    Object.keys(cluster.total).map(k =>
+      [esc(k), esc(cluster.available[k] ?? 0), esc(cluster.total[k])]));
+  html += '<h2>Nodes</h2>' + table(['node', 'alive', 'resources'],
+    nodes.map(n => [`<code>${esc(n.node_id)}</code>`, esc(n.alive),
+                    esc(JSON.stringify(n.resources_total))]));
+  html += '<h2>Actors</h2>' + table(['actor', 'class', 'state', 'node'],
+    actors.map(a => [`<code>${esc(a.actor_id)}</code>`, esc(a.class_name ?? ''),
+                     esc(a.state), `<code>${esc(a.node_id ?? '')}</code>`]));
+  html += '<h2>Placement groups</h2>' + table(['pg', 'state', 'bundles'],
+    pgs.map(p => [`<code>${esc(p.pg_id)}</code>`, esc(p.state),
+                  esc(JSON.stringify(p.bundles))]));
+  return html;
+}
+async function tasks() {
+  const rows = await fetch('/api/tasks').then(r => r.json());
+  const when = t => {
+    const ts = t.end_time ?? t.start_time;
+    return ts ? new Date(ts * 1000).toLocaleTimeString() : '';
+  };
+  return '<h2>Recent tasks</h2>' + table(
+    ['task', 'name', 'state', 'node', 'time'],
+    rows.slice(-200).reverse().map(t =>
+      [`<code>${esc((t.task_id ?? '').slice(-12))}</code>`, esc(t.name),
+       esc(t.state), `<code>${esc((t.node_id ?? '').slice(-8))}</code>`,
+       esc(when(t))]));
+}
+async function jobs() {
+  const rows = await fetch('/api/jobs').then(r => r.json());
+  return '<h2>Jobs</h2>' + table(['job', 'state', 'started'],
+    rows.map(j => [`<code>${esc(j.job_id)}</code>`, esc(j.state),
+                   esc(new Date(j.start_time * 1000).toLocaleString())]));
+}
+async function events() {
+  const rows = await fetch('/api/events?limit=200').then(r => r.json());
+  return '<h2>Exported events</h2><div class="muted">structured lifecycle export (events_*.jsonl)</div>' +
+    table(['time', 'source', 'data'],
+      rows.reverse().map(e =>
+        [esc(new Date(e.timestamp * 1000).toLocaleTimeString()),
+         esc(e.source_type),
+         `<code>${esc(JSON.stringify(e.data).slice(0, 140))}</code>`]));
+}
+async function refresh() {
+  const render = {overview, tasks, jobs, events}[view];
+  try { document.getElementById('content').innerHTML = await render(); }
+  catch (err) { document.getElementById('content').innerHTML = 'error: ' + esc(err); }
 }
 refresh(); setInterval(refresh, 3000);
 </script>

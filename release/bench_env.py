@@ -27,3 +27,14 @@ def force_cpu(devices: int = 8) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+
+
+def smoke() -> bool:
+    """True when the runner asked for CI-sized workloads
+    (release/run_all.py --smoke sets RAY_TPU_RELEASE_SMOKE=1)."""
+    return bool(os.environ.get("RAY_TPU_RELEASE_SMOKE"))
+
+
+def smoke_scale(full: int, small: int) -> int:
+    """Pick a workload size: ``full`` normally, ``small`` under --smoke."""
+    return small if smoke() else full

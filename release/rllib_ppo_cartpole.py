@@ -19,6 +19,9 @@ import time
 
 
 def main(target_return: float = 150.0, max_iters: int = 20):
+    import bench_env
+    if bench_env.smoke():
+        target_return, max_iters = 40.0, 4
     import numpy as np
 
     import ray_tpu

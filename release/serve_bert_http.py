@@ -19,6 +19,9 @@ import time
 
 
 def main(tiny: bool = True, seconds: float = 8.0, concurrency: int = 16):
+    import bench_env
+    if bench_env.smoke():
+        seconds, concurrency = 3.0, 4
     import numpy as np
 
     import ray_tpu

@@ -11,6 +11,7 @@ Prints one JSON line: {"img_per_s": ..., "final_loss": ...}.
 import json
 import sys
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
+import bench_env
 from bench_env import force_cpu
 
 force_cpu()
@@ -71,7 +72,8 @@ def main():
         ray_tpu.init(num_cpus=8)
     trainer = JaxTrainer(
         train_loop,
-        train_loop_config={"lr": 1e-3, "batch_size": 64, "steps": 30},
+        train_loop_config={"lr": 1e-3, "batch_size": 64,
+                           "steps": bench_env.smoke_scale(30, 4)},
         scaling_config=ScalingConfig(num_workers=2),
         run_config=RunConfig(name="fmnist_bench"),
     )

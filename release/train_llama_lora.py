@@ -45,6 +45,9 @@ def main(full: bool = False):
     if full:
         config = TransformerConfig.llama2_7b(max_seq=2048, dtype=jnp.bfloat16)
         batch, seq, steps = dp * 1, 2048, 10
+        import bench_env
+        if bench_env.smoke():
+            seq, steps = 256, 2
     else:
         config = TransformerConfig.tiny()
         batch, seq, steps = dp * 2, min(64, config.max_seq), 5

@@ -43,7 +43,7 @@ def trainable(config):
     # Learnable synthetic mapping: labels derived from the data so accuracy
     # can actually improve (measures the sweep, not the dataset).
     labels = (images.sum(axis=(1, 2, 3)) > 0).astype(np.int32)
-    for epoch in range(8):
+    for epoch in range(2 if __import__('bench_env').smoke() else 8):
         for _ in range(4):
             params, opt_state, loss, acc = step(params, opt_state, images, labels)
         tune.report({"acc": float(acc), "loss": float(loss)})

@@ -210,7 +210,7 @@ class Engine {
     return id;
   }
 
-  // Build + send a frame. Returns 0 on success, <0 on error.
+  // Debug probe: fills a 6-slot array (wq/woff/fd/closed/bytes/rbuf).
   int ConnDebug(long conn_id, long long *out) {
     auto conn = Lookup(conn_id);
     if (!conn) return -1;
@@ -239,6 +239,7 @@ class Engine {
     return n;
   }
 
+  // Build + send a frame. Returns 0 on success, <0 on error.
   int Send(long conn_id, uint8_t kind, uint32_t msgid, const uint8_t *method,
            uint32_t mlen, const uint8_t *payload, uint32_t plen) {
     if (mlen > 0xFFFF) return -EINVAL;
