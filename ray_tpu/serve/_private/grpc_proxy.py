@@ -1,0 +1,210 @@
+"""gRPC ingress — the second proxy protocol.
+
+Role-equivalent of the reference proxy's gRPC server
+(python/ray/serve/_private/proxy.py gRPC path, SURVEY §2.6): a grpc.aio
+server per node exposing Serve applications over two generic methods —
+no compiled user protos required (the reference routes user-defined
+protos; here the generic-bytes envelope keeps the ingress
+schema-agnostic, with JSON as the payload convention):
+
+  /raytpu.serve.Serve/Predict        (unary)    route+payload → result
+  /raytpu.serve.Serve/PredictStream  (server streaming) one message per
+                                     item of a streaming deployment
+                                     (LLM token streaming over gRPC)
+
+Request bytes: JSON {"route": "/app", "data": <payload>}; response
+bytes: JSON result (bytes results pass through raw). Routing, handles,
+and long-poll route refresh are shared with the HTTP proxy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from typing import Any
+
+SERVICE = "raytpu.serve.Serve"
+
+
+class GRPCProxy:
+    """Runs inside the proxy actor beside the HTTP server."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+        self.host = host
+        self.port = port
+        self._routes: dict[str, str] = {}
+        self._handles: dict[str, Any] = {}
+        self._num_requests = 0
+        self._started = threading.Event()
+        self._start_error: Exception | None = None
+        self._thread = threading.Thread(target=self._serve_forever, daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError(
+                f"gRPC proxy failed to start: {self._start_error}"
+            )
+        if self._start_error is not None:
+            raise self._start_error
+
+    def _serve_forever(self) -> None:
+        try:
+            asyncio.run(self._amain())
+        except Exception as exc:
+            self._start_error = exc
+            self._started.set()
+
+    async def _amain(self) -> None:
+        import grpc
+
+        server = grpc.aio.server()
+
+        def unary(method):
+            return grpc.unary_unary_rpc_method_handler(
+                method,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+
+        def streaming(method):
+            return grpc.unary_stream_rpc_method_handler(
+                method,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+
+        handler = grpc.method_handlers_generic_handler(
+            SERVICE,
+            {
+                "Predict": unary(self._predict),
+                "PredictStream": streaming(self._predict_stream),
+                "Healthz": unary(self._healthz),
+            },
+        )
+        server.add_generic_rpc_handlers((handler,))
+        bound = server.add_insecure_port(f"{self.host}:{self.port}")
+        if bound == 0:
+            raise RuntimeError(f"gRPC proxy could not bind {self.port}")
+        self.port = bound
+        await server.start()
+        self._started.set()
+        await server.wait_for_termination()
+
+    # -- shared routing (long-poll refreshed, like the HTTP proxy) -------
+    def _refresh_routes(self) -> None:
+        from ray_tpu.serve._private.long_poll import get_subscriber
+
+        self._routes = get_subscriber().get_routes()
+
+    def _match(self, path: str) -> tuple[str, str] | None:
+        best = None
+        for route, deployment in self._routes.items():
+            if path == route or path.startswith(route.rstrip("/") + "/") or route == "/":
+                if best is None or len(route) > len(best[0]):
+                    best = (route, deployment)
+        return best
+
+    def _resolve(self, raw_request: bytes) -> tuple[Any, Any]:
+        """→ (handle, data). Raises ValueError for bad requests."""
+        try:
+            request = json.loads(raw_request or b"{}")
+        except json.JSONDecodeError as exc:
+            raise ValueError(f"request must be JSON: {exc}")
+        route = request.get("route", "/")
+        self._refresh_routes()
+        match = self._match(route)
+        if match is None:
+            raise LookupError(f"no Serve route for {route!r}")
+        _, qualified = match
+        app_name, dep_name = qualified.split("_", 1)
+        key = f"{app_name}_{dep_name}"
+        handle = self._handles.get(key)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            handle = DeploymentHandle(dep_name, app_name)
+            self._handles[key] = handle
+        return handle, request.get("data")
+
+    @staticmethod
+    def _encode(item: Any) -> bytes:
+        if isinstance(item, bytes):
+            return item
+        try:
+            return json.dumps(item).encode()
+        except TypeError:
+            return str(item).encode()
+
+    # -- RPC methods -----------------------------------------------------
+    async def _healthz(self, request: bytes, context) -> bytes:
+        return b"ok"
+
+    async def _predict(self, request: bytes, context) -> bytes:
+        import grpc
+
+        self._num_requests += 1
+        try:
+            handle, data = await asyncio.to_thread(self._resolve, request)
+            result = await asyncio.to_thread(
+                lambda: handle.remote(data).result(timeout=120)
+            )
+        except LookupError as exc:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+        except ValueError as exc:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        except Exception as exc:
+            await context.abort(
+                grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}"
+            )
+        from ray_tpu.serve.handle import ResponseStream
+
+        if isinstance(result, ResponseStream):
+            # Unary caller asked a streaming deployment: drain into one blob.
+            chunks: list = []
+            while True:
+                batch = await asyncio.to_thread(result.next_batch)
+                if not batch:
+                    break
+                chunks.extend(batch)
+            return self._encode(chunks)
+        return self._encode(result)
+
+    async def _predict_stream(self, request: bytes, context):
+        import grpc
+
+        self._num_requests += 1
+        try:
+            handle, data = await asyncio.to_thread(self._resolve, request)
+            result = await asyncio.to_thread(
+                lambda: handle.remote(data).result(timeout=120)
+            )
+        except LookupError as exc:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+            return
+        except ValueError as exc:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            return
+        except Exception as exc:
+            await context.abort(
+                grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}"
+            )
+            return
+        from ray_tpu.serve.handle import ResponseStream
+
+        if not isinstance(result, ResponseStream):
+            yield self._encode(result)
+            return
+        try:
+            while True:
+                batch = await asyncio.to_thread(result.next_batch)
+                if not batch:
+                    break
+                for item in batch:
+                    yield self._encode(item)
+        except BaseException:
+            await asyncio.to_thread(result.cancel)
+            raise
+
+    def get_num_requests(self) -> int:
+        return self._num_requests

@@ -23,6 +23,8 @@ from ray_tpu.serve.handle import DeploymentHandle, _HandlePlaceholder
 
 _proxy_handle = None
 _proxy_port: Optional[int] = None
+_grpc_handle = None
+_grpc_port: Optional[int] = None
 
 
 class Application:
@@ -186,24 +188,69 @@ def _get_or_create_controller():
         return ray_tpu.get_actor(CONTROLLER_NAME)
 
 
-def start(http_host: str = "127.0.0.1", http_port: int = 8000):
-    """Start controller + HTTP proxy (reference: serve.start)."""
-    global _proxy_handle, _proxy_port
+def _get_or_create_proxy(proxy_cls, name: str, ready_method: str, *args):
+    """Name-keyed get-or-create of a detached proxy actor, race-safe (the
+    _get_or_create_controller pattern), blocking until it serves."""
+    try:
+        handle = ray_tpu.get_actor(name)
+    except ValueError:
+        try:
+            handle = (
+                ray_tpu.remote(proxy_cls)
+                .options(name=name, lifetime="detached", max_concurrency=64)
+                .remote(*args)
+            )
+        except ValueError:
+            # Raced with another creator of the same named actor.
+            handle = ray_tpu.get_actor(name)
+    ray_tpu.get(getattr(handle, ready_method).remote(), timeout=60)
+    return handle
+
+
+def _kill_quietly(handle) -> None:
+    if handle is not None:
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+
+
+def start(
+    http_host: str = "127.0.0.1",
+    http_port: Optional[int] = 8000,
+    grpc_port: Optional[int] = None,
+):
+    """Start controller + ingress (reference: serve.start). ``http_port``
+    None leaves any existing HTTP proxy untouched; ``grpc_port`` starts a
+    gRPC ingress beside the HTTP one (reference: the proxy's dual
+    HTTP+gRPC servers). Changing a port replaces (kills) the previous
+    proxy on the old port."""
+    global _proxy_handle, _proxy_port, _grpc_handle, _grpc_port
     controller = _get_or_create_controller()
-    if _proxy_handle is None or _proxy_port != http_port:
+    if http_port is not None and (
+        _proxy_handle is None or _proxy_port != http_port
+    ):
         from ray_tpu.serve._private.proxy import HTTPProxy
 
-        name = f"SERVE_PROXY::{http_port}"
-        try:
-            _proxy_handle = ray_tpu.get_actor(name)
-        except ValueError:
-            _proxy_handle = (
-                ray_tpu.remote(HTTPProxy)
-                .options(name=name, lifetime="detached", max_concurrency=64)
-                .remote(http_host, http_port)
-            )
-        ray_tpu.get(_proxy_handle.ready.remote(), timeout=60)
+        if _proxy_port is not None and _proxy_port != http_port:
+            _kill_quietly(_proxy_handle)
+        _proxy_handle = _get_or_create_proxy(
+            HTTPProxy, f"SERVE_PROXY::{http_port}", "ready",
+            http_host, http_port,
+        )
         _proxy_port = http_port
+    if grpc_port is not None and (
+        _grpc_handle is None or _grpc_port != grpc_port
+    ):
+        from ray_tpu.serve._private.grpc_proxy import GRPCProxy
+
+        if _grpc_port is not None and _grpc_port != grpc_port:
+            _kill_quietly(_grpc_handle)
+        _grpc_handle = _get_or_create_proxy(
+            GRPCProxy, f"SERVE_GRPC_PROXY::{grpc_port}", "get_num_requests",
+            http_host, grpc_port,
+        )
+        _grpc_port = grpc_port
     return controller
 
 
@@ -214,12 +261,15 @@ def run(
     route_prefix: Optional[str] = "/",
     _blocking_timeout_s: float = 120.0,
     http_port: Optional[int] = None,
+    grpc_port: Optional[int] = None,
 ) -> DeploymentHandle:
     """Deploy an application; block until running; return ingress handle."""
     if not isinstance(target, Application):
         raise TypeError("serve.run expects Deployment.bind(...) output")
-    if http_port is not None:
-        start(http_port=http_port)
+    if http_port is not None or grpc_port is not None:
+        # http_port=None: leave whatever HTTP proxy exists alone (a
+        # grpc-only run must not repoint/recreate the HTTP ingress).
+        start(http_port=http_port, grpc_port=grpc_port)
     else:
         controller = _get_or_create_controller()
     controller = ray_tpu.get_actor(CONTROLLER_NAME)
@@ -288,7 +338,7 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
-    global _proxy_handle, _proxy_port
+    global _proxy_handle, _proxy_port, _grpc_handle, _grpc_port
     from ray_tpu.serve._private.long_poll import reset_subscriber
 
     reset_subscriber()
@@ -301,10 +351,9 @@ def shutdown() -> None:
         ray_tpu.kill(controller)
     except Exception:
         pass
-    if _proxy_handle is not None:
-        try:
-            ray_tpu.kill(_proxy_handle)
-        except Exception:
-            pass
+    _kill_quietly(_proxy_handle)
+    _kill_quietly(_grpc_handle)
     _proxy_handle = None
     _proxy_port = None
+    _grpc_handle = None
+    _grpc_port = None

@@ -411,3 +411,56 @@ applications:
     assert status["yamlapp"]["deployments"]["Greeter"]["running_replicas"] == 2
     handle = serve.get_app_handle("yamlapp")
     assert handle.remote("world").result() == "hola world"
+
+
+def test_grpc_proxy(serve_instance):
+    """gRPC ingress (reference: the proxy's dual HTTP+gRPC servers):
+    unary predict, server-streaming predict, and NOT_FOUND routing."""
+    import json
+
+    import grpc
+
+    @serve.deployment
+    class GrpcEcho:
+        def __call__(self, body):
+            return {"grpc_echo": body}
+
+    @serve.deployment
+    class GrpcTokens:
+        def __call__(self, body):
+            def gen():
+                for tok in ["alpha", "beta", "gamma"]:
+                    yield tok
+            return gen()
+
+    serve.run(GrpcEcho.bind(), name="gecho", route_prefix="/gecho",
+              grpc_port=9123)
+    serve.run(GrpcTokens.bind(), name="gtok", route_prefix="/gtok",
+              grpc_port=9123)
+
+    channel = grpc.insecure_channel("127.0.0.1:9123")
+    predict = channel.unary_unary(
+        "/raytpu.serve.Serve/Predict",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    reply = predict(
+        json.dumps({"route": "/gecho", "data": {"x": 7}}).encode(),
+        timeout=60,
+    )
+    assert json.loads(reply) == {"grpc_echo": {"x": 7}}
+
+    stream = channel.unary_stream(
+        "/raytpu.serve.Serve/PredictStream",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    tokens = [json.loads(item) for item in stream(
+        json.dumps({"route": "/gtok", "data": None}).encode(), timeout=60
+    )]
+    assert tokens == ["alpha", "beta", "gamma"]
+
+    with pytest.raises(grpc.RpcError) as excinfo:
+        predict(json.dumps({"route": "/nope"}).encode(), timeout=30)
+    assert excinfo.value.code() == grpc.StatusCode.NOT_FOUND
+    channel.close()
