@@ -1,0 +1,71 @@
+"""Usage/telemetry recording — reference usage_lib role, airgap-first.
+
+Role-equivalent of python/ray/_private/usage/usage_lib.py (SURVEY §2.3):
+records which framework features a cluster used. The reference phones
+home; this build NEVER transmits — it only merges a local JSON summary
+under the session dir (``usage_stats.json``) that operators may inspect
+or ship themselves. Disabled entirely with RAY_TPU_usage_stats_enabled=0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_features: set[str] = set()
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_usage_stats_enabled", "1").lower() not in (
+        "0", "false", "no",
+    )
+
+
+def record_feature(name: str) -> None:
+    """Mark a library/feature as used this session (idempotent, cheap)."""
+    if not enabled():
+        return
+    with _lock:
+        if name in _features:
+            return
+        _features.add(name)
+        _flush_locked()
+
+
+def _flush_locked() -> None:
+    session_dir = os.environ.get("RAYTPU_SESSION_DIR")
+    if not session_dir:
+        return
+    path = os.path.join(session_dir, "usage_stats.json")
+    # Merge-on-write: several processes (driver, trial/train workers)
+    # share the session file; a truncate-write from in-memory state alone
+    # would drop the other processes' features.
+    merged = set(_features)
+    try:
+        with open(path) as fh:
+            merged.update(json.load(fh).get("features", []))
+    except (OSError, json.JSONDecodeError):
+        pass
+    try:
+        with open(path, "w") as fh:
+            json.dump(
+                {
+                    "features": sorted(merged),
+                    "updated_at": time.time(),
+                    "transmitted": False,  # never — local record only
+                },
+                fh,
+            )
+    except OSError:
+        pass
+
+
+def read(session_dir: str) -> dict:
+    try:
+        with open(os.path.join(session_dir, "usage_stats.json")) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return {"features": [], "transmitted": False}

@@ -98,7 +98,7 @@ def init(
             _local_cluster = cluster
             # Driver-side tracing/profile exports land in the session dir
             # (workers inherit it via RAYTPU_SESSION_DIR at spawn).
-            os.environ.setdefault("RAYTPU_SESSION_DIR", cluster.session_dir)
+            os.environ["RAYTPU_SESSION_DIR"] = cluster.session_dir
             from ray_tpu.util import tracing as _tracing
 
             _tracing.configure(cluster.session_dir)

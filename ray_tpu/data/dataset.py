@@ -36,6 +36,9 @@ from ray_tpu.data._internal.plan import plan_stages
 
 class Dataset:
     def __init__(self, plan: LogicalPlan):
+        from ray_tpu._private import usage
+
+        usage.record_feature("data")
         self._plan = plan
         self._materialized_refs: Optional[list] = None
         self._stats = DatasetStats()

@@ -12,6 +12,7 @@ from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.bc.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.marwil.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import (
@@ -27,7 +28,7 @@ from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
-    "IMPALAConfig", "DQN", "DQNConfig", "BC", "BCConfig", "SAC", "SACConfig", "Learner",
+    "IMPALAConfig", "DQN", "DQNConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig", "SAC", "SACConfig", "Learner",
     "LearnerGroup", "MultiAgentLearnerGroup", "MultiRLModule",
     "MultiRLModuleSpec", "RLModule", "RLModuleSpec", "MLPModule",
     "SingleAgentEnvRunner", "EnvRunnerGroup", "MultiAgentEnv",

@@ -169,6 +169,9 @@ class AlgorithmConfig:
     def build_algo(self):
         if self.algo_class is None:
             raise ValueError("no algorithm class bound to this config")
+        from ray_tpu._private import usage
+
+        usage.record_feature("rllib")
         self.validate()
         return self.algo_class(self.copy())
 

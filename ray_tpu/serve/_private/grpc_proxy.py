@@ -28,7 +28,10 @@ from typing import Any
 SERVICE = "raytpu.serve.Serve"
 
 
-class GRPCProxy:
+from ray_tpu.serve._private.routing import RoutingMixin  # noqa: E402
+
+
+class GRPCProxy(RoutingMixin):
     """Runs inside the proxy actor beside the HTTP server."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 9000):
@@ -91,19 +94,7 @@ class GRPCProxy:
         self._started.set()
         await server.wait_for_termination()
 
-    # -- shared routing (long-poll refreshed, like the HTTP proxy) -------
-    def _refresh_routes(self) -> None:
-        from ray_tpu.serve._private.long_poll import get_subscriber
-
-        self._routes = get_subscriber().get_routes()
-
-    def _match(self, path: str) -> tuple[str, str] | None:
-        best = None
-        for route, deployment in self._routes.items():
-            if path == route or path.startswith(route.rstrip("/") + "/") or route == "/":
-                if best is None or len(route) > len(best[0]):
-                    best = (route, deployment)
-        return best
+    # Routing/_match/_handle_for come from RoutingMixin.
 
     def _resolve(self, raw_request: bytes) -> tuple[Any, Any]:
         """→ (handle, data). Raises ValueError for bad requests."""
@@ -111,21 +102,17 @@ class GRPCProxy:
             request = json.loads(raw_request or b"{}")
         except json.JSONDecodeError as exc:
             raise ValueError(f"request must be JSON: {exc}")
+        if not isinstance(request, dict):
+            raise ValueError(
+                f"request must be a JSON object, got {type(request).__name__}"
+            )
         route = request.get("route", "/")
         self._refresh_routes()
         match = self._match(route)
         if match is None:
             raise LookupError(f"no Serve route for {route!r}")
         _, qualified = match
-        app_name, dep_name = qualified.split("_", 1)
-        key = f"{app_name}_{dep_name}"
-        handle = self._handles.get(key)
-        if handle is None:
-            from ray_tpu.serve.handle import DeploymentHandle
-
-            handle = DeploymentHandle(dep_name, app_name)
-            self._handles[key] = handle
-        return handle, request.get("data")
+        return self._handle_for(qualified), request.get("data")
 
     @staticmethod
     def _encode(item: Any) -> bytes:
@@ -162,11 +149,18 @@ class GRPCProxy:
         if isinstance(result, ResponseStream):
             # Unary caller asked a streaming deployment: drain into one blob.
             chunks: list = []
-            while True:
-                batch = await asyncio.to_thread(result.next_batch)
-                if not batch:
-                    break
-                chunks.extend(batch)
+            try:
+                while True:
+                    batch = await asyncio.to_thread(result.next_batch)
+                    if not batch:
+                        break
+                    chunks.extend(batch)
+            except BaseException as exc:
+                await asyncio.to_thread(result.cancel)
+                await context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    f"stream failed: {type(exc).__name__}: {exc}",
+                )
             return self._encode(chunks)
         return self._encode(result)
 
@@ -202,9 +196,12 @@ class GRPCProxy:
                     break
                 for item in batch:
                     yield self._encode(item)
-        except BaseException:
+        except BaseException as exc:
             await asyncio.to_thread(result.cancel)
-            raise
+            await context.abort(
+                grpc.StatusCode.INTERNAL,
+                f"stream failed: {type(exc).__name__}: {exc}",
+            )
 
     def get_num_requests(self) -> int:
         return self._num_requests

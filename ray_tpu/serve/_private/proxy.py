@@ -18,9 +18,10 @@ from typing import Any
 
 import ray_tpu
 from ray_tpu.serve._private.common import CONTROLLER_NAME
+from ray_tpu.serve._private.routing import RoutingMixin
 
 
-class HTTPProxy:
+class HTTPProxy(RoutingMixin):
     """Runs inside a ray_tpu actor; owns an aiohttp server on `port`."""
 
     ROUTE_REFRESH_S = 1.0
@@ -58,21 +59,6 @@ class HTTPProxy:
             await asyncio.sleep(3600)
 
     # -- request path ---------------------------------------------------
-    def _refresh_routes(self) -> None:
-        # Routes arrive by long-poll push (no per-request controller RPC).
-        from ray_tpu.serve._private.long_poll import get_subscriber
-
-        self._routes = get_subscriber().get_routes()
-        self._last_refresh = time.monotonic()
-
-    def _match(self, path: str) -> tuple[str, str] | None:
-        """Longest-prefix route match → (route, qualified deployment)."""
-        best = None
-        for route, deployment in self._routes.items():
-            if path == route or path.startswith(route.rstrip("/") + "/") or route == "/":
-                if best is None or len(route) > len(best[0]):
-                    best = (route, deployment)
-        return best
 
     async def _handle(self, request):
         from aiohttp import web
@@ -164,13 +150,7 @@ class HTTPProxy:
         return response
 
     def _call_deployment(self, app_name: str, dep_name: str, body: Any) -> Any:
-        from ray_tpu.serve.handle import DeploymentHandle
-
-        key = f"{app_name}_{dep_name}"
-        handle = self._handles.get(key)
-        if handle is None:
-            handle = DeploymentHandle(dep_name, app_name)
-            self._handles[key] = handle
+        handle = self._handle_for(f"{app_name}_{dep_name}")
         return handle.remote(body).result(timeout=120)
 
     # -- control --------------------------------------------------------

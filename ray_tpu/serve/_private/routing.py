@@ -1,0 +1,48 @@
+"""Shared ingress routing: long-poll-refreshed route table + handle cache.
+
+One implementation of route matching and deployment-handle resolution for
+every proxy protocol (HTTP, gRPC) — reference proxy_router.py role. A
+future change to prefix-matching or the qualified-name encoding lands in
+both ingresses at once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class RoutingMixin:
+    """State: ``self._routes`` dict + ``self._handles`` cache."""
+
+    _routes: dict
+    _handles: dict
+
+    def _refresh_routes(self) -> None:
+        # Routes arrive by long-poll push (no per-request controller RPC).
+        from ray_tpu.serve._private.long_poll import get_subscriber
+
+        self._routes = get_subscriber().get_routes()
+
+    def _match(self, path: str) -> Optional[tuple[str, str]]:
+        """Longest-prefix route match → (route, qualified deployment)."""
+        best = None
+        for route, deployment in self._routes.items():
+            if (
+                path == route
+                or path.startswith(route.rstrip("/") + "/")
+                or route == "/"
+            ):
+                if best is None or len(route) > len(best[0]):
+                    best = (route, deployment)
+        return best
+
+    def _handle_for(self, qualified: str) -> Any:
+        """Cached DeploymentHandle for an ``<app>_<deployment>`` name."""
+        handle = self._handles.get(qualified)
+        if handle is None:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            app_name, dep_name = qualified.split("_", 1)
+            handle = DeploymentHandle(dep_name, app_name)
+            self._handles[qualified] = handle
+        return handle

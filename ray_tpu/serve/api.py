@@ -264,6 +264,9 @@ def run(
     grpc_port: Optional[int] = None,
 ) -> DeploymentHandle:
     """Deploy an application; block until running; return ingress handle."""
+    from ray_tpu._private import usage
+
+    usage.record_feature("serve")
     if not isinstance(target, Application):
         raise TypeError("serve.run expects Deployment.bind(...) output")
     if http_port is not None or grpc_port is not None:

@@ -92,6 +92,9 @@ class DataParallelTrainer:
         return self.run_config.name or type(self).__name__.lower()
 
     def fit(self) -> Result:
+        from ray_tpu._private import usage
+
+        usage.record_feature("train")
         run_cfg = self.run_config
         storage = StorageContext(
             run_cfg.resolved_storage_path(),

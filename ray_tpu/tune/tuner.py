@@ -132,6 +132,9 @@ class Tuner:
         return os.path.join(self.run_config.resolved_storage_path(), name)
 
     def fit(self) -> ResultGrid:
+        from ray_tpu._private import usage
+
+        usage.record_feature("tune")
         cfg = self.tune_config
         searcher = cfg.search_alg or BasicVariantGenerator(
             self.param_space,

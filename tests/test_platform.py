@@ -271,3 +271,24 @@ def test_native_sanitizers():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "ALL NATIVE TESTS PASSED" in proc.stdout
+
+
+# ---------- usage/telemetry (airgap: local record only) ----------
+
+def test_usage_stats_recorded_locally(ray_start_shared):
+    from ray_tpu._private import usage
+    from ray_tpu._private import worker as worker_mod
+
+    import ray_tpu.data as rt_data
+
+    rt_data.range(4).count()  # records the "data" feature
+    session_dir = worker_mod._local_cluster.session_dir
+    deadline = time.time() + 10
+    stats = {}
+    while time.time() < deadline:
+        stats = usage.read(session_dir)
+        if "data" in stats.get("features", []):
+            break
+        time.sleep(0.2)
+    assert "data" in stats["features"]
+    assert stats["transmitted"] is False  # never phones home
