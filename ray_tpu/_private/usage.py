@@ -16,6 +16,7 @@ import time
 
 _lock = threading.Lock()
 _features: set[str] = set()
+_flushed_dir: str | None = None
 
 
 def enabled() -> bool:
@@ -28,11 +29,17 @@ def record_feature(name: str) -> None:
     """Mark a library/feature as used this session (idempotent, cheap)."""
     if not enabled():
         return
+    global _flushed_dir
     with _lock:
-        if name in _features:
+        session_dir = os.environ.get("RAYTPU_SESSION_DIR")
+        # Skip the disk write only when this feature already reached THIS
+        # session's file — a long-lived process (test runs, notebooks)
+        # crosses init/shutdown cycles and each new session starts empty.
+        if name in _features and session_dir == _flushed_dir:
             return
         _features.add(name)
         _flush_locked()
+        _flushed_dir = session_dir
 
 
 def _flush_locked() -> None:
