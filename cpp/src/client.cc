@@ -129,8 +129,10 @@ void encode_into(const Value &value, std::string &out) {
       size_t n = value.array.size();
       if (n < 16) {
         out.push_back(char(0x90 | n));
-      } else {
+      } else if (n < 65536) {
         out.push_back(char(0xdc)); put_u16(out, uint16_t(n));
+      } else {
+        out.push_back(char(0xdd)); put_u32(out, uint32_t(n));
       }
       for (const auto &item : value.array) encode_into(item, out);
       break;
@@ -139,8 +141,10 @@ void encode_into(const Value &value, std::string &out) {
       size_t n = value.map.size();
       if (n < 16) {
         out.push_back(char(0x80 | n));
-      } else {
+      } else if (n < 65536) {
         out.push_back(char(0xde)); put_u16(out, uint16_t(n));
+      } else {
+        out.push_back(char(0xdf)); put_u32(out, uint32_t(n));
       }
       for (const auto &kv : value.map) {
         encode_into(Value::str(kv.first), out);
@@ -239,6 +243,12 @@ Value decode_value(Reader &r) {
     case 0xdb: return Value::str(r.bytes(r.u32()));
     case 0xdc: {
       size_t n = r.u16();
+      std::vector<Value> items(n);
+      for (auto &item : items) item = decode_value(r);
+      return Value::arr(std::move(items));
+    }
+    case 0xdd: {
+      size_t n = r.u32();
       std::vector<Value> items(n);
       for (auto &item : items) item = decode_value(r);
       return Value::arr(std::move(items));
@@ -452,7 +462,14 @@ Value Client::SubmitTask(const std::string &fn_ref,
     throw std::runtime_error("raytpu: worker lease failed");
   }
   const Value *worker_addr = lease.get("worker_addr");
-  std::string lease_id = lease.get("lease_id")->as_str();
+  if (worker_addr == nullptr || worker_addr->array.size() != 2) {
+    throw std::runtime_error("raytpu: malformed worker_addr");
+  }
+  const Value *lease_id_val = lease.get("lease_id");
+  if (lease_id_val == nullptr) {
+    throw std::runtime_error("raytpu: lease reply missing lease_id");
+  }
+  std::string lease_id = lease_id_val->as_str();
   Connection worker;
   worker.Connect(worker_addr->array[0].as_str(),
                  int(worker_addr->array[1].as_int()));

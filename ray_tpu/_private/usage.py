@@ -9,6 +9,7 @@ or ship themselves. Disabled entirely with RAY_TPU_usage_stats_enabled=0.
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import threading
@@ -48,26 +49,50 @@ def _flush_locked() -> None:
         return
     path = os.path.join(session_dir, "usage_stats.json")
     # Merge-on-write: several processes (driver, trial/train workers)
-    # share the session file; a truncate-write from in-memory state alone
-    # would drop the other processes' features.
-    merged = set(_features)
+    # share the session file. The read-merge-write must be one critical
+    # section (flock on a sidecar) and the write must land atomically
+    # (temp + os.replace) so concurrent flushers can't drop each other's
+    # features and readers never observe torn JSON.
     try:
-        with open(path) as fh:
-            merged.update(json.load(fh).get("features", []))
-    except (OSError, json.JSONDecodeError):
-        pass
-    try:
-        with open(path, "w") as fh:
-            json.dump(
-                {
-                    "features": sorted(merged),
-                    "updated_at": time.time(),
-                    "transmitted": False,  # never — local record only
-                },
-                fh,
-            )
+        lock_fh = open(path + ".lock", "a")
     except OSError:
-        pass
+        return
+    try:
+        try:
+            fcntl.flock(lock_fh, fcntl.LOCK_EX)
+        except OSError:
+            # No lock service (NFS without lockd, ENOLCK): fall back to
+            # unserialized merge — telemetry must never crash user code.
+            pass
+        merged = set(_features)
+        try:
+            with open(path) as fh:
+                merged.update(json.load(fh).get("features", []))
+        except (OSError, json.JSONDecodeError):
+            pass
+        tmp = path + f".tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(
+                    {
+                        "features": sorted(merged),
+                        "updated_at": time.time(),
+                        "transmitted": False,  # never — local record only
+                    },
+                    fh,
+                )
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    finally:
+        try:
+            fcntl.flock(lock_fh, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        lock_fh.close()
 
 
 def read(session_dir: str) -> dict:

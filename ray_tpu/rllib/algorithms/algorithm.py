@@ -221,15 +221,23 @@ class Algorithm:
 
         params = self.learner_group.get_weights()
         fwd = jax.jit(module.forward_inference)
+        # Running statistics (NormalizeObservations) must come from
+        # training — a fresh normalizer would map early eval observations
+        # to ~0, a distribution the trained policy never saw.
+        connector_state = self.env_runner_group.get_connector_state()
         returns = []
         for _ in range(self.config.evaluation_duration):
             # Fresh pipeline per episode: stateful connectors (framestack)
-            # must not carry history across episode boundaries.
+            # must not carry history across episode boundaries —
+            # get_state() excludes per-episode history, so restoring it
+            # here only seeds the running statistics.
             pipeline = (
                 self.config.env_to_module_connector()
                 if self.config.env_to_module_connector
                 else default_env_to_module()
             )
+            if connector_state:
+                pipeline.set_state(connector_state)
             obs, _ = env.reset()
             total, done = 0.0, False
             while not done:

@@ -63,6 +63,9 @@ class _NullRunnerGroup:
         return {"episode_return_mean": np.nan, "episode_len_mean": np.nan,
                 "num_episodes": 0}
 
+    def get_connector_state(self) -> dict:
+        return {}
+
     def stop(self) -> None:
         pass
 

@@ -41,6 +41,14 @@ class ConnectorV2:
     def __call__(self, batch: Any, **kwargs) -> Any:
         raise NotImplementedError
 
+    def get_state(self) -> dict:
+        """Cross-episode state worth syncing between pipelines (running
+        statistics). Per-episode state (framestack history) stays out."""
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
     @property
     def name(self) -> str:
         return type(self).__name__
@@ -78,6 +86,19 @@ class ConnectorPipelineV2(ConnectorV2):
     @property
     def stateful(self) -> bool:  # type: ignore[override]
         return any(c.stateful for c in self.connectors)
+
+    def get_state(self) -> dict:
+        return {
+            i: state
+            for i, c in enumerate(self.connectors)
+            if (state := c.get_state())
+        }
+
+    def set_state(self, state: dict) -> None:
+        for i, sub in state.items():
+            idx = int(i)
+            if 0 <= idx < len(self.connectors):
+                self.connectors[idx].set_state(sub)
 
 
 # ---------------------------------------------------------------------------
@@ -121,6 +142,22 @@ class NormalizeObservations(ConnectorV2):
         self.count = total
         normalized = (flat - self.mean) / np.sqrt(self.var + 1e-8)
         return np.clip(normalized, -self.clip, self.clip).astype(np.float32)
+
+    def get_state(self) -> dict:
+        if self.mean is None:
+            return {}
+        return {
+            "count": float(self.count),
+            "mean": self.mean.copy(),
+            "var": self.var.copy(),
+        }
+
+    def set_state(self, state: dict) -> None:
+        if not state:
+            return
+        self.count = state["count"]
+        self.mean = np.asarray(state["mean"], dtype=np.float64).copy()
+        self.var = np.asarray(state["var"], dtype=np.float64).copy()
 
 
 class FrameStack(ConnectorV2):

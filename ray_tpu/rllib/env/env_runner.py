@@ -103,6 +103,11 @@ class SingleAgentEnvRunner:
     def get_weights(self):
         return self._params
 
+    def get_connector_state(self) -> dict:
+        """Cross-episode env→module state (running normalizers) so
+        evaluation pipelines can start from the training distribution."""
+        return self._env_to_module.get_state()
+
     # -- rollout ---------------------------------------------------------
     def sample(self, num_steps: int | None = None) -> SampleBatch:
         assert self._params is not None, "set_weights before sample"

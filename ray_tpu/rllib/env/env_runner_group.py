@@ -89,6 +89,23 @@ class EnvRunnerGroup:
                 self._inflight[idx] = self.runners[idx].sample.remote()
         return out
 
+    def get_connector_state(self) -> dict:
+        """Running env→module connector state from runner 0 (the
+        reference syncs connector state the same one-of-many way)."""
+        try:
+            return ray_tpu.get(
+                self.runners[0].get_connector_state.remote(), timeout=60
+            )
+        except Exception as exc:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "connector-state fetch from runner 0 failed (%s); "
+                "evaluation will run with FRESH normalizer statistics",
+                exc,
+            )
+            return {}
+
     def get_metrics(self) -> dict:
         metrics = ray_tpu.get(
             [r.get_metrics.remote() for r in self.runners], timeout=120

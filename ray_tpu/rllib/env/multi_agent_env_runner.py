@@ -102,6 +102,11 @@ class MultiAgentEnvRunner:
         self._episode_len = 0
         self._completed: list[tuple[float, int]] = []
 
+    def get_connector_state(self) -> dict:
+        # Stateful env→module connectors are rejected in __init__, so
+        # there is never running state to sync.
+        return {}
+
     # -- weights ---------------------------------------------------------
     def set_weights(self, params: dict) -> str:
         self._params = jax.device_put(params)
@@ -209,6 +214,15 @@ class MultiAgentEnvRunner:
                 continue
             agent_ids = col.pop(AGENT_ID)
             data = {k: np.stack(v) for k, v in col.items() if v}
+            # When one module serves several agents, rows interleave
+            # (agent_0, agent_1, agent_0, ...) with distinct eps_ids.
+            # GAE segments on contiguous eps_id runs, so stable-sort by
+            # eps_id to make each agent's episode contiguous; the sort is
+            # stable, so time order within an episode is preserved.
+            order = np.argsort(data[EPS_ID], kind="stable")
+            if not np.array_equal(order, np.arange(len(order))):
+                data = {k: v[order] for k, v in data.items()}
+                agent_ids = [agent_ids[i] for i in order]
             batch = SampleBatch(data)
             batch[AGENT_ID] = np.array(agent_ids)
             batches[mid] = batch

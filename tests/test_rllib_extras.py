@@ -130,6 +130,67 @@ def test_env_runner_custom_connector(ray_start_shared):
 
 # ---------- multi-agent units ----------
 
+def test_normalize_observations_state_roundtrip():
+    from ray_tpu.rllib.connectors import (
+        ConnectorPipelineV2, FlattenObservations, NormalizeObservations,
+    )
+
+    train_pipe = ConnectorPipelineV2(
+        [FlattenObservations(), NormalizeObservations()]
+    )
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        train_pipe(rng.normal(5.0, 2.0, size=(32, 4)))
+    state = train_pipe.get_state()
+    assert state, "stateful pipeline must expose running statistics"
+
+    eval_pipe = ConnectorPipelineV2(
+        [FlattenObservations(), NormalizeObservations()]
+    )
+    eval_pipe.set_state(state)
+    probe = rng.normal(5.0, 2.0, size=(64, 4))
+    # With synced running stats, the eval pipeline normalizes to ~N(0,1)
+    # instead of the ~all-zeros a fresh batch-of-N normalizer produces.
+    out = eval_pipe(probe)
+    assert abs(float(out.mean())) < 0.5
+    assert 0.5 < float(out.std()) < 2.0
+
+
+def test_multi_agent_shared_policy_episodes_contiguous(ray_start_shared):
+    """Two agents on ONE policy: rows interleave during collection, but the
+    returned per-module batch must keep each agent-episode contiguous or
+    GAE degenerates to 1-step TD (round-3 advisor finding)."""
+    from ray_tpu.rllib.env.multi_agent_env import MultiAgentCartPole
+    from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
+    from ray_tpu.rllib.core.multi_rl_module import MultiRLModuleSpec
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+    from ray_tpu.rllib.policy.sample_batch import EPS_ID
+    import jax
+
+    spec = MultiRLModuleSpec(
+        {"shared": RLModuleSpec(model_config={"fcnet_hiddens": (8,)})}
+    )
+    runner = MultiAgentEnvRunner(
+        lambda: MultiAgentCartPole({"num_agents": 2}),
+        spec,
+        policy_mapping_fn=lambda agent_id, *a, **k: "shared",
+        rollout_fragment_length=64,
+        seed=0,
+    )
+    runner.set_weights(
+        runner.module.init_params(jax.random.PRNGKey(0))
+    )
+    batch = runner.sample()
+    rows = batch.policy_batches["shared"]
+    ids = rows[EPS_ID]
+    assert len(set(ids.tolist())) >= 2, "want >=2 interleaved episodes"
+    # each eps_id must occupy exactly one contiguous run
+    changes = int(np.count_nonzero(np.diff(ids)))
+    assert changes == len(set(ids.tolist())) - 1, (
+        f"eps_ids not contiguous: {ids.tolist()}"
+    )
+
+
 def test_multi_agent_batch_ops():
     a = MultiAgentBatch(
         {"p0": SampleBatch({OBS: np.zeros((4, 2))}),
