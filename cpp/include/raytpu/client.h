@@ -66,6 +66,8 @@ class Connection {
 
   // Synchronous request/reply. Throws on transport error or ERR reply.
   Value Call(const std::string &method, const Value &payload);
+  // Same, with a pre-encoded payload (typed wire_gen.h messages).
+  Value CallRaw(const std::string &method, const std::string &payload);
 
  private:
   int fd_ = -1;

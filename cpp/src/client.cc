@@ -2,6 +2,8 @@
 
 #include "raytpu/client.h"
 
+#include "raytpu/wire_gen.h"
+
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -340,6 +342,11 @@ void Connection::Close() {
 }
 
 Value Connection::Call(const std::string &method, const Value &payload) {
+  return CallRaw(method, msgpack_encode(payload));
+}
+
+Value Connection::CallRaw(const std::string &method,
+                          const std::string &payload) {
   if (fd_ < 0) throw std::runtime_error("raytpu: not connected");
   constexpr uint8_t kVersion = 1, kReq = 0, kRep = 1, kErr = 2, kPush = 3;
   std::string body;
@@ -352,7 +359,7 @@ Value Connection::Call(const std::string &method, const Value &payload) {
   body.push_back(char(mlen & 0xff));
   body.push_back(char(mlen >> 8));
   body += method;
-  body += msgpack_encode(payload);
+  body += payload;
   std::string frame;
   uint32_t len = uint32_t(body.size());
   for (int shift = 0; shift < 32; shift += 8) frame.push_back(char(len >> shift));
@@ -430,88 +437,77 @@ std::map<std::string, double> Client::ClusterResources() {
 
 Value Client::SubmitTask(const std::string &fn_ref,
                          const std::vector<Value> &args, double num_cpus) {
-  Value resources = Value::obj({{"CPU", Value::number(num_cpus)}});
-  Value lease_hint = controller_.Call("request_lease", Value::obj({
-      {"resources", resources},
-      {"job_id", Value::str(job_id_)},
-      {"submitter_node", Value::str("")},
-      {"scheduling_strategy", Value::nil()},
-  }));
-  if (lease_hint.get("status") == nullptr ||
-      lease_hint.get("status")->as_str() != "ok") {
+  // Typed wire messages (generated from src/schema/wire_schema.py — the
+  // reference's protobuf TaskSpec role, SURVEY N14) replace hand-built
+  // payload maps on the whole lease→push→reply path.
+  wire::LeaseRequest lease_req;
+  lease_req.resources["CPU"] = num_cpus;
+  lease_req.job_id = job_id_;
+  wire::LeaseGrant grant = wire::LeaseGrant::FromValue(
+      controller_.CallRaw("request_lease", lease_req.Encode()));
+  if (grant.status != "ok") {
     throw std::runtime_error("raytpu: lease request failed: " +
-                             (lease_hint.get("status")
-                                  ? lease_hint.get("status")->as_str()
-                                  : "<no status>"));
+                             (grant.status.empty() ? "<no status>"
+                                                   : grant.status));
   }
-  const Value *agent_addr = lease_hint.get("agent_addr");
-  if (agent_addr == nullptr || agent_addr->array.size() != 2) {
+  if (grant.agent_addr.type != Value::Type::Array ||
+      grant.agent_addr.array.size() != 2) {
     throw std::runtime_error("raytpu: malformed agent_addr");
   }
   Connection agent;
-  agent.Connect(agent_addr->array[0].as_str(),
-                int(agent_addr->array[1].as_int()));
-  Value lease = agent.Call("lease_worker", Value::obj({
-      {"resources", resources},
-      {"runtime_env", Value::obj({})},
-      {"job_id", Value::str(job_id_)},
-      {"bundle", Value::nil()},
-  }));
-  if (lease.get("status") == nullptr ||
-      lease.get("status")->as_str() != "ok") {
+  agent.Connect(grant.agent_addr.array[0].as_str(),
+                int(grant.agent_addr.array[1].as_int()));
+  wire::WorkerLeaseRequest worker_req;
+  worker_req.resources["CPU"] = num_cpus;
+  worker_req.runtime_env = Value::obj({});
+  worker_req.job_id = job_id_;
+  wire::WorkerLeaseReply lease = wire::WorkerLeaseReply::FromValue(
+      agent.CallRaw("lease_worker", worker_req.Encode()));
+  if (lease.status != "ok") {
     throw std::runtime_error("raytpu: worker lease failed");
   }
-  const Value *worker_addr = lease.get("worker_addr");
-  if (worker_addr == nullptr || worker_addr->array.size() != 2) {
+  if (lease.worker_addr.type != Value::Type::Array ||
+      lease.worker_addr.array.size() != 2) {
     throw std::runtime_error("raytpu: malformed worker_addr");
   }
-  const Value *lease_id_val = lease.get("lease_id");
-  if (lease_id_val == nullptr) {
+  if (lease.lease_id.empty()) {
     throw std::runtime_error("raytpu: lease reply missing lease_id");
   }
-  std::string lease_id = lease_id_val->as_str();
   Connection worker;
-  worker.Connect(worker_addr->array[0].as_str(),
-                 int(worker_addr->array[1].as_int()));
+  worker.Connect(lease.worker_addr.array[0].as_str(),
+                 int(lease.worker_addr.array[1].as_int()));
 
-  std::string task_id =
-      "tsk-cpp-" + std::to_string(++task_counter_);
-  std::vector<Value> arg_list(args);
-  Value spec = Value::obj({
-      {"task_id", Value::str(task_id)},
-      {"job_id", Value::str(job_id_)},
-      {"cross_language", Value::boolean(true)},
-      {"function_ref", Value::str(fn_ref)},
-      {"name", Value::str(fn_ref)},
-      {"args", Value::bin(msgpack_encode(Value::arr(std::move(arg_list))))},
-      {"num_returns", Value::integer(1)},
-      {"resources", resources},
-      {"owner", Value::obj({{"worker_id", Value::str("cpp-client")},
-                            {"address", Value::arr({Value::str(""),
-                                                    Value::integer(0)})}})},
-      {"runtime_env", Value::obj({})},
-      {"max_retries", Value::integer(0)},
-      {"retry_exceptions", Value::boolean(false)},
-  });
-  Value reply = worker.Call("push_task", spec);
+  wire::TaskSpec spec;
+  spec.task_id = "tsk-cpp-" + std::to_string(++task_counter_);
+  spec.job_id = job_id_;
+  spec.cross_language = true;
+  spec.function_ref = fn_ref;
+  spec.name = fn_ref;
+  spec.args = msgpack_encode(Value::arr(std::vector<Value>(args)));
+  spec.num_returns = 1;
+  spec.resources["CPU"] = num_cpus;
+  spec.owner.worker_id = "cpp-client";
+  spec.owner.address = Value::arr({Value::str(""), Value::integer(0)});
+  spec.runtime_env = Value::obj({});
+  wire::TaskReply reply = wire::TaskReply::FromValue(
+      worker.CallRaw("push_task", spec.Encode()));
   // Hand the lease back so the worker returns to the agent's idle pool.
   try {
-    agent.Call("return_worker",
-               Value::obj({{"lease_id", Value::str(lease_id)}}));
+    wire::ReturnWorkerRequest ret;
+    ret.lease_id = lease.lease_id;
+    agent.CallRaw("return_worker", ret.Encode());
   } catch (const std::exception &) {
   }
-  const Value *status = reply.get("status");
-  if (status == nullptr || status->as_str() != "ok") {
-    const Value *error_text = reply.get("error_text");
+  if (reply.status != "ok") {
     throw std::runtime_error(
         "raytpu task failed: " +
-        (error_text ? error_text->as_str() : std::string("<no detail>")));
+        (reply.error_text.empty() ? std::string("<no detail>")
+                                  : reply.error_text));
   }
-  const Value *returns = reply.get("returns");
-  if (returns == nullptr || returns->array.empty()) return Value::nil();
-  const Value *data = returns->array[0].get("data");
-  if (data == nullptr) return Value::nil();
-  return msgpack_decode(data->s);
+  if (reply.returns.empty() || reply.returns[0].data.empty()) {
+    return Value::nil();
+  }
+  return msgpack_decode(reply.returns[0].data);
 }
 
 }  // namespace raytpu

@@ -1,0 +1,196 @@
+"""Typed wire schema tests (reference protobuf-message role, SURVEY N14).
+
+Covers: generated-codec roundtrips, version skew in both directions
+(unknown keys ignored/passed through, missing keys defaulted), the
+fixed-offset patchable actor seq, generator freshness (--check), and
+Python↔C++ codec agreement via a compiled probe.
+"""
+
+import os
+import subprocess
+import sys
+
+import msgpack
+import pytest
+
+from ray_tpu._private import wire_gen as w
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_task_spec_roundtrip():
+    spec = {
+        "task_id": "tsk-1", "job_id": "j", "function_id": "fn",
+        "name": "noop", "args": b"\x90", "num_returns": 2,
+        "resources": {"CPU": 1.0, "TPU": 4.0},
+        "owner": {"worker_id": "w1", "address": ["127.0.0.1", 9]},
+        "max_retries": 3, "retry_exceptions": True,
+    }
+    d = w.decode_task_spec(w.encode_task_spec(spec))
+    for k, v in spec.items():
+        assert d[k] == v, k
+    # defaults materialize for everything the sender omitted
+    assert d["cross_language"] is False
+    assert d["trace_ctx"] is None
+    assert d["scheduling_strategy"] is None
+
+
+def test_task_reply_roundtrip():
+    reply = {
+        "status": "ok",
+        "returns": [
+            {"kind": "inline", "data": b"abc"},
+            {"kind": "shm", "size": 1024, "location": {"node_id": "n1"}},
+        ],
+    }
+    d = w.decode_task_reply(w.encode_task_reply(reply))
+    assert d["status"] == "ok"
+    assert d["returns"][0]["data"] == b"abc"
+    assert d["returns"][1]["size"] == 1024
+    assert d["error"] == b""
+
+
+def test_payload_stays_plain_msgpack():
+    """Generic peers (old clients, the asyncio backend) must keep decoding
+    typed payloads with plain msgpack."""
+    raw = w.encode_task_spec({"task_id": "t", "args": b"zz"})
+    d = msgpack.unpackb(raw, raw=False)
+    assert d["task_id"] == "t"
+    assert d["args"] == b"zz"
+
+
+def test_version_skew_old_reader_new_sender():
+    """v2 sender adds a field; v1 reader (schema without it) must not choke
+    and the field must pass through encode (forwarder case)."""
+    raw = w.encode_task_spec({"task_id": "t", "v2_field": {"x": [1, 2]}})
+    d = w.decode_task_spec(raw)
+    assert d["v2_field"] == {"x": [1, 2]}
+    # re-encode keeps the unknown field (no silent drops when forwarding)
+    d2 = w.decode_task_spec(w.encode_task_spec(d))
+    assert d2["v2_field"] == {"x": [1, 2]}
+
+
+def test_version_skew_new_reader_old_sender():
+    """v1 sender omits new fields: a minimal hand-built msgpack map (what
+    an old peer sends) decodes with every schema default applied."""
+    raw = msgpack.packb({"task_id": "old", "name": "f"}, use_bin_type=True)
+    d = w.decode_task_spec(raw)
+    assert d["task_id"] == "old"
+    assert d["num_returns"] == 1
+    assert d["resources"] == {}
+    assert d["retry_exceptions"] is False
+    # mutable defaults must be fresh per decode (no shared-state bleed)
+    d["resources"]["CPU"] = 1.0
+    assert w.decode_task_spec(raw)["resources"] == {}
+
+
+def test_actor_seq_fixed_offset_patch():
+    raw = w.encode_actor_task_spec(
+        {"seq": 5, "actor_id": "a", "method": "m", "task_id": "t"}
+    )
+    assert w.decode_actor_task_spec(raw)["seq"] == 5
+    # seq is a 5-byte uint32 at a fixed, detectable offset
+    off = w._seq_offset(bytearray(raw))
+    assert raw[off - 1] == 0xCE
+    patched = w.patch_seq(raw, 0xDEADBEEF & 0x7FFFFFFF)
+    assert w.decode_actor_task_spec(patched)["seq"] == 0xDEADBEEF & 0x7FFFFFFF
+    # everything else is untouched
+    a, b = w.decode_actor_task_spec(raw), w.decode_actor_task_spec(patched)
+    a.pop("seq"), b.pop("seq")
+    assert a == b
+
+
+def test_method_codec_table_covers_task_object_lease_methods():
+    for method in (
+        "push_task", "push_actor_task", "get_object", "wait_object",
+        "add_borrower", "remove_borrower", "add_location", "free_object",
+        "cancel_task", "request_lease", "lease_worker", "return_worker",
+    ):
+        enc, dec, _enc_rep, _dec_rep = w.METHOD_CODECS[method]
+        assert callable(enc) and callable(dec)
+
+
+def test_generator_outputs_fresh():
+    """Generated files must match the schema (single source of truth)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "src", "schema", "gen_wire.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.fixture(scope="module")
+def cpp_probe(tmp_path_factory):
+    src = tmp_path_factory.mktemp("wireprobe") / "probe.cc"
+    src.write_text(
+        """
+#include <cstdio>
+#include <cstdlib>
+#include "raytpu/wire_gen.h"
+using namespace raytpu;
+static std::string unhex(const char *h) {
+  std::string out;
+  for (size_t i = 0; h[i] && h[i+1]; i += 2) {
+    char b[3] = {h[i], h[i+1], 0};
+    out.push_back(char(strtol(b, nullptr, 16)));
+  }
+  return out;
+}
+int main(int argc, char **argv) {
+  if (std::string(argv[1]) == "encode") {
+    wire::ActorTaskSpec a;
+    a.seq = 77; a.actor_id = "act"; a.method = "ping";
+    a.task_id = "t9"; a.args = "\\x90"; a.num_returns = 1;
+    std::string raw = a.Encode();
+    if (wire::seq_offset(raw) < 0) return 2;
+    for (unsigned char c : raw) printf("%02x", c);
+    printf("\\n");
+    return 0;
+  }
+  // decode: python-encoded TaskSpec arrives as hex in argv[2]
+  wire::TaskSpec s = wire::TaskSpec::Decode(unhex(argv[2]));
+  printf("%s|%s|%lld|%d|%.1f\\n", s.task_id.c_str(), s.name.c_str(),
+         (long long)s.num_returns, int(s.retry_exceptions),
+         s.resources.count("CPU") ? s.resources["CPU"] : -1.0);
+  return 0;
+}
+"""
+    )
+    out = str(tmp_path_factory.mktemp("wireprobe_bin") / "probe")
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-I", os.path.join(REPO, "cpp", "include"),
+         str(src), os.path.join(REPO, "cpp", "src", "client.cc"), "-o", out],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert build.returncode == 0, build.stderr
+    return out
+
+
+def test_cpp_decodes_python_encoding(cpp_probe):
+    raw = w.encode_task_spec(
+        {"task_id": "tsk-x", "name": "fn", "num_returns": 3,
+         "retry_exceptions": True, "resources": {"CPU": 2.0},
+         "unknown_future_key": [1]}
+    )
+    proc = subprocess.run(
+        [cpp_probe, "decode", raw.hex()],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "tsk-x|fn|3|1|2.0"
+
+
+def test_python_decodes_cpp_encoding(cpp_probe):
+    proc = subprocess.run(
+        [cpp_probe, "encode"], capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    raw = bytes.fromhex(proc.stdout.strip())
+    d = w.decode_actor_task_spec(raw)
+    assert d["seq"] == 77
+    assert d["actor_id"] == "act"
+    assert d["method"] == "ping"
+    # the two languages agree on the patchable offset
+    assert w._seq_offset(bytearray(raw)) == 6
+    assert w.decode_actor_task_spec(w.patch_seq(raw, 9))["seq"] == 9
