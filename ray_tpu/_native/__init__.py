@@ -101,6 +101,28 @@ def load() -> ctypes.CDLL:
                 ctypes.POINTER(ctypes.c_longlong),
             ]
             lib.rt_conn_debug.restype = ctypes.c_int
+            # --- native call table + exec fast lane (hot path, N18-N20) ---
+            lib.rt_call_start.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p,
+                ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32,
+            ]
+            lib.rt_call_start.restype = ctypes.c_uint64
+            lib.rt_call_wait.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
+                ctypes.c_void_p,
+            ]
+            lib.rt_call_wait.restype = ctypes.c_int
+            lib.rt_call_poll.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ]
+            lib.rt_call_poll.restype = ctypes.c_int
+            lib.rt_call_abandon.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.rt_exec_filter.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_exec_next.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+            ]
+            lib.rt_exec_next.restype = ctypes.c_int
+            lib.rt_exec_inject.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
             lib.rt_list_conns.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
                 ctypes.c_int,
@@ -138,6 +160,19 @@ def load_nogilrelease() -> ctypes.PyDLL:
             lib.rt_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
             lib.rt_next.restype = ctypes.c_int
             lib.rt_msg_free.argtypes = [ctypes.c_void_p]
+            # Non-blocking fast-lane entry points (safe to keep the GIL:
+            # rt_call_start's inline send is on a non-blocking fd).
+            lib.rt_call_start.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p,
+                ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32,
+            ]
+            lib.rt_call_start.restype = ctypes.c_uint64
+            lib.rt_call_poll.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ]
+            lib.rt_call_poll.restype = ctypes.c_int
+            lib.rt_call_abandon.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.rt_exec_inject.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
             _pylib = lib
     return _pylib
 

@@ -28,6 +28,8 @@
 
 #include <atomic>
 #include <cerrno>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <deque>
@@ -36,6 +38,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include <arpa/inet.h>
@@ -52,6 +55,10 @@ namespace raytpu {
 namespace rpc {
 
 constexpr uint8_t kVersion = 1;
+constexpr uint8_t kReq = 0;
+constexpr uint8_t kRep = 1;
+constexpr uint8_t kErr = 2;
+constexpr uint8_t kInjected = 253;  // synthetic: rt_exec_inject wakeup
 constexpr uint8_t kAccepted = 254;
 constexpr uint8_t kClosed = 255;
 constexpr size_t kMaxFrame = 1u << 30;  // 1 GiB sanity bound
@@ -98,21 +105,49 @@ class Engine {
     thread_ = std::thread([this] { Loop(); });
   }
 
-  ~Engine() { Stop(); }
+  ~Engine() {
+    Stop();
+    // Free replies nobody collected (callers must not be blocked in
+    // CallWait past Stop — the Python engine wrapper guarantees it).
+    std::lock_guard<std::mutex> lock(call_mu_);
+    for (auto &kv : calls_) delete kv.second.reply;
+    calls_.clear();
+  }
 
   void Stop() {
     bool expected = true;
     if (!running_.compare_exchange_strong(expected, false)) return;
     Wake();
     if (thread_.joinable()) thread_.join();
-    std::lock_guard<std::mutex> lock(mu_);
-    for (auto &kv : conns_) CloseFd(*kv.second);
-    conns_.clear();
-    close(epfd_);
-    close(wakefd_);
-    close(notifyfd_);
-    for (auto *m : inbox_) delete m;
-    inbox_.clear();
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      for (auto &kv : conns_) CloseFd(*kv.second);
+      conns_.clear();
+      close(epfd_);
+      close(wakefd_);
+      close(notifyfd_);
+      for (auto *m : inbox_) delete m;
+      inbox_.clear();
+    }
+    {
+      // Fail every parked native call, then WAIT for the waiters to
+      // drain: a thread still inside CallWait/ExecNext when the engine
+      // is deleted would wake on a destroyed mutex (TSAN-caught).
+      std::unique_lock<std::mutex> lock(call_mu_);
+      for (auto &kv : calls_) {
+        if (kv.second.state == 0) kv.second.state = 2;
+      }
+      conn_calls_.clear();
+      call_cv_.notify_all();
+      call_cv_.wait(lock, [&] { return call_waiters_ == 0; });
+    }
+    {
+      std::unique_lock<std::mutex> lock(exec_mu_);
+      for (auto *m : execq_) delete m;
+      execq_.clear();
+      exec_cv_.notify_all();
+      exec_cv_.wait(lock, [&] { return exec_waiters_ == 0; });
+    }
   }
 
   int notify_fd() const { return notifyfd_; }
@@ -301,7 +336,219 @@ class Engine {
     return m;
   }
 
+  // -------------------------------------------------------------------
+  // Native call table — request/reply matching in C++ (the reference's
+  // ClientCallManager / task-reply matching role, N18/N19): callers on
+  // ANY thread start a call and block in CallWait with the GIL released;
+  // the engine thread captures the matching REP/ERR before it ever
+  // reaches the Python inbox. Shares msgid space with the asyncio
+  // clients on the same conn, so both styles coexist per connection.
+  // -------------------------------------------------------------------
+  struct PendingCall {
+    long conn = 0;
+    uint32_t msgid = 0;
+    int state = 0;  // 0=waiting 1=done 2=conn-lost
+    Msg *reply = nullptr;
+  };
+
+  uint64_t CallStart(long conn_id, const uint8_t *method, uint32_t mlen,
+                     const uint8_t *payload, uint32_t plen) {
+    uint32_t msgid = NextMsgid(conn_id);
+    if (msgid == 0) return 0;
+    uint64_t handle;
+    {
+      std::lock_guard<std::mutex> lock(call_mu_);
+      handle = next_call_++;
+      PendingCall &pc = calls_[handle];
+      pc.conn = conn_id;
+      pc.msgid = msgid;
+      conn_calls_[conn_id][msgid] = handle;
+    }
+    int rc = Send(conn_id, kReq, msgid, method, mlen, payload, plen);
+    if (rc != 0) {
+      std::lock_guard<std::mutex> lock(call_mu_);
+      calls_.erase(handle);
+      auto it = conn_calls_.find(conn_id);
+      if (it != conn_calls_.end()) it->second.erase(msgid);
+      return 0;
+    }
+    return handle;
+  }
+
+  // 1 = reply ready (view filled, caller owns reply via rt_msg_free),
+  // 0 = timeout, -1 = connection lost, -2 = unknown handle.
+  int CallWait(uint64_t handle, int timeout_ms, Msg **out) {
+    std::unique_lock<std::mutex> lock(call_mu_);
+    auto it = calls_.find(handle);
+    if (it == calls_.end()) return -2;
+    if (it->second.state == 0) {
+      auto pred = [&] {
+        auto i = calls_.find(handle);
+        return i == calls_.end() || i->second.state != 0;
+      };
+      ++call_waiters_;
+      bool satisfied = true;
+      if (timeout_ms < 0) {
+        call_cv_.wait(lock, pred);
+      } else {
+        satisfied = call_cv_.wait_for(
+            lock, std::chrono::milliseconds(timeout_ms), pred);
+      }
+      if (--call_waiters_ == 0 && !running_.load()) {
+        call_cv_.notify_all();  // release a Stop() draining waiters
+      }
+      if (!satisfied) return 0;
+      it = calls_.find(handle);
+      if (it == calls_.end()) return -2;
+    }
+    int state = it->second.state;
+    if (state == 0) return 0;
+    *out = it->second.reply;  // may be nullptr on conn-lost
+    calls_.erase(it);
+    return state == 1 ? 1 : -1;
+  }
+
+  // Non-blocking probe; same returns as CallWait (0 = still pending).
+  int CallPoll(uint64_t handle, Msg **out) {
+    std::lock_guard<std::mutex> lock(call_mu_);
+    auto it = calls_.find(handle);
+    if (it == calls_.end()) return -2;
+    if (it->second.state == 0) return 0;
+    *out = it->second.reply;
+    int state = it->second.state;
+    calls_.erase(it);
+    return state == 1 ? 1 : -1;
+  }
+
+  void CallAbandon(uint64_t handle) {
+    std::lock_guard<std::mutex> lock(call_mu_);
+    auto it = calls_.find(handle);
+    if (it == calls_.end()) return;
+    delete it->second.reply;
+    auto cit = conn_calls_.find(it->second.conn);
+    if (cit != conn_calls_.end()) cit->second.erase(it->second.msgid);
+    calls_.erase(it);
+  }
+
+  // -------------------------------------------------------------------
+  // Exec queue — the worker-side fast lane (task_receiver.cc role, N20):
+  // REQ frames whose method is in the filter set bypass the Python inbox
+  // (and thus the asyncio loop) and land in a dedicated queue consumed
+  // by the worker's execution thread via ExecNext (GIL released while
+  // blocked). ExecInject lets Python enqueue its own work items so one
+  // thread serves both lanes in arrival order.
+  // -------------------------------------------------------------------
+  void ExecFilterAdd(const char *method) {
+    std::lock_guard<std::mutex> lock(exec_mu_);
+    exec_methods_.insert(method);
+    exec_filter_on_.store(true, std::memory_order_release);
+  }
+
+  int ExecNext(int timeout_ms, Msg **out) {
+    std::unique_lock<std::mutex> lock(exec_mu_);
+    auto pred = [&] { return !execq_.empty() || !running_.load(); };
+    ++exec_waiters_;
+    bool satisfied = true;
+    if (timeout_ms < 0) {
+      exec_cv_.wait(lock, pred);
+    } else {
+      satisfied =
+          exec_cv_.wait_for(lock, std::chrono::milliseconds(timeout_ms), pred);
+    }
+    if (--exec_waiters_ == 0 && !running_.load()) {
+      exec_cv_.notify_all();  // release a Stop() draining waiters
+    }
+    if (!satisfied) return 0;
+    if (!execq_.empty()) {
+      *out = execq_.front();
+      execq_.pop_front();
+      return 1;
+    }
+    return -1;  // engine stopping
+  }
+
+  void ExecInject(uint32_t tag) {
+    auto *m = new Msg();
+    m->kind = kInjected;
+    m->msgid = tag;
+    std::lock_guard<std::mutex> lock(exec_mu_);
+    execq_.push_back(m);
+    exec_cv_.notify_one();
+  }
+
  private:
+  // Engine thread: route freshly parsed frames. Native-call replies and
+  // filtered exec requests are consumed here (never touch the Python
+  // inbox); everything else lands in `rest` for the inbox.
+  void RouteDecoded(std::vector<Msg *> &decoded, std::vector<Msg *> &rest) {
+    bool exec_on = exec_filter_on_.load(std::memory_order_acquire);
+    std::vector<Msg *> to_exec;
+    {
+      std::lock_guard<std::mutex> lock(call_mu_);
+      for (auto *&m : decoded) {
+        if (m == nullptr) continue;
+        if (m->kind == kRep || m->kind == kErr) {
+          auto cit = conn_calls_.find(m->conn);
+          if (cit != conn_calls_.end()) {
+            auto mit = cit->second.find(m->msgid);
+            if (mit != cit->second.end()) {
+              auto pit = calls_.find(mit->second);
+              if (pit != calls_.end()) {
+                pit->second.reply = m;
+                pit->second.state = 1;
+              } else {
+                delete m;  // abandoned call: drop the late reply
+              }
+              cit->second.erase(mit);
+              m = nullptr;
+              continue;
+            }
+          }
+        }
+      }
+    }
+    if (exec_on) {
+      std::lock_guard<std::mutex> lock(exec_mu_);
+      for (auto *&m : decoded) {
+        if (m == nullptr) continue;
+        if (m->kind == kReq && exec_methods_.count(m->method)) {
+          to_exec.push_back(m);
+          m = nullptr;
+        }
+      }
+      for (auto *m : to_exec) execq_.push_back(m);
+      if (!to_exec.empty()) exec_cv_.notify_one();
+    }
+    bool any_reply = false;
+    for (auto *m : decoded) {
+      if (m != nullptr) {
+        rest.push_back(m);
+      } else {
+        any_reply = true;
+      }
+    }
+    if (any_reply) call_cv_.notify_all();
+  }
+
+  // Fail every native call pending on a conn (engine thread, conn close).
+  void FailCallsForConn(long conn_id) {
+    bool any = false;
+    {
+      std::lock_guard<std::mutex> lock(call_mu_);
+      auto cit = conn_calls_.find(conn_id);
+      if (cit != conn_calls_.end()) {
+        for (auto &kv : cit->second) {
+          auto pit = calls_.find(kv.second);
+          if (pit != calls_.end()) {
+            pit->second.state = 2;
+            any = true;
+          }
+        }
+        conn_calls_.erase(cit);
+      }
+    }
+    if (any) call_cv_.notify_all();
+  }
   std::shared_ptr<Conn> Lookup(long conn_id) {
     std::lock_guard<std::mutex> lock(mu_);
     auto it = conns_.find(conn_id);
@@ -419,6 +666,7 @@ class Engine {
       inbox_.push_back(m);
       *notified = true;
     }
+    FailCallsForConn(id);
     std::lock_guard<std::mutex> wlock(conn->wmu);
     CloseFd(*conn);
   }
@@ -523,9 +771,13 @@ class Engine {
       break;
     }
     if (!decoded.empty()) {
-      std::lock_guard<std::mutex> lock(mu_);
-      for (auto *m : decoded) inbox_.push_back(m);
-      *notified = true;
+      std::vector<Msg *> rest;
+      RouteDecoded(decoded, rest);
+      if (!rest.empty()) {
+        std::lock_guard<std::mutex> lock(mu_);
+        for (auto *m : rest) inbox_.push_back(m);
+        *notified = true;
+      }
     }
     if (dead) RequestClose(c.id);
   }
@@ -576,6 +828,23 @@ class Engine {
   std::vector<long> pending_close_;
   std::vector<long> pending_arm_;
   long next_id_ = 1;
+
+  // native call table (CallStart/CallWait)
+  std::mutex call_mu_;
+  std::condition_variable call_cv_;
+  std::unordered_map<uint64_t, PendingCall> calls_;
+  std::unordered_map<long, std::unordered_map<uint32_t, uint64_t>>
+      conn_calls_;
+  uint64_t next_call_ = 1;
+  int call_waiters_ = 0;  // guarded by call_mu_ (Stop drains to zero)
+
+  // exec fast lane (ExecFilterAdd/ExecNext/ExecInject)
+  std::mutex exec_mu_;
+  std::condition_variable exec_cv_;
+  std::deque<Msg *> execq_;
+  std::unordered_set<std::string> exec_methods_;
+  std::atomic<bool> exec_filter_on_{false};
+  int exec_waiters_ = 0;  // guarded by exec_mu_ (Stop drains to zero)
 };
 
 }  // namespace rpc
@@ -669,6 +938,70 @@ int rt_next(void *e, rt_msg_view *out) {
 
 void rt_msg_free(void *opaque) {
   delete static_cast<raytpu::rpc::Msg *>(opaque);
+}
+
+static void fill_view(raytpu::rpc::Msg *m, rt_msg_view *out) {
+  out->conn = m->conn;
+  out->kind = m->kind;
+  out->msgid = m->msgid;
+  out->method = m->method.data();
+  out->mlen = uint32_t(m->method.size());
+  out->payload = reinterpret_cast<const char *>(m->payload.data());
+  out->plen = uint32_t(m->payload.size());
+  out->opaque = m;
+}
+
+// ---------------------------------------------------------------------------
+// Native call table: request/reply matching without the asyncio loop.
+// ---------------------------------------------------------------------------
+uint64_t rt_call_start(void *e, long conn, const uint8_t *method,
+                       uint32_t mlen, const uint8_t *payload, uint32_t plen) {
+  return static_cast<raytpu::rpc::Engine *>(e)->CallStart(conn, method, mlen,
+                                                          payload, plen);
+}
+
+// 1=reply (view filled; free via rt_msg_free), 0=timeout,
+// -1=connection lost, -2=unknown handle. Blocks: call via CDLL only.
+int rt_call_wait(void *e, uint64_t handle, int timeout_ms, rt_msg_view *out) {
+  raytpu::rpc::Msg *m = nullptr;
+  int rc = static_cast<raytpu::rpc::Engine *>(e)->CallWait(handle, timeout_ms,
+                                                           &m);
+  if (rc == 1 && m != nullptr) fill_view(m, out);
+  if (rc == -1 && m != nullptr) delete m;
+  return rc;
+}
+
+// Non-blocking twin of rt_call_wait (PyDLL-safe).
+int rt_call_poll(void *e, uint64_t handle, rt_msg_view *out) {
+  raytpu::rpc::Msg *m = nullptr;
+  int rc = static_cast<raytpu::rpc::Engine *>(e)->CallPoll(handle, &m);
+  if (rc == 1 && m != nullptr) fill_view(m, out);
+  if (rc == -1 && m != nullptr) delete m;
+  return rc;
+}
+
+void rt_call_abandon(void *e, uint64_t handle) {
+  static_cast<raytpu::rpc::Engine *>(e)->CallAbandon(handle);
+}
+
+// ---------------------------------------------------------------------------
+// Exec fast lane: divert chosen REQ methods to a dedicated consumer.
+// ---------------------------------------------------------------------------
+void rt_exec_filter(void *e, const char *method) {
+  static_cast<raytpu::rpc::Engine *>(e)->ExecFilterAdd(method);
+}
+
+// 1=message (REQ or injected; free via rt_msg_free), 0=timeout,
+// -1=engine stopping. Blocks: call via CDLL only.
+int rt_exec_next(void *e, int timeout_ms, rt_msg_view *out) {
+  raytpu::rpc::Msg *m = nullptr;
+  int rc = static_cast<raytpu::rpc::Engine *>(e)->ExecNext(timeout_ms, &m);
+  if (rc == 1 && m != nullptr) fill_view(m, out);
+  return rc;
+}
+
+void rt_exec_inject(void *e, uint32_t tag) {
+  static_cast<raytpu::rpc::Engine *>(e)->ExecInject(tag);
 }
 
 }  // extern "C"

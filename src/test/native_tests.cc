@@ -46,6 +46,14 @@ int rt_send(void *e, long conn, uint8_t kind, uint32_t msgid,
 void rt_close_conn(void *e, long conn);
 int rt_next(void *e, rt_msg_view *out);
 void rt_msg_free(void *opaque);
+uint64_t rt_call_start(void *e, long conn, const uint8_t *method,
+                       uint32_t mlen, const uint8_t *payload, uint32_t plen);
+int rt_call_wait(void *e, uint64_t handle, int timeout_ms, rt_msg_view *out);
+int rt_call_poll(void *e, uint64_t handle, rt_msg_view *out);
+void rt_call_abandon(void *e, uint64_t handle);
+void rt_exec_filter(void *e, const char *method);
+int rt_exec_next(void *e, int timeout_ms, rt_msg_view *out);
+void rt_exec_inject(void *e, uint32_t tag);
 
 void *raytpu_store_start(const char *socket_path, const char *shm_path,
                          uint64_t capacity, const char *spill_dir);
@@ -224,12 +232,126 @@ void test_store_lifecycle_and_garbage() {
   std::printf("store lifecycle + garbage input: ok\n");
 }
 
+void test_call_table_multithreaded() {
+  // N caller threads block in rt_call_wait against an echo thread that
+  // serves via the exec fast lane: covers call registration, reply
+  // interception, exec diversion, and cross-thread wakeups under TSAN.
+  void *server = rt_engine_new();
+  rt_exec_filter(server, "fastecho");
+  int port = 0;
+  assert(rt_listen_tcp(server, "127.0.0.1", 0, &port) >= 0);
+  void *client = rt_engine_new();
+  long conn = rt_connect_tcp(client, "127.0.0.1", port);
+  assert(conn > 0);
+
+  std::thread echo_server([&] {
+    rt_msg_view view{};
+    while (true) {
+      int rc = rt_exec_next(server, 5000, &view);
+      if (rc != 1) break;  // engine stopping (or idle timeout = done)
+      if (view.plen == 4 &&
+          std::memcmp(view.payload, "stop", 4) == 0) {
+        rt_send(server, view.conn, kRep, view.msgid,
+                reinterpret_cast<const uint8_t *>("fastecho"), 8,
+                reinterpret_cast<const uint8_t *>("bye"), 3);
+        rt_msg_free(view.opaque);
+        break;
+      }
+      rt_send(server, view.conn, kRep, view.msgid,
+              reinterpret_cast<const uint8_t *>("fastecho"), 8,
+              reinterpret_cast<const uint8_t *>(view.payload), view.plen);
+      rt_msg_free(view.opaque);
+    }
+  });
+
+  constexpr int kThreads = 4;
+  constexpr int kPerThread = 100;
+  std::vector<std::thread> callers;
+  for (int t = 0; t < kThreads; ++t) {
+    callers.emplace_back([&, t] {
+      for (int i = 0; i < kPerThread; ++i) {
+        std::string payload =
+            "p" + std::to_string(t) + ":" + std::to_string(i);
+        uint64_t h = rt_call_start(
+            client, conn, reinterpret_cast<const uint8_t *>("fastecho"), 8,
+            reinterpret_cast<const uint8_t *>(payload.data()),
+            uint32_t(payload.size()));
+        assert(h != 0);
+        rt_msg_view view{};
+        int rc = rt_call_wait(client, h, 20000, &view);
+        assert(rc == 1);
+        assert(view.kind == kRep);
+        assert(std::string(view.payload, view.plen) == payload);
+        rt_msg_free(view.opaque);
+      }
+    });
+  }
+  for (auto &th : callers) th.join();
+
+  // Abandoned call: the late reply must be dropped, not leaked (ASAN).
+  uint64_t h = rt_call_start(client, conn,
+                             reinterpret_cast<const uint8_t *>("fastecho"), 8,
+                             reinterpret_cast<const uint8_t *>("zz"), 2);
+  assert(h != 0);
+  rt_call_abandon(client, h);
+
+  uint64_t stop_h = rt_call_start(
+      client, conn, reinterpret_cast<const uint8_t *>("fastecho"), 8,
+      reinterpret_cast<const uint8_t *>("stop"), 4);
+  rt_msg_view view{};
+  assert(rt_call_wait(client, stop_h, 20000, &view) == 1);
+  rt_msg_free(view.opaque);
+  echo_server.join();
+
+  rt_engine_stop(client);
+  rt_engine_stop(server);
+  std::printf("call table multithreaded: ok (%d calls)\n",
+              kThreads * kPerThread);
+}
+
+void test_call_table_conn_lost_and_stop() {
+  // Waiters parked on calls must wake with conn-lost when the peer dies,
+  // and engine stop must not strand an exec consumer.
+  void *server = rt_engine_new();
+  int port = 0;
+  assert(rt_listen_tcp(server, "127.0.0.1", 0, &port) >= 0);
+  void *client = rt_engine_new();
+  long conn = rt_connect_tcp(client, "127.0.0.1", port);
+  assert(conn > 0);
+
+  uint64_t h = rt_call_start(client, conn,
+                             reinterpret_cast<const uint8_t *>("never"), 5,
+                             reinterpret_cast<const uint8_t *>("x"), 1);
+  assert(h != 0);
+  std::thread killer([&] {
+    usleep(100 * 1000);
+    rt_close_conn(client, conn);
+  });
+  rt_msg_view view{};
+  assert(rt_call_wait(client, h, 20000, &view) == -1);
+  killer.join();
+
+  std::thread exec_waiter([&] {
+    rt_msg_view v{};
+    // blocks until Stop wakes it with -1
+    int rc = rt_exec_next(client, 20000, &v);
+    assert(rc == -1 || rc == 0);
+  });
+  usleep(50 * 1000);
+  rt_engine_stop(client);
+  exec_waiter.join();
+  rt_engine_stop(server);
+  std::printf("call table conn-lost + stop: ok\n");
+}
+
 }  // namespace
 
 int main() {
   test_rpc_round_trip();
   test_rpc_multithreaded_stress();
   test_rpc_teardown_with_inflight();
+  test_call_table_multithreaded();
+  test_call_table_conn_lost_and_stop();
   test_store_lifecycle_and_garbage();
   std::printf("ALL NATIVE TESTS PASSED\n");
   return 0;
