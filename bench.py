@@ -67,12 +67,22 @@ def main() -> None:
     # warmup/compile. float() forces a device->host read — on remote-attached
     # chips block_until_ready alone does not guarantee execution finished.
     params, opt_state, loss = train_step(params, opt_state, tokens)
-    float(loss)
+    first_loss = float(loss)
     start = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss = train_step(params, opt_state, tokens)
     loss_value = float(loss)  # chained params => all steps must complete
     elapsed = time.perf_counter() - start
+    # Loss sanity: repeated steps on a fixed batch must strictly improve —
+    # the throughput number provably comes from real, chained optimizer
+    # steps (a broken/no-op step would leave the loss flat).
+    if not (loss_value < first_loss):
+        print(
+            f"BENCH SANITY FAILED: loss did not decrease "
+            f"({first_loss} -> {loss_value})",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
 
     tokens_per_step = batch * config.max_seq
     tokens_per_s = tokens_per_step * steps / elapsed

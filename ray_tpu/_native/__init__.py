@@ -107,6 +107,18 @@ def load() -> ctypes.CDLL:
                 ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32,
             ]
             lib.rt_call_start.restype = ctypes.c_uint64
+            lib.rt_call_start_buf.argtypes = lib.rt_call_start.argtypes
+            lib.rt_call_start_buf.restype = ctypes.c_uint64
+            lib.rt_send_buf.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_uint8,
+                ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_char_p, ctypes.c_uint32,
+            ]
+            lib.rt_send_buf.restype = ctypes.c_int
+            lib.rt_exec_pending.argtypes = [ctypes.c_void_p]
+            lib.rt_exec_pending.restype = ctypes.c_int
+            lib.rt_conn_inflight.argtypes = [ctypes.c_void_p, ctypes.c_long]
+            lib.rt_conn_inflight.restype = ctypes.c_int
             lib.rt_call_wait.argtypes = [
                 ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int,
                 ctypes.c_void_p,
@@ -167,6 +179,18 @@ def load_nogilrelease() -> ctypes.PyDLL:
                 ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32,
             ]
             lib.rt_call_start.restype = ctypes.c_uint64
+            lib.rt_call_start_buf.argtypes = lib.rt_call_start.argtypes
+            lib.rt_call_start_buf.restype = ctypes.c_uint64
+            lib.rt_send_buf.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_uint8,
+                ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32,
+                ctypes.c_char_p, ctypes.c_uint32,
+            ]
+            lib.rt_send_buf.restype = ctypes.c_int
+            lib.rt_exec_pending.argtypes = [ctypes.c_void_p]
+            lib.rt_exec_pending.restype = ctypes.c_int
+            lib.rt_conn_inflight.argtypes = [ctypes.c_void_p, ctypes.c_long]
+            lib.rt_conn_inflight.restype = ctypes.c_int
             lib.rt_call_poll.argtypes = [
                 ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
             ]

@@ -76,6 +76,12 @@ class RayTpuConfig:
     # Amortizes per-task wakeups/syscalls; 1 = strict request-reply.
     worker_pipeline_depth: int = _env("worker_pipeline_depth", 4)
 
+    # Direct-call lane: simple tasks/actor calls ride the native C++ call
+    # table from the caller thread (no asyncio on the hot path —
+    # reference: normal_task_submitter.cc direct calls [N19]). Set
+    # RAY_TPU_direct_call=0 to force everything through the asyncio path.
+    direct_call: bool = _env("direct_call", True)
+
     # --- tasks / fault tolerance ---
     task_max_retries_default: int = _env("task_max_retries_default", 3)
     actor_max_restarts_default: int = _env("actor_max_restarts_default", 0)

@@ -24,15 +24,22 @@ import weakref
 from typing import Any, Callable, Sequence
 
 from ray_tpu import exceptions
-from ray_tpu._private import serialization
+from ray_tpu._private import serialization, wire_gen
 from ray_tpu._private.config import global_config
 from ray_tpu.util import tracing
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFull
-from ray_tpu._private.rpc import ConnectionLost, IoThread, RpcClient, RpcError, RpcServer, spawn_task
+from ray_tpu._private.rpc import (
+    ConnectionLost, ERR, IoThread, REP, RpcClient, RpcError, RpcServer,
+    native_available, spawn_task,
+)
 
 PENDING, INLINE, SHM, FAILED = "pending", "inline", "shm", "failed"
+
+# Sentinel: the direct-lane get() could not prove everything local and the
+# caller must fall back to the asyncio path.
+_DIRECT_MISS = object()
 
 # Zero-copy reads: values whose out-of-band buffers exceed this stay views
 # onto the arena (object pinned until the value is GC'd); smaller values are
@@ -41,7 +48,10 @@ _ZERO_COPY_THRESHOLD = 1 << 20
 
 
 class ObjectState:
-    __slots__ = ("status", "data", "locations", "size", "error", "event")
+    __slots__ = (
+        "status", "data", "locations", "size", "error", "event", "record",
+        "waited",
+    )
 
     def __init__(self):
         self.status = PENDING
@@ -50,6 +60,14 @@ class ObjectState:
         self.size = 0
         self.error: str | None = None
         self.event = asyncio.Event()
+        # Direct-lane backlink: the PendingTask whose native reply settles
+        # this state (None for put()s and asyncio-path tasks).
+        self.record: "PendingTask | None" = None
+        # True once a loop-side waiter parked on `event`; caller-thread
+        # settles then notify the loop (asyncio.Event is not thread-safe
+        # to set from outside, and an unconditional call_soon_threadsafe
+        # per task would cost a loop wakeup per task).
+        self.waited = False
 
 
 class LeasedWorker:
@@ -65,7 +83,11 @@ class LeasedWorker:
 
 
 class PendingTask:
-    __slots__ = ("spec", "attempts", "return_ids", "arg_refs", "done")
+    __slots__ = (
+        "spec", "attempts", "return_ids", "arg_refs", "done",
+        "direct", "native_handle", "direct_worker", "settle_lock",
+        "done_event",
+    )
 
     def __init__(self, spec, return_ids, arg_refs):
         self.spec = spec
@@ -73,6 +95,34 @@ class PendingTask:
         self.return_ids = return_ids
         self.arg_refs = arg_refs
         self.done = False
+        # Direct-lane fields (set by the native submitter): the in-flight
+        # C++ call handle, the pool worker it rode, and settle coordination
+        # (first settler consumes the handle; others wait on done_event,
+        # which is a threading.Event — safe to set from any thread).
+        self.direct = False
+        self.native_handle: int | None = None
+        self.direct_worker: "DirectWorker | None" = None
+        self.settle_lock: threading.Lock | None = None
+        self.done_event: threading.Event | None = None
+
+    def make_direct(self) -> None:
+        self.direct = True
+        self.settle_lock = threading.Lock()
+        self.done_event = threading.Event()
+
+
+class DirectWorker:
+    """A leased worker conn owned by the direct-call lane (the lease-reuse
+    role of a dispatcher, minus the asyncio machinery)."""
+
+    __slots__ = ("leased", "conn_id", "inflight", "last_used", "dead")
+
+    def __init__(self, leased: "LeasedWorker", conn_id: int):
+        self.leased = leased
+        self.conn_id = conn_id
+        self.inflight = 0
+        self.last_used = time.monotonic()
+        self.dead = False
 
 
 def _resources_key(resources: dict, runtime_env_hash: str) -> str:
@@ -122,6 +172,21 @@ class CoreContext:
         self._running_tasks: dict[str, RpcClient] = {}  # task_id -> worker client
         self._task_records: dict[str, PendingTask] = {}
 
+        # Direct-call lane (native C++ call table, [N19] direct calls):
+        # caller threads submit/settle without touching the asyncio loop.
+        self._engine = None  # _NativeEngine of the io loop (set on connect)
+        self._direct_lock = threading.Lock()
+        self._direct_pool: dict[str, list[DirectWorker]] = {}
+        self._direct_grows: dict[str, int] = {}
+        self._direct_backoff: dict[str, float] = {}
+        self._direct_reaper_started = False
+        self._actor_pending_slow: dict[str, int] = {}
+        self._actor_spec_templates: dict[tuple, dict] = {}
+        # Unsettled direct calls (GIL-guarded int): >=2 means a burst is in
+        # flight, so submits use the buffered send (engine-thread writev)
+        # instead of paying an inline syscall + preemption per frame.
+        self._direct_unsettled = 0
+
         # lease cache: resources_key -> list[LeasedWorker]
         self._idle_leases: dict[str, list[LeasedWorker]] = {}
         self._task_queues: dict[str, asyncio.Queue] = {}
@@ -165,6 +230,10 @@ class CoreContext:
         self.core_server.route_object(self)
         port = await self.core_server.start()
         self.address = ("127.0.0.1", port)
+        if native_available() and global_config().direct_call:
+            from ray_tpu._private.rpc import _NativeEngine
+
+            self._engine = _NativeEngine.for_running_loop()
         self.controller = RpcClient(
             self.controller_addr, name="to-controller", auto_reconnect=True
         )
@@ -231,6 +300,16 @@ class CoreContext:
         # Close every outstanding peer client (direct, actor, leased-worker)
         # so their recv loops are reaped — dropping them unclosed leaves
         # "Task was destroyed but it is pending!" noise at exit.
+        with self._direct_lock:
+            direct_workers = [
+                dw for pool in self._direct_pool.values() for dw in pool
+            ]
+            self._direct_pool.clear()
+        for dw in direct_workers:
+            try:
+                await self._release_lease(dw.leased, reusable=True)
+            except Exception:
+                pass
         peers = list(self._clients.values())
         for leases in self._idle_leases.values():
             peers.extend(w.client for w in leases if w.client is not None)
@@ -323,7 +402,22 @@ class CoreContext:
     async def _free_owned(self, object_id: str) -> None:
         state = self._objects.pop(object_id, None)
         self._lineage.pop(object_id, None)
-        if state is None or state.status != SHM:
+        if state is None:
+            return
+        record = state.record
+        if (
+            record is not None
+            and record.direct
+            and not record.done
+            and all(rid not in self._objects for rid in record.return_ids)
+        ):
+            # Fire-and-forget: every ref to this direct-lane task's returns
+            # is gone and nobody will ever collect the reply — abandon the
+            # native call entry (the task still executes; only the reply
+            # is dropped, matching ignored-ref semantics) so the C++ call
+            # table, task records, and worker inflight counts don't leak.
+            self._direct_abandon(record)
+        if state.status != SHM:
             return
         for loc in state.locations:
             try:
@@ -359,12 +453,12 @@ class CoreContext:
             state.status = SHM
             state.size = total
             state.locations = [self._local_location()]
-        self.io.run(self._finish_state(object_id, state))
-        return self.new_object_ref(object_id)
-
-    async def _finish_state(self, object_id: str, state: ObjectState) -> None:
-        self._objects[object_id] = state
+        # Publish directly from this thread: the state is settled before
+        # anyone can see it, so setting the (waiterless) event is safe and
+        # the put pays no io-loop round-trip.
         state.event.set()
+        self._objects[object_id] = state
+        return self.new_object_ref(object_id)
 
     def _store_put_local(self, object_id: str, payload: bytes) -> None:
         try:
@@ -411,6 +505,10 @@ class CoreContext:
     def get(self, refs: ObjectRef | Sequence[ObjectRef], timeout: float | None = None) -> Any:
         single = isinstance(refs, ObjectRef)
         ref_list = [refs] if single else list(refs)
+        if self._engine is not None and ref_list:
+            values = self._get_direct(ref_list, timeout)
+            if values is not _DIRECT_MISS:
+                return values[0] if single else values
 
         async def _gather():
             return await asyncio.wait_for(
@@ -505,7 +603,7 @@ class CoreContext:
         """Returns (payload bytes/memoryview, is_pinned_view)."""
         state = self._objects.get(ref.id)
         if state is not None:
-            await state.event.wait()
+            await self._await_state(state)
             return await self._payload_from_state(ref.id, state)
         # Not the owner: ask the owner (blocks server-side until ready).
         owner = ref.owner_address
@@ -687,10 +785,431 @@ class CoreContext:
     async def _wait_ready(self, ref: ObjectRef) -> None:
         state = self._objects.get(ref.id)
         if state is not None:
-            await state.event.wait()
+            await self._await_state(state)
             return
         client = await self._client_for(ref.owner_address)
         await client.call("wait_object", {"object_id": ref.id})
+
+    # ------------------------------------------------------------------
+    # direct-call lane — the native per-call hot path (N18/N19).
+    #
+    # Simple tasks (no ref args, default strategy/runtime-env) and actor
+    # calls ride the C++ call table (src/rpc/transport.cc rt_call_*)
+    # straight from the calling thread: spec encode (typed wire schema),
+    # submit, reply matching, and inline-return settling never touch the
+    # asyncio loop. Python keeps ONLY the scheduling policy (lease
+    # acquisition via the asyncio path) and failure handling (fallback to
+    # the asyncio machinery). Role split mirrors the reference's
+    # normal_task_submitter.cc / actor_task_submitter.cc over C++ rpc.
+    # ------------------------------------------------------------------
+    def _direct_pick(self, key: str, spec: dict) -> "DirectWorker | None":
+        """Least-loaded live direct worker for this resource shape, or
+        None (caller falls back to the asyncio path). Triggers ASYNC pool
+        growth so the next submits find capacity — never blocks."""
+        cfg = global_config()
+        now = time.monotonic()
+        with self._direct_lock:
+            pool = self._direct_pool.get(key)
+            alive = [w for w in pool if not w.dead] if pool else []
+            if pool is not None and len(alive) != len(pool):
+                self._direct_pool[key] = alive
+            best = min(alive, key=lambda w: w.inflight) if alive else None
+            growing = self._direct_grows.get(key, 0)
+            backoff_until = self._direct_backoff.get(key, 0.0)
+            hint = self._lease_capacity_hint.get(
+                key, self._MAX_DISPATCHERS_PER_KEY
+            )
+            cap = min(self._MAX_DISPATCHERS_PER_KEY, max(1, hint))
+            # Grow on the NATIVE in-flight depth (calls still awaiting a
+            # reply in the C++ table), not the Python uncollected count: a
+            # burst of already-executed-but-not-yet-collected fast tasks
+            # must not spawn workers the machine will only thrash between.
+            want_grow = best is None or (
+                best.inflight >= cfg.worker_pipeline_depth
+                and len(alive) + growing < cap
+                and self._engine.pylib.rt_conn_inflight(
+                    self._engine.handle, best.conn_id
+                ) >= cfg.worker_pipeline_depth
+            )
+            if (
+                want_grow
+                and now >= backoff_until
+                and growing < 2
+                and len(alive) + growing < cap
+            ):
+                self._direct_grows[key] = growing + 1
+                self.io.spawn(self._direct_grow(key, dict(spec)))
+            if best is not None:
+                best.inflight += 1
+                best.last_used = now
+            return best
+
+    async def _direct_grow(self, key: str, spec: dict) -> None:
+        try:
+            leased = await self._acquire_lease(spec)
+            conn_id = getattr(leased.client, "_conn_id", None)
+            if conn_id is None:  # asyncio-backend client: lane unusable
+                await self._release_lease(leased, reusable=True)
+                return
+            dw = DirectWorker(leased, conn_id)
+            with self._direct_lock:
+                self._direct_pool.setdefault(key, []).append(dw)
+            if not self._direct_reaper_started:
+                self._direct_reaper_started = True
+                spawn_task(self._direct_reaper())
+        except Exception:
+            # No capacity: back off so a hot submit loop doesn't churn
+            # controller lease RPCs (the dispatcher's capacity-hint role).
+            with self._direct_lock:
+                self._direct_backoff[key] = time.monotonic() + 2.0
+        finally:
+            with self._direct_lock:
+                self._direct_grows[key] = max(
+                    0, self._direct_grows.get(key, 1) - 1
+                )
+
+    async def _direct_reaper(self) -> None:
+        """Idle direct leases return to the agent after the grace period
+        (raylet idle-lease grace role) so pool resources never strand."""
+        grace = global_config().worker_lease_grace_s
+        while not self._shutdown:
+            await asyncio.sleep(max(grace, 0.1))
+            now = time.monotonic()
+            to_release = []
+            with self._direct_lock:
+                for key, pool in list(self._direct_pool.items()):
+                    keep = []
+                    for dw in pool:
+                        if dw.dead:
+                            continue
+                        if dw.inflight == 0 and now - dw.last_used > grace:
+                            to_release.append(dw)
+                        else:
+                            keep.append(dw)
+                    self._direct_pool[key] = keep
+            for dw in to_release:
+                try:
+                    await self._release_lease(dw.leased, reusable=True)
+                except Exception:
+                    pass
+
+    def _direct_note_dead(self, dw: DirectWorker) -> None:
+        dw.dead = True
+        with self._direct_lock:
+            pool = self._direct_pool.get(dw.leased.resources_key)
+            if pool and dw in pool:
+                pool.remove(dw)
+        try:
+            self.io.spawn(self._release_lease(dw.leased, reusable=False))
+        except RuntimeError:
+            pass
+
+    def _direct_submit(self, key: str, record: PendingTask) -> bool:
+        """Put a simple task on the wire via the native call table from
+        THIS thread. False = caller must use the asyncio path."""
+        engine = self._engine
+        if engine is None:
+            return False
+        worker = self._direct_pick(key, record.spec)
+        if worker is None:
+            return False
+        payload = wire_gen.encode_task_spec(record.spec)
+        lib = (
+            engine.pylib
+            if len(payload) < engine._PYLIB_MAX_PAYLOAD
+            else engine.lib
+        )
+        starter = (
+            lib.rt_call_start_buf
+            if self._direct_unsettled >= 2
+            else lib.rt_call_start
+        )
+        handle = starter(
+            engine.handle, worker.conn_id, b"push_task", 9,
+            payload, len(payload),
+        )
+        if handle == 0:
+            with self._direct_lock:
+                worker.inflight -= 1
+            self._direct_note_dead(worker)
+            return False
+        self._direct_unsettled += 1
+        record.make_direct()
+        record.attempts = 1
+        record.native_handle = handle
+        record.direct_worker = worker
+        for rid in record.return_ids:
+            state = self._objects.get(rid)
+            if state is not None:
+                state.record = record
+        self._running_tasks[record.spec["task_id"]] = worker.leased.client
+        return True
+
+    def _settle_native(
+        self, record: PendingTask, timeout: float | None
+    ) -> bool:
+        """Drive a direct-lane record to completion from THIS thread
+        (blocking, GIL released inside rt_call_wait). True = settled;
+        False = timeout. Safe under contention: the first settler consumes
+        the native handle, everyone else waits on record.done_event."""
+        import ctypes
+
+        from ray_tpu import _native
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        engine = self._engine
+        while not record.done:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+            acquired = record.settle_lock.acquire(
+                timeout=-1 if remaining is None else remaining
+            )
+            if not acquired:
+                return False
+            settled_here = False
+            try:
+                if record.done:
+                    return True
+                handle = record.native_handle
+                if handle is not None:
+                    timeout_ms = (
+                        -1 if remaining is None else max(1, int(remaining * 1000))
+                    )
+                    view = _native.RtMsgView()
+                    rc = engine.lib.rt_call_wait(
+                        engine.handle, handle, timeout_ms, ctypes.byref(view)
+                    )
+                    if rc == 0:
+                        return False
+                    record.native_handle = None
+                    self._direct_unsettled = max(0, self._direct_unsettled - 1)
+                    if rc == 1:
+                        kind = view.kind
+                        raw = (
+                            ctypes.string_at(view.payload, view.plen)
+                            if view.plen
+                            else b""
+                        )
+                        engine.pylib.rt_msg_free(view.opaque)
+                        settled_here = self._direct_reply(record, kind, raw)
+                    elif rc == -1:
+                        settled_here = self._direct_conn_lost(record)
+                    # rc == -2: someone else consumed the handle — fall
+                    # through to done_event below.
+            finally:
+                record.settle_lock.release()
+            if settled_here or record.done:
+                return True
+            # The record is now owned by the asyncio machinery (retry /
+            # actor protocol): wait for _finish_record / _run_actor_task.
+            wait_s = None
+            if deadline is not None:
+                wait_s = max(0.0, deadline - time.monotonic())
+            if not record.done_event.wait(wait_s):
+                return False
+        return True
+
+    def _direct_reply(self, record: PendingTask, kind: int, raw: bytes) -> bool:
+        """Apply a native reply frame. True = record finished; False =
+        requeued through the asyncio path (retry_exceptions)."""
+        dw = record.direct_worker
+        if dw is not None:
+            record.direct_worker = None
+            with self._direct_lock:
+                dw.inflight -= 1
+                dw.last_used = time.monotonic()
+        spec = record.spec
+        task_id = spec["task_id"]
+        self._running_tasks.pop(task_id, None)
+        if kind == ERR:
+            self._finish_record(
+                record,
+                error=exceptions.WorkerCrashedError(
+                    f"task {spec['name']}: remote dispatch error: "
+                    f"{raw[:300]!r}"
+                ),
+            )
+            return True
+        reply = wire_gen.decode_task_reply(raw)
+        if reply["status"] == "cancelled":
+            self._finish_record(
+                record,
+                error=exceptions.TaskCancelledError(
+                    f"task {spec['name']} was cancelled"
+                ),
+            )
+            return True
+        if (
+            reply["status"] == "error"
+            and spec.get("retry_exceptions")
+            and record.attempts <= spec.get("max_retries", 0)
+            and task_id not in self._cancelled_tasks
+            and not spec.get("actor_id")
+        ):
+            try:
+                self.io.loop.call_soon_threadsafe(self._enqueue_task, record)
+                return False
+            except RuntimeError:
+                pass
+        self._finish_record(record, reply=reply)
+        return True
+
+    def _direct_conn_lost(self, record: PendingTask) -> bool:
+        """Native call failed with connection loss: apply the same policy
+        as the asyncio submitter (_push_one / _run_actor_task). True =
+        record finished here; False = handed to the asyncio machinery."""
+        dw = record.direct_worker
+        if dw is not None:
+            record.direct_worker = None
+            with self._direct_lock:
+                dw.inflight -= 1
+            self._direct_note_dead(dw)
+        spec = record.spec
+        task_id = spec["task_id"]
+        self._running_tasks.pop(task_id, None)
+        if task_id in self._cancelled_tasks:
+            self._finish_record(
+                record,
+                error=exceptions.WorkerCrashedError(
+                    f"task {spec['name']} force-cancelled"
+                ),
+            )
+            return True
+        if spec.get("actor_id"):
+            # Actor protocol (controller consult / restart retry) lives in
+            # _run_actor_task — replay the record through it.
+            try:
+                self.io.loop.call_soon_threadsafe(
+                    lambda: spawn_task(self._run_actor_task(record))
+                )
+                return False
+            except RuntimeError:
+                pass
+        elif record.attempts <= spec.get("max_retries", 0):
+            try:
+                self.io.loop.call_soon_threadsafe(self._enqueue_task, record)
+                return False
+            except RuntimeError:
+                pass
+        self._finish_record(
+            record,
+            error=exceptions.WorkerCrashedError(
+                f"task {spec['name']} failed after {record.attempts} "
+                f"attempts: connection to worker lost"
+            ),
+        )
+        return True
+
+    def _direct_abandon(self, record: PendingTask) -> None:
+        """Release a direct-lane record nobody will settle (all return
+        refs dropped). Safe: with zero live refs there can be no
+        concurrent settler (settlers hold a ref)."""
+        with record.settle_lock:
+            if record.done:
+                return
+            handle = record.native_handle
+            record.native_handle = None
+            if handle is not None:
+                engine = self._engine
+                if engine is not None and engine.handle:
+                    engine.pylib.rt_call_abandon(engine.handle, handle)
+                self._direct_unsettled = max(0, self._direct_unsettled - 1)
+            dw = record.direct_worker
+            record.direct_worker = None
+            if dw is not None:
+                with self._direct_lock:
+                    dw.inflight -= 1
+                    dw.last_used = time.monotonic()
+            record.done = True
+            task_id = record.spec.get("task_id")
+            self._task_records.pop(task_id, None)
+            self._running_tasks.pop(task_id, None)
+            if record.done_event is not None:
+                record.done_event.set()
+
+    async def _settle_native_async(self, record: PendingTask) -> None:
+        """Loop-side access to a direct-lane record: drive completion on
+        an executor thread (rt_call_wait must never block the io loop)."""
+        if record.done:
+            return
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._settle_native, record, None)
+
+    async def _await_state(self, state: ObjectState) -> None:
+        """Wait until `state` settles, driving direct-lane records to
+        completion (their replies sit in the C++ call table until someone
+        collects — a bare event.wait would park forever)."""
+        if state.status != PENDING:
+            return
+        record = state.record
+        if record is not None and record.direct:
+            await self._settle_native_async(record)
+            return
+        state.waited = True
+        if state.status != PENDING:  # settled between check and flag
+            return
+        await state.event.wait()
+
+    def _get_direct(self, ref_list, timeout):
+        """All-local fast get: settle direct-lane records and read local
+        payloads entirely on the calling thread. Returns _DIRECT_MISS to
+        fall back to the asyncio path for anything it cannot prove local
+        (partial settling is fine — the asyncio path is idempotent)."""
+        states = []
+        for ref in ref_list:
+            state = self._objects.get(ref.id)
+            if state is None:
+                return _DIRECT_MISS
+            if state.status == PENDING and (
+                state.record is None or not state.record.direct
+            ):
+                return _DIRECT_MISS
+            states.append(state)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for ref, state in zip(ref_list, states):
+            while state.status == PENDING:
+                record = state.record
+                if record is None or not record.direct:
+                    return _DIRECT_MISS
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise exceptions.GetTimeoutError(
+                            f"get() timed out after {timeout}s"
+                        )
+                if not self._settle_native(record, remaining):
+                    if os.environ.get("RAY_TPU_debug_hang"):
+                        self._dump_hang_state([r.id for r in ref_list])
+                    raise exceptions.GetTimeoutError(
+                        f"get() timed out after {timeout}s"
+                    )
+        values = []
+        for ref, state in zip(ref_list, states):
+            if state.status == FAILED:
+                self._raise_stored_error(state.error)
+            if state.status == INLINE:
+                values.append(
+                    self._deserialize_value(ref.id, state.data, False)
+                )
+                continue
+            # SHM: serve only local-store hits on this thread.
+            view = self.store.get(ref.id, timeout_ms=0)
+            if view is None:
+                local = any(
+                    loc.get("node_id") == self.node_id
+                    for loc in state.locations
+                )
+                view = (
+                    self.store.get(ref.id, timeout_ms=2000) if local else None
+                )
+            if view is None:
+                return _DIRECT_MISS
+            values.append(self._deserialize_value(ref.id, view, True))
+        return values
 
     # ------------------------------------------------------------------
     # task submission (N19/N22)
@@ -700,37 +1219,28 @@ class CoreContext:
             self._task_counter += 1
             return TaskID(f"tsk-{self.worker_id[4:]}-{self._task_counter}")
 
-    def submit_task(
+    def make_spec_template(
         self,
         *,
         function_id: str,
         name: str,
-        args: tuple,
-        kwargs: dict,
         num_returns: int = 1,
         resources: dict | None = None,
         max_retries: int | None = None,
         retry_exceptions: bool = False,
         runtime_env: dict | None = None,
         scheduling_strategy: Any = None,
-    ) -> list[ObjectRef]:
+    ) -> dict:
+        """Static spec fields for a (function, options) pair — cached by
+        RemoteFunction so each submit pays one dict copy, not a rebuild
+        (the reference caches its TaskSpec builder the same way)."""
         cfg = global_config()
-        task_id = self.next_task_id()
-        payload, contained = serialization.serialize((args, kwargs))
-        arg_ref_ids = [r.id for r in contained]
-        # Submitted-task references: args stay alive until the task finishes.
-        with self._refs_lock:
-            for rid in arg_ref_ids:
-                self._submitted_refs[rid] = self._submitted_refs.get(rid, 0) + 1
-        return_ids = [
-            ObjectID.for_task_return(task_id, i) for i in range(num_returns)
-        ]
-        spec = {
-            "task_id": task_id,
+        return {
+            "task_id": "",
             "job_id": self.job_id,
             "function_id": function_id,
             "name": name,
-            "args": payload,
+            "args": b"",
             "num_returns": num_returns,
             "resources": resources or {"CPU": 1},
             "owner": {"worker_id": self.worker_id, "address": list(self.address)},
@@ -740,11 +1250,66 @@ class CoreContext:
                 cfg.task_max_retries_default if max_retries is None else max_retries
             ),
             "retry_exceptions": retry_exceptions,
+            "has_ref_args": False,
+            # direct-pool key, precomputed (popped before the wire)
+            "_dkey": _resources_key(
+                resources or {"CPU": 1}, repr(runtime_env or {})
+            ),
         }
+
+    def submit_task(
+        self,
+        *,
+        function_id: str = "",
+        name: str = "",
+        args: tuple = (),
+        kwargs: dict | None = None,
+        num_returns: int = 1,
+        resources: dict | None = None,
+        max_retries: int | None = None,
+        retry_exceptions: bool = False,
+        runtime_env: dict | None = None,
+        scheduling_strategy: Any = None,
+        spec_template: dict | None = None,
+    ) -> list[ObjectRef]:
+        task_id = self.next_task_id()
+        payload, contained = serialization.serialize((args, kwargs or {}))
+        arg_ref_ids = [r.id for r in contained]
+        # Submitted-task references: args stay alive until the task finishes.
+        if arg_ref_ids:
+            with self._refs_lock:
+                for rid in arg_ref_ids:
+                    self._submitted_refs[rid] = (
+                        self._submitted_refs.get(rid, 0) + 1
+                    )
+        if spec_template is not None:
+            spec = dict(spec_template)
+            num_returns = spec["num_returns"]
+        else:
+            spec = self.make_spec_template(
+                function_id=function_id,
+                name=name,
+                num_returns=num_returns,
+                resources=resources,
+                max_retries=max_retries,
+                retry_exceptions=retry_exceptions,
+                runtime_env=runtime_env,
+                scheduling_strategy=scheduling_strategy,
+            )
+        direct_key = spec.pop("_dkey", None)
+        return_ids = [
+            ObjectID.for_task_return(task_id, i) for i in range(num_returns)
+        ]
+        spec["task_id"] = task_id
+        spec["args"] = payload
+        # Workers use this hint to route ref-carrying tasks off the fast
+        # execution lane (dependency resolution must not block the main
+        # lane — see worker_proc).
+        spec["has_ref_args"] = bool(arg_ref_ids)
         if tracing.enabled():
             # Submit span: its context rides in the spec so the worker's
             # execute span becomes this one's child (SURVEY §5.1).
-            with tracing.span(f"submit {name}", task_id=task_id):
+            with tracing.span(f"submit {spec['name']}", task_id=task_id):
                 spec["trace_ctx"] = tracing.inject()
         record = PendingTask(spec, return_ids, arg_ref_ids)
         self._task_records[task_id] = record
@@ -755,6 +1320,21 @@ class CoreContext:
             if global_config().lineage_pinning_enabled:
                 self._lineage[rid] = record
             refs.append(self.new_object_ref(rid))
+        # Direct lane: simple tasks ride the native call table from this
+        # very thread — no loop handoff, no dispatcher (N19 direct calls).
+        if (
+            self._engine is not None
+            and not arg_ref_ids
+            and not spec["scheduling_strategy"]
+            and not spec["runtime_env"]
+            and "trace_ctx" not in spec
+        ):
+            if direct_key is None:
+                direct_key = _resources_key(
+                    spec["resources"], repr(spec["runtime_env"])
+                )
+            if self._direct_submit(direct_key, record):
+                return refs
         # Batched handoff to the io loop: appending to a deque and waking
         # the loop once per burst (scheduled only on the empty->nonempty
         # edge, under a lock so concurrent submitters can't both skip the
@@ -929,6 +1509,10 @@ class CoreContext:
                 self._active_dispatchers[key] = 1
                 spawn_task(self._dispatcher(key, queue))
 
+    # (direct-lane records that fall back re-enter through _enqueue_task;
+    # their done_event is set by _finish_record when the asyncio side
+    # settles them.)
+
     async def _push_one(
         self, worker: LeasedWorker, queue: asyncio.Queue, record: PendingTask
     ) -> "LeasedWorker | None":
@@ -1009,6 +1593,8 @@ class CoreContext:
             self._fail_returns(record, error)
         else:
             self._apply_reply(record, reply)
+        if record.done_event is not None:
+            record.done_event.set()
         with self._refs_lock:
             for rid in record.arg_refs:
                 count = self._submitted_refs.get(rid, 0) - 1
@@ -1108,6 +1694,24 @@ class CoreContext:
         except Exception:
             pass
 
+    def _set_state_event(self, state: ObjectState) -> None:
+        """Settle notification that is safe from ANY thread: on the io
+        loop, set directly; from a caller thread, wake the loop only when
+        someone actually parked on the event (state.waited) — an
+        unconditional call_soon_threadsafe would cost one loop wakeup per
+        task on the direct lane."""
+        try:
+            on_loop = asyncio.get_running_loop() is self.io.loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            state.event.set()
+        elif state.waited:
+            try:
+                self.io.loop.call_soon_threadsafe(state.event.set)
+            except RuntimeError:
+                pass  # loop already closed (shutdown)
+
     def _apply_reply(self, record: PendingTask, reply: dict) -> None:
         if reply.get("status") == "error":
             self._fail_returns_payload(record, reply["error"])
@@ -1124,7 +1728,8 @@ class CoreContext:
                 state.status = SHM
                 state.size = result["size"]
                 state.locations = [result["location"]]
-            state.event.set()
+            state.record = None
+            self._set_state_event(state)
 
     def _fail_returns(self, record: PendingTask, exc: Exception) -> None:
         payload, _ = serialization.serialize(exc)
@@ -1137,7 +1742,8 @@ class CoreContext:
                 continue
             state.status = FAILED
             state.error = error_payload
-            state.event.set()
+            state.record = None
+            self._set_state_event(state)
 
     async def _try_reconstruct(self, object_id: str) -> bool:
         """Object recovery via lineage re-execution ([N23]): reset the return
@@ -1171,41 +1777,146 @@ class CoreContext:
         num_returns: int = 1,
         max_task_retries: int = 0,
     ) -> list[ObjectRef]:
-        with self._actor_seq_lock:
-            seq = self._actor_seq.get(actor_id, 0)
-            self._actor_seq[actor_id] = seq + 1
         task_id = self.next_task_id()
         payload, contained = serialization.serialize((args, kwargs))
         arg_ref_ids = [r.id for r in contained]
-        with self._refs_lock:
-            for rid in arg_ref_ids:
-                self._submitted_refs[rid] = self._submitted_refs.get(rid, 0) + 1
+        if arg_ref_ids:
+            with self._refs_lock:
+                for rid in arg_ref_ids:
+                    self._submitted_refs[rid] = (
+                        self._submitted_refs.get(rid, 0) + 1
+                    )
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
-        spec = {
-            "task_id": task_id,
-            "job_id": self.job_id,
-            "actor_id": actor_id,
-            "method": method_name,
-            "name": f"{actor_id}.{method_name}",
-            "args": payload,
-            "num_returns": num_returns,
-            "owner": {"worker_id": self.worker_id, "address": list(self.address)},
-            "caller_id": self.worker_id,
-            "seq": seq,
-            "max_retries": max_task_retries,
-            "retry_exceptions": False,
-        }
-        if tracing.enabled():
+        tkey = (actor_id, method_name, num_returns, max_task_retries)
+        template = self._actor_spec_templates.get(tkey)
+        if template is None:
+            template = self._actor_spec_templates[tkey] = {
+                "task_id": "",
+                "job_id": self.job_id,
+                "actor_id": actor_id,
+                "method": method_name,
+                "name": f"{actor_id}.{method_name}",
+                "args": b"",
+                "num_returns": num_returns,
+                "owner": {
+                    "worker_id": self.worker_id,
+                    "address": list(self.address),
+                },
+                "caller_id": self.worker_id,
+                "seq": 0,  # assigned under the actor lock below
+                "max_retries": max_task_retries,
+                "retry_exceptions": False,
+                "has_ref_args": False,
+            }
+        spec = dict(template)
+        spec["task_id"] = task_id
+        spec["args"] = payload
+        spec["has_ref_args"] = bool(arg_ref_ids)
+        traced = tracing.enabled()
+        if traced:
             with tracing.span(f"submit {spec['name']}", task_id=task_id):
                 spec["trace_ctx"] = tracing.inject()
         record = PendingTask(spec, return_ids, arg_ref_ids)
         self._task_records[task_id] = record
         refs = []
+        states = []
         for rid in return_ids:
-            self._objects[rid] = ObjectState()
+            state = ObjectState()
+            self._objects[rid] = state
+            states.append(state)
             refs.append(self.new_object_ref(rid))
+        # Seq assignment and the (possible) direct send are ONE atomic
+        # step under the per-process actor lock: the wire then carries
+        # frames in seq order — the C++ conn write queue is the ordered
+        # actor queue (actor_task_submitter.cc send-in-order role).
+        direct_client = None
+        if (
+            self._engine is not None
+            and not arg_ref_ids
+            and not traced
+        ):
+            direct_client = self._direct_actor_conn(actor_id)
+        with self._actor_seq_lock:
+            seq = self._actor_seq.get(actor_id, 0)
+            self._actor_seq[actor_id] = seq + 1
+            spec["seq"] = seq
+            handle = 0
+            if (
+                direct_client is not None
+                and self._actor_pending_slow.get(actor_id, 0) == 0
+            ):
+                # A pending slow send would write AFTER this frame and
+                # invert program order — direct only when none are queued.
+                engine = self._engine
+                wire = wire_gen.encode_actor_task_spec(spec)
+                lib = (
+                    engine.pylib
+                    if len(wire) < engine._PYLIB_MAX_PAYLOAD
+                    else engine.lib
+                )
+                starter = (
+                    lib.rt_call_start_buf
+                    if self._direct_unsettled >= 2
+                    else lib.rt_call_start
+                )
+                handle = starter(
+                    engine.handle, direct_client[0], b"push_actor_task", 15,
+                    wire, len(wire),
+                )
+                if handle:
+                    self._direct_unsettled += 1
+                    # Keep the io-loop send gate in step so interleaved
+                    # slow sends order correctly behind this frame.
+                    gate = self._actor_send_gate.setdefault(
+                        actor_id, {"next": 0, "waiters": {}}
+                    )
+                    gate["next"] = max(gate["next"], seq + 1)
+                    if gate["waiters"]:
+                        try:
+                            self.io.loop.call_soon_threadsafe(
+                                self._gate_release_waiters, actor_id
+                            )
+                        except RuntimeError:
+                            pass
+            if not handle:
+                self._actor_pending_slow[actor_id] = (
+                    self._actor_pending_slow.get(actor_id, 0) + 1
+                )
+        if handle:
+            record.make_direct()
+            record.attempts = 1
+            record.native_handle = handle
+            for state in states:
+                state.record = record
+            self._running_tasks[task_id] = direct_client[1]
+            return refs
         self.io.spawn(self._run_actor_task(record))
         return refs
+
+    def _direct_actor_conn(self, actor_id: str):
+        """(conn_id, client) for an actor with a live direct connection,
+        else None (first call to an actor always takes the asyncio path,
+        which resolves the address and dials)."""
+        addr = self._actor_addr_cache.get(actor_id)
+        if addr is None:
+            return None
+        client = self._clients.get(tuple(addr))
+        if client is None or not client.connected:
+            return None
+        conn_id = getattr(client, "_conn_id", None)
+        if conn_id is None:
+            return None
+        return (conn_id, client)
+
+    def _gate_release_waiters(self, actor_id: str) -> None:
+        """io-loop: wake slow senders whose seq the direct lane passed."""
+        gate = self._actor_send_gate.get(actor_id)
+        if not gate:
+            return
+        for s, ev in list(gate["waiters"].items()):
+            if s <= gate["next"]:
+                ev.set()
+                gate["waiters"].pop(s, None)
 
     async def _run_actor_task(self, record: PendingTask) -> None:
         spec = record.spec
@@ -1234,6 +1945,14 @@ class CoreContext:
             waiter = gate["waiters"].pop(gate["next"], None)
             if waiter is not None:
                 waiter.set()
+            if not record.direct:
+                # Slow-path submits counted themselves in pending_slow to
+                # keep the direct lane from jumping program order; the
+                # frame is now on the wire (or abandoned) — release.
+                with self._actor_seq_lock:
+                    self._actor_pending_slow[actor_id] = max(
+                        0, self._actor_pending_slow.get(actor_id, 1) - 1
+                    )
 
         attempts = 0
         try:
@@ -1310,6 +2029,8 @@ class CoreContext:
             record.done = True
             self._task_records.pop(spec["task_id"], None)
             self._cancelled_tasks.discard(spec["task_id"])
+            if record.done_event is not None:
+                record.done_event.set()
             with self._refs_lock:
                 for rid in record.arg_refs:
                     count = self._submitted_refs.get(rid, 0) - 1
@@ -1351,7 +2072,7 @@ class CoreContext:
             return {"status": "failed", "error": serialization.serialize(
                 exceptions.ObjectLostError(f"{object_id}: unknown to owner")
             )[0]}
-        await state.event.wait()
+        await self._await_state(state)
         if state.status == FAILED:
             return {"status": "failed", "error": state.error}
         if state.status == INLINE:
@@ -1361,7 +2082,7 @@ class CoreContext:
     async def rpc_wait_object(self, conn, payload) -> dict:
         state = self._objects.get(payload["object_id"])
         if state is not None:
-            await state.event.wait()
+            await self._await_state(state)
         return {"status": "ok"}
 
     async def rpc_add_borrower(self, conn, payload) -> dict:

@@ -34,8 +34,13 @@ def main() -> dict:
         def noop(self):
             return None
 
-    # warmup (worker spawn + code ship)
-    ray_tpu.get([noop.remote() for _ in range(10)])
+    # Warmup: spawn workers + ship code, then let the spawn burst settle —
+    # the sync phase must not time worker-startup noise (the reference's
+    # `ray microbenchmark` warms up each phase the same way).
+    ray_tpu.get([noop.remote() for _ in range(20)])
+    time.sleep(1.0)
+    for _ in range(20):
+        ray_tpu.get(noop.remote())
 
     n = 200
     start = time.perf_counter()
@@ -45,6 +50,7 @@ def main() -> dict:
     results["single_client_sync_tasks_per_s"] = n / dt
     print(f"single-client sync tasks: {_rate(n, dt)}")
 
+    ray_tpu.get([noop.remote() for _ in range(200)])  # phase warmup
     n = 1000
     start = time.perf_counter()
     ray_tpu.get([noop.remote() for _ in range(n)])
@@ -53,7 +59,7 @@ def main() -> dict:
     print(f"1:N async tasks:          {_rate(n, dt)}")
 
     actor = Actor.remote()
-    ray_tpu.get(actor.noop.remote())
+    ray_tpu.get([actor.noop.remote() for _ in range(50)])
     n = 500
     start = time.perf_counter()
     for _ in range(n):

@@ -20,6 +20,7 @@ import os
 import sys
 import queue
 import threading
+import time as _time
 import traceback
 from typing import Any
 
@@ -74,6 +75,25 @@ class WorkerRuntime:
         self._dag_buffers: dict[str, dict] = {}
         self._dag_results: dict[tuple, Any] = {}
         self._dag_events: dict[tuple, asyncio.Event] = {}
+        # Fast execution lane (native exec queue, task_receiver.cc role):
+        # push_task/push_actor_task frames bypass asyncio; the main thread
+        # consumes them via rt_exec_next. Ineligible frames bounce back to
+        # the asyncio handlers.
+        self._engine = None
+        self._fast_mode = False
+        self._inject_lock = threading.Lock()
+        self._next_inject = 1
+        self._main_injected: dict[int, tuple] = {}
+        self._bounced_actor = 0
+        # guards _bounced_actor: incremented on the exec thread,
+        # decremented on the io loop — bare += would lose updates and
+        # either run two tasks on a max_concurrency=1 actor or wedge the
+        # fast lane shut.
+        self._bounce_lock = threading.Lock()
+        # per-callable coroutine-ness (inspect.iscoroutinefunction costs
+        # ~3us per call; keyed by __func__ so bound methods hit)
+        self._coro_cache: dict = {}
+        self._method_cache: dict[str, Any] = {}
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -85,6 +105,17 @@ class WorkerRuntime:
         ):
             ctx.core_server.route(method, getattr(self, f"rpc_{method}"))
         ctx.connect()
+        if ctx._engine is not None:
+            # Divert the task-push methods into the native exec queue —
+            # they never touch the asyncio inbox (the reference's
+            # task_receiver fast path). Everything else (cancel, stacks,
+            # dag, create_actor, exit) stays on the asyncio server.
+            self._engine = ctx._engine
+            self._engine.lib.rt_exec_filter(self._engine.handle, b"push_task")
+            self._engine.lib.rt_exec_filter(
+                self._engine.handle, b"push_actor_task"
+            )
+            self._fast_mode = True
         # Make the global API (ray_tpu.get/put/remote...) work inside tasks.
         from ray_tpu._private import worker as worker_mod
 
@@ -105,6 +136,9 @@ class WorkerRuntime:
 
         self._main_ident = threading.get_ident()
         _signal.signal(_signal.SIGINT, self._on_sigint)
+        if self._fast_mode:
+            self._run_fast_main_loop()
+            return
         while True:
             fn, fut = self._main_work.get()
             if not fut.set_running_or_notify_cancel():
@@ -113,6 +147,194 @@ class WorkerRuntime:
                 fut.set_result(fn())
             except BaseException as exc:  # noqa: BLE001 - ferry to waiter
                 fut.set_exception(exc)
+
+    def _run_fast_main_loop(self) -> None:
+        """Fast-lane twin of the loop above: consumes the native exec
+        queue (diverted push frames + injected io-loop work) in arrival
+        order. Decode via the typed wire schema, execute, reply — all on
+        this thread; the asyncio loop is only involved for bounced frames.
+        """
+        import ctypes
+
+        from ray_tpu import _native
+        from ray_tpu._private import wire_gen
+        from ray_tpu._private.rpc import REP
+
+        engine = self._engine
+        lib = _native.load()  # CDLL: rt_exec_next blocks with GIL released
+        view = _native.RtMsgView()
+        while True:
+            rc = lib.rt_exec_next(engine.handle, 1000, ctypes.byref(view))
+            if rc == 0:
+                continue
+            if rc == -1:
+                return  # engine stopped: process is shutting down
+            if view.kind == 253:  # injected Python work item
+                tag = view.msgid
+                lib.rt_msg_free(view.opaque)
+                pair = self._main_injected.pop(tag, None)
+                if pair is None:
+                    continue
+                fn, fut = pair
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(fn())
+                except BaseException as exc:  # noqa: BLE001
+                    fut.set_exception(exc)
+                continue
+            conn = view.conn
+            msgid = view.msgid
+            method = (
+                ctypes.string_at(view.method, view.mlen) if view.mlen else b""
+            )
+            raw = (
+                ctypes.string_at(view.payload, view.plen) if view.plen else b""
+            )
+            lib.rt_msg_free(view.opaque)
+            try:
+                if method == b"push_task":
+                    reply = self._fast_push_task(conn, msgid, method, raw)
+                else:
+                    reply = self._fast_push_actor_task(
+                        conn, msgid, method, raw
+                    )
+            except Exception:
+                payload, _ = serialization.serialize(
+                    exceptions.TaskError("fast-lane", traceback.format_exc())
+                )
+                reply = {"status": "error", "error": payload}
+            if reply is not None:
+                out = wire_gen.encode_task_reply(reply)
+                if engine.pylib.rt_exec_pending(engine.handle) > 0:
+                    # More work queued: buffer the reply for the engine
+                    # thread's coalesced writev instead of paying an
+                    # inline syscall (+ scheduler preemption) per task.
+                    engine.pylib.rt_send_buf(
+                        engine.handle, conn, REP, msgid,
+                        method, len(method), out, len(out),
+                    )
+                else:
+                    engine.send(conn, REP, msgid, method, out)
+
+    def _fast_push_task(self, conn, msgid, method, raw):
+        """Execute a push_task frame on this thread, or bounce it to the
+        asyncio handler (cross-language, cold function cache, ref args —
+        dependency resolution must never block the main lane: a pipelined
+        upstream task could be queued right behind us)."""
+        from ray_tpu._private import wire_gen
+
+        spec = wire_gen.decode_task_spec(raw)
+        if spec.get("cross_language") or spec.get("has_ref_args"):
+            # has_ref_args: the submitter's hint skips deserializing a
+            # payload we would bounce anyway (the scan below still guards
+            # against third-party clients that omit the hint).
+            self._bounce(conn, msgid, method, "push_task", spec)
+            return None
+        fn = self._fn_cache.get(spec["function_id"])
+        if fn is None:
+            self._bounce(conn, msgid, method, "push_task", spec)
+            return None
+        args, kwargs = self._deserialize_args(spec["args"])
+        if any(isinstance(a, ObjectRef) for a in args) or any(
+            isinstance(v, ObjectRef) for v in kwargs.values()
+        ):
+            self._bounce(conn, msgid, method, "push_task", spec)
+            return None
+        return self._execute(spec, fn, False, (args, kwargs))
+
+    def _fast_push_actor_task(self, conn, msgid, method, raw):
+        """Execute an actor call on this thread when the actor is a plain
+        sync max_concurrency=1 actor; otherwise bounce. Frames arrive
+        per-conn FIFO and submitters write in seq order, so arrival order
+        IS seq order (the C++ conn queue is the ordered actor queue); a
+        gap only appears when an earlier submission died with a previous
+        incarnation — baseline forward like the asyncio path does."""
+        from ray_tpu._private import wire_gen
+
+        spec = wire_gen.decode_actor_task_spec(raw)
+        caller = spec.get("caller_id", "?")
+        seq = spec.get("seq", 0)
+        state = self._order.get(caller)
+        if state is None:
+            state = self._order[caller] = {"expected": seq, "waiters": {}}
+        state["expected"] = max(state["expected"], seq + 1)
+        method_name = spec["method"]
+        if (
+            self.actor_instance is None
+            or method_name == "__ray_terminate__"
+            or self._actor_concurrency > 1
+            or self._bounced_actor > 0
+            or spec.get("has_ref_args")
+        ):
+            self._bounce(conn, msgid, method, "push_actor_task", spec,
+                         actor=True)
+            return None
+        bound = self._method_cache.get(method_name)
+        if bound is None:
+            bound = getattr(self.actor_instance, method_name, None)
+            if bound is None:
+                payload, _ = serialization.serialize(
+                    AttributeError(f"actor has no method {method_name!r}")
+                )
+                return {"status": "error", "error": payload}
+            self._method_cache[method_name] = bound
+        fn_key = getattr(bound, "__func__", bound)
+        is_coro = self._coro_cache.get(fn_key)
+        if is_coro is None:
+            is_coro = inspect.iscoroutinefunction(bound)
+            self._coro_cache[fn_key] = is_coro
+        if is_coro:
+            self._bounce(conn, msgid, method, "push_actor_task", spec,
+                         actor=True)
+            return None
+        args, kwargs = self._deserialize_args(spec["args"])
+        if any(isinstance(a, ObjectRef) for a in args) or any(
+            isinstance(v, ObjectRef) for v in kwargs.values()
+        ):
+            self._bounce(conn, msgid, method, "push_actor_task", spec,
+                         actor=True)
+            return None
+        return self._execute(spec, bound, True, (args, kwargs))
+
+    def _bounce(self, conn, msgid, method, handler_name, spec, actor=False):
+        """Hand a frame the fast lane must not run to the asyncio handler;
+        the reply is sent from the io loop. While a bounced actor task is
+        outstanding, later actor frames bounce too so a max_concurrency=1
+        actor never runs two tasks at once."""
+        from ray_tpu._private import wire_gen
+        from ray_tpu._private.rpc import REP, spawn_task
+
+        if actor:
+            with self._bounce_lock:
+                self._bounced_actor += 1
+        handler = getattr(self, f"rpc_{handler_name}")
+        engine = self._engine
+
+        async def run():
+            try:
+                try:
+                    reply = await handler(None, spec)
+                except Exception:
+                    payload, _ = serialization.serialize(
+                        exceptions.TaskError(
+                            spec.get("name", "task"), traceback.format_exc()
+                        )
+                    )
+                    reply = {"status": "error", "error": payload}
+                try:
+                    engine.send(
+                        conn, REP, msgid, method,
+                        wire_gen.encode_task_reply(reply),
+                    )
+                except Exception:
+                    pass  # conn died: nothing more to tell the peer
+            finally:
+                if actor:
+                    with self._bounce_lock:
+                        self._bounced_actor -= 1
+
+        self.ctx.io.loop.call_soon_threadsafe(spawn_task, run())
 
     def _on_sigint(self, signum, frame) -> None:
         # Only deliver while the TARGETED task is executing: a SIGINT that
@@ -128,7 +350,14 @@ class WorkerRuntime:
 
     async def _run_on_main(self, fn) -> dict:
         fut: concurrent.futures.Future = concurrent.futures.Future()
-        self._main_work.put((fn, fut))
+        if self._fast_mode:
+            with self._inject_lock:
+                tag = self._next_inject
+                self._next_inject = (self._next_inject % 0xFFFFFFF0) + 1
+                self._main_injected[tag] = (fn, fut)
+            self._engine.pylib.rt_exec_inject(self._engine.handle, tag)
+        else:
+            self._main_work.put((fn, fut))
         return await asyncio.wrap_future(fut)
 
     def _async_exec_loop(self) -> asyncio.AbstractEventLoop:
@@ -235,6 +464,10 @@ class WorkerRuntime:
             self._cancelled_pending.discard(task_id)
             self._record_task_event(spec, "CANCELLED")
             return {"status": "cancelled"}
+        # RUNNING is recorded eagerly — a hung task must be visible to the
+        # state API while stuck; the terminal record additionally carries
+        # start_ts so one record describes the whole span.
+        start_ts = _time.time()
         self._record_task_event(spec, "RUNNING")
         on_main = threading.get_ident() == self._main_ident
         self._running_exec[task_id] = threading.get_ident()
@@ -250,15 +483,24 @@ class WorkerRuntime:
             else contextlib.nullcontext()
         )
         with trace_scope:
-            return self._execute_inner(spec, fn, preresolved, name, task_id, on_main)
+            return self._execute_inner(
+                spec, fn, preresolved, name, task_id, on_main, start_ts
+            )
 
-    def _execute_inner(self, spec, fn, preresolved, name, task_id, on_main) -> dict:
+    def _execute_inner(
+        self, spec, fn, preresolved, name, task_id, on_main, start_ts=None
+    ) -> dict:
         try:
             if preresolved is not None:
                 args, kwargs = preresolved
             else:
                 args, kwargs = self._resolve_args(spec["args"])
-            if inspect.iscoroutinefunction(fn):
+            fn_key = getattr(fn, "__func__", fn)
+            is_coro = self._coro_cache.get(fn_key)
+            if is_coro is None:
+                is_coro = inspect.iscoroutinefunction(fn)
+                self._coro_cache[fn_key] = is_coro
+            if is_coro:
                 loop = self._async_exec_loop()
                 cfut = asyncio.run_coroutine_threadsafe(
                     fn(*args, **kwargs), loop
@@ -272,17 +514,17 @@ class WorkerRuntime:
                 value = fn(*args, **kwargs)
             num_returns = spec.get("num_returns", 1)
             values = [value] if num_returns == 1 else list(value)
-            self._record_task_event(spec, "FINISHED")
+            self._record_task_event(spec, "FINISHED", start_ts)
             return {"status": "ok", "returns": self._package_returns(spec, values)}
         except (KeyboardInterrupt, concurrent.futures.CancelledError,
                 asyncio.CancelledError):
             # KeyboardInterrupt: raised by rpc_cancel_task via SIGINT /
             # async-exc (ray.cancel convention — the task sees it).
             # CancelledError: an async task's coroutine was cancelled.
-            self._record_task_event(spec, "CANCELLED")
+            self._record_task_event(spec, "CANCELLED", start_ts)
             return {"status": "cancelled"}
         except Exception:
-            self._record_task_event(spec, "FAILED")
+            self._record_task_event(spec, "FAILED", start_ts)
             err = exceptions.TaskError(name, traceback.format_exc())
             payload, _ = serialization.serialize(err)
             return {"status": "error", "error": payload}
@@ -292,23 +534,25 @@ class WorkerRuntime:
                 self._main_current_task = None
             self._running_exec.pop(task_id, None)
 
-    def _record_task_event(self, spec: dict, state: str) -> None:
+    def _record_task_event(
+        self, spec: dict, state: str, start_ts: float | None = None
+    ) -> None:
         """Task lifecycle events feed the state API + `ray_tpu timeline`
-        (reference: profile_event.cc → gcs_task_manager.cc [N5])."""
-        import time as _time
-
+        (reference: profile_event.cc → gcs_task_manager.cc [N5]). Terminal
+        events carry ``start_ts`` so one record describes the whole span."""
         with self._task_event_lock:
-            self.ctx._task_events.append(
-                {
-                    "task_id": spec.get("task_id"),
-                    "name": spec.get("name"),
-                    "state": state,
-                    "node_id": self.ctx.node_id,
-                    "worker_id": self.ctx.worker_id,
-                    "pid": os.getpid(),
-                    "ts": _time.time(),
-                }
-            )
+            event = {
+                "task_id": spec.get("task_id"),
+                "name": spec.get("name"),
+                "state": state,
+                "node_id": self.ctx.node_id,
+                "worker_id": self.ctx.worker_id,
+                "pid": os.getpid(),
+                "ts": _time.time(),
+            }
+            if start_ts is not None:
+                event["start_ts"] = start_ts
+            self.ctx._task_events.append(event)
             # Batch: size- or time-triggered, never per-event (the reference
             # buffers in a ring and reports periodically, gcs_task_manager).
             now = _time.monotonic()
@@ -608,6 +852,7 @@ class WorkerRuntime:
         if self._async_sem is None:
             self._async_sem = asyncio.Semaphore(self._actor_concurrency)
         async with self._async_sem:
+            start_ts = _time.time()
             self._record_task_event(spec, "RUNNING")
             try:
                 args, kwargs = await self._resolve_args_async(spec["args"])
@@ -621,17 +866,17 @@ class WorkerRuntime:
                     self._running_async.pop(task_id, None)
                 num_returns = spec.get("num_returns", 1)
                 values = [value] if num_returns == 1 else list(value)
-                self._record_task_event(spec, "FINISHED")
+                self._record_task_event(spec, "FINISHED", start_ts)
                 return {
                     "status": "ok",
                     "returns": self._package_returns(spec, values),
                 }
             except (asyncio.CancelledError,
                     concurrent.futures.CancelledError):
-                self._record_task_event(spec, "CANCELLED")
+                self._record_task_event(spec, "CANCELLED", start_ts)
                 return {"status": "cancelled"}
             except Exception:
-                self._record_task_event(spec, "FAILED")
+                self._record_task_event(spec, "FAILED", start_ts)
                 err = exceptions.TaskError(name, traceback.format_exc())
                 payload, _ = serialization.serialize(err)
                 return {"status": "error", "error": payload}

@@ -31,6 +31,8 @@ class RemoteFunction:
         self._function_id: str | None = None
         self._exported_for: str | None = None  # job id of the exporting cluster
         self._export_lock = threading.Lock()
+        # (ctx, template) — static spec fields cached per cluster context
+        self._submit_cache: tuple | None = None
         self.__name__ = getattr(fn, "__name__", "remote_fn")
         self.__doc__ = getattr(fn, "__doc__", None)
 
@@ -49,11 +51,13 @@ class RemoteFunction:
     def __getstate__(self):
         state = self.__dict__.copy()
         state.pop("_export_lock", None)
+        state.pop("_submit_cache", None)  # holds a live CoreContext
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._export_lock = threading.Lock()
+        self._submit_cache = None
         if "_exported_for" not in self.__dict__:
             self._exported_for = None
 
@@ -90,22 +94,26 @@ class RemoteFunction:
         ctx = worker.get_global_context()
         function_id = self._ensure_exported()
         opts = self._options
-        resources = dict(opts["resources"] or {})
-        if opts["num_cpus"] is not None:
-            resources.setdefault("CPU", opts["num_cpus"])
-        num_tpus = opts.get("num_tpus")
-        if num_tpus:
-            resources["TPU"] = num_tpus
+        cache = self._submit_cache
+        if cache is None or cache[0] is not ctx:
+            resources = dict(opts["resources"] or {})
+            if opts["num_cpus"] is not None:
+                resources.setdefault("CPU", opts["num_cpus"])
+            num_tpus = opts.get("num_tpus")
+            if num_tpus:
+                resources["TPU"] = num_tpus
+            template = ctx.make_spec_template(
+                function_id=function_id,
+                name=self.__name__,
+                num_returns=opts["num_returns"],
+                resources=resources,
+                max_retries=opts["max_retries"],
+                retry_exceptions=opts["retry_exceptions"],
+                runtime_env=opts["runtime_env"],
+                scheduling_strategy=opts["scheduling_strategy"],
+            )
+            self._submit_cache = cache = (ctx, template)
         refs = ctx.submit_task(
-            function_id=function_id,
-            name=self.__name__,
-            args=args,
-            kwargs=kwargs,
-            num_returns=opts["num_returns"],
-            resources=resources,
-            max_retries=opts["max_retries"],
-            retry_exceptions=opts["retry_exceptions"],
-            runtime_env=opts["runtime_env"],
-            scheduling_strategy=opts["scheduling_strategy"],
+            args=args, kwargs=kwargs, spec_template=cache[1],
         )
         return refs[0] if opts["num_returns"] == 1 else refs

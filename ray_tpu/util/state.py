@@ -84,6 +84,9 @@ def list_tasks(filters: dict | None = None, limit: int = 1000) -> list[dict]:
         ts = event.get("ts")
         if state in ("RUNNING",) and ts:
             row["start_time"] = ts
+        if event.get("start_ts"):
+            # terminal events carry the span start (single-event form)
+            row["start_time"] = event["start_ts"]
         if state in ("FINISHED", "FAILED") and ts:
             row["end_time"] = ts
     return _apply_filters(list(latest.values()), filters, limit)

@@ -1,0 +1,22 @@
+"""Core-runtime microbenchmark as a release entry (SURVEY §4.5 / §6).
+
+Runs `ray_tpu microbenchmark` (ray_perf) and prints its metrics as one
+JSON line so release_tests.yaml can enforce numeric floors on the core
+hot path (task/actor dispatch, put/get throughput).
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+from ray_tpu._private.ray_perf import main as perf_main  # noqa: E402
+
+
+def main() -> None:
+    results = perf_main()
+    print(json.dumps({"benchmark": "core_microbenchmark", **results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,7 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <sys/un.h>
 #include <unistd.h>
 
@@ -275,8 +276,14 @@ class Engine {
   }
 
   // Build + send a frame. Returns 0 on success, <0 on error.
+  // allow_inline=false defers the socket write to the engine thread: a
+  // bursting (GIL-holding) submitter then pays only a memcpy + one
+  // eventfd wake, and the engine coalesces queued frames with writev —
+  // instead of one ::send syscall (plus a scheduler preemption to the
+  // woken peer, measured ~120us on 1-core hosts) per frame.
   int Send(long conn_id, uint8_t kind, uint32_t msgid, const uint8_t *method,
-           uint32_t mlen, const uint8_t *payload, uint32_t plen) {
+           uint32_t mlen, const uint8_t *payload, uint32_t plen,
+           bool allow_inline = true) {
     if (mlen > 0xFFFF) return -EINVAL;
     auto conn = Lookup(conn_id);
     if (!conn) return -ENOTCONN;
@@ -296,7 +303,7 @@ class Engine {
     {
       std::lock_guard<std::mutex> wlock(conn->wmu);
       if (conn->closed || conn->fd < 0) return -ENOTCONN;
-      if (conn->wq.empty()) {
+      if (allow_inline && conn->wq.empty()) {
         // Fast path: write inline from the caller thread.
         ssize_t n = ::send(conn->fd, frame.data(), frame.size(), MSG_NOSIGNAL);
         if (n == ssize_t(frame.size())) return 0;
@@ -352,7 +359,8 @@ class Engine {
   };
 
   uint64_t CallStart(long conn_id, const uint8_t *method, uint32_t mlen,
-                     const uint8_t *payload, uint32_t plen) {
+                     const uint8_t *payload, uint32_t plen,
+                     bool allow_inline = true) {
     uint32_t msgid = NextMsgid(conn_id);
     if (msgid == 0) return 0;
     uint64_t handle;
@@ -364,7 +372,8 @@ class Engine {
       pc.msgid = msgid;
       conn_calls_[conn_id][msgid] = handle;
     }
-    int rc = Send(conn_id, kReq, msgid, method, mlen, payload, plen);
+    int rc = Send(conn_id, kReq, msgid, method, mlen, payload, plen,
+                  allow_inline);
     if (rc != 0) {
       std::lock_guard<std::mutex> lock(call_mu_);
       calls_.erase(handle);
@@ -474,6 +483,20 @@ class Engine {
     std::lock_guard<std::mutex> lock(exec_mu_);
     execq_.push_back(m);
     exec_cv_.notify_one();
+  }
+
+  int ExecPending() {
+    std::lock_guard<std::mutex> lock(exec_mu_);
+    return int(execq_.size());
+  }
+
+  // Native calls on `conn` still awaiting a reply (entries leave the map
+  // the moment the engine captures the reply): the TRUE in-flight depth,
+  // unlike any Python-side uncollected counter.
+  int ConnInflight(long conn_id) {
+    std::lock_guard<std::mutex> lock(call_mu_);
+    auto it = conn_calls_.find(conn_id);
+    return it == conn_calls_.end() ? 0 : int(it->second.size());
   }
 
  private:
@@ -723,18 +746,39 @@ class Engine {
     // armed, the next loop iteration continues the drain.
     size_t budget = 1 << 20;
     while (!c.wq.empty()) {
-      auto &front = c.wq.front();
-      ssize_t n = ::send(c.fd, front.data() + c.woff, front.size() - c.woff,
-                         MSG_NOSIGNAL);
+      // Coalesce queued frames into one writev: a burst of small task
+      // frames costs one syscall, not one per frame.
+      iovec iov[64];
+      int iovcnt = 0;
+      size_t bytes = 0;
+      size_t off = c.woff;
+      for (auto it = c.wq.begin();
+           it != c.wq.end() && iovcnt < 64 && bytes < budget; ++it) {
+        iov[iovcnt].iov_base = it->data() + off;
+        iov[iovcnt].iov_len = it->size() - off;
+        bytes += iov[iovcnt].iov_len;
+        ++iovcnt;
+        off = 0;
+      }
+      ssize_t n = ::writev(c.fd, iov, iovcnt);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) return;
         RequestClose(c.id);
         return;
       }
-      c.woff += size_t(n);
-      if (c.woff < front.size()) return;
-      c.wq.pop_front();
-      c.woff = 0;
+      size_t left = size_t(n);
+      while (left > 0 && !c.wq.empty()) {
+        size_t frame_rest = c.wq.front().size() - c.woff;
+        if (left >= frame_rest) {
+          left -= frame_rest;
+          c.wq.pop_front();
+          c.woff = 0;
+        } else {
+          c.woff += left;
+          left = 0;
+        }
+      }
+      if (c.woff > 0) return;  // partial frame: wait for EPOLLOUT
       if (size_t(n) >= budget) return;  // keep EPOLLOUT armed, resume next tick
       budget -= size_t(n);
     }
@@ -958,6 +1002,31 @@ uint64_t rt_call_start(void *e, long conn, const uint8_t *method,
                        uint32_t mlen, const uint8_t *payload, uint32_t plen) {
   return static_cast<raytpu::rpc::Engine *>(e)->CallStart(conn, method, mlen,
                                                           payload, plen);
+}
+
+// Buffered variant: the frame is queued for the engine thread (coalesced
+// writev) instead of an inline send — for bursting submitters.
+uint64_t rt_call_start_buf(void *e, long conn, const uint8_t *method,
+                           uint32_t mlen, const uint8_t *payload,
+                           uint32_t plen) {
+  return static_cast<raytpu::rpc::Engine *>(e)->CallStart(
+      conn, method, mlen, payload, plen, /*allow_inline=*/false);
+}
+
+// Buffered plain send (worker replies while more exec work is queued).
+int rt_send_buf(void *e, long conn, uint8_t kind, uint32_t msgid,
+                const uint8_t *method, uint32_t mlen, const uint8_t *payload,
+                uint32_t plen) {
+  return static_cast<raytpu::rpc::Engine *>(e)->Send(
+      conn, kind, msgid, method, mlen, payload, plen, /*allow_inline=*/false);
+}
+
+int rt_exec_pending(void *e) {
+  return static_cast<raytpu::rpc::Engine *>(e)->ExecPending();
+}
+
+int rt_conn_inflight(void *e, long conn) {
+  return static_cast<raytpu::rpc::Engine *>(e)->ConnInflight(conn);
 }
 
 // 1=reply (view filled; free via rt_msg_free), 0=timeout,

@@ -107,7 +107,11 @@ def test_actor_restart_on_crash(ray_start_shared):
     assert ray_tpu.get(restartable.read.remote(), timeout=60) == 3
     try:
         ray_tpu.get(restartable.crash.remote(), timeout=60)
-    except (exceptions.ActorDiedError, exceptions.TaskError, exceptions.WorkerCrashedError):
+    except (exceptions.ActorDiedError, exceptions.TaskError,
+            exceptions.WorkerCrashedError, exceptions.ActorUnavailableError):
+        # ActorUnavailableError: the controller can already be mid-restart
+        # when the in-flight call's failure is examined (max_task_retries=0
+        # semantics — the call is not retried across the restart).
         pass
     # State resets after restart (no automatic state checkpointing — same as
     # the reference), but the actor is alive again.

@@ -267,6 +267,62 @@ def test_exec_filter_diverts_to_exec_thread(io):
         thread.join(timeout=5)
 
 
+def test_fire_and_forget_direct_calls_release_resources(ray_start_shared):
+    """Refs dropped without get(): the side effects still run, and the
+    native call-table entries / task records / inflight counts all drain
+    (review finding: fire-and-forget leaked them forever)."""
+    import gc
+
+    import ray_tpu
+    from ray_tpu._private.worker import get_global_context
+
+    @ray_tpu.remote
+    class Tally:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+
+        def read(self):
+            return self.n
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ctx = get_global_context()
+    tally = Tally.remote()
+    for _ in range(100):
+        tally.bump.remote()  # refs dropped immediately
+    for _ in range(100):
+        noop.remote()
+    gc.collect()
+    # side effects still execute (same-conn FIFO orders read after bumps)
+    assert ray_tpu.get(tally.read.remote(), timeout=120) == 100
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        gc.collect()
+        records = {
+            k: v for k, v in ctx._task_records.items() if not v.done
+        }
+        idle = all(
+            dw.inflight == 0
+            for pool in ctx._direct_pool.values()
+            for dw in pool
+        )
+        if len(records) == 0 and idle and ctx._direct_unsettled <= 1:
+            break
+        time.sleep(0.2)
+    else:
+        import pytest
+
+        pytest.fail(
+            f"leak: records={len(records)} unsettled={ctx._direct_unsettled}"
+        )
+    ray_tpu.kill(tally)
+
+
 def test_exec_inject_wakes_consumer(io):
     async def get_engine():
         return _NativeEngine.for_running_loop()
