@@ -82,6 +82,15 @@ class RayTpuConfig:
     # RAY_TPU_direct_call=0 to force everything through the asyncio path.
     direct_call: bool = _env("direct_call", True)
 
+    # --- memory monitor (reference: memory_monitor.cc + raylet OOM
+    # killer, RAY_memory_usage_threshold / RAY_memory_monitor_refresh_ms) ---
+    memory_monitor_interval_s: float = _env("memory_monitor_interval_s", 0.25)
+    # Node-level usage fraction past which the largest-RSS worker is killed.
+    memory_usage_threshold: float = _env("memory_usage_threshold", 0.95)
+    # Absolute per-worker RSS cap in MiB (0 = disabled); any worker above
+    # it is killed regardless of node usage — also the testing knob.
+    memory_worker_rss_limit_mb: int = _env("memory_worker_rss_limit_mb", 0)
+
     # --- tasks / fault tolerance ---
     task_max_retries_default: int = _env("task_max_retries_default", 3)
     actor_max_restarts_default: int = _env("actor_max_restarts_default", 0)

@@ -784,9 +784,16 @@ class Controller:
             if payload.get("intended") or actor.state == "DEAD":
                 pass
             else:
-                await self._handle_actor_failure(
-                    actor, f"worker process died (exit={payload.get('exit_code')})"
-                )
+                if payload.get("reason") == "oom":
+                    cause = (
+                        "worker killed by the node memory monitor (OOM, "
+                        f"exit={payload.get('exit_code')})"
+                    )
+                else:
+                    cause = (
+                        f"worker process died (exit={payload.get('exit_code')})"
+                    )
+                await self._handle_actor_failure(actor, cause)
         return {"status": "ok"}
 
     async def rpc_get_actor_info(self, conn, payload) -> dict:

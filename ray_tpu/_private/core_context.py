@@ -1094,6 +1094,28 @@ class CoreContext:
                 return False
             except RuntimeError:
                 pass
+        elif dw is not None:
+            # Final failure: attribute the death (OOM vs crash) on the io
+            # loop — the tombstone query is an RPC. Caller waits on
+            # done_event; _finish_record sets it.
+            leased = dw.leased
+
+            async def _finish_attributed():
+                self._finish_record(
+                    record,
+                    error=await self._worker_failure_error(
+                        leased, spec, record.attempts,
+                        "connection to worker lost",
+                    ),
+                )
+
+            try:
+                self.io.loop.call_soon_threadsafe(
+                    lambda: spawn_task(_finish_attributed())
+                )
+                return False
+            except RuntimeError:
+                pass
         self._finish_record(
             record,
             error=exceptions.WorkerCrashedError(
@@ -1541,9 +1563,8 @@ class CoreContext:
             else:
                 self._finish_record(
                     record,
-                    error=exceptions.WorkerCrashedError(
-                        f"task {spec['name']} failed after "
-                        f"{record.attempts} attempts: {exc}"
+                    error=await self._worker_failure_error(
+                        worker, spec, record.attempts, exc
                     ),
                 )
             return worker
@@ -1576,6 +1597,44 @@ class CoreContext:
             return None
         self._finish_record(record, reply=reply)
         return None
+
+    async def _worker_failure_error(
+        self, worker: "LeasedWorker", spec: dict, attempts: int, exc
+    ) -> Exception:
+        """Attribute a worker death: the node agent's memory monitor
+        leaves a tombstone, so an OOM kill surfaces as the distinct
+        (retriable, system-level) OutOfMemoryError instead of a generic
+        crash (reference memory_monitor.cc / raylet OOM policy, N15).
+        The tombstone may land moments after the conn drops — poll
+        briefly."""
+        reason = rss = None
+        try:
+            agent = await self._client_for(worker.agent_addr)
+            for _ in range(8):
+                info = await agent.call(
+                    "worker_death_info",
+                    {"worker_id": worker.worker_id},
+                    timeout=5,
+                )
+                detail = info.get("info")
+                if detail:
+                    reason = detail.get("reason")
+                    rss = detail.get("rss")
+                    break
+                if info.get("alive"):
+                    break  # no death, no tombstone coming — stop polling
+                await asyncio.sleep(0.25)
+        except Exception:
+            pass
+        if reason == "oom":
+            mib = f" (rss {rss >> 20} MiB)" if rss else ""
+            return exceptions.OutOfMemoryError(
+                f"task {spec['name']}: worker {worker.worker_id} was killed "
+                f"by the node memory monitor{mib} after {attempts} attempts"
+            )
+        return exceptions.WorkerCrashedError(
+            f"task {spec['name']} failed after {attempts} attempts: {exc}"
+        )
 
     def _finish_record(
         self,
@@ -1688,9 +1747,14 @@ class CoreContext:
         # Always hand the lease back: the agent keeps the worker process warm
         # in its pool, so the next lease is cheap, and the node's resources
         # are never held hostage by an idle submitter (worker_pool.cc [N11]).
+        # reusable=False tells the agent NOT to pool the worker (we saw its
+        # connection die) — pooling it would burn the next lease's tasks.
         try:
             agent = await self._client_for(worker.agent_addr)
-            await agent.call("return_worker", {"lease_id": worker.lease_id})
+            await agent.call(
+                "return_worker",
+                {"lease_id": worker.lease_id, "reusable": reusable},
+            )
         except Exception:
             pass
 

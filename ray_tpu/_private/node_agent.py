@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import collections
 import itertools
 import json
 import os
+import signal
 import sys
 import time
 from typing import Any
@@ -125,6 +127,10 @@ class WorkerProcess:
         self.intended_exit = False
         self.resources: dict = {}
         self.bundle: dict | None = None
+        # Set by the memory monitor before the SIGKILL so _watch_worker
+        # can attribute the death ("oom") instead of a generic crash.
+        self.death_reason: str | None = None
+        self.oom_rss: int | None = None
 
 
 class Lease:
@@ -184,6 +190,11 @@ class NodeAgent:
 
         self.workers: dict[str, WorkerProcess] = {}
         self.idle_workers: dict[str, list[WorkerProcess]] = {}
+        # Tombstones for owners asking WHY a worker died (OOM vs crash);
+        # bounded so long-lived agents don't accumulate forever.
+        self.death_info: "collections.OrderedDict[str, dict]" = (
+            collections.OrderedDict()
+        )
         self.runtime_envs = RuntimeEnvManager(session_dir)
         self.leases: dict[str, Lease] = {}
         self.bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> {resources, available, committed}
@@ -209,7 +220,97 @@ class NodeAgent:
         self.controller.on_reconnect = self._register_with_controller
         await self._register_with_controller()
         spawn_task(self._heartbeat_loop())
+        spawn_task(self._memory_monitor_loop())
         return self.address
+
+    async def _memory_monitor_loop(self) -> None:
+        """Per-worker RSS watchdog (reference: memory_monitor.cc + the
+        raylet OOM-kill policy, N15). When node usage crosses
+        memory_usage_threshold, the largest-RSS worker is killed; any
+        worker above memory_worker_rss_limit_mb (absolute cap, also the
+        testing knob) is killed outright. The owner of its tasks sees a
+        retriable OutOfMemoryError (via worker_death_info), never a
+        whole-node OOM."""
+        import psutil
+
+        cfg = global_config()
+        interval = cfg.memory_monitor_interval_s
+        if interval <= 0:
+            return
+        procs: dict[str, "psutil.Process"] = {}
+        while True:
+            await asyncio.sleep(interval)
+            limit_bytes = cfg.memory_worker_rss_limit_mb * (1 << 20)
+            try:
+                node_frac = psutil.virtual_memory().percent / 100.0
+            except Exception:
+                continue
+            over_node = node_frac >= cfg.memory_usage_threshold
+            if not over_node and limit_bytes <= 0:
+                continue
+            samples = []
+            live_ids = set()
+            for worker in list(self.workers.values()):
+                pid = getattr(worker.proc, "pid", None)
+                if pid is None or worker.proc.returncode is not None:
+                    continue
+                live_ids.add(worker.worker_id)
+                try:
+                    proc = procs.get(worker.worker_id)
+                    if proc is None or proc.pid != pid:
+                        proc = procs[worker.worker_id] = psutil.Process(pid)
+                    samples.append((proc.memory_info().rss, worker))
+                except Exception:
+                    continue
+            for worker_id in list(procs):
+                if worker_id not in live_ids:
+                    procs.pop(worker_id, None)
+            if not samples:
+                continue
+            # Kill preference (raylet policy analog): retriable task
+            # workers before actors, largest RSS first.
+            samples.sort(key=lambda item: (item[1].actor_id is not None,
+                                           -item[0]))
+            to_kill = []
+            if limit_bytes > 0:
+                to_kill = [s for s in samples if s[0] > limit_bytes]
+            if over_node and not to_kill:
+                to_kill = [samples[0]]  # preferred offender
+            for rss, worker in to_kill:
+                if worker.death_reason is not None:
+                    continue
+                worker.death_reason = "oom"
+                worker.oom_rss = rss
+                print(
+                    f"[raytpu-agent] memory monitor killing worker "
+                    f"{worker.worker_id} (rss={rss >> 20} MiB, "
+                    f"node={node_frac:.0%})",
+                    file=sys.stderr,
+                )
+                self._kill_worker_tree(worker)
+
+    @staticmethod
+    def _kill_worker_tree(worker: WorkerProcess) -> None:
+        """SIGKILL the worker AND any subprocesses the task spawned.
+        Workers deliberately share the agent's session (node teardown
+        kills the whole group), so a group kill is not available —
+        psutil's recursive child walk reaches forked helpers instead."""
+        try:
+            import psutil
+
+            for child in psutil.Process(worker.proc.pid).children(
+                recursive=True
+            ):
+                try:
+                    child.kill()
+                except Exception:
+                    pass
+        except Exception:
+            pass
+        try:
+            worker.proc.kill()
+        except ProcessLookupError:
+            pass
 
     async def _register_with_controller(self) -> None:
         await self.controller.call(
@@ -335,7 +436,10 @@ class NodeAgent:
         pool = self.idle_workers.get(env_hash) or []
         for i in range(len(pool) - 1, -1, -1):
             candidate = pool[i]
-            if candidate.proc.returncode is not None:
+            if (
+                candidate.proc.returncode is not None
+                or candidate.death_reason is not None
+            ):
                 pool.pop(i)
                 continue
             if candidate.job_id == job_id:
@@ -433,6 +537,14 @@ class NodeAgent:
     async def _watch_worker(self, worker: WorkerProcess) -> None:
         code = await worker.proc.wait()
         self.workers.pop(worker.worker_id, None)
+        self.death_info[worker.worker_id] = {
+            "reason": worker.death_reason
+            or ("intended" if worker.intended_exit else "crash"),
+            "exit_code": code,
+            "rss": worker.oom_rss,
+        }
+        while len(self.death_info) > 256:
+            self.death_info.popitem(last=False)
         pool = self.idle_workers.get(worker.env_hash)
         if pool and worker in pool:
             pool.remove(worker)
@@ -462,10 +574,31 @@ class NodeAgent:
                     "actor_id": worker.actor_id,
                     "exit_code": code,
                     "intended": worker.intended_exit,
+                    "reason": worker.death_reason,
                 },
             )
         except Exception:
             pass
+
+    async def rpc_worker_death_info(self, conn, payload) -> dict:
+        """Why a worker died (owner-side OOM attribution, N15). `alive`
+        lets callers stop polling: a live worker will never grow a
+        tombstone."""
+        worker_id = payload.get("worker_id", "")
+        worker = self.workers.get(worker_id)
+        # "alive" must be false while a kill is in flight (death mark set,
+        # process not yet reaped) — the tombstone IS coming; callers that
+        # stopped polling here would misattribute an OOM as a crash.
+        alive = (
+            worker is not None
+            and worker.proc.returncode is None
+            and worker.death_reason is None
+        )
+        return {
+            "status": "ok",
+            "info": self.death_info.get(worker_id),
+            "alive": alive,
+        }
 
     # ------------------------------------------------------------------
     # RPC: worker registration + leases
@@ -519,8 +652,19 @@ class NodeAgent:
         if lease is None:
             return {"status": "unknown_lease"}
         self._give_back(lease.resources, lease.bundle_key)
-        if lease.worker.proc.returncode is None and not lease.worker.actor_id:
-            self.idle_workers.setdefault(lease.worker.env_hash, []).append(lease.worker)
+        worker = lease.worker
+        if worker.proc.returncode is None and not worker.actor_id:
+            if payload.get("reusable", True) and worker.death_reason is None:
+                self.idle_workers.setdefault(
+                    worker.env_hash, []
+                ).append(worker)
+            else:
+                # reusable=False (the owner saw the conn die) or a pending
+                # death mark: pooling would burn the next lease's tasks,
+                # and leaving the process idling would leak it (and its
+                # RSS) forever — kill it; the pool respawns on demand.
+                worker.intended_exit = True
+                self._kill_worker_tree(worker)
         return {"status": "ok"}
 
     # ------------------------------------------------------------------

@@ -38,6 +38,13 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died (e.g. OOM-killed, segfault)."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """The node's memory monitor killed the worker (reference
+    memory_monitor.cc / raylet OOM-killer role, N15): a system failure
+    distinct from application exceptions — it participates in task
+    retries (max_retries) and never masquerades as user code raising."""
+
+
 class ActorDiedError(RayTpuError):
     """The actor is permanently dead (restarts exhausted or never restartable)."""
 

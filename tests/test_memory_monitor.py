@@ -1,0 +1,61 @@
+"""Memory monitor / OOM policy (reference memory_monitor.cc + raylet
+OOM-killer role, N15): a worker whose RSS crosses the limit is killed by
+the node agent, the task is retried (system failure, max_retries), and
+the final error is the distinct retriable OutOfMemoryError — never an
+application exception, never a node-wide OOM.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+@pytest.fixture()
+def oom_cluster(monkeypatch):
+    # Env must be set BEFORE init: the agent process inherits it.
+    monkeypatch.setenv("RAY_TPU_memory_worker_rss_limit_mb", "400")
+    monkeypatch.setenv("RAY_TPU_memory_monitor_interval_s", "0.2")
+    assert not ray_tpu.is_initialized()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_memory_hog_killed_retried_and_oom_error(oom_cluster, tmp_path):
+    tally = str(tmp_path / "attempts.log")
+
+    @ray_tpu.remote(max_retries=1)
+    def hog(path):
+        with open(path, "a") as fh:
+            fh.write(f"{os.getpid()}\n")
+        ballast = bytearray(700 * 1024 * 1024)  # over the 400 MiB cap
+        ballast[::4096] = b"x" * len(ballast[::4096])  # touch the pages
+        time.sleep(60)  # stay fat until the monitor fires
+        return len(ballast)
+
+    ref = hog.remote(tally)
+    with pytest.raises(exceptions.OutOfMemoryError) as excinfo:
+        ray_tpu.get(ref, timeout=180)
+    assert "memory monitor" in str(excinfo.value)
+    # The OOM error is a WorkerCrashedError subclass (system failure),
+    # not an application TaskError.
+    assert isinstance(excinfo.value, exceptions.WorkerCrashedError)
+    assert not isinstance(excinfo.value, exceptions.TaskError)
+    with open(tally) as fh:
+        attempts = len(fh.read().splitlines())
+    assert attempts == 2, f"expected original + 1 retry, got {attempts}"
+
+
+def test_small_tasks_survive_the_monitor(oom_cluster):
+    @ray_tpu.remote
+    def modest(i):
+        data = bytes(1 * 1024 * 1024)  # well under the cap
+        return i + len(data) // len(data)
+
+    assert ray_tpu.get(
+        [modest.remote(i) for i in range(20)], timeout=120
+    ) == [i + 1 for i in range(20)]
