@@ -237,6 +237,7 @@ class NodeAgent:
         interval = cfg.memory_monitor_interval_s
         if interval <= 0:
             return
+        self._last_pressure_kill = 0.0
         procs: dict[str, "psutil.Process"] = {}
         while True:
             await asyncio.sleep(interval)
@@ -274,8 +275,21 @@ class NodeAgent:
             to_kill = []
             if limit_bytes > 0:
                 to_kill = [s for s in samples if s[0] > limit_bytes]
-            if over_node and not to_kill:
+            # Node-pressure kills need a grace period: freeing tens of GB
+            # takes longer than one tick, and an unreaped victim still
+            # counts in virtual_memory() — without the gate one spike
+            # cascade-kills healthy workers (raylet waits for a kill to
+            # take effect before choosing another victim).
+            kill_pending = any(
+                w.death_reason is not None for w in self.workers.values()
+            )
+            in_grace = (
+                time.monotonic() - self._last_pressure_kill
+                < max(1.0, 4 * interval)
+            )
+            if over_node and not to_kill and not kill_pending and not in_grace:
                 to_kill = [samples[0]]  # preferred offender
+                self._last_pressure_kill = time.monotonic()
             for rss, worker in to_kill:
                 if worker.death_reason is not None:
                     continue

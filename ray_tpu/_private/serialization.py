@@ -166,10 +166,17 @@ def serialize_parts(value: Any) -> tuple[list, int, list]:
     return parts, total, refs
 
 
+def join_parts(parts) -> bytes:
+    """Wire-order parts -> one contiguous payload."""
+    return b"".join(
+        bytes(p) if isinstance(p, memoryview) else p for p in parts
+    )
+
+
 def serialize(value: Any) -> tuple[bytes, list]:
     """Returns (payload, contained_object_refs)."""
     parts, _total, refs = serialize_parts(value)
-    return b"".join(bytes(p) if isinstance(p, memoryview) else p for p in parts), refs
+    return join_parts(parts), refs
 
 
 def serialized_size(payload: bytes) -> int:

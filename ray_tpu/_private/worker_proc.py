@@ -892,30 +892,98 @@ class WorkerRuntime:
 
     async def rpc_dag_register(self, conn, payload) -> dict:
         stage = payload["stage"]
-        self._dag_stages[payload["dag_id"]] = stage
-        self._dag_buffers.setdefault(payload["dag_id"], {})
+        key = (payload["dag_id"], stage["node"])
+        self._dag_stages[key] = stage
+        self._dag_buffers.setdefault(key, {})
+        return {"status": "ok"}
+
+    async def rpc_dag_teardown(self, conn, payload) -> dict:
+        """Release every resource a compiled DAG holds on this worker:
+        stage specs, buffered inputs, parked results, and any unread
+        shared-memory channel slots (reference: CompiledDAG.teardown and
+        channel closing in shared_memory_channel.py)."""
+        dag_id = payload["dag_id"]
+        for key in [k for k in self._dag_stages if k[0] == dag_id]:
+            stage = self._dag_stages.pop(key)
+            self._dag_buffers.pop(key, None)
+            depth = stage.get("depth", 8)
+            # incoming channel slots are consumer-owned: delete leftovers
+            for base in stage.get("in_channels", ()):
+                for i in range(depth):
+                    try:
+                        self.ctx.store.delete(f"{base}-{i}")
+                    except Exception:
+                        pass
+        for key in [k for k in self._dag_results if k[0] == dag_id]:
+            self._dag_results.pop(key, None)
+        for key in [k for k in self._dag_events if k[0] == dag_id]:
+            self._dag_events.pop(key, None)
+        return {"status": "ok"}
+
+    def _chan_read(self, base: str, seq: int, depth: int):
+        """Consumer side of a shm channel (dag/channel.py primitives)."""
+        from ray_tpu.dag import channel
+
+        return channel.read_consume(
+            self.ctx.store, channel.slot_name(base, seq, depth)
+        )
+
+    async def _chan_write(
+        self, base: str, seq: int, depth: int, parts, total: int
+    ) -> None:
+        """Producer side: stream parts into ring slot seq%depth once it
+        frees (the consumer's delete is the backpressure release)."""
+        from ray_tpu.dag import channel
+
+        name = channel.slot_name(base, seq, depth)
+        deadline = asyncio.get_running_loop().time() + 120.0
+        while not channel.try_write(self.ctx.store, name, parts, total):
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(
+                    f"channel slot {name} still unread after 120s"
+                )
+            await asyncio.sleep(0.002)
+
+    async def _dag_deliver(self, dag_id, node, seq, slot, value) -> dict:
+        """Feed one input slot of a stage; runs the stage when complete."""
+        key = (dag_id, node)
+        stage = self._dag_stages.get(key)
+        if stage is None:
+            return {"status": "error",
+                    "error": f"dag {dag_id} stage {node} not registered"}
+        slots = self._dag_buffers[key].setdefault(seq, {})
+        slots[slot] = value
+        if set(slots) != set(stage["slots"]):
+            return {"status": "ok"}
+        self._dag_buffers[key].pop(seq)
+        # Detach execution+forward: the push acks as soon as inputs are
+        # buffered, so upstream (and the driver) pipelines the next seq
+        # while this stage computes — the point of compiled-graph channels.
+        from ray_tpu._private.rpc import spawn_task
+
+        spawn_task(self._dag_run_stage(dag_id, seq, stage, slots))
         return {"status": "ok"}
 
     async def rpc_dag_push(self, conn, payload) -> dict:
         dag_id = payload["dag_id"]
         seq = payload["seq"]
-        stage = self._dag_stages.get(dag_id)
+        stage_key = (dag_id, payload["node"])
+        stage = self._dag_stages.get(stage_key)
         if stage is None:
-            return {"status": "error", "error": f"dag {dag_id} not registered"}
-        value = serialization.deserialize(payload["value"], zero_copy=False)
-        buffers = self._dag_buffers[dag_id]
-        slots = buffers.setdefault(seq, {})
-        slots[payload["slot"]] = value
-        if set(slots) != set(stage["slots"]):
-            return {"status": "ok"}
-        buffers.pop(seq)
-        # Detach execution+forward: the push RPC acks as soon as inputs are
-        # buffered, so upstream (and the driver) pipelines the next seq while
-        # this stage computes — the whole point of compiled-graph channels.
-        from ray_tpu._private.rpc import spawn_task
-
-        spawn_task(self._dag_run_stage(dag_id, seq, stage, slots))
-        return {"status": "ok"}
+            return {"status": "error",
+                    "error": f"dag {dag_id} stage {payload['node']} unknown"}
+        if payload.get("channel"):
+            # shm channel: only a tiny notify crossed the socket
+            loop = asyncio.get_running_loop()
+            value = await loop.run_in_executor(
+                None, self._chan_read, payload["channel"], seq,
+                stage.get("depth", 8),
+            )
+        else:
+            value = serialization.deserialize(payload["value"], zero_copy=False)
+        return await self._dag_deliver(
+            dag_id, payload["node"], seq, payload["slot"], value
+        )
 
     async def _dag_run_stage(
         self, dag_id: str, seq: int, stage: dict, slots: dict
@@ -931,24 +999,77 @@ class WorkerRuntime:
             result = await loop.run_in_executor(self.executor, run)
         except Exception:
             result = exceptions.TaskError(stage["method"], traceback.format_exc())
+        failed = isinstance(result, exceptions.TaskError)
         if stage.get("is_output"):
             key = (dag_id, seq)
+            out_base = stage.get("out_channel")
+            if out_base and not failed:
+                parts, total, _ = serialization.serialize_parts(result)
+                try:
+                    await self._chan_write(
+                        out_base, seq, stage.get("depth", 8), parts, total
+                    )
+                    result = ("__dagchan__", out_base)
+                except Exception:
+                    pass  # fall back to inline result
             self._dag_results[key] = result
             self._dag_events.setdefault(key, asyncio.Event()).set()
             return
-        raw, _ = serialization.serialize(result)
+        parts, total, _ = serialization.serialize_parts(result)
+        raw = None  # joined lazily: only inline/same-actor edges need it
+        depth = stage.get("depth", 8)
         for target in stage.get("downstream", ()):
             try:
+                use_chan = bool(target.get("channel")) and not failed
+                if not use_chan and raw is None:
+                    # same-actor edges never get channels (compile guard),
+                    # so this join also covers the branch below
+                    raw = serialization.join_parts(parts)
+                if target["actor_id"] == (self.actor_spec or {}).get(
+                    "actor_id"
+                ):
+                    # Same-actor edge (multi-stage actors): no channel, no
+                    # socket — deliver a private copy in-process.
+                    await self._dag_deliver(
+                        dag_id, target["node"], seq, target["slot"],
+                        serialization.deserialize(raw, zero_copy=False),
+                    )
+                    continue
+                if use_chan:
+                    await self._chan_write(
+                        target["channel"], seq, depth, parts, total
+                    )
                 client = await self.ctx._actor_client(target["actor_id"])
-                await client.call(
-                    "dag_push",
-                    {
-                        "dag_id": dag_id,
-                        "seq": seq,
-                        "slot": target["slot"],
-                        "value": raw,
-                    },
-                )
+                msg = {
+                    "dag_id": dag_id,
+                    "node": target["node"],
+                    "seq": seq,
+                    "slot": target["slot"],
+                }
+                if use_chan:
+                    # Channel edge: the DATA already sits in shm — the
+                    # notify is fire-and-forget (the unread REP is dropped
+                    # by the client's resolver). Errors surface as pop
+                    # timeouts, the same failure envelope as a died stage.
+                    msg["channel"] = target["channel"]
+                    engine = getattr(client, "_engine", None)
+                    conn_id = getattr(client, "_conn_id", None)
+                    if engine is not None and conn_id is not None:
+                        from ray_tpu._private.rpc import (
+                            REQ, _encode_payload,
+                        )
+
+                        msgid = engine.pylib.rt_next_msgid(
+                            engine.handle, conn_id
+                        )
+                        engine.send(
+                            conn_id, REQ, msgid, b"dag_push",
+                            _encode_payload(msg),
+                        )
+                        continue
+                else:
+                    msg["value"] = raw
+                await client.call("dag_push", msg)
             except Exception:
                 traceback.print_exc()
 
@@ -961,6 +1082,13 @@ class WorkerRuntime:
             return {"status": "timeout"}
         result = self._dag_results.pop(key)
         self._dag_events.pop(key, None)
+        if (
+            isinstance(result, tuple)
+            and len(result) == 2
+            and result[0] == "__dagchan__"
+        ):
+            # result already sits in the driver-co-located shm channel
+            return {"status": "ok", "channel": result[1]}
         raw, _ = serialization.serialize(result)
         return {"status": "ok", "value": raw}
 

@@ -1,0 +1,78 @@
+"""Shared-memory channel primitives for compiled DAGs.
+
+Role-equivalent of python/ray/experimental/channel/shared_memory_channel.py
+(SURVEY §2.2 aDAG row): a channel is a bounded ring of named slots in the
+node's shm object store. The producer streams serialized parts straight
+into the arena allocation (create/seal, one copy total) and the consumer
+deletes the slot after reading — the delete IS the backpressure release.
+Cross-process payloads therefore never touch a socket; only a tiny notify
+RPC moves per hop.
+"""
+
+from __future__ import annotations
+
+import weakref
+
+from ray_tpu._private import serialization
+
+# Payloads at or above this deserialize as zero-copy views onto the
+# arena; the ring slot is freed when the VALUE is garbage-collected
+# (backpressure then tracks value lifetime, like plasma pinning).
+# Like the core get() path (and the reference's plasma-backed arrays),
+# zero-copy values are READ-ONLY — stages that mutate inputs in place
+# must copy first; the socket (non-co-located) path returns writable
+# copies, so in-place mutation is placement-dependent by construction.
+# Non-weakref-able payloads (dicts/tuples) pay a second, copying
+# deserialize — numpy/array payloads (the hot case) are weakref-able.
+ZERO_COPY_THRESHOLD = 256 * 1024
+
+
+def slot_name(base: str, seq: int, depth: int) -> str:
+    return f"{base}-{seq % depth}"
+
+
+def try_write(store, name: str, parts, total: int) -> bool:
+    """One streamed write attempt; False when the ring slot is still
+    occupied (consumer behind — caller waits and retries)."""
+    try:
+        view = store.create(name, total)
+    except FileExistsError:
+        return False
+    offset = 0
+    for part in parts:
+        n = part.nbytes if isinstance(part, memoryview) else len(part)
+        view[offset:offset + n] = part
+        offset += n
+    store.seal(name)
+    return True
+
+
+def _free_slot(store, name: str) -> None:
+    try:
+        store.release(name)
+    except Exception:
+        pass
+    try:
+        store.delete(name)
+    except Exception:
+        pass
+
+
+def read_consume(store, name: str, timeout_ms: int = 60_000):
+    """Blocking read of a slot, then free it (producer unblocks). Large
+    payloads come back as zero-copy views; their slot frees when the
+    value dies."""
+    view = store.get(name, timeout_ms=timeout_ms)
+    if view is None:
+        raise TimeoutError(f"channel slot {name} never arrived")
+    if view.nbytes >= ZERO_COPY_THRESHOLD:
+        value = serialization.deserialize(view, zero_copy=True)
+        try:
+            weakref.finalize(value, _free_slot, store, name)
+            return value
+        except TypeError:
+            pass  # not weakref-able: copy out below
+    try:
+        return serialization.deserialize(view, zero_copy=False)
+    finally:
+        _free_slot(store, name)

@@ -22,6 +22,7 @@ enters stage 0 while seq k is in stage 1 — microbatch pipelining.
 from __future__ import annotations
 
 import itertools
+import time
 import uuid
 from typing import Any, Optional
 
@@ -58,13 +59,6 @@ class ClassMethodNode(DAGNode):
         self.actor = actor_handle
         self.method_name = method_name
         self.args = args
-        for arg in args:
-            if isinstance(arg, ClassMethodNode) and arg.actor._actor_id == (
-                actor_handle._actor_id
-            ):
-                raise ValueError(
-                    "compiled DAGs cannot chain two stages on the same actor"
-                )
 
     def _upstream(self) -> list[DAGNode]:
         return [a for a in self.args if isinstance(a, DAGNode)]
@@ -127,6 +121,13 @@ class DAGRef:
 
 
 class CompiledDAG:
+    """v2 compiled graph: multi-stage actors, pre-allocated shared-memory
+    channels (co-located edges move ONE tiny notify RPC per hop — the
+    payload rides the node's shm store in a bounded ring, reference
+    shared_memory_channel.py role), and real teardown()."""
+
+    CHANNEL_DEPTH = 8  # ring slots per edge = max pipelined seqs in flight
+
     def __init__(self, output_node: DAGNode):
         if isinstance(output_node, InputNode):
             raise ValueError("cannot compile a bare InputNode")
@@ -135,8 +136,27 @@ class CompiledDAG:
         self._seq = itertools.count()
         self._ctx = worker_mod.get_global_context()
         self._stages: dict[int, dict] = {}  # node_id → stage spec
-        self._input_targets: list[tuple[str, str]] = []  # (actor_id, slot)
+        self._input_targets: list[dict] = []
+        self._torn_down = False
+        self._inflight: set[int] = set()
         self._compile()
+
+    def _actor_node(self, actor_id: str) -> str | None:
+        """Which cluster node hosts this actor (channel co-location).
+        Waits for placement: compile typically runs right after actor
+        creation, before scheduling assigns a node."""
+        try:
+            info = self._ctx.io.run(
+                self._ctx.controller.call(
+                    "get_actor_info",
+                    {"actor_id": actor_id, "wait_ready": True},
+                    timeout=60,
+                ),
+                timeout=70,
+            )
+        except Exception:
+            return None
+        return info.get("node_id")
 
     # -- graph lowering --------------------------------------------------
     def _compile(self) -> None:
@@ -153,15 +173,9 @@ class CompiledDAG:
         method_nodes = [
             n for n in nodes.values() if isinstance(n, ClassMethodNode)
         ]
-        actor_ids = [n.actor._actor_id for n in method_nodes]
-        if len(set(actor_ids)) != len(actor_ids):
-            raise ValueError(
-                "compiled DAGs need one stage per actor (an actor appears "
-                "in two nodes)"
-            )
-        # Build stage specs: slots for DAG-node args; constants are baked in
-        # by wrapping... constants unsupported beyond being pre-bound: keep
-        # the reference restriction that bind args are nodes.
+        # Build stage specs: slots for DAG-node args; constants stay the
+        # reference restriction (close over them in the actor).
+        actor_nodes: dict[str, str | None] = {}
         for node in method_nodes:
             slots = []
             for i, arg in enumerate(node.args):
@@ -173,30 +187,70 @@ class CompiledDAG:
                         "InputNode (got a constant; close over it in the "
                         "actor instead)"
                     )
+            actor_id = node.actor._actor_id
+            if actor_id not in actor_nodes:
+                actor_nodes[actor_id] = self._actor_node(actor_id)
             self._stages[node.node_id] = {
-                "actor_id": node.actor._actor_id,
+                "node": node.node_id,
+                "actor_id": actor_id,
+                "cluster_node": actor_nodes[actor_id],
                 "method": node.method_name,
                 "slots": slots,
                 "downstream": [],
+                "in_channels": [],
                 "is_output": node.node_id == self.output_node.node_id,
+                "depth": self.CHANNEL_DEPTH,
             }
-        # Wire edges.
+        driver_node = self._ctx.node_id
+        # Wire edges; co-located endpoints get a shm channel.
         for node in method_nodes:
+            stage = self._stages[node.node_id]
             for i, arg in enumerate(node.args):
                 slot = f"a{i}"
                 if isinstance(arg, InputNode):
+                    chan = None
+                    if stage["cluster_node"] == driver_node:
+                        chan = (
+                            f"dagch-{self.dag_id}-in-{node.node_id}-{slot}"
+                        )
+                        stage["in_channels"].append(chan)
                     self._input_targets.append(
-                        (self._stages[node.node_id]["actor_id"], slot)
-                    )
-                elif isinstance(arg, ClassMethodNode):
-                    self._stages[arg.node_id]["downstream"].append(
                         {
-                            "actor_id": self._stages[node.node_id]["actor_id"],
+                            "actor_id": stage["actor_id"],
+                            "node": node.node_id,
                             "slot": slot,
+                            "channel": chan,
                         }
                     )
-        self._output_actor = self._stages[self.output_node.node_id]["actor_id"]
-        # Register every stage with its hosting worker.
+                elif isinstance(arg, ClassMethodNode):
+                    src = self._stages[arg.node_id]
+                    chan = None
+                    if (
+                        src["cluster_node"] is not None
+                        and src["cluster_node"] == stage["cluster_node"]
+                        and src["actor_id"] != stage["actor_id"]
+                    ):
+                        chan = (
+                            f"dagch-{self.dag_id}-e{arg.node_id}-"
+                            f"{node.node_id}-{slot}"
+                        )
+                        stage["in_channels"].append(chan)
+                    src["downstream"].append(
+                        {
+                            "actor_id": stage["actor_id"],
+                            "node": node.node_id,
+                            "slot": slot,
+                            "channel": chan,
+                        }
+                    )
+        out_stage = self._stages[self.output_node.node_id]
+        self._output_actor = out_stage["actor_id"]
+        self._out_channel = None
+        if out_stage["cluster_node"] == driver_node:
+            self._out_channel = f"dagch-{self.dag_id}-out"
+            out_stage["out_channel"] = self._out_channel
+        # Register every stage with its hosting worker (channels are part
+        # of the registration — pre-allocated at compile time).
         for stage in self._stages.values():
             self._call_actor(
                 stage["actor_id"],
@@ -205,39 +259,200 @@ class CompiledDAG:
             )
 
     # -- worker RPC helpers ----------------------------------------------
-    def _call_actor(self, actor_id: str, method: str, payload: dict) -> dict:
-        async def call():
-            client = await self._ctx._actor_client(actor_id)
-            return await client.call(method, payload)
+    def _call_actor(
+        self, actor_id: str, method: str, payload: dict,
+        timeout: float = 300.0,
+    ) -> dict:
+        ctx = self._ctx
+        # Fast lane: channel notifies and pops ride the native call table
+        # straight from this thread (no io-loop round trip per hop).
+        conn = (
+            ctx._direct_actor_conn(actor_id)
+            if ctx._engine is not None
+            else None
+        )
+        if conn is not None:
+            import ctypes
+            import msgpack
 
-        return self._ctx.io.run(call())
+            from ray_tpu import _native
+            from ray_tpu._private.rpc import REP, RpcError
+
+            engine = ctx._engine
+            raw = msgpack.packb(payload, use_bin_type=True)
+            lib = (
+                engine.pylib
+                if len(raw) < engine._PYLIB_MAX_PAYLOAD
+                else engine.lib
+            )
+            handle = lib.rt_call_start(
+                engine.handle, conn[0], method.encode(), len(method),
+                raw, len(raw),
+            )
+            if handle:
+                view = _native.RtMsgView()
+                rc = engine.lib.rt_call_wait(
+                    engine.handle, handle, int(timeout * 1000),
+                    ctypes.byref(view),
+                )
+                if rc == 1:
+                    kind = view.kind
+                    out = (
+                        msgpack.unpackb(
+                            ctypes.string_at(view.payload, view.plen),
+                            raw=False,
+                        )
+                        if view.plen
+                        else None
+                    )
+                    engine.pylib.rt_msg_free(view.opaque)
+                    if kind == REP:
+                        return out
+                    raise RpcError(out)
+                # dag methods are NOT idempotent (a pop consumes the
+                # result, a push feeds a slot): once the request is on the
+                # wire we must never re-issue it — surface the failure.
+                engine.pylib.rt_call_abandon(engine.handle, handle)
+                if rc == 0:
+                    raise TimeoutError(
+                        f"{method} to {actor_id} timed out after {timeout}s"
+                    )
+                from ray_tpu._private.rpc import ConnectionLost
+
+                raise ConnectionLost(
+                    f"{method}: connection to actor {actor_id} lost"
+                )
+
+        async def call():
+            client = await ctx._actor_client(actor_id)
+            return await client.call(method, payload, timeout=timeout)
+
+        return ctx.io.run(call(), timeout=timeout + 30)
 
     # -- execution -------------------------------------------------------
     def execute(self, value: Any) -> DAGRef:
-        seq = next(self._seq)
-        raw, _ = serialization.serialize(value)
-        for actor_id, slot in self._input_targets:
-            self._call_actor(
-                actor_id,
-                "dag_push",
-                {"dag_id": self.dag_id, "seq": seq, "slot": slot, "value": raw},
+        if self._torn_down:
+            raise RuntimeError(f"{self.dag_id} is torn down")
+        # Bounded in-flight executions (the reference's max-inflight cap):
+        # channel rings hold CHANNEL_DEPTH seqs per edge, so admitting
+        # more un-popped executions than the ring depth would wedge the
+        # submitting thread against its own un-issued pops.
+        if len(self._inflight) >= self.CHANNEL_DEPTH:
+            raise RuntimeError(
+                f"{self.dag_id}: {len(self._inflight)} executions already "
+                f"in flight (max {self.CHANNEL_DEPTH}); get() earlier "
+                "results before submitting more"
             )
+        seq = next(self._seq)
+        self._inflight.add(seq)
+        parts, total, _ = serialization.serialize_parts(value)
+        raw = None
+        written: set[str] = set()
+        for target in self._input_targets:
+            chan = target["channel"]
+            msg = {
+                "dag_id": self.dag_id,
+                "node": target["node"],
+                "seq": seq,
+                "slot": target["slot"],
+            }
+            if chan is not None:
+                if chan not in written:
+                    self._chan_put(chan, seq, parts, total)
+                    written.add(chan)
+                msg["channel"] = chan
+            else:
+                if raw is None:
+                    raw = serialization.join_parts(parts)
+                msg["value"] = raw
+            self._call_actor(target["actor_id"], "dag_push", msg)
         return DAGRef(self, seq)
 
+    def _chan_put(self, base: str, seq: int, parts, total: int) -> None:
+        """Driver-side producer: streamed ring-slot write with
+        backpressure (slot freed when the consumer deletes it)."""
+        from ray_tpu.dag import channel
+
+        name = channel.slot_name(base, seq, self.CHANNEL_DEPTH)
+        deadline = time.monotonic() + 120.0
+        while not channel.try_write(self._ctx.store, name, parts, total):
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"channel slot {name} stuck for 120s")
+            time.sleep(0.002)
+
     def _pop(self, seq: int, timeout: float) -> Any:
+        self._inflight.discard(seq)
+        # Client deadline strictly AFTER the server-side pop wait, so the
+        # timeout reply always beats the transport deadline (an abandoned
+        # pop would consume the result into a dropped reply).
         resp = self._call_actor(
             self._output_actor,
             "dag_pop",
             {"dag_id": self.dag_id, "seq": seq, "timeout": timeout},
+            timeout=timeout + 15,
         )
         if resp["status"] == "timeout":
             raise TimeoutError(f"dag output seq={seq} not ready in {timeout}s")
-        value = serialization.deserialize(resp["value"], zero_copy=False)
+        if resp.get("channel"):
+            from ray_tpu.dag import channel
+
+            value = channel.read_consume(
+                self._ctx.store,
+                channel.slot_name(resp["channel"], seq, self.CHANNEL_DEPTH),
+            )
+        else:
+            value = serialization.deserialize(resp["value"], zero_copy=False)
         from ray_tpu import exceptions
 
         if isinstance(value, exceptions.TaskError):
             raise value
         return value
 
+    async def _teardown_async(self) -> None:
+        for actor_id in {s["actor_id"] for s in self._stages.values()}:
+            try:
+                client = await self._ctx._actor_client(actor_id)
+                await client.call(
+                    "dag_teardown", {"dag_id": self.dag_id}, timeout=10
+                )
+            except Exception:
+                pass
+        # Driver-owned output ring: freed here too, so the __del__ path
+        # (which can only fire-and-forget this coroutine) leaks nothing.
+        if self._out_channel:
+            for i in range(self.CHANNEL_DEPTH):
+                try:
+                    self._ctx.store.delete(f"{self._out_channel}-{i}")
+                except Exception:
+                    pass
+
     def teardown(self) -> None:
-        pass  # stages are garbage-collected with their actors
+        """Release stage registrations, buffered inputs, and channel slots
+        on every participating worker (and the driver's output ring)."""
+        if self._torn_down:
+            return
+        self._torn_down = True
+        try:
+            import asyncio
+
+            on_io_loop = asyncio.get_running_loop() is self._ctx.io.loop
+        except RuntimeError:
+            on_io_loop = False
+        try:
+            if on_io_loop or getattr(self._ctx, "_shutdown", False):
+                # Never block the io loop (a GC-triggered __del__ can run
+                # on ANY thread, including the loop itself): fire and
+                # forget — worker-side teardown is idempotent.
+                self._ctx.io.spawn(self._teardown_async())
+            else:
+                self._ctx.io.run(self._teardown_async(), timeout=30)
+        except Exception:
+            pass
+
+    def __del__(self):  # best-effort: a dropped DAG must not leak slots
+        try:
+            if not self._torn_down:
+                self._torn_down = True
+                self._ctx.io.spawn(self._teardown_async())
+        except Exception:
+            pass
