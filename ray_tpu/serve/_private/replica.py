@@ -48,6 +48,10 @@ class Replica:
         self._latencies: list[float] = []
         self._streams: dict[str, tuple] = {}
         self._stream_counter = 0
+        # Shape keys served here (explicit request shape_keys); unioned
+        # with the batching module's compiled buckets in
+        # get_warm_shapes() for compile-cache-aware routing.
+        self._warm_shapes: set[str] = set()
         init_args = _resolve_handle_placeholders(init_args)
         init_kwargs = _resolve_handle_placeholders(init_kwargs)
         if isinstance(cls_or_fn, type):
@@ -70,6 +74,8 @@ class Replica:
                 )
         self._ongoing += 1
         self._total += 1
+        if meta.get("shape_key"):
+            self._warm_shapes.add(meta["shape_key"])
         start = time.perf_counter()
         token = _request_context.set(meta)
         try:
@@ -217,6 +223,15 @@ class Replica:
 
     def get_num_ongoing(self) -> int:
         return self._ongoing
+
+    def get_warm_shapes(self) -> list:
+        """Shape keys whose XLA programs this replica has already
+        compiled (explicit request shape_keys + batching buckets) — the
+        router prefers warm replicas to avoid compile-latency cliffs
+        (SURVEY §3.4 'compile-cache-aware stickiness')."""
+        from ray_tpu.serve import batching
+
+        return sorted(self._warm_shapes | batching.warm_shapes())
 
     def prepare_to_drain(self) -> str:
         return "ok"

@@ -14,7 +14,24 @@ from __future__ import annotations
 import asyncio
 import functools
 import inspect
+import threading
 from typing import Any, Callable, Optional, Sequence
+
+# Shape keys this PROCESS has compiled for (one replica per process):
+# bucket flushes land here; the replica wrapper unions them into its
+# warm-shape report for compile-cache-aware routing (SURVEY §3.4).
+_WARM_SHAPES: set[str] = set()
+_WARM_LOCK = threading.Lock()
+
+
+def note_warm_shape(key: str) -> None:
+    with _WARM_LOCK:
+        _WARM_SHAPES.add(key)
+
+
+def warm_shapes() -> set[str]:
+    with _WARM_LOCK:
+        return set(_WARM_SHAPES)
 
 
 class _BatchQueue:
@@ -45,6 +62,10 @@ class _BatchQueue:
                 (b for b in self.bucket_sizes if b >= real), self.bucket_sizes[-1]
             )
             items = items + [items[-1]] * (bucket - real)
+            # This process's jitted model has now compiled (or is about
+            # to compile) this bucket shape: report it warm so routers
+            # can steer same-shape traffic here (SURVEY §3.4 TPU note).
+            note_warm_shape(f"batch:{bucket}")
         return items, real
 
     async def submit(self, item: Any) -> Any:

@@ -158,6 +158,10 @@ class Router:
         self._max_ongoing = 100
         self._last_refresh = 0.0
         self._lock = threading.Lock()
+        # Compile-cache-aware stickiness (SURVEY §3.4): per-replica warm
+        # shape keys, polled lazily once any caller routes by shape_key.
+        self._warm: dict[str, set] = {}
+        self._warm_ts = 0.0
 
     def _refresh(self, force: bool = False) -> None:
         """Membership comes from the process-wide long-poll subscriber
@@ -183,12 +187,54 @@ class Router:
             self._handles[actor_name] = handle
         return handle
 
-    def choose_replica(self) -> str:
+    def _refresh_warm(self, candidates: list) -> None:
+        """Poll per-replica warm shape sets (2s cadence): a replica that
+        has compiled a bucket/shape reports it; the router then prefers
+        warm replicas for same-shape traffic so autoscaling events don't
+        turn into compile-latency cliffs (SURVEY §3.4)."""
+        if time.monotonic() - self._warm_ts < 2.0:
+            return
+        self._warm_ts = time.monotonic()
+        import ray_tpu
+
+        # Fan out, then collect under ONE short total budget: a hung
+        # replica must not stall the request path for 5s x N.
+        refs = {}
+        for name in candidates:
+            try:
+                refs[name] = self._replica_handle(
+                    name
+                ).get_warm_shapes.remote()
+            except Exception:
+                self._warm.pop(name, None)
+        deadline = time.monotonic() + 2.0
+        for name, ref in refs.items():
+            try:
+                remaining = max(0.05, deadline - time.monotonic())
+                self._warm[name] = set(ray_tpu.get(ref, timeout=remaining))
+            except Exception:
+                self._warm.pop(name, None)
+
+    def choose_replica(self, shape_key: str | None = None) -> str:
         deadline = time.monotonic() + 30.0
         while True:
             self._refresh()
             with self._lock:
                 candidates = list(self._replicas)
+            if candidates and shape_key:
+                self._refresh_warm(candidates)
+                warm = [
+                    c for c in candidates
+                    if shape_key in self._warm.get(c, ())
+                ]
+                # Prefer warm replicas unless they are saturated — a cold
+                # compile beats unbounded queueing behind the warm one.
+                warm_free = [
+                    c for c in warm
+                    if self._ongoing.get(c, 0) < self._max_ongoing
+                ]
+                if warm_free:
+                    candidates = warm_free
             if candidates:
                 if len(candidates) == 1:
                     pick = candidates[0]
@@ -225,6 +271,7 @@ class DeploymentHandle:
         self._router: Optional[Router] = None
         self._method_name = "__call__"
         self._model_id = ""
+        self._shape_key = ""
 
     def _get_router(self) -> Router:
         if self._router is None:
@@ -232,10 +279,18 @@ class DeploymentHandle:
         return self._router
 
     def options(self, *, method_name: str | None = None,
-                multiplexed_model_id: str | None = None) -> "DeploymentHandle":
+                multiplexed_model_id: str | None = None,
+                shape_key: str | None = None) -> "DeploymentHandle":
+        """shape_key: opaque label of the request's compiled shape
+        (sequence-length bucket, resolution, ...). Requests with the same
+        key stick to replicas that already compiled it (§3.4)."""
         clone = DeploymentHandle(self.deployment_name, self.app_name)
+        # Share ONE router across option clones (materialize it now: a
+        # None copied here would fork load counts and warm caches later).
+        clone._router = self._get_router()
         clone._method_name = method_name or self._method_name
         clone._model_id = multiplexed_model_id or self._model_id
+        clone._shape_key = shape_key or self._shape_key
         return clone
 
     def __getattr__(self, name: str):
@@ -256,7 +311,9 @@ class DeploymentHandle:
         )
         last_exc: Exception | None = None
         for _ in range(3):
-            replica_name = router.choose_replica()
+            replica_name = router.choose_replica(
+                shape_key=self._shape_key or None
+            )
             replica = router._replica_handle(replica_name)
             try:
                 ref = replica.handle_request.remote(
@@ -264,6 +321,7 @@ class DeploymentHandle:
                         "request_id": meta.request_id,
                         "method_name": meta.method_name,
                         "multiplexed_model_id": meta.multiplexed_model_id,
+                        "shape_key": self._shape_key,
                     },
                     args,
                     kwargs,
@@ -279,16 +337,19 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (_rebuild_handle, (self.deployment_name, self.app_name,
-                                  self._method_name, self._model_id))
+                                  self._method_name, self._model_id,
+                                  self._shape_key))
 
     def __repr__(self):
         return f"DeploymentHandle({self.app_name}/{self.deployment_name})"
 
 
-def _rebuild_handle(deployment, app_name, method_name, model_id):
+def _rebuild_handle(deployment, app_name, method_name, model_id,
+                    shape_key=""):
     handle = DeploymentHandle(deployment, app_name)
     handle._method_name = method_name
     handle._model_id = model_id
+    handle._shape_key = shape_key
     return handle
 
 

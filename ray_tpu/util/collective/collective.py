@@ -44,6 +44,18 @@ class BaseGroup:
     # subclasses implement: allreduce, allgather, reducescatter, broadcast,
     # barrier, send, recv, destroy
 
+    def p2p(self, array, src_rank: int, dst_rank: int):
+        """Group-wide p2p entry point: every rank calls with the same
+        (src, dst); returns the array on dst, None elsewhere. Host-memory
+        backends only involve the endpoints; the xla backend overrides
+        this with a true all-rank ppermute collective."""
+        if self.rank == src_rank:
+            self.send(np.asarray(array), dst_rank)
+            return None
+        if self.rank == dst_rank:
+            return self.recv(src_rank)
+        return None
+
 
 # ---------------------------------------------------------------------------
 # ring backend (host memory over RPC p2p)
@@ -280,6 +292,7 @@ class XlaGroup(BaseGroup):
             for device in jax.devices():
                 per_process.setdefault(device.process_index, device)
             self._rank_devices = [per_process[i] for i in range(world_size)]
+        self._p2p_cache: dict = {}
 
     def _cross_rank(self, array, reducer):
         import jax
@@ -332,15 +345,83 @@ class XlaGroup(BaseGroup):
     def barrier(self):
         self.allreduce(np.zeros((1,), np.float32))
 
-    def send(self, array, dst_rank: int, tag: str = ""):
-        raise NotImplementedError(
-            "xla backend has no host p2p; use backend='ring' for send/recv"
-        )
+    def p2p(self, array, src_rank: int, dst_rank: int):
+        """Point-to-point as an XLA collective: ONE ppermute over the rank
+        mesh moves src's block to dst over ICI/DCN (device-to-device — no
+        host round trip). SPMD contract: EVERY rank in the group calls
+        p2p with the SAME (src, dst) pair (bystanders pass a zeros
+        template; their block is discarded) — exactly like the
+        reference's NCCL send/recv, which is also a paired collective.
+        Returns the transferred array on dst; None elsewhere."""
+        import jax
 
-    def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0):
-        raise NotImplementedError(
-            "xla backend has no host p2p; use backend='ring' for send/recv"
-        )
+        if src_rank == dst_rank:
+            raise ValueError("p2p with src_rank == dst_rank is a local copy")
+        array = np.asarray(array)
+        key = (array.shape, array.dtype.str, src_rank, dst_rank)
+        shift = self._p2p_cache.get(key)
+        if shift is None:
+            import jax.numpy as jnp
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            mesh = Mesh(np.array(self._rank_devices), ("ranks",))
+            sharding = NamedSharding(mesh, P("ranks"))
+
+            def permute(block):
+                return jax.lax.ppermute(
+                    block, "ranks", perm=[(src_rank, dst_rank)]
+                )
+
+            jitted = jax.jit(
+                shard_map(
+                    permute, mesh=mesh, in_specs=P("ranks"),
+                    out_specs=P("ranks"),
+                )
+            )
+
+            def shift(local_np):
+                local = jnp.asarray(local_np)[None]
+                global_arr = jax.make_array_from_single_device_arrays(
+                    (self.world_size, *local.shape[1:]),
+                    sharding,
+                    [jax.device_put(local, self._rank_devices[self.rank])],
+                )
+                return jitted(global_arr)
+
+            # Cache the jitted program: a per-step halo exchange must not
+            # retrace/recompile on every call.
+            self._p2p_cache[key] = shift
+        out = shift(array)
+        if self.rank != dst_rank:
+            return None
+        return np.asarray(out.addressable_data(0))[0]
+
+    def send(self, array, dst_rank: int, tag: str = ""):
+        """p2p send over the XLA mesh. The destination must concurrently
+        call ``recv(src_rank=<this rank>, like=<same shape/dtype>)`` and,
+        for world_size > 2, every OTHER rank must enter
+        ``p2p(zeros_template, src, dst)`` — one ppermute program across
+        the whole group (paired-collective semantics, like NCCL p2p)."""
+        if dst_rank == self.rank:
+            raise ValueError("xla send to self is unsupported")
+        self.p2p(np.asarray(array), self.rank, dst_rank)
+
+    def recv(
+        self, src_rank: int, tag: str = "", timeout: float = 60.0,
+        like=None,
+    ):
+        """p2p receive: ``like`` supplies the shape/dtype of the incoming
+        array (XLA programs are shape-static; the reference's NCCL recv
+        takes a pre-allocated tensor the same way)."""
+        if like is None:
+            raise ValueError(
+                "xla recv needs like=<array of the incoming shape/dtype> "
+                "(shape-static paired collective)"
+            )
+        if src_rank == self.rank:
+            raise ValueError("xla recv from self is unsupported")
+        return self.p2p(np.zeros_like(like), src_rank, self.rank)
 
     def destroy(self):
         pass
@@ -489,8 +570,14 @@ def send(array, dst_rank: int, group_name: str = "default"):
     get_group(group_name).send(array, dst_rank)
 
 
-def recv(src_rank: int, group_name: str = "default", timeout: float = 60.0):
-    return get_group(group_name).recv(src_rank, timeout=timeout)
+def recv(
+    src_rank: int, group_name: str = "default", timeout: float = 60.0,
+    like=None,
+):
+    group = get_group(group_name)
+    if like is not None:
+        return group.recv(src_rank, timeout=timeout, like=like)
+    return group.recv(src_rank, timeout=timeout)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:

@@ -62,6 +62,39 @@ def test_xla_group_spans_two_processes(two_proc_xla_gang):
         np.testing.assert_allclose(res["broadcast"], [42.0])
 
 
+def _xla_p2p(ctx):
+    """Rank 0 sends a block to rank 1 via the xla backend's ppermute p2p
+    (paired collective: both ranks enter the same program)."""
+    g = ctx.collective()
+    payload = np.arange(6, dtype=np.float32).reshape(2, 3) + 100.0
+    if ctx.rank == 0:
+        g.send(payload, dst_rank=1)
+        received = None
+    else:
+        received = g.recv(src_rank=0, like=np.zeros((2, 3), np.float32))
+    # reverse direction with a different value
+    back = np.full((4,), float(ctx.rank), np.float32)
+    if ctx.rank == 1:
+        g.send(back, dst_rank=0)
+        received2 = None
+    else:
+        received2 = g.recv(src_rank=1, like=np.zeros((4,), np.float32))
+    return {
+        "got01": None if received is None else np.asarray(received),
+        "got10": None if received2 is None else np.asarray(received2),
+    }
+
+
+def test_xla_group_p2p_send_recv(two_proc_xla_gang):
+    results = two_proc_xla_gang.run(_xla_p2p, timeout=120)
+    by_rank = {i: r for i, r in enumerate(results)}
+    np.testing.assert_allclose(
+        by_rank[1]["got01"],
+        np.arange(6, dtype=np.float32).reshape(2, 3) + 100.0,
+    )
+    np.testing.assert_allclose(by_rank[0]["got10"], np.full((4,), 1.0))
+
+
 def _hier_allreduce(ctx, shards_per_host):
     g = ctx.collective()
     shards = [

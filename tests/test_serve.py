@@ -254,6 +254,36 @@ def test_multiplexed_deployment(serve_instance):
     assert h2.remote(5).result() == 10  # cached
 
 
+def test_compile_cache_aware_routing(serve_instance):
+    """Requests sharing a shape_key stick to the replica that already
+    compiled it (SURVEY §3.4: router needs compile-cache-aware
+    stickiness — autoscaling events must not become compile cliffs)."""
+    import time as _time
+
+    @serve.deployment(num_replicas=2)
+    class ShapeServer:
+        def __call__(self, x):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(
+        ShapeServer.bind(), name="shapes", route_prefix="/shapes"
+    )
+    warm_handle = handle.options(shape_key="seq:1024")
+    first_pid = warm_handle.remote(0).result()
+    # let the router's warm-cache poll observe the replica's report
+    _time.sleep(2.5)
+    pids = {warm_handle.remote(i).result() for i in range(12)}
+    assert pids == {first_pid}, (
+        f"shape-keyed requests scattered across replicas: {pids} "
+        f"(warm replica pid={first_pid})"
+    )
+    # keyless requests still spread over both replicas (pow-2 unchanged)
+    spread = {handle.remote(i).result() for i in range(20)}
+    assert len(spread) == 2
+
+
 def test_replica_failure_recovery(serve_instance):
     @serve.deployment(num_replicas=1, health_check_period_s=0.5)
     class Fragile:
