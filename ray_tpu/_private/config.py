@@ -101,6 +101,9 @@ class RayTpuConfig:
 
     # --- control-plane persistence (reference: redis_store_client [N7]) ---
     controller_snapshot_period_s: float = _env("controller_snapshot_period_s", 0.5)
+    # Snapshot backend: "file" (session dir), "memory", or
+    # "kv://host:port" (external wire-v1 KV — survives head-disk loss).
+    controller_store: str = _env("controller_store", "file")
 
     # --- pubsub / rpc ---
     rpc_connect_timeout_s: float = _env("rpc_connect_timeout_s", 30.0)

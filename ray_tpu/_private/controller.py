@@ -168,8 +168,19 @@ class Controller:
         # Persistence (role-equivalent of the reference's
         # redis_store_client-backed GCS tables [N7]: restart the control
         # plane and the cluster survives). Snapshots are JSON (bytes
-        # base64-wrapped) written atomically by _snapshot_loop.
-        self.snapshot_path = os.path.join(session_dir, "controller_state.json")
+        # base64-wrapped) written by _snapshot_loop through a PLUGGABLE
+        # store: file (default), memory, or an external wire-v1 KV
+        # service (kv://host:port — head-disk loss no longer loses the
+        # cluster). Selected via RAY_TPU_controller_store.
+        from ray_tpu._private.snapshot_store import make_store
+
+        self.store = make_store(
+            global_config().controller_store, session_dir
+        )
+        print(
+            f"[controller] persistence: {self.store.describe()}",
+            file=sys.stderr, flush=True,
+        )
         self._dirty = False
         self._restored = self._load_snapshot()
 
@@ -229,7 +240,10 @@ class Controller:
     def _mark_dirty(self) -> None:
         self._dirty = True
 
-    def _save_snapshot(self) -> None:
+    def _build_snapshot_blob(self) -> bytes:
+        """Runs ON the event loop: the state walk must be atomic w.r.t.
+        handlers mutating actors/pgs/kv — only the (pure) store write is
+        pushed to a worker thread."""
         state = {
             "actors": {
                 aid: {
@@ -260,17 +274,32 @@ class Controller:
             "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
             "jobs": self.jobs,
         }
-        tmp = self.snapshot_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(_jsonify(state), f)
-        os.replace(tmp, self.snapshot_path)
+        return json.dumps(_jsonify(state)).encode()
 
     def _load_snapshot(self) -> bool:
-        if not os.path.exists(self.snapshot_path):
+        blob = None
+        last_exc = None
+        for attempt in range(5):
+            try:
+                blob = self.store.load()
+                last_exc = None
+                break
+            except Exception as exc:
+                last_exc = exc
+                time.sleep(0.5 * (attempt + 1))
+        if last_exc is not None:
+            # An UNREACHABLE store is not the same as an EMPTY one:
+            # booting fresh would later overwrite the good external
+            # snapshot with empty state. Fail the boot; the operator (or
+            # supervisor restart loop) retries once the store is back.
+            raise RuntimeError(
+                f"snapshot store {self.store.describe()} unreachable at "
+                f"boot: {last_exc}"
+            )
+        if blob is None:
             return False
         try:
-            with open(self.snapshot_path) as f:
-                state = _dejsonify(json.load(f))
+            state = _dejsonify(json.loads(blob))
         except Exception as exc:
             print(
                 f"[controller] snapshot load failed: {exc}",
@@ -311,13 +340,17 @@ class Controller:
 
     async def _snapshot_loop(self) -> None:
         period = global_config().controller_snapshot_period_s
+        loop = asyncio.get_running_loop()
         while True:
             await asyncio.sleep(period)
             if not self._dirty:
                 continue
             self._dirty = False
             try:
-                self._save_snapshot()
+                blob = self._build_snapshot_blob()  # on-loop: consistent
+                # executor: an external store's socket write must not
+                # stall the control plane's event loop.
+                await loop.run_in_executor(None, self.store.save, blob)
             except Exception as exc:
                 self._dirty = True  # retry next tick; don't lose the state
                 print(

@@ -74,8 +74,6 @@ class Replica:
                 )
         self._ongoing += 1
         self._total += 1
-        if meta.get("shape_key"):
-            self._warm_shapes.add(meta["shape_key"])
         start = time.perf_counter()
         token = _request_context.set(meta)
         try:
@@ -95,7 +93,14 @@ class Replica:
                 # token stream IS an ongoing request for autoscaling.
                 stream_id = self._open_stream(result)
                 self._ongoing += 1  # released by _finish_stream
+                if meta.get("shape_key"):
+                    self._warm_shapes.add(meta["shape_key"])
                 return {"__serve_stream__": stream_id}
+            # Warmth is recorded only on SUCCESS: a replica that keeps
+            # failing a shape must not advertise it and pin the whole
+            # shape's traffic (plus its retries) onto itself.
+            if meta.get("shape_key"):
+                self._warm_shapes.add(meta["shape_key"])
             return result
         finally:
             _request_context.reset(token)

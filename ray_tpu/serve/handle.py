@@ -192,9 +192,12 @@ class Router:
         has compiled a bucket/shape reports it; the router then prefers
         warm replicas for same-shape traffic so autoscaling events don't
         turn into compile-latency cliffs (SURVEY §3.4)."""
-        if time.monotonic() - self._warm_ts < 2.0:
-            return
-        self._warm_ts = time.monotonic()
+        with self._lock:
+            # check-and-set under the lock: concurrent callers must not
+            # stampede duplicate warm polls
+            if time.monotonic() - self._warm_ts < 2.0:
+                return
+            self._warm_ts = time.monotonic()
         import ray_tpu
 
         # Fan out, then collect under ONE short total budget: a hung
@@ -206,14 +209,25 @@ class Router:
                     name
                 ).get_warm_shapes.remote()
             except Exception:
-                self._warm.pop(name, None)
+                pass
         deadline = time.monotonic() + 2.0
-        for name, ref in refs.items():
+        updates: dict[str, set | None] = {}
+        for name in candidates:
+            ref = refs.get(name)
+            if ref is None:
+                updates[name] = None
+                continue
             try:
                 remaining = max(0.05, deadline - time.monotonic())
-                self._warm[name] = set(ray_tpu.get(ref, timeout=remaining))
+                updates[name] = set(ray_tpu.get(ref, timeout=remaining))
             except Exception:
-                self._warm.pop(name, None)
+                updates[name] = None
+        with self._lock:
+            for name, warm in updates.items():
+                if warm is None:
+                    self._warm.pop(name, None)
+                else:
+                    self._warm[name] = warm
 
     def choose_replica(self, shape_key: str | None = None) -> str:
         deadline = time.monotonic() + 30.0

@@ -140,7 +140,11 @@ class RingGroup(BaseGroup):
 
         self.ctx.io.run(_send())
 
-    def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0) -> np.ndarray:
+    def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0,
+             like=None) -> np.ndarray:
+        # `like` is the xla backend's static-shape template; host-memory
+        # transfers carry their own metadata, so it is accepted and
+        # ignored here for backend-portable call sites.
         seq_key = (src_rank, tag)
         seq = self._recv_seq.get(seq_key, 0)
         key = (src_rank, f"{tag}#{seq}")
@@ -510,7 +514,8 @@ class HierarchicalGroup(BaseGroup):
     def send(self, array, dst_rank: int, tag: str = ""):
         self._ring.send(array, dst_rank, tag=tag)
 
-    def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0):
+    def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0,
+             like=None):
         return self._ring.recv(src_rank, tag=tag, timeout=timeout)
 
     def destroy(self):

@@ -55,6 +55,91 @@ def test_named_actor_survives_controller_restart(ft_cluster):
     assert ray_tpu.get(c.incr.remote(), timeout=60) == 3
 
 
+def test_external_store_recovery_after_local_snapshot_loss(
+    tmp_path, monkeypatch
+):
+    """Chaos (reference redis_store_client HA role, N7): kill the
+    controller AND delete every local snapshot file — the restarted
+    controller must restore named actors and KV from the EXTERNAL
+    wire-v1 KV store."""
+    import glob
+    import json
+    import os
+    import subprocess
+    import sys
+
+    ready = tmp_path / "kv_ready.json"
+    kv_proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.kv_store_server",
+         "--port", "0", "--data", str(tmp_path / "kv.json"),
+         "--ready-file", str(ready)],
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while not ready.exists():
+            assert time.monotonic() < deadline, "kv store never came up"
+            time.sleep(0.1)
+        info = json.loads(ready.read_text())
+        monkeypatch.setenv(
+            "RAY_TPU_controller_store",
+            f"kv://{info['host']}:{info['port']}",
+        )
+        assert not ray_tpu.is_initialized()
+        cluster = Cluster(
+            initialize_head=True, head_node_args={"resources": {"CPU": 8}}
+        )
+        ray_tpu.init(address=cluster.address)
+        try:
+            from ray_tpu._private.worker import get_global_context
+
+            @ray_tpu.remote
+            class Keeper:
+                def __init__(self):
+                    self.n = 41
+
+                def incr(self):
+                    self.n += 1
+                    return self.n
+
+            keeper = Keeper.options(
+                name="ha-keeper", lifetime="detached"
+            ).remote()
+            assert ray_tpu.get(keeper.incr.remote(), timeout=120) == 42
+            ctx = get_global_context()
+            ctx.io.run(ctx.controller.call(
+                "kv_put",
+                {"namespace": "ha", "key": "k", "value": b"external"},
+            ))
+            _wait_snapshot_flush()
+
+            cluster.kill_controller()
+            # Delete every LOCAL snapshot trace: recovery must come from
+            # the external store alone.
+            removed = 0
+            for path in glob.glob(
+                os.path.join(cluster.session_dir, "controller_state.json*")
+            ):
+                os.remove(path)
+                removed += 1
+            assert removed == 0, (
+                "kv:// mode must not write local snapshots "
+                f"(found {removed})"
+            )
+            cluster.restart_controller()
+
+            resolved = ray_tpu.get_actor("ha-keeper")
+            assert ray_tpu.get(resolved.incr.remote(), timeout=120) >= 42
+            resp = ctx.io.run(ctx.controller.call(
+                "kv_get", {"namespace": "ha", "key": "k"}
+            ))
+            assert resp["value"] == b"external"
+        finally:
+            ray_tpu.shutdown()
+            cluster.shutdown()
+    finally:
+        kv_proc.kill()
+
+
 def test_kv_and_new_tasks_survive_controller_restart(ft_cluster):
     from ray_tpu._private.worker import get_global_context
 
