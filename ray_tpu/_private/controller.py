@@ -666,6 +666,18 @@ class Controller:
         gcs_resource_manager.cc resource load reports → autoscaler)."""
         return {
             "pending_demands": list(self.pending_demands.values()),
+            # Unplaced placement groups (autoscaler v2 input: a pending
+            # pod-slice PG is THE TPU-native scale-up signal — slices are
+            # allocated whole, not host by host).
+            "pending_pgs": [
+                {
+                    "pg_id": pid,
+                    "strategy": pg.strategy,
+                    "bundles": pg.bundles,
+                }
+                for pid, pg in self.pgs.items()
+                if pg.state in ("PENDING", "RESCHEDULING")
+            ],
             "nodes": [
                 {
                     "node_id": n.node_id,

@@ -6,6 +6,11 @@ from ray_tpu.autoscaler.autoscaler import (
     StandardAutoscaler,
     bin_pack_unmet_demand,
 )
+from ray_tpu.autoscaler.v2 import (
+    AutoscalerV2,
+    InstanceManagerV2,
+    PodSliceProvider,
+)
 
 __all__ = [
     "StandardAutoscaler",
@@ -14,4 +19,7 @@ __all__ = [
     "NodeProvider",
     "FakeNodeProvider",
     "bin_pack_unmet_demand",
+    "AutoscalerV2",
+    "InstanceManagerV2",
+    "PodSliceProvider",
 ]

@@ -1,0 +1,347 @@
+"""Autoscaler v2 — instance lifecycle state machine + pod-slice provider.
+
+Role-equivalent of python/ray/autoscaler/v2/ :: instance_manager +
+instance lifecycle (SURVEY §2.3 autoscaler v2 row), redesigned around
+the TPU-native unit of scale: a POD SLICE. Chips in one slice share an
+ICI domain, so capacity comes and goes slice-at-a-time — the v2 policy
+reads pending pod-slice placement groups (bundles carrying a
+``TPU-<slice_spec>`` resource, produced by
+``ray_tpu.util.placement_group.tpu_slice_bundles``) and allocates WHOLE
+slices; scale-down likewise drains a slice atomically once every host in
+it has been idle past the timeout (terminating one host of a live slice
+would break the ICI mesh for the rest).
+
+Every instance (one TPU host VM) moves through an explicit, audited FSM:
+
+    REQUESTED -> ALLOCATED -> RUNNING -> DRAINING -> TERMINATED
+         \\-> ALLOCATION_FAILED (terminal; slice retried as a whole)
+
+Illegal transitions raise — the reconciler's reasoning is table-testable
+exactly like the reference's InstanceManager transition tests.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu._private import worker as worker_mod
+
+# -- instance lifecycle -----------------------------------------------------
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RUNNING = "RUNNING"
+DRAINING = "DRAINING"
+TERMINATED = "TERMINATED"
+ALLOCATION_FAILED = "ALLOCATION_FAILED"
+
+_LEGAL_TRANSITIONS = {
+    REQUESTED: {ALLOCATED, ALLOCATION_FAILED},
+    ALLOCATED: {RUNNING, TERMINATED},
+    RUNNING: {DRAINING, TERMINATED},
+    DRAINING: {TERMINATED, RUNNING},  # RUNNING: drain cancelled (new load)
+    TERMINATED: set(),
+    ALLOCATION_FAILED: set(),
+}
+
+_ids = itertools.count(1)
+
+
+@dataclass
+class Instance:
+    """One TPU host VM of a slice, with its audited lifecycle."""
+
+    instance_id: str
+    slice_id: str
+    slice_type: str
+    host_index: int
+    resources: dict
+    state: str = REQUESTED
+    cloud_node_id: Optional[str] = None  # provider node once ALLOCATED
+    history: list = field(default_factory=list)
+
+    def transition(self, new_state: str, reason: str = "") -> None:
+        if new_state not in _LEGAL_TRANSITIONS[self.state]:
+            raise ValueError(
+                f"illegal instance transition {self.state} -> {new_state} "
+                f"({self.instance_id})"
+            )
+        self.history.append((time.time(), self.state, new_state, reason))
+        self.state = new_state
+
+
+class PodSliceProvider:
+    """Dry-run TPU pod-slice provider.
+
+    The cloud-CRM role (reference: node_provider implementations), shaped
+    for TPU: allocation is per SLICE, hosts come with the slice's
+    ``TPU``/``TPU-<spec>`` resources and a slice-id label. Backed by an
+    in-process ``cluster_utils.Cluster`` when one is given (tests get
+    REAL nodes); otherwise it only records the dry-run inventory.
+    """
+
+    def __init__(self, cluster=None):
+        self.cluster = cluster
+        self._slices: dict[str, list[str]] = {}
+
+    def slice_shape(self, slice_type: str, bundles: list[dict]) -> list[dict]:
+        """Per-host resource dicts for one slice serving these bundles.
+        The PG's OWN bundles define the shape (extra per-bundle resources
+        and bundle counts are honored); the canonical tpu_slice_bundles
+        layout is only the fallback."""
+        shape = [
+            dict(bundle)
+            for bundle in bundles
+            if any(key.startswith("TPU-") for key in bundle)
+        ]
+        if shape:
+            return shape
+        from ray_tpu.util.placement_group import tpu_slice_bundles
+
+        return tpu_slice_bundles(slice_type)
+
+    def create_slice_host(
+        self, slice_id: str, slice_type: str, host_index: int, resources: dict
+    ) -> str:
+        """Allocate ONE host VM of a slice; returns the cloud node id."""
+        labeled = dict(resources)
+        labeled[f"tpu-slice:{slice_id}"] = 1.0
+        if self.cluster is not None:
+            node_id = self.cluster.add_node(resources=labeled, num_cpus=2)
+        else:
+            node_id = f"dryrun-{slice_id}-h{host_index}"
+        self._slices.setdefault(slice_id, []).append(node_id)
+        return node_id
+
+    def terminate_slice(self, slice_id: str) -> None:
+        for node_id in self._slices.pop(slice_id, []):
+            if self.cluster is not None:
+                try:
+                    self.cluster.remove_node(node_id)
+                except Exception:
+                    pass
+
+    def non_terminated_slices(self) -> dict[str, list[str]]:
+        return {sid: list(nodes) for sid, nodes in self._slices.items()}
+
+
+class InstanceManagerV2:
+    """Owns every Instance and drives the FSM from observed cluster state
+    (reference: autoscaler/v2 instance_manager reconciler)."""
+
+    def __init__(self, provider: PodSliceProvider):
+        self.provider = provider
+        self.instances: dict[str, Instance] = {}
+
+    def request_slice(self, slice_type: str, shape: list[dict]) -> str:
+        """Admit a whole slice's hosts as REQUESTED instances."""
+        slice_id = f"slice-{next(_ids)}"
+        for host_index, resources in enumerate(shape):
+            inst = Instance(
+                instance_id=f"inst-{next(_ids)}",
+                slice_id=slice_id,
+                slice_type=slice_type,
+                host_index=host_index,
+                resources=dict(resources),
+            )
+            self.instances[inst.instance_id] = inst
+        return slice_id
+
+    def by_slice(self) -> dict[str, list[Instance]]:
+        out: dict[str, list[Instance]] = {}
+        for inst in self.instances.values():
+            out.setdefault(inst.slice_id, []).append(inst)
+        return out
+
+    def reconcile(self, alive_node_ids: set[str]) -> None:
+        """One reconciliation pass: allocate requested hosts, promote
+        allocated hosts whose node registered, terminate drained hosts."""
+        for slice_id, members in self.by_slice().items():
+            for inst in members:
+                if inst.state == REQUESTED:
+                    try:
+                        inst.cloud_node_id = self.provider.create_slice_host(
+                            slice_id, inst.slice_type, inst.host_index,
+                            inst.resources,
+                        )
+                        inst.transition(ALLOCATED, "provider created host")
+                    except Exception as exc:
+                        inst.transition(ALLOCATION_FAILED, str(exc))
+                elif inst.state == ALLOCATED:
+                    if inst.cloud_node_id in alive_node_ids:
+                        inst.transition(RUNNING, "node registered")
+                elif inst.state == RUNNING:
+                    if (
+                        inst.cloud_node_id is not None
+                        and inst.cloud_node_id not in alive_node_ids
+                        and not inst.cloud_node_id.startswith("dryrun-")
+                    ):
+                        inst.transition(TERMINATED, "node lost")
+
+    def drain_slice(self, slice_id: str, reason: str) -> None:
+        for inst in self.by_slice().get(slice_id, []):
+            if inst.state == RUNNING:
+                inst.transition(DRAINING, reason)
+
+    def cancel_drain(self, slice_id: str, reason: str) -> None:
+        for inst in self.by_slice().get(slice_id, []):
+            if inst.state == DRAINING:
+                inst.transition(RUNNING, reason)
+
+    def finish_drain(self, slice_id: str) -> None:
+        self.provider.terminate_slice(slice_id)
+        for inst in self.by_slice().get(slice_id, []):
+            if inst.state == DRAINING:
+                inst.transition(TERMINATED, "slice drained")
+
+    def abort_slice(self, slice_id: str, reason: str) -> None:
+        """Tear a slice down wholesale (allocation failure / lost host —
+        a partial slice's ICI mesh is broken, its survivors are useless)."""
+        self.provider.terminate_slice(slice_id)
+        for inst in self.by_slice().get(slice_id, []):
+            if inst.state in (ALLOCATED, RUNNING, DRAINING):
+                inst.transition(TERMINATED, reason)
+
+
+class AutoscalerV2:
+    """Slice-granular scaling policy over the instance manager.
+
+    Scale-up: every pending pod-slice placement group (bundles carrying
+    a ``TPU-<spec>`` resource) gets one whole slice REQUESTED. Scale-down:
+    a slice whose hosts are ALL fully idle past ``idle_timeout_s`` drains
+    atomically.
+    """
+
+    def __init__(
+        self,
+        provider: PodSliceProvider,
+        idle_timeout_s: float = 60.0,
+        max_slices: int = 8,
+        update_interval_s: float = 1.0,
+    ):
+        self.manager = InstanceManagerV2(provider)
+        self.idle_timeout_s = idle_timeout_s
+        self.max_slices = max_slices
+        self.update_interval_s = update_interval_s
+        self._pg_slices: dict[str, str] = {}  # pg_id -> slice_id
+        self._slice_idle_since: dict[str, float] = {}
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _slice_type_of(bundles: list[dict]) -> Optional[str]:
+        for bundle in bundles:
+            for key in bundle:
+                if key.startswith("TPU-"):
+                    return key[len("TPU-"):]
+        return None
+
+    def update(self) -> dict:
+        ctx = worker_mod.get_global_context()
+        load = ctx.io.run(ctx.controller.call("get_load", {}))
+        alive = {n["node_id"] for n in load["nodes"] if n["alive"]}
+        node_info = {n["node_id"]: n for n in load["nodes"] if n["alive"]}
+
+        requested = 0
+        # -- scale up: one whole slice per pending pod-slice PG ----------
+        pending_pg_ids = set()
+        for pg in load.get("pending_pgs", []):
+            slice_type = self._slice_type_of(pg["bundles"])
+            if slice_type is None:
+                continue
+            pending_pg_ids.add(pg["pg_id"])
+            if pg["pg_id"] in self._pg_slices:
+                continue  # slice already on the way
+            live = {
+                sid
+                for sid, members in self.manager.by_slice().items()
+                if any(i.state not in (TERMINATED, ALLOCATION_FAILED)
+                       for i in members)
+            }
+            if len(live) >= self.max_slices:
+                continue
+            shape = self.manager.provider.slice_shape(
+                slice_type, pg["bundles"]
+            )
+            slice_id = self.manager.request_slice(slice_type, shape)
+            self._pg_slices[pg["pg_id"]] = slice_id
+            requested += 1
+        for pg_id in list(self._pg_slices):
+            if pg_id not in pending_pg_ids:
+                self._pg_slices.pop(pg_id)  # pg placed or removed
+
+        self.manager.reconcile(alive)
+
+        # -- failure repair: a partial slice is a broken ICI mesh --------
+        # Any slice with a failed allocation or a lost host is torn down
+        # wholesale; its PG mapping drops so the NEXT update requests a
+        # fresh slice (retry-as-a-whole).
+        for slice_id, members in self.manager.by_slice().items():
+            states = {i.state for i in members}
+            broken = ALLOCATION_FAILED in states or (
+                TERMINATED in states and states != {TERMINATED}
+            )
+            if broken:
+                self.manager.abort_slice(slice_id, "partial slice failure")
+                for pg_id, sid in list(self._pg_slices.items()):
+                    if sid == slice_id:
+                        self._pg_slices.pop(pg_id)
+                self._slice_idle_since.pop(slice_id, None)
+
+        def _slice_idle(members) -> bool:
+            return all(
+                (info := node_info.get(i.cloud_node_id)) is not None
+                and info["resources_available"] == info["resources_total"]
+                for i in members
+            )
+
+        # -- scale down: atomically drain fully-idle slices --------------
+        drained = 0
+        now = time.monotonic()
+        for slice_id, members in self.manager.by_slice().items():
+            states = {i.state for i in members}
+            if states == {DRAINING}:
+                # Re-verify against the CURRENT load report: anything
+                # scheduled in the drain window cancels the drain (the
+                # FSM's DRAINING -> RUNNING path) instead of losing its
+                # nodes.
+                if _slice_idle(members):
+                    self.manager.finish_drain(slice_id)
+                    drained += 1
+                else:
+                    self.manager.cancel_drain(slice_id, "new load arrived")
+                continue
+            if states != {RUNNING}:
+                self._slice_idle_since.pop(slice_id, None)
+                continue
+            if not _slice_idle(members):
+                self._slice_idle_since.pop(slice_id, None)
+                continue
+            since = self._slice_idle_since.setdefault(slice_id, now)
+            if now - since > self.idle_timeout_s:
+                self.manager.drain_slice(slice_id, "idle past timeout")
+                self._slice_idle_since.pop(slice_id, None)
+        states = [i.state for i in self.manager.instances.values()]
+        return {
+            "slices_requested": requested,
+            "slices_drained": drained,
+            "instances": {s: states.count(s) for s in set(states)},
+        }
+
+    def start(self) -> None:
+        def loop():
+            while not self._stopped.is_set():
+                try:
+                    self.update()
+                except Exception:
+                    pass
+                self._stopped.wait(self.update_interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()

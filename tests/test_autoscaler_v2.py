@@ -1,0 +1,175 @@
+"""Autoscaler v2: instance lifecycle FSM + slice-granular scaling
+(reference: python/ray/autoscaler/v2/ :: instance_manager, SURVEY §2.3).
+
+Covers the transition table (legal + illegal moves), scale-UP from a
+pending pod-slice placement group onto real in-process nodes, and
+atomic scale-DOWN of an idle slice.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.v2 import (
+    ALLOCATED, ALLOCATION_FAILED, AutoscalerV2, DRAINING, Instance,
+    InstanceManagerV2, PodSliceProvider, REQUESTED, RUNNING, TERMINATED,
+)
+from ray_tpu.util.placement_group import (
+    placement_group, placement_group_table, remove_placement_group,
+    tpu_slice_bundles,
+)
+
+
+# ---------- FSM table tests ----------
+
+def _inst(state):
+    inst = Instance(
+        instance_id="i1", slice_id="s1", slice_type="v4-8",
+        host_index=0, resources={"TPU": 2},
+    )
+    inst.state = state
+    return inst
+
+
+def test_instance_fsm_legal_paths():
+    inst = _inst(REQUESTED)
+    for state in (ALLOCATED, RUNNING, DRAINING, TERMINATED):
+        inst.transition(state)
+    assert [h[2] for h in inst.history] == [
+        ALLOCATED, RUNNING, DRAINING, TERMINATED,
+    ]
+    # drain can be cancelled back to RUNNING
+    inst2 = _inst(DRAINING)
+    inst2.transition(RUNNING, "new load")
+    # allocation failure is terminal from REQUESTED
+    inst3 = _inst(REQUESTED)
+    inst3.transition(ALLOCATION_FAILED, "stockout")
+
+
+@pytest.mark.parametrize(
+    "start,bad",
+    [
+        (REQUESTED, RUNNING),     # cannot run before allocation
+        (REQUESTED, DRAINING),
+        (ALLOCATED, DRAINING),    # cannot drain before running
+        (RUNNING, ALLOCATED),     # no going back
+        (TERMINATED, RUNNING),    # terminal
+        (ALLOCATION_FAILED, ALLOCATED),
+        (DRAINING, ALLOCATED),
+    ],
+)
+def test_instance_fsm_illegal_transitions_raise(start, bad):
+    with pytest.raises(ValueError, match="illegal instance transition"):
+        _inst(start).transition(bad)
+
+
+def test_dryrun_slice_allocation_without_cluster():
+    provider = PodSliceProvider(cluster=None)
+    manager = InstanceManagerV2(provider)
+    shape = provider.slice_shape("v4-8", tpu_slice_bundles("v4-8"))
+    slice_id = manager.request_slice("v4-8", shape)
+    manager.reconcile(alive_node_ids=set())
+    members = manager.by_slice()[slice_id]
+    assert all(i.state == ALLOCATED for i in members)
+    assert len(provider.non_terminated_slices()[slice_id]) == len(shape)
+    manager.provider.terminate_slice(slice_id)
+
+
+def test_allocation_failure_aborts_whole_slice():
+    """One failed host allocation tears the slice down wholesale (a
+    partial slice is a broken ICI mesh) so the pending PG gets a fresh
+    slice on the next pass instead of deadlocking."""
+
+    class FlakyProvider(PodSliceProvider):
+        def __init__(self):
+            super().__init__(cluster=None)
+            self.calls = 0
+
+        def create_slice_host(self, slice_id, slice_type, host_index, res):
+            self.calls += 1
+            if host_index == 1:
+                raise RuntimeError("stockout")
+            return super().create_slice_host(
+                slice_id, slice_type, host_index, res
+            )
+
+    provider = FlakyProvider()
+    manager = InstanceManagerV2(provider)
+    slice_id = manager.request_slice(
+        "v4-16", [{"TPU": 2}, {"TPU": 2}]
+    )
+    manager.reconcile(alive_node_ids=set())
+    states = {i.state for i in manager.by_slice()[slice_id]}
+    assert states == {ALLOCATED, ALLOCATION_FAILED}
+    manager.abort_slice(slice_id, "partial slice failure")
+    states = {i.state for i in manager.by_slice()[slice_id]}
+    assert states == {TERMINATED, ALLOCATION_FAILED}
+    assert provider.non_terminated_slices() == {}
+
+
+def test_slice_shape_honors_pg_bundles():
+    provider = PodSliceProvider(cluster=None)
+    custom = [
+        {"TPU": 4, "TPU-v4-32": 4, "CPU": 8},
+        {"TPU": 4, "TPU-v4-32": 4, "CPU": 8},
+        {"TPU": 4, "TPU-v4-32": 4, "CPU": 8},
+    ]
+    shape = provider.slice_shape("v4-32", custom)
+    assert shape == custom  # count AND extra resources preserved
+
+
+# ---------- end-to-end slice scale-up / scale-down ----------
+
+def test_pending_slice_pg_scales_up_then_idle_slice_drains(ray_start_cluster):
+    cluster = ray_start_cluster
+    provider = PodSliceProvider(cluster=cluster)
+    scaler = AutoscalerV2(provider, idle_timeout_s=2.0)
+
+    # A whole-slice PG: STRICT_SPREAD bundles carrying TPU-v4-8 resources
+    # that no current node can satisfy -> the v2 scale-up signal.
+    pg = placement_group(
+        tpu_slice_bundles("v4-8"), strategy="STRICT_SPREAD", name="slicepg"
+    )
+    time.sleep(0.5)
+    report = scaler.update()
+    assert report["slices_requested"] == 1
+
+    # The slice's hosts come up as real in-process nodes; the PG places.
+    pg.ready(timeout=120)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        scaler.update()
+        states = {i.state for i in scaler.manager.instances.values()}
+        if states == {RUNNING}:
+            break
+        time.sleep(0.5)
+    assert {i.state for i in scaler.manager.instances.values()} == {RUNNING}
+    row = next(
+        r for r in placement_group_table() if r["pg_id"] == pg.id
+    )
+    assert row["state"] == "CREATED"
+    # strict spread: each bundle on a distinct slice host
+    assert len(set(row["bundle_nodes"])) == len(row["bundle_nodes"])
+
+    # Release the PG; the whole slice goes idle and drains ATOMICALLY.
+    remove_placement_group(pg)
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        scaler.update()
+        states = {i.state for i in scaler.manager.instances.values()}
+        if states == {TERMINATED}:
+            break
+        time.sleep(0.5)
+    assert {i.state for i in scaler.manager.instances.values()} == {
+        TERMINATED
+    }
+    assert provider.non_terminated_slices() == {}
+    # the drained hosts really left the cluster
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        alive = [n for n in ray_tpu.nodes() if n["alive"]]
+        if len(alive) == 1:
+            break
+        time.sleep(0.5)
+    assert len([n for n in ray_tpu.nodes() if n["alive"]]) == 1
