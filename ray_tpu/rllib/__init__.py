@@ -9,6 +9,7 @@ algorithms (single- and multi-agent) with fluent AlgorithmConfigs.
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig
@@ -28,7 +29,7 @@ from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
-    "IMPALAConfig", "DQN", "DQNConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig", "SAC", "SACConfig", "Learner",
+    "IMPALAConfig", "APPO", "APPOConfig", "DQN", "DQNConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig", "SAC", "SACConfig", "Learner",
     "LearnerGroup", "MultiAgentLearnerGroup", "MultiRLModule",
     "MultiRLModuleSpec", "RLModule", "RLModuleSpec", "MLPModule",
     "SingleAgentEnvRunner", "EnvRunnerGroup", "MultiAgentEnv",

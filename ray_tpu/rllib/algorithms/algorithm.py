@@ -240,9 +240,15 @@ class Algorithm:
                 pipeline.set_state(connector_state)
             obs, _ = env.reset()
             total, done = 0.0, False
+            stateful = getattr(module, "is_stateful", False)
+            state = module.initial_state(1) if stateful else None
             while not done:
                 module_obs = pipeline(np.asarray(obs)[None])
-                action = np.asarray(fwd(params, module_obs))[0]
+                if stateful:
+                    action_arr, state = fwd(params, module_obs, state)
+                else:
+                    action_arr = fwd(params, module_obs)
+                action = np.asarray(action_arr)[0]
                 obs, reward, term, trunc, _ = env.step(
                     action.item() if action.shape == () else action
                 )

@@ -1,0 +1,78 @@
+"""APPO — asynchronous PPO: IMPALA's pipeline + PPO's clipped surrogate.
+
+Role-equivalent of rllib/algorithms/appo/appo.py (SURVEY §2.8): env
+runners sample continuously (the IMPALA async harvest), V-trace corrects
+the off-policyness of stale fragments, and the policy update applies the
+PPO clipped surrogate over the V-trace advantages instead of IMPALA's
+plain policy gradient — bounded-step updates on an asynchronous data
+path. The whole SGD step remains one jitted XLA function.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.impala.impala import (
+    IMPALA, IMPALAConfig, IMPALALearner, vtrace,
+)
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTION_LOGP, ACTIONS, OBS, REWARDS, TERMINATEDS, TRUNCATEDS,
+)
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self.clip_param: float = 0.3
+        self.lr = 5e-4
+
+
+class APPOLearner(IMPALALearner):
+    def compute_loss(self, params, batch: dict):
+        cfg = self.config
+        logp, entropy, vf = self.module.action_logp(
+            params, batch[OBS], batch[ACTIONS]
+        )
+        done = jnp.logical_or(batch[TERMINATEDS], batch[TRUNCATEDS])
+        discounts = cfg.get("gamma", 0.99) * (1.0 - done.astype(jnp.float32))
+        vs, pg_adv = vtrace(
+            batch[ACTION_LOGP],
+            logp,
+            batch[REWARDS],
+            vf,
+            batch["bootstrap_value"][0],
+            discounts,
+            cfg.get("clip_rho_threshold", 1.0),
+            cfg.get("clip_c_threshold", 1.0),
+        )
+        # PPO clipped surrogate over the V-trace advantages (the APPO
+        # twist: bounded policy steps on asynchronous data).
+        clip = cfg.get("clip_param", 0.3)
+        ratio = jnp.exp(logp - batch[ACTION_LOGP])
+        surrogate = jnp.minimum(
+            ratio * pg_adv,
+            jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * pg_adv,
+        )
+        policy_loss = -jnp.mean(surrogate)
+        vf_loss = 0.5 * jnp.mean((vf - vs) ** 2)
+        entropy_mean = jnp.mean(entropy)
+        total = (
+            policy_loss
+            + cfg.get("vf_loss_coeff", 0.5) * vf_loss
+            - cfg.get("entropy_coeff", 0.01) * entropy_mean
+        )
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy_mean,
+            "mean_ratio": jnp.mean(ratio),
+        }
+
+
+class APPO(IMPALA):
+    learner_class = APPOLearner
+
+    def _learner_config(self) -> dict:
+        cfg = super()._learner_config()
+        cfg.update(clip_param=self.config.clip_param)
+        return cfg

@@ -18,7 +18,8 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.learner import Learner
 from ray_tpu.rllib.policy.sample_batch import (
-    ACTION_LOGP, ACTIONS, ADVANTAGES, OBS, SampleBatch, VALUE_TARGETS,
+    ACTION_LOGP, ACTIONS, ADVANTAGES, OBS, SampleBatch, TERMINATEDS,
+    TRUNCATEDS, VALUE_TARGETS,
 )
 from ray_tpu.rllib.utils.postprocessing import compute_gae
 
@@ -42,9 +43,17 @@ class PPOConfig(AlgorithmConfig):
 class PPOLearner(Learner):
     def compute_loss(self, params, batch: dict):
         cfg = self.config
-        logp, entropy, vf = self.module.action_logp(
-            params, batch[OBS], batch[ACTIONS]
-        )
+        if getattr(self.module, "is_stateful", False):
+            # recurrent modules replay the rollout's state trajectory —
+            # dones reset the training scan at episode starts
+            dones = jnp.logical_or(batch[TERMINATEDS], batch[TRUNCATEDS])
+            logp, entropy, vf = self.module.action_logp(
+                params, batch[OBS], batch[ACTIONS], dones=dones
+            )
+        else:
+            logp, entropy, vf = self.module.action_logp(
+                params, batch[OBS], batch[ACTIONS]
+            )
         ratio = jnp.exp(logp - batch[ACTION_LOGP])
         adv = batch[ADVANTAGES]
         clip = cfg.get("clip_param", 0.2)
@@ -156,11 +165,33 @@ class PPO(Algorithm):
         self._total_env_steps += len(batch)
         # 2. learner connectors: GAE (bootstrap values from current params)
         batch = self._learner_pipeline()(batch, value_fn=self._value_fn())
-        # 3. minibatch SGD epochs
+        # 3. minibatch SGD epochs (recurrent modules get sequence-
+        # preserving minibatches: shuffling rows would scramble the
+        # lax.scan recurrence windows)
         rng = np.random.default_rng(self.iteration)
+        from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+        spec = config.rl_module_spec or RLModuleSpec(
+            model_config=dict(config.model)
+        )
+        stateful = bool(getattr(spec.module_class, "is_stateful", False))
         metrics: dict = {}
         for _ in range(config.num_epochs):
-            for mb in batch.minibatches(config.minibatch_size, rng):
+            if stateful:
+                seq_len = int(spec.model_config.get("max_seq_len", 16))
+                if config.rollout_fragment_length % seq_len != 0:
+                    raise ValueError(
+                        "recurrent PPO needs rollout_fragment_length "
+                        f"({config.rollout_fragment_length}) divisible by "
+                        f"max_seq_len ({seq_len}) — otherwise training "
+                        "windows straddle unrelated envs' rows"
+                    )
+                mbs = batch.seq_minibatches(
+                    seq_len, config.minibatch_size, rng,
+                )
+            else:
+                mbs = batch.minibatches(config.minibatch_size, rng)
+            for mb in mbs:
                 metrics = self.learner_group.update(mb)
         # 4. broadcast fresh weights to runners
         self.env_runner_group.sync_weights(self.learner_group.get_weights())

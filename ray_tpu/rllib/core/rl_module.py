@@ -20,8 +20,15 @@ import numpy as np
 
 class RLModuleSpec:
     def __init__(self, module_class=None, model_config: dict | None = None):
-        self.module_class = module_class or MLPModule
         self.model_config = dict(model_config or {})
+        if module_class is None:
+            # catalog selection (reference model-catalog use_lstm flag)
+            module_class = (
+                LSTMModule
+                if self.model_config.get("use_lstm")
+                else MLPModule
+            )
+        self.module_class = module_class
 
     def build(self, observation_space, action_space) -> "RLModule":
         return self.module_class(
@@ -147,3 +154,157 @@ class MLPModule(RLModule):
         )
         entropy = jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
         return logp, entropy, fwd["vf"]
+
+
+class LSTMModule(MLPModule):
+    """Recurrent module (reference: model catalog ``use_lstm`` — the
+    rllib/models LSTM wrapper role), TPU-first: training runs the whole
+    recurrence as one ``lax.scan`` over fixed-length subsequences (static
+    shapes, XLA-fusable), rollouts thread an explicit (h, c) state per
+    env through ``forward_*`` (the env runner owns the state).
+
+    Training-time state handling matches the reference's default
+    zero-init-per-sequence simplification: the episode-contiguous batch
+    is chopped into ``max_seq_len`` windows, each starting from zeros
+    (no cross-window carryover); use PPO's sequence-preserving
+    minibatcher so windows stay intact.
+    """
+
+    is_stateful = True
+
+    def __init__(self, observation_space, action_space, model_config):
+        super().__init__(observation_space, action_space, model_config)
+        self.cell_size = int(model_config.get("lstm_cell_size", 128))
+        self.max_seq_len = int(model_config.get("max_seq_len", 16))
+
+    def init_params(self, rng) -> dict:
+        enc_rng, lstm_rng, pi_rng, vf_rng = jax.random.split(rng, 4)
+        hidden = self.hiddens[0] if self.hiddens else 128
+        scale_x = jnp.sqrt(1.0 / hidden)
+        scale_h = jnp.sqrt(1.0 / self.cell_size)
+        return {
+            "enc": _mlp_init(enc_rng, (self.obs_dim, hidden)),
+            "lstm": {
+                "wx": jax.random.normal(
+                    lstm_rng, (hidden, 4 * self.cell_size)
+                ) * scale_x,
+                "wh": jax.random.normal(
+                    jax.random.fold_in(lstm_rng, 1),
+                    (self.cell_size, 4 * self.cell_size),
+                ) * scale_h,
+                "b": jnp.zeros((4 * self.cell_size,)),
+            },
+            "pi": _mlp_init(pi_rng, (self.cell_size, self.num_outputs)),
+            "vf": _mlp_init(vf_rng, (self.cell_size, 1)),
+        }
+
+    # -- recurrence -----------------------------------------------------
+    def initial_state(self, batch_size: int):
+        zeros = jnp.zeros((batch_size, self.cell_size))
+        return (zeros, zeros)
+
+    def _encode(self, params, obs):
+        obs = obs.reshape(obs.shape[0], -1)
+        return jax.nn.tanh(_mlp_apply(params["enc"], obs))
+
+    def _cell(self, params, x, state):
+        h, c = state
+        gates = x @ params["lstm"]["wx"] + h @ params["lstm"]["wh"] + params["lstm"]["b"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, (h, c)
+
+    def _heads(self, params, features) -> dict:
+        out = _mlp_apply(params["pi"], features)
+        vf = _mlp_apply(params["vf"], features)[..., 0]
+        if self.discrete:
+            return {"logits": out, "vf": vf}
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return {"mean": mean, "log_std": jnp.clip(log_std, -20, 2), "vf": vf}
+
+    def forward_train(self, params, obs, dones=None) -> dict:
+        """[B, ...] episode-contiguous rows -> heads, recurrence scanned
+        over max_seq_len windows (zero state per window, padded tail).
+        ``dones`` (row-aligned, done AT that step) resets the scan state
+        at episode starts INSIDE a window — matching the rollout, which
+        zeroes the per-env state after every done."""
+        n = obs.shape[0]
+        seq = self.max_seq_len
+        pad = (-n) % seq
+        x = self._encode(params, obs)
+        if dones is None:
+            dones_f = jnp.zeros((n,))
+        else:
+            dones_f = jnp.asarray(dones).astype(jnp.float32).reshape(-1)
+        # state entering step t is zeroed when step t-1 ended an episode
+        starts = jnp.concatenate([jnp.zeros((1,)), dones_f[:-1]])
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]))], axis=0)
+            starts = jnp.concatenate([starts, jnp.zeros((pad,))])
+        windows = x.reshape(-1, seq, x.shape[1])  # [S, L, H]
+        time_major = jnp.swapaxes(windows, 0, 1)  # [L, S, H]
+        reset_tm = jnp.swapaxes(starts.reshape(-1, seq), 0, 1)  # [L, S]
+        state0 = self.initial_state(windows.shape[0])
+
+        def step(state, inputs):
+            xt, reset_t = inputs
+            keep = (1.0 - reset_t)[:, None]
+            state = jax.tree_util.tree_map(lambda s: s * keep, state)
+            h, state = self._cell(params, xt, state)
+            return state, h
+
+        _, hs = jax.lax.scan(step, state0, (time_major, reset_tm))
+        features = jnp.swapaxes(hs, 0, 1).reshape(-1, self.cell_size)[:n]
+        return self._heads(params, features)
+
+    def action_logp(self, params, obs, actions, dones=None) -> tuple:
+        """(logp, entropy, vf) with episode-reset-aware recurrence."""
+        fwd = self.forward_train(params, obs, dones=dones)
+        if self.discrete:
+            logp_all = jax.nn.log_softmax(fwd["logits"])
+            logp = jnp.take_along_axis(
+                logp_all, actions[:, None].astype(jnp.int32), axis=-1
+            )[:, 0]
+            entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+            return logp, entropy, fwd["vf"]
+        mean, log_std = fwd["mean"], fwd["log_std"]
+        std = jnp.exp(log_std)
+        logp = -0.5 * jnp.sum(
+            ((actions - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi),
+            axis=-1,
+        )
+        entropy = jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+        return logp, entropy, fwd["vf"]
+
+    # -- stateful rollout steps ----------------------------------------
+    def forward_exploration(self, params, obs, rng, state=None):
+        if state is None:
+            state = self.initial_state(obs.shape[0])
+        x = self._encode(params, obs)
+        features, new_state = self._cell(params, x, state)
+        fwd = self._heads(params, features)
+        if self.discrete:
+            logits = fwd["logits"]
+            actions = jax.random.categorical(rng, logits)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]
+            return actions, logp, {"vf_preds": fwd["vf"]}, new_state
+        mean, log_std = fwd["mean"], fwd["log_std"]
+        std = jnp.exp(log_std)
+        actions = mean + std * jax.random.normal(rng, mean.shape)
+        logp = -0.5 * jnp.sum(
+            ((actions - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi),
+            axis=-1,
+        )
+        return actions, logp, {"vf_preds": fwd["vf"]}, new_state
+
+    def forward_inference(self, params, obs, state=None):
+        if state is None:
+            state = self.initial_state(obs.shape[0])
+        x = self._encode(params, obs)
+        features, new_state = self._cell(params, x, state)
+        fwd = self._heads(params, features)
+        if self.discrete:
+            return jnp.argmax(fwd["logits"], axis=-1), new_state
+        return fwd["mean"], new_state

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rllib.policy.sample_batch import (
@@ -78,6 +79,13 @@ class SingleAgentEnvRunner:
         )
         self._fwd = jax.jit(self.module.forward_exploration)
         self._fwd_greedy = jax.jit(self.module.forward_inference)
+        # Recurrent modules (use_lstm): the runner owns one (h, c) per
+        # env, threads it through forward_* and zeroes finished envs'
+        # rows at episode boundaries.
+        self._stateful = bool(getattr(self.module, "is_stateful", False))
+        self._state = (
+            self.module.initial_state(num_envs) if self._stateful else None
+        )
         # Epsilon-greedy override (DQN-style): when set, actions are greedy
         # w.r.t. the module with prob 1-ε and uniform-random with prob ε —
         # applied BEFORE stepping the env so replay data stays consistent.
@@ -112,6 +120,16 @@ class SingleAgentEnvRunner:
     def sample(self, num_steps: int | None = None) -> SampleBatch:
         assert self._params is not None, "set_weights before sample"
         steps = num_steps or self.rollout_fragment_length
+        if self._stateful:
+            # Truncated BPTT aligned with fragments: zero the recurrent
+            # state at every fragment start so the TRAINING scan (which
+            # zero-inits its windows) replays the exact state trajectory
+            # the rollout used — otherwise importance ratios are computed
+            # against logps from different hidden states and PPO's clipped
+            # updates drift (observed: returns plateau then decline).
+            # Set model_config max_seq_len == rollout_fragment_length for
+            # exact window alignment.
+            self._state = self.module.initial_state(self.num_envs)
         cols: dict[str, list] = {
             OBS: [], ACTIONS: [], REWARDS: [], TERMINATEDS: [],
             TRUNCATEDS: [], NEXT_OBS: [], ACTION_LOGP: [], VF_PREDS: [],
@@ -120,7 +138,15 @@ class SingleAgentEnvRunner:
         for _ in range(steps):
             self._rng, key = jax.random.split(self._rng)
             if self._epsilon is not None:
-                actions = np.asarray(self._fwd_greedy(self._params, self._obs))
+                if self._stateful:
+                    actions, self._state = self._fwd_greedy(
+                        self._params, self._obs, self._state
+                    )
+                    actions = np.asarray(actions)
+                else:
+                    actions = np.asarray(
+                        self._fwd_greedy(self._params, self._obs)
+                    )
                 mask = self._np_rng.random(self.num_envs) < self._epsilon
                 if mask.any():
                     actions = np.where(
@@ -133,10 +159,22 @@ class SingleAgentEnvRunner:
                 logp = np.zeros(self.num_envs)
                 vf = np.zeros(self.num_envs)
             elif self.explore:
-                actions, logp, extra = self._fwd(self._params, self._obs, key)
+                if self._stateful:
+                    actions, logp, extra, self._state = self._fwd(
+                        self._params, self._obs, key, self._state
+                    )
+                else:
+                    actions, logp, extra = self._fwd(
+                        self._params, self._obs, key
+                    )
                 vf = extra["vf_preds"]
             else:
-                actions = self._fwd_greedy(self._params, self._obs)
+                if self._stateful:
+                    actions, self._state = self._fwd_greedy(
+                        self._params, self._obs, self._state
+                    )
+                else:
+                    actions = self._fwd_greedy(self._params, self._obs)
                 logp = np.zeros(self.num_envs)
                 vf = np.zeros(self.num_envs)
             actions_np = np.asarray(actions)
@@ -164,6 +202,12 @@ class SingleAgentEnvRunner:
             self._episode_returns += rewards
             self._episode_lens += 1
             done = np.logical_or(terms, truncs)
+            if self._stateful and done.any():
+                # reset finished envs' recurrent state rows
+                keep = jnp.asarray(1.0 - done.astype(np.float32))[:, None]
+                self._state = jax.tree_util.tree_map(
+                    lambda s: s * keep, self._state
+                )
             for i in np.nonzero(done)[0]:
                 self._completed.append(
                     (float(self._episode_returns[i]), int(self._episode_lens[i]))

@@ -55,6 +55,12 @@ class MultiAgentEnvRunner:
             obs_spaces.setdefault(mid, self.env.get_observation_space(agent))
             act_spaces.setdefault(mid, self.env.get_action_space(agent))
         self.module = module_spec.build(obs_spaces, act_spaces)
+        for mid, module in self.module.items():
+            if getattr(module, "is_stateful", False):
+                raise ValueError(
+                    "MultiAgentEnvRunner does not support stateful "
+                    f"(use_lstm) modules yet; module {mid!r} is recurrent"
+                )
         self._act_spaces = act_spaces
         self._params: Optional[dict] = None
         self._fwd = {

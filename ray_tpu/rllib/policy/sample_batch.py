@@ -58,6 +58,34 @@ class SampleBatch(dict):
             if len(mb) == minibatch_size:
                 yield mb
 
+    def seq_minibatches(
+        self,
+        seq_len: int,
+        minibatch_size: int,
+        rng: np.random.Generator | None = None,
+    ) -> Iterator["SampleBatch"]:
+        """Sequence-preserving minibatches for recurrent modules: rows
+        chop into contiguous seq_len windows, WINDOWS shuffle (never rows
+        — that would scramble the recurrence), and each minibatch is a
+        whole number of windows."""
+        rng = rng or np.random.default_rng()
+        n_windows = len(self) // seq_len
+        if n_windows == 0:
+            yield self
+            return
+        # never yield ZERO minibatches (a batch smaller than the requested
+        # minibatch must still train once)
+        per_mb = min(max(1, minibatch_size // seq_len), n_windows)
+        order = rng.permutation(n_windows)
+        for start in range(0, n_windows - per_mb + 1, per_mb):
+            idx = np.concatenate(
+                [
+                    np.arange(w * seq_len, (w + 1) * seq_len)
+                    for w in order[start:start + per_mb]
+                ]
+            )
+            yield SampleBatch({k: v[idx] for k, v in self.items()})
+
     @staticmethod
     def concat_samples(batches: list["SampleBatch"]) -> "SampleBatch":
         batches = [b for b in batches if len(b)]

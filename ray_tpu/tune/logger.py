@@ -79,15 +79,25 @@ class CSVLoggerCallback(LoggerCallback):
 
 
 class TBXLoggerCallback(LoggerCallback):
-    """TensorBoard scalars via tensorboardX if available, else no-op."""
+    """TensorBoard scalars (reference: logger/tensorboardx.py). Prefers
+    tensorboardX; falls back to torch.utils.tensorboard (present in this
+    image), so real tfevents files are written without extra deps."""
 
     def __init__(self):
+        self._writer_cls = None
+        self._dir_kw = "logdir"
         try:
             from tensorboardX import SummaryWriter  # noqa: F401
 
             self._writer_cls = SummaryWriter
         except ImportError:
-            self._writer_cls = None
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._writer_cls = SummaryWriter
+                self._dir_kw = "log_dir"
+            except ImportError:
+                pass
         self._writers: dict[str, object] = {}
 
     def on_trial_result(self, trial, result: dict) -> None:
@@ -95,7 +105,7 @@ class TBXLoggerCallback(LoggerCallback):
             return
         writer = self._writers.get(trial.trial_id)
         if writer is None:
-            writer = self._writer_cls(logdir=trial.local_dir)
+            writer = self._writer_cls(**{self._dir_kw: trial.local_dir})
             self._writers[trial.trial_id] = writer
         step = result.get("training_iteration", 0)
         for key, value in result.items():

@@ -1,0 +1,85 @@
+"""Release-suite criteria enforcement (SURVEY §4.5 success-criteria
+role, VERDICT r3 #6 'give the release suite teeth'): the runner's
+criterion math must fail slowed runs, smoke mode must swap criteria,
+and every YAML entry must carry NUMERIC floors."""
+
+import importlib.util
+import os
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_run_all():
+    spec = importlib.util.spec_from_file_location(
+        "release_run_all", os.path.join(REPO, "release", "run_all.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_criterion_expressions():
+    run_all = _load_run_all()
+    assert run_all._check(5.0, ">=5")
+    assert not run_all._check(4.9, ">=5")
+    assert run_all._check(4.9, "<5")
+    assert not run_all._check(5.0, "<5")
+    assert run_all._check(6.0, "==6")
+    assert run_all._check(0.1, ">0")
+    assert not run_all._check(0.0, ">0")
+
+
+def test_evaluate_fails_slow_run_and_missing_metric():
+    run_all = _load_run_all()
+    entry = {
+        "name": "x", "script": "x.py",
+        "criteria": {"img_per_s": ">=2000", "max_wall_s": 100},
+    }
+    ok = run_all._evaluate(
+        entry, {"img_per_s": 2500.0, "wall_s": 50.0}, smoke=False
+    )
+    assert ok == []
+    slowed = run_all._evaluate(
+        entry, {"img_per_s": 900.0, "wall_s": 50.0}, smoke=False
+    )
+    assert slowed and "img_per_s" in slowed[0]
+    overtime = run_all._evaluate(
+        entry, {"img_per_s": 2500.0, "wall_s": 500.0}, smoke=False
+    )
+    assert overtime and "wall_s" in overtime[0]
+    missing = run_all._evaluate(entry, {"wall_s": 1.0}, smoke=False)
+    assert any("missing" in f for f in missing)
+    errored = run_all._evaluate(entry, {"error": "boom"}, smoke=False)
+    assert errored and "errored" in errored[0]
+
+
+def test_smoke_criteria_override():
+    run_all = _load_run_all()
+    entry = {
+        "name": "x", "script": "x.py",
+        "criteria": {"img_per_s": ">=2000"},
+        "smoke_criteria": {"img_per_s": ">=500"},
+    }
+    assert run_all._evaluate(entry, {"img_per_s": 800.0}, smoke=True) == []
+    assert run_all._evaluate(entry, {"img_per_s": 800.0}, smoke=False)
+
+
+def test_yaml_entries_all_have_numeric_criteria():
+    with open(os.path.join(REPO, "release", "release_tests.yaml")) as fh:
+        entries = yaml.safe_load(fh)
+    assert len(entries) >= 6
+    for entry in entries:
+        criteria = entry.get("criteria") or {}
+        assert criteria, f"{entry['name']}: no criteria"
+        for metric, expr in criteria.items():
+            # every criterion carries a real numeric bound (never ">0"
+            # ... except where the bound IS a count equality)
+            bound = str(expr).lstrip("><=")
+            assert bound.replace(".", "", 1).isdigit(), (
+                f"{entry['name']}.{metric}: non-numeric bound {expr!r}"
+            )
+        assert os.path.exists(
+            os.path.join(REPO, entry["script"])
+        ), f"{entry['name']}: script missing"

@@ -128,6 +128,130 @@ def test_env_runner_custom_connector(ray_start_shared):
         algo.stop()
 
 
+# ---------- APPO + LSTM (round-4 breadth) ----------
+
+def test_lstm_module_shapes_and_state():
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.rl_module import LSTMModule, RLModuleSpec
+
+    space = gym.spaces.Box(-1, 1, (4,))
+    act = gym.spaces.Discrete(2)
+    # catalog selection via use_lstm
+    spec = RLModuleSpec(model_config={"use_lstm": True, "lstm_cell_size": 16,
+                                      "max_seq_len": 8,
+                                      "fcnet_hiddens": (32,)})
+    module = spec.build(space, act)
+    assert isinstance(module, LSTMModule)
+    params = module.init_params(jax.random.PRNGKey(0))
+    # train path: non-multiple-of-seq batch pads + unpads
+    fwd = module.forward_train(params, jnp.zeros((21, 4)))
+    assert fwd["logits"].shape == (21, 2)
+    assert fwd["vf"].shape == (21,)
+    # stateful step: state evolves and feeds back
+    state = module.initial_state(3)
+    obs = jnp.ones((3, 4))
+    actions, state1 = module.forward_inference(params, obs, state)
+    assert actions.shape == (3,)
+    assert not np.allclose(np.asarray(state1[0]), 0.0)
+    a2, logp, extra, state2 = module.forward_exploration(
+        params, obs, jax.random.PRNGKey(1), state1
+    )
+    assert logp.shape == (3,)
+    assert not np.allclose(np.asarray(state2[0]), np.asarray(state1[0]))
+    # memory actually matters: same obs, different state -> different logits
+    h_a = module._cell(params, module._encode(params, obs), state)[0]
+    h_b = module._cell(params, module._encode(params, obs), state2)[0]
+    assert not np.allclose(np.asarray(h_a), np.asarray(h_b))
+
+
+def test_seq_minibatches_preserve_windows():
+    n, seq = 64, 8
+    batch = SampleBatch({OBS: np.arange(n, dtype=np.float32)})
+    rng = np.random.default_rng(0)
+    seen = []
+    for mb in batch.seq_minibatches(seq, 16, rng):
+        assert len(mb) == 16
+        rows = mb[OBS]
+        for w in range(0, 16, seq):
+            window = rows[w:w + seq]
+            # each window is contiguous and starts on a window boundary
+            assert window[0] % seq == 0
+            assert np.array_equal(
+                window, np.arange(window[0], window[0] + seq)
+            )
+        seen.extend(rows.tolist())
+    assert sorted(seen) == list(range(n))
+
+
+def test_lstm_ppo_smoke(ray_start_shared):
+    """PPO with use_lstm: rollouts thread recurrent state, training uses
+    sequence minibatches, and returns improve over the random policy."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                     rollout_fragment_length=64)
+        .training(
+            lr=1e-3, train_batch_size=512, minibatch_size=128,
+            num_epochs=4,
+            # max_seq_len == rollout_fragment_length: training windows
+            # align exactly with the runner's zero-init fragments
+            model={"use_lstm": True, "lstm_cell_size": 32,
+                   "max_seq_len": 64, "fcnet_hiddens": (64,)},
+        )
+        .debugging(seed=0)
+        .build_algo()
+    )
+    try:
+        best = -np.inf
+        for _ in range(22):
+            result = algo.train()
+            ret = result.get("episode_return_mean", np.nan)
+            if not np.isnan(ret):
+                best = max(best, ret)
+            if best >= 45.0:
+                break
+        # Random CartPole is ~20; the state-mismatch bug this test pinned
+        # plateaued at ~35 then declined — 45 discriminates both.
+        assert best >= 45.0, f"LSTM PPO failed to improve: best={best}"
+    finally:
+        algo.stop()
+
+
+def test_appo_cartpole_learns(ray_start_shared):
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (
+        APPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(
+            num_env_runners=2, num_envs_per_env_runner=4,
+            rollout_fragment_length=64,
+        )
+        .training(lr=1e-3, entropy_coeff=0.01,
+                  model={"fcnet_hiddens": (64, 64)})
+        .debugging(seed=0)
+        .build_algo()
+    )
+    try:
+        best = -np.inf
+        for _ in range(60):
+            result = algo.train()
+            ret = result.get("episode_return_mean", np.nan)
+            if not np.isnan(ret):
+                best = max(best, ret)
+            if best >= 80.0:
+                break
+        assert best >= 80.0, f"APPO failed to learn: best={best}"
+    finally:
+        algo.stop()
+
+
 # ---------- multi-agent units ----------
 
 def test_normalize_observations_state_roundtrip():

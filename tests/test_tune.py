@@ -241,6 +241,70 @@ def test_tuner_grid_end_to_end(ray_start_shared, tmp_path):
     assert len(df) == 3
 
 
+def test_tpe_searcher_concentrates_and_runs_in_tuner(
+    ray_start_shared, tmp_path
+):
+    """In-tree TPE (HyperOpt-adapter role): concentrates suggestions near
+    the optimum in a pure loop, and drives a real Tuner run."""
+    from ray_tpu.tune.search.tpe import TPESearch
+
+    space = {"x": tune.uniform(0.0, 1.0)}
+    tpe = TPESearch(metric="score", mode="max", seed=3, n_initial_points=8)
+    tpe.set_search_properties("score", "max", space)
+    xs = []
+    for i in range(60):
+        cfg = tpe.suggest(f"t{i}")
+        tpe.on_trial_complete(f"t{i}", {"score": -((cfg["x"] - 0.3) ** 2)})
+        xs.append(cfg["x"])
+    early = sum(abs(x - 0.3) for x in xs[:10]) / 10
+    late = sum(abs(x - 0.3) for x in xs[-10:]) / 10
+    assert late < early, (early, late)
+
+    def quad(config):
+        tune.report({"score": -((config["x"] - 0.3) ** 2)})
+
+    tuner = Tuner(
+        quad,
+        param_space=space,
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            search_alg=TPESearch(seed=1, n_initial_points=4),
+            num_samples=12,
+        ),
+        run_config=ray_tpu.train.RunConfig(
+            name="tpe_e2e", storage_path=str(tmp_path)
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 12
+    assert results.get_best_result().metrics["score"] > -0.05
+
+
+def test_tensorboard_logger_writes_event_files(ray_start_shared, tmp_path):
+    """TBX logger (logger/tensorboardx.py role) falls back to torch's
+    SummaryWriter, so tfevents land without tensorboardX installed."""
+    import glob
+
+    from ray_tpu.tune.logger import TBXLoggerCallback
+
+    tuner = Tuner(
+        _trainable,
+        param_space={"slope": tune.grid_search([1.0, 2.0])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=ray_tpu.train.RunConfig(
+            name="tb_e2e", storage_path=str(tmp_path),
+            callbacks=[TBXLoggerCallback()],
+        ),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    events = glob.glob(
+        os.path.join(str(tmp_path), "tb_e2e", "**", "*tfevents*"),
+        recursive=True,
+    )
+    assert events, "no TensorBoard event files written"
+
+
 def test_tuner_function_checkpoint_and_restore(ray_start_shared, tmp_path):
     def trainable(config):
         ckpt = tune.get_checkpoint()
