@@ -143,6 +143,9 @@ class StreamingExecutor:
         self.stages = stages
         self.ctx = ctx or DataContext.get_current()
         self.stage_stats: list[_StageStats] = []
+        self._throttled = 0  # byte-budget admission rejections (stats)
+        self._budget_checked_at = 0.0
+        self._budget_over = False
 
     # -- public --
 
@@ -165,6 +168,37 @@ class StreamingExecutor:
     def execute_to_refs(self) -> list:
         return list(self.execute())
 
+    # -- backpressure ----------------------------------------------------
+    def _admit(self, n_pending: int, window: int) -> bool:
+        """Admission control = task window AND object-store byte budget
+        (reference ReservationOpResourceAllocator role): beyond the first
+        in-flight task, launching stops while the local arena sits above
+        ``streaming_store_budget_fraction`` of capacity — a task-count
+        window alone lets large-block pipelines overrun the store."""
+        if n_pending >= window:
+            return False
+        if n_pending == 0:
+            return True  # progress guarantee
+        frac = getattr(self.ctx, "streaming_store_budget_fraction", 1.0)
+        if frac >= 1.0:
+            return True
+        now = time.monotonic()
+        if now - self._budget_checked_at > 0.05:
+            # short-cached: one stats RPC per ~50ms, never one per launch
+            self._budget_checked_at = now
+            try:
+                import ray_tpu._private.worker as worker_mod
+
+                stats = worker_mod.get_global_context().store.stats()
+                self._budget_over = (
+                    stats["used"] > frac * stats["capacity"]
+                )
+            except Exception:
+                self._budget_over = False  # no store visibility: window only
+        if self._budget_over:
+            self._throttled += 1
+        return not self._budget_over
+
     # -- stages --
 
     def _run_source(self, stage: SourceStage, stats: _StageStats) -> Iterator:
@@ -186,7 +220,7 @@ class StreamingExecutor:
         idx = 0
         try:
             while idx < len(tasks) or pending:
-                while idx < len(tasks) and len(pending) < window:
+                while idx < len(tasks) and self._admit(len(pending), window):
                     block_ref, meta_ref = _run_read_task.remote(tasks[idx])
                     meta_refs.append(meta_ref)
                     pending.append(block_ref)
@@ -216,7 +250,7 @@ class StreamingExecutor:
         exhausted = False
         try:
             while not exhausted or pending:
-                while not exhausted and len(pending) < window:
+                while not exhausted and self._admit(len(pending), window):
                     try:
                         block_ref = next(stream)
                     except StopIteration:
@@ -250,7 +284,12 @@ class StreamingExecutor:
         completed = False
         try:
             while not exhausted or pending:
-                while not exhausted and min(load) < per_actor_inflight:
+                while (
+                    not exhausted
+                    and min(load) < per_actor_inflight
+                    and self._admit(len(pending), len(actors)
+                                    * per_actor_inflight)
+                ):
                     # autoscale up to max while all actors are busy
                     if (
                         all(l > 0 for l in load)

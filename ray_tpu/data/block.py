@@ -39,6 +39,15 @@ class DataContext:
     actor_pool_min_size: int = 1
     actor_pool_max_size: int = 4
     streaming_max_inflight_tasks: int = 8
+    # Object-store BYTE budget for streaming admission (the reference's
+    # ReservationOpResourceAllocator role): no new task launches while
+    # store usage exceeds this fraction of arena capacity — a task-count
+    # window alone lets a large-block pipeline overrun the arena.
+    # Progress is always guaranteed (>=1 task stays admitted). Counts
+    # TOTAL usage including results the consumer retains: a caller
+    # holding more than the budget deliberately degrades the pipeline
+    # toward serial (spill-pressure beats arena overrun).
+    streaming_store_budget_fraction: float = 0.75
     eager_free: bool = True
 
     _current: "DataContext | None" = None

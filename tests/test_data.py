@@ -341,3 +341,4 @@ def test_custom_datasource_roundtrip(ray_start_shared):
     sink = CollectingDatasink()
     ds.write_datasink(sink)
     assert sink.started and sink.completed == 100
+

@@ -1,0 +1,48 @@
+"""Streaming-executor byte-budget backpressure (own small-store
+cluster — must not share the module fixture's cluster)."""
+
+import numpy as np
+
+import ray_tpu
+
+
+def test_streaming_byte_budget_backpressure(monkeypatch):
+    """Admission is gated on an object-store BYTE budget, not just the
+    task window (reference ReservationOpResourceAllocator role): with a
+    tiny budget the pipeline throttles to near-serial execution but
+    still completes — large-block pipelines can no longer overrun the
+    arena while staying under the task-count window."""
+    from ray_tpu import data as rd
+    from ray_tpu.data.block import DataContext
+    from ray_tpu.data._internal.plan import plan_stages
+    from ray_tpu.data._internal.streaming_executor import StreamingExecutor
+
+    assert not ray_tpu.is_initialized()
+    ray_tpu.init(num_cpus=4, object_store_memory=96 * 1024 * 1024)
+    ctx = DataContext.get_current()
+    old_frac = ctx.streaming_store_budget_fraction
+    # budget ~= 9.6 MiB: a handful of 4 MiB blocks trips it immediately
+    ctx.streaming_store_budget_fraction = 0.1
+    try:
+        ds = rd.from_items(
+            [np.ones(1024 * 1024, dtype=np.float32) for _ in range(12)]
+        ).map(
+            lambda row: {
+                "item": np.asarray(row["item"], dtype=np.float32) * 2.0
+            }
+        )
+        # raw executor: observe the throttle counter engaging
+        executor = StreamingExecutor(plan_stages(ds._plan))
+        out_refs = list(executor.execute())
+        assert out_refs, "pipeline produced nothing"
+        assert executor._throttled > 0, (
+            "byte budget never engaged despite store pressure"
+        )
+        # public surface: the throttled pipeline still completes correctly
+        total = sum(
+            float(np.asarray(row["item"]).sum()) for row in ds.take_all()
+        )
+        assert total == 12 * 1024 * 1024 * 2.0
+    finally:
+        ctx.streaming_store_budget_fraction = old_frac
+        ray_tpu.shutdown()
