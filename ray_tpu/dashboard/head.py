@@ -40,9 +40,13 @@ _INDEX_HTML = """<!doctype html>
  <a href="#" onclick="view='overview';refresh();return false">overview</a>
  <a href="#" onclick="view='tasks';refresh();return false">tasks</a>
  <a href="#" onclick="view='jobs';refresh();return false">jobs</a>
+ <a href="#" onclick="view='serveView';refresh();return false">serve</a>
+ <a href="#" onclick="view='workers';refresh();return false">workers</a>
+ <a href="#" onclick="view='logs';refresh();return false">logs</a>
  <a href="#" onclick="view='events';refresh();return false">events</a>
  <a href="/api/timeline">timeline</a>
  <a href="/metrics">metrics</a>
+ <a href="/api/grafana_dashboard" download="raytpu-grafana.json">grafana</a>
 </nav>
 <div id="content">loading…</div>
 <script>
@@ -105,8 +109,36 @@ async function events() {
          esc(e.source_type),
          `<code>${esc(JSON.stringify(e.data).slice(0, 140))}</code>`]));
 }
+async function serveView() {
+  const apps = await fetch('/api/serve').then(r => r.json());
+  if (apps.__error__) return '<h2>Serve</h2><div>error: ' + esc(apps.__error__) + '</div>';
+  const names = Object.keys(apps);
+  if (!names.length) return '<h2>Serve</h2><div class="muted">no applications deployed</div>';
+  let html = '<h2>Serve applications</h2>';
+  for (const app of names) {
+    const info = apps[app];
+    html += `<h2>${esc(app)} <span class="muted">${esc(info.status ?? '')}</span></h2>`;
+    const deps = info.deployments ?? {};
+    html += table(['deployment', 'status', 'replicas'],
+      Object.keys(deps).map(d => [esc(d), esc(JSON.stringify(deps[d].status ?? deps[d])),
+        esc(deps[d].running_replicas ?? '')]));
+  }
+  return html;
+}
+async function workers() {
+  const rows = await fetch('/api/workers').then(r => r.json());
+  return '<h2>Workers</h2>' + table(['worker', 'node', 'pid/state'],
+    rows.slice(-200).map(w => [`<code>${esc((w.worker_id ?? '').slice(-12))}</code>`,
+      `<code>${esc((w.node_id ?? '').slice(-8))}</code>`,
+      esc(w.pid ?? w.state ?? '')]));
+}
+async function logs() {
+  const files = await fetch('/api/logs').then(r => r.json());
+  return '<h2>Session logs</h2>' + table(['file'],
+    files.map(f => [`<a href="/api/logs/${encodeURIComponent(f)}">${esc(f)}</a>`]));
+}
 async function refresh() {
-  const render = {overview, tasks, jobs, events}[view];
+  const render = {overview, tasks, jobs, serveView, workers, logs, events}[view];
   try { document.getElementById('content').innerHTML = await render(); }
   catch (err) { document.getElementById('content').innerHTML = 'error: ' + esc(err); }
 }
@@ -114,6 +146,10 @@ refresh(); setInterval(refresh, 3000);
 </script>
 </body></html>
 """
+
+
+def _dumps(obj) -> str:
+    return json.dumps(obj, default=str)
 
 
 class DashboardHead:
@@ -155,6 +191,9 @@ class DashboardHead:
         app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/stacks", self._stacks)
         app.router.add_post("/api/profile", self._profile)
+        app.router.add_get("/api/serve", self._serve_state)
+        app.router.add_get("/api/workers", self._workers)
+        app.router.add_get("/api/grafana_dashboard", self._grafana)
         app.router.add_get("/metrics", self._metrics)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
@@ -248,6 +287,43 @@ class DashboardHead:
 
         text = await asyncio.to_thread(metrics_mod.collect_prometheus_text)
         return web.Response(text=text, content_type="text/plain")
+
+    async def _serve_state(self, request):
+        """Serve drill-down: per-app deployment/replica status (the
+        reference dashboard's Serve view role)."""
+        from aiohttp import web
+
+        def status():
+            # serve.status() itself returns {} for the legitimate
+            # nothing-deployed case; a raising controller must surface
+            # as an error, not masquerade as an empty deployment list.
+            try:
+                from ray_tpu import serve
+
+                return serve.status()
+            except Exception as exc:
+                return {"__error__": f"serve status unavailable: {exc}"}
+
+        return web.json_response(
+            await asyncio.to_thread(status), dumps=_dumps
+        )
+
+    async def _workers(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            await asyncio.to_thread(state_mod.list_workers), dumps=_dumps
+        )
+
+    async def _grafana(self, request):
+        """Importable Grafana dashboard generated from the LIVE metric
+        registry (grafana_dashboard_factory role)."""
+        from aiohttp import web
+
+        from ray_tpu.dashboard import grafana
+
+        text = await asyncio.to_thread(metrics_mod.collect_prometheus_text)
+        return web.json_response(grafana.generate_dashboard(text))
 
     async def _tracing(self, request):
         from aiohttp import web

@@ -122,6 +122,23 @@ def test_dashboard_endpoints(ray_start_shared):
     assert isinstance(actors, list)
     metrics_text = httpx.get(base + "/metrics", timeout=30).text
     assert isinstance(metrics_text, str)
+    # drill-down endpoints (serve / workers / grafana factory)
+    serve_state = httpx.get(base + "/api/serve", timeout=30).json()
+    assert isinstance(serve_state, dict)  # {} when nothing deployed
+    workers = httpx.get(base + "/api/workers", timeout=30).json()
+    assert isinstance(workers, list)
+    # grafana_dashboard_factory role: importable dashboard JSON with one
+    # panel per live metric family
+    from ray_tpu.util import metrics as metrics_mod
+
+    metrics_mod.Counter("dash_probe_total", "probe").inc(3)
+    metrics_mod.flush()
+    time.sleep(0.5)
+    board = httpx.get(base + "/api/grafana_dashboard", timeout=30).json()
+    assert board["schemaVersion"] >= 36
+    titles = [p["title"] for p in board["panels"]]
+    assert any("dash_probe_total" in t for t in titles), titles
+    assert all(p["targets"][0]["expr"] for p in board["panels"])
 
 
 # ---------- job submission ----------
