@@ -201,6 +201,64 @@ def load_nogilrelease() -> ctypes.PyDLL:
     return _pylib
 
 
+# ---------------------------------------------------------------------------
+# _fastlane — CPython extension for the per-task hot path (src/pyext/).
+# Built separately from libraytpu.so (it needs Python headers); it attaches
+# to the SAME engine library at runtime via dlopen, so the two stay one
+# native runtime. Failure to build/load degrades to the ctypes path.
+# ---------------------------------------------------------------------------
+_FASTLANE_SRC = os.path.join(_REPO, "src", "pyext", "fastlane.cc")
+_FASTLANE_PATH = os.path.join(_HERE, "_fastlane.so")
+_fastlane_mod = None
+_fastlane_failed = False
+
+
+def build_fastlane(force: bool = False) -> str:
+    import sysconfig
+
+    if (
+        force
+        or not os.path.exists(_FASTLANE_PATH)
+        or os.path.getmtime(_FASTLANE_SRC) > os.path.getmtime(_FASTLANE_PATH)
+    ):
+        cmd = [
+            "g++", "-std=c++17", "-O2", "-fPIC", "-shared",
+            f"-I{sysconfig.get_paths()['include']}",
+            "-o", _FASTLANE_PATH, _FASTLANE_SRC,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return _FASTLANE_PATH
+
+
+def load_fastlane():
+    """Import the _fastlane extension, attached to the engine lib.
+    Returns the module, or None when disabled/unbuildable."""
+    global _fastlane_mod, _fastlane_failed
+    if _fastlane_mod is not None:
+        return _fastlane_mod
+    if _fastlane_failed or os.environ.get("RAY_TPU_fastlane") == "0":
+        return None
+    with _lock:
+        if _fastlane_mod is not None:
+            return _fastlane_mod
+        try:
+            import importlib.util
+
+            lib_path = build()
+            ext_path = build_fastlane()
+            spec = importlib.util.spec_from_file_location(
+                "ray_tpu._native._fastlane", ext_path
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            mod.attach(lib_path)
+            _fastlane_mod = mod
+        except Exception:
+            _fastlane_failed = True
+            return None
+    return _fastlane_mod
+
+
 class RtMsgView(ctypes.Structure):
     """Mirror of rt_msg_view in src/rpc/transport.cc."""
 

@@ -175,6 +175,8 @@ class CoreContext:
         # Direct-call lane (native C++ call table, [N19] direct calls):
         # caller threads submit/settle without touching the asyncio loop.
         self._engine = None  # _NativeEngine of the io loop (set on connect)
+        self._fastlane = None  # _fastlane C extension (set on connect)
+        self._actor_spec_parts: dict[tuple, tuple] = {}
         self._direct_lock = threading.Lock()
         self._direct_pool: dict[str, list[DirectWorker]] = {}
         self._direct_grows: dict[str, int] = {}
@@ -231,9 +233,11 @@ class CoreContext:
         port = await self.core_server.start()
         self.address = ("127.0.0.1", port)
         if native_available() and global_config().direct_call:
+            from ray_tpu import _native
             from ray_tpu._private.rpc import _NativeEngine
 
             self._engine = _NativeEngine.for_running_loop()
+            self._fastlane = _native.load_fastlane()
         self.controller = RpcClient(
             self.controller_addr, name="to-controller", auto_reconnect=True
         )
@@ -388,18 +392,11 @@ class CoreContext:
             if owner is not None:
                 self.io.spawn(self._notify_remove_borrower(object_id, owner))
             return
-        self.io.spawn(self._free_owned(object_id))
-
-    async def _notify_remove_borrower(self, object_id: str, owner: tuple) -> None:
-        try:
-            client = await self._client_for(owner)
-            await client.call(
-                "remove_borrower", {"object_id": object_id, "borrower": self.worker_id}
-            )
-        except Exception:
-            pass
-
-    async def _free_owned(self, object_id: str) -> None:
+        # Free synchronously on THIS thread: for inline objects (the per-task
+        # common case) the whole release is dict pops + an optional native
+        # abandon — paying a run_coroutine_threadsafe loop wakeup (~50us on
+        # 1-core hosts) per dropped ref would dominate small-task throughput.
+        # Only SHM deletion needs the io loop (it's an RPC).
         state = self._objects.pop(object_id, None)
         self._lineage.pop(object_id, None)
         if state is None:
@@ -419,7 +416,19 @@ class CoreContext:
             self._direct_abandon(record)
         if state.status != SHM:
             return
-        for loc in state.locations:
+        self.io.spawn(self._delete_shm_object(object_id, list(state.locations)))
+
+    async def _notify_remove_borrower(self, object_id: str, owner: tuple) -> None:
+        try:
+            client = await self._client_for(owner)
+            await client.call(
+                "remove_borrower", {"object_id": object_id, "borrower": self.worker_id}
+            )
+        except Exception:
+            pass
+
+    async def _delete_shm_object(self, object_id: str, locations: list) -> None:
+        for loc in locations:
             try:
                 client = await self._client_for((loc["agent_host"], loc["agent_port"]))
                 await client.call("delete_object", {"object_id": object_id})
@@ -904,7 +913,9 @@ class CoreContext:
         except RuntimeError:
             pass
 
-    def _direct_submit(self, key: str, record: PendingTask) -> bool:
+    def _direct_submit(
+        self, key: str, record: PendingTask, parts: tuple | None = None
+    ) -> bool:
         """Put a simple task on the wire via the native call table from
         THIS thread. False = caller must use the asyncio path."""
         engine = self._engine
@@ -913,21 +924,32 @@ class CoreContext:
         worker = self._direct_pick(key, record.spec)
         if worker is None:
             return False
-        payload = wire_gen.encode_task_spec(record.spec)
-        lib = (
-            engine.pylib
-            if len(payload) < engine._PYLIB_MAX_PAYLOAD
-            else engine.lib
-        )
-        starter = (
-            lib.rt_call_start_buf
-            if self._direct_unsettled >= 2
-            else lib.rt_call_start
-        )
-        handle = starter(
-            engine.handle, worker.conn_id, b"push_task", 9,
-            payload, len(payload),
-        )
+        fl = self._fastlane
+        if fl is not None and parts is not None:
+            # One C call: splice the canonical payload from the precompiled
+            # template parts + start the native call (buffered in bursts).
+            handle = fl.submit(
+                engine.handle, worker.conn_id, b"push_task",
+                parts[0], record.spec["task_id"], parts[1],
+                record.spec["args"], parts[2], 0, -1,
+                1 if self._direct_unsettled >= 2 else 0,
+            )
+        else:
+            payload = wire_gen.encode_task_spec(record.spec)
+            lib = (
+                engine.pylib
+                if len(payload) < engine._PYLIB_MAX_PAYLOAD
+                else engine.lib
+            )
+            starter = (
+                lib.rt_call_start_buf
+                if self._direct_unsettled >= 2
+                else lib.rt_call_start
+            )
+            handle = starter(
+                engine.handle, worker.conn_id, b"push_task", 9,
+                payload, len(payload),
+            )
         if handle == 0:
             with self._direct_lock:
                 worker.inflight -= 1
@@ -978,27 +1000,61 @@ class CoreContext:
                     timeout_ms = (
                         -1 if remaining is None else max(1, int(remaining * 1000))
                     )
-                    view = _native.RtMsgView()
-                    rc = engine.lib.rt_call_wait(
-                        engine.handle, handle, timeout_ms, ctypes.byref(view)
-                    )
-                    if rc == 0:
-                        return False
-                    record.native_handle = None
-                    self._direct_unsettled = max(0, self._direct_unsettled - 1)
-                    if rc == 1:
-                        kind = view.kind
-                        raw = (
-                            ctypes.string_at(view.payload, view.plen)
-                            if view.plen
-                            else b""
+                    fl = self._fastlane
+                    if fl is not None:
+                        # C-side wait + reply decode: the common ok/inline
+                        # case comes back as ready-to-store bytes.
+                        res = fl.call_wait(engine.handle, handle, timeout_ms)
+                        rc = res[0]
+                        if rc == 0:
+                            return False
+                        record.native_handle = None
+                        self._direct_unsettled = max(
+                            0, self._direct_unsettled - 1
                         )
-                        engine.pylib.rt_msg_free(view.opaque)
-                        settled_here = self._direct_reply(record, kind, raw)
-                    elif rc == -1:
-                        settled_here = self._direct_conn_lost(record)
-                    # rc == -2: someone else consumed the handle — fall
-                    # through to done_event below.
+                        if rc == 1:
+                            settled_here = self._direct_reply_inline(
+                                record, res[1]
+                            )
+                        elif rc == 2:
+                            settled_here = self._direct_reply(
+                                record, REP, res[1]
+                            )
+                        elif rc == 3:
+                            settled_here = self._direct_reply(
+                                record, ERR, res[1]
+                            )
+                        elif rc == -1:
+                            settled_here = self._direct_conn_lost(record)
+                        # rc == -2: someone else consumed the handle —
+                        # fall through to done_event below.
+                    else:
+                        view = _native.RtMsgView()
+                        rc = engine.lib.rt_call_wait(
+                            engine.handle, handle, timeout_ms,
+                            ctypes.byref(view),
+                        )
+                        if rc == 0:
+                            return False
+                        record.native_handle = None
+                        self._direct_unsettled = max(
+                            0, self._direct_unsettled - 1
+                        )
+                        if rc == 1:
+                            kind = view.kind
+                            raw = (
+                                ctypes.string_at(view.payload, view.plen)
+                                if view.plen
+                                else b""
+                            )
+                            engine.pylib.rt_msg_free(view.opaque)
+                            settled_here = self._direct_reply(
+                                record, kind, raw
+                            )
+                        elif rc == -1:
+                            settled_here = self._direct_conn_lost(record)
+                        # rc == -2: someone else consumed the handle — fall
+                        # through to done_event below.
             finally:
                 record.settle_lock.release()
             if settled_here or record.done:
@@ -1010,6 +1066,57 @@ class CoreContext:
                 wait_s = max(0.0, deadline - time.monotonic())
             if not record.done_event.wait(wait_s):
                 return False
+        return True
+
+    def _direct_reply_inline(self, record: PendingTask, data: bytes) -> bool:
+        """Slim settle for the dominant reply shape (status ok, one inline
+        return, already isolated by the C-side scan): store the bytes and
+        finish the record without building a reply dict. Mirrors
+        _direct_reply + _finish_record for that shape exactly."""
+        if len(record.return_ids) != 1:
+            # Expected-returns mismatch: take the generic path (it zips
+            # and fails/fills per state like the asyncio machinery).
+            return self._direct_reply(
+                record,
+                REP,
+                wire_gen.encode_task_reply(
+                    {"status": "ok",
+                     "returns": [{"kind": "inline", "data": data}]}
+                ),
+            )
+        dw = record.direct_worker
+        if dw is not None:
+            record.direct_worker = None
+            with self._direct_lock:
+                dw.inflight -= 1
+                dw.last_used = time.monotonic()
+        spec = record.spec
+        task_id = spec["task_id"]
+        self._running_tasks.pop(task_id, None)
+        if record.done:
+            return True
+        record.done = True
+        self._task_records.pop(task_id, None)
+        self._cancelled_tasks.discard(task_id)
+        state = self._objects.get(record.return_ids[0])
+        if state is not None:
+            state.status = INLINE
+            state.data = data
+            state.size = len(data)
+            state.record = None
+            self._set_state_event(state)
+        if record.done_event is not None:
+            record.done_event.set()
+        if record.arg_refs:
+            with self._refs_lock:
+                for rid in record.arg_refs:
+                    count = self._submitted_refs.get(rid, 0) - 1
+                    if count <= 0:
+                        self._submitted_refs.pop(rid, None)
+                    else:
+                        self._submitted_refs[rid] = count
+            for rid in record.arg_refs:
+                self._maybe_free(rid)
         return True
 
     def _direct_reply(self, record: PendingTask, kind: int, raw: bytes) -> bool:
@@ -1257,7 +1364,7 @@ class CoreContext:
         RemoteFunction so each submit pays one dict copy, not a rebuild
         (the reference caches its TaskSpec builder the same way)."""
         cfg = global_config()
-        return {
+        template = {
             "task_id": "",
             "job_id": self.job_id,
             "function_id": function_id,
@@ -1273,11 +1380,16 @@ class CoreContext:
             ),
             "retry_exceptions": retry_exceptions,
             "has_ref_args": False,
-            # direct-pool key, precomputed (popped before the wire)
-            "_dkey": _resources_key(
-                resources or {"CPU": 1}, repr(runtime_env or {})
-            ),
         }
+        # Precompiled splice parts: the direct lane re-encodes only
+        # (task_id, args) per submit. Computed BEFORE the private keys
+        # below join the dict — unknown keys would pass through to p2.
+        template["_parts"] = wire_gen.make_task_spec_parts(template)
+        # direct-pool key, precomputed (popped before the wire)
+        template["_dkey"] = _resources_key(
+            resources or {"CPU": 1}, repr(runtime_env or {})
+        )
+        return template
 
     def submit_task(
         self,
@@ -1295,7 +1407,10 @@ class CoreContext:
         spec_template: dict | None = None,
     ) -> list[ObjectRef]:
         task_id = self.next_task_id()
-        payload, contained = serialization.serialize((args, kwargs or {}))
+        if not args and not kwargs:
+            payload, contained = serialization.EMPTY_ARGS_PAYLOAD, ()
+        else:
+            payload, contained = serialization.serialize((args, kwargs or {}))
         arg_ref_ids = [r.id for r in contained]
         # Submitted-task references: args stay alive until the task finishes.
         if arg_ref_ids:
@@ -1319,6 +1434,7 @@ class CoreContext:
                 scheduling_strategy=scheduling_strategy,
             )
         direct_key = spec.pop("_dkey", None)
+        spec_parts = spec.pop("_parts", None)
         return_ids = [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
@@ -1355,7 +1471,7 @@ class CoreContext:
                 direct_key = _resources_key(
                     spec["resources"], repr(spec["runtime_env"])
                 )
-            if self._direct_submit(direct_key, record):
+            if self._direct_submit(direct_key, record, spec_parts):
                 return refs
         # Batched handoff to the io loop: appending to a deque and waking
         # the loop once per burst (scheduled only on the empty->nonempty
@@ -1842,7 +1958,10 @@ class CoreContext:
         max_task_retries: int = 0,
     ) -> list[ObjectRef]:
         task_id = self.next_task_id()
-        payload, contained = serialization.serialize((args, kwargs))
+        if not args and not kwargs:
+            payload, contained = serialization.EMPTY_ARGS_PAYLOAD, ()
+        else:
+            payload, contained = serialization.serialize((args, kwargs))
         arg_ref_ids = [r.id for r in contained]
         if arg_ref_ids:
             with self._refs_lock:
@@ -1912,21 +2031,36 @@ class CoreContext:
                 # A pending slow send would write AFTER this frame and
                 # invert program order — direct only when none are queued.
                 engine = self._engine
-                wire = wire_gen.encode_actor_task_spec(spec)
-                lib = (
-                    engine.pylib
-                    if len(wire) < engine._PYLIB_MAX_PAYLOAD
-                    else engine.lib
-                )
-                starter = (
-                    lib.rt_call_start_buf
-                    if self._direct_unsettled >= 2
-                    else lib.rt_call_start
-                )
-                handle = starter(
-                    engine.handle, direct_client[0], b"push_actor_task", 15,
-                    wire, len(wire),
-                )
+                fl = self._fastlane
+                if fl is not None:
+                    ap = self._actor_spec_parts.get(tkey)
+                    if ap is None:
+                        ap = self._actor_spec_parts[tkey] = (
+                            wire_gen.make_actor_task_spec_parts(template)
+                        )
+                    # One C call: splice (task_id, args), patch seq at its
+                    # fixed offset, start the native call.
+                    handle = fl.submit(
+                        engine.handle, direct_client[0], b"push_actor_task",
+                        ap[0], task_id, ap[1], payload, ap[2], seq, ap[3],
+                        1 if self._direct_unsettled >= 2 else 0,
+                    )
+                else:
+                    wire = wire_gen.encode_actor_task_spec(spec)
+                    lib = (
+                        engine.pylib
+                        if len(wire) < engine._PYLIB_MAX_PAYLOAD
+                        else engine.lib
+                    )
+                    starter = (
+                        lib.rt_call_start_buf
+                        if self._direct_unsettled >= 2
+                        else lib.rt_call_start
+                    )
+                    handle = starter(
+                        engine.handle, direct_client[0], b"push_actor_task",
+                        15, wire, len(wire),
+                    )
                 if handle:
                     self._direct_unsettled += 1
                     # Keep the io-loop send gate in step so interleaved

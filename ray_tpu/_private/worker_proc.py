@@ -149,10 +149,210 @@ class WorkerRuntime:
                 fut.set_exception(exc)
 
     def _run_fast_main_loop(self) -> None:
+        from ray_tpu import _native
+
+        fl = _native.load_fastlane()
+        if fl is not None:
+            self._run_fastlane_loop(fl)
+        else:
+            self._run_ctypes_fast_loop()
+
+    def _run_fastlane_loop(self, fl) -> None:
+        """Native fast lane (the task_receiver.cc role done properly):
+        the _fastlane C extension decodes push frames, classifies
+        eligibility, and encodes+sends replies — one C call in, one C
+        call out per task. Python keeps pickle + the user function.
+        Anything the extension can't prove simple arrives as a bounce
+        tuple and takes the asyncio path unchanged."""
+        engine = self._engine
+        eng = engine.handle
+        ObjectRefT = ObjectRef
+        fn_cache = self._fn_cache
+        while True:
+            item = fl.exec_next(eng, 1000)
+            if item is None:
+                continue
+            tag = item[0]
+            if tag == 1:  # plain task, pre-decoded
+                (_, conn, msgid, task_id, function_id, name, args_raw,
+                 num_returns, raw) = item
+                try:
+                    fn = fn_cache.get(function_id)
+                    if fn is None:
+                        self._bounce_raw(conn, msgid, b"push_task", raw)
+                        continue
+                    args, kwargs = self._deserialize_args(args_raw)
+                    if any(isinstance(a, ObjectRefT) for a in args) or any(
+                        isinstance(v, ObjectRefT) for v in kwargs.values()
+                    ):
+                        self._bounce_raw(conn, msgid, b"push_task", raw)
+                        continue
+                    spec = {
+                        "task_id": task_id,
+                        "name": name,
+                        "num_returns": num_returns,
+                    }
+                    reply = self._execute(spec, fn, False, (args, kwargs))
+                except Exception:
+                    payload, _ = serialization.serialize(
+                        exceptions.TaskError(name, traceback.format_exc())
+                    )
+                    reply = {"status": "error", "error": payload}
+                self._send_fast_reply(
+                    fl, eng, conn, msgid, b"push_task", reply
+                )
+                continue
+            if tag == 2:  # actor task, pre-decoded
+                (_, conn, msgid, task_id, method_name, name, caller_id,
+                 args_raw, num_returns, seq, raw) = item
+                state = self._order.get(caller_id)
+                if state is None:
+                    state = self._order[caller_id] = {
+                        "expected": seq, "waiters": {},
+                    }
+                state["expected"] = max(state["expected"], seq + 1)
+                try:
+                    if (
+                        self.actor_instance is None
+                        or method_name == "__ray_terminate__"
+                        or self._actor_concurrency > 1
+                        or self._bounced_actor > 0
+                    ):
+                        self._bounce_raw(
+                            conn, msgid, b"push_actor_task", raw
+                        )
+                        continue
+                    bound = self._method_cache.get(method_name)
+                    if bound is None:
+                        bound = getattr(
+                            self.actor_instance, method_name, None
+                        )
+                        if bound is None:
+                            payload, _ = serialization.serialize(
+                                AttributeError(
+                                    f"actor has no method {method_name!r}"
+                                )
+                            )
+                            self._send_fast_reply(
+                                fl, eng, conn, msgid, b"push_actor_task",
+                                {"status": "error", "error": payload},
+                            )
+                            continue
+                        self._method_cache[method_name] = bound
+                    fn_key = getattr(bound, "__func__", bound)
+                    is_coro = self._coro_cache.get(fn_key)
+                    if is_coro is None:
+                        is_coro = inspect.iscoroutinefunction(bound)
+                        self._coro_cache[fn_key] = is_coro
+                    if is_coro:
+                        self._bounce_raw(
+                            conn, msgid, b"push_actor_task", raw
+                        )
+                        continue
+                    args, kwargs = self._deserialize_args(args_raw)
+                    if any(isinstance(a, ObjectRefT) for a in args) or any(
+                        isinstance(v, ObjectRefT) for v in kwargs.values()
+                    ):
+                        self._bounce_raw(
+                            conn, msgid, b"push_actor_task", raw
+                        )
+                        continue
+                    spec = {
+                        "task_id": task_id,
+                        "name": name,
+                        "num_returns": num_returns,
+                    }
+                    reply = self._execute(spec, bound, True, (args, kwargs))
+                except Exception:
+                    payload, _ = serialization.serialize(
+                        exceptions.TaskError(name, traceback.format_exc())
+                    )
+                    reply = {"status": "error", "error": payload}
+                self._send_fast_reply(
+                    fl, eng, conn, msgid, b"push_actor_task", reply
+                )
+                continue
+            if tag == 0:  # injected Python work item
+                pair = self._main_injected.pop(item[1], None)
+                if pair is None:
+                    continue
+                fn, fut = pair
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(fn())
+                except BaseException as exc:  # noqa: BLE001
+                    fut.set_exception(exc)
+                continue
+            if tag == 4:  # engine stopping
+                return
+            # tag == 3: ineligible frame — full Python decode + asyncio.
+            # A frame even the full codec cannot decode must reply with a
+            # TaskError, not kill this thread (a dead fast lane hangs
+            # every subsequent task with no reply).
+            _, conn, msgid, method, payload = item
+            try:
+                self._bounce_raw(conn, msgid, method, payload)
+            except Exception:
+                err, _ = serialization.serialize(
+                    exceptions.TaskError(
+                        method.decode("utf-8", "replace"),
+                        traceback.format_exc(),
+                    )
+                )
+                self._send_fast_reply(
+                    fl, eng, conn, msgid, method,
+                    {"status": "error", "error": err},
+                )
+
+    def _bounce_raw(self, conn, msgid, method, payload) -> None:
+        """Decode a raw frame with the full typed codec and hand it to
+        the asyncio handler (the fastlane twin of the ctypes loop's
+        inline bounce decisions)."""
+        from ray_tpu._private import wire_gen
+
+        if method == b"push_task":
+            spec = wire_gen.decode_task_spec(payload)
+            self._bounce(conn, msgid, method, "push_task", spec)
+        else:
+            spec = wire_gen.decode_actor_task_spec(payload)
+            caller = spec.get("caller_id", "?")
+            seq = spec.get("seq", 0)
+            state = self._order.get(caller)
+            if state is None:
+                state = self._order[caller] = {
+                    "expected": seq, "waiters": {},
+                }
+            state["expected"] = max(state["expected"], seq + 1)
+            self._bounce(conn, msgid, method, "push_actor_task", spec,
+                         actor=True)
+
+    def _send_fast_reply(
+        self, fl, eng, conn, msgid, method, reply
+    ) -> None:
+        from ray_tpu._private import wire_gen
+
+        if reply is None:
+            return
+        if reply.get("status") == "ok":
+            rets = reply.get("returns")
+            if (
+                rets is not None
+                and len(rets) == 1
+                and rets[0].get("kind") == "inline"
+            ):
+                fl.reply_inline(eng, conn, msgid, method, rets[0]["data"])
+                return
+        fl.reply_raw(
+            eng, conn, msgid, method, wire_gen.encode_task_reply(reply)
+        )
+
+    def _run_ctypes_fast_loop(self) -> None:
         """Fast-lane twin of the loop above: consumes the native exec
         queue (diverted push frames + injected io-loop work) in arrival
         order. Decode via the typed wire schema, execute, reply — all on
         this thread; the asyncio loop is only involved for bounced frames.
+        (Fallback when the _fastlane extension is unavailable.)
         """
         import ctypes
 
@@ -431,6 +631,11 @@ class WorkerRuntime:
         cfg = global_config()
         out = []
         for index, value in enumerate(values):
+            if value is None:
+                out.append(
+                    {"kind": "inline", "data": serialization.NONE_PAYLOAD}
+                )
+                continue
             payload, _ = serialization.serialize(value)
             if len(payload) <= cfg.max_direct_call_object_size:
                 out.append({"kind": "inline", "data": payload})
@@ -541,20 +746,15 @@ class WorkerRuntime:
         (reference: profile_event.cc → gcs_task_manager.cc [N5]). Terminal
         events carry ``start_ts`` so one record describes the whole span."""
         with self._task_event_lock:
-            event = {
-                "task_id": spec.get("task_id"),
-                "name": spec.get("name"),
-                "state": state,
-                "node_id": self.ctx.node_id,
-                "worker_id": self.ctx.worker_id,
-                "pid": os.getpid(),
-                "ts": _time.time(),
-            }
-            if start_ts is not None:
-                event["start_ts"] = start_ts
-            self.ctx._task_events.append(event)
-            # Batch: size- or time-triggered, never per-event (the reference
-            # buffers in a ring and reports periodically, gcs_task_manager).
+            # Hot path appends a tuple; the flush below expands it into the
+            # full record (the reference buffers a ring of slim events and
+            # reports periodically, gcs_task_manager) — building an 8-key
+            # dict per lifecycle event costs more than the task envelope.
+            self.ctx._task_events.append(
+                (spec.get("task_id"), spec.get("name"), state, start_ts,
+                 _time.time())
+            )
+            # Batch: size- or time-triggered, never per-event.
             now = _time.monotonic()
             due = (
                 len(self.ctx._task_events) >= 100
@@ -562,9 +762,26 @@ class WorkerRuntime:
             )
             if not due:
                 return
-            events = self.ctx._task_events[:]
+            slim = self.ctx._task_events[:]
             self.ctx._task_events.clear()
             self._task_events_last_flush = now
+        node_id = self.ctx.node_id
+        worker_id = self.ctx.worker_id
+        pid = os.getpid()
+        events = []
+        for task_id, name, ev_state, ev_start, ts in slim:
+            event = {
+                "task_id": task_id,
+                "name": name,
+                "state": ev_state,
+                "node_id": node_id,
+                "worker_id": worker_id,
+                "pid": pid,
+                "ts": ts,
+            }
+            if ev_start is not None:
+                event["start_ts"] = ev_start
+            events.append(event)
 
         async def _flush():
             try:

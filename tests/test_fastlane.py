@@ -1,0 +1,169 @@
+"""_fastlane C-extension parity tests.
+
+The extension (src/pyext/fastlane.cc) re-implements the hot-path subset
+of the generated wire codecs (SURVEY N14/N18-N20): these tests pin it
+byte-for-byte / field-for-field against ray_tpu._private.wire_gen, so a
+schema change that regenerates the Python codecs but silently diverges
+from the C scanners fails here instead of on the wire.
+"""
+
+import pytest
+
+from ray_tpu import _native
+from ray_tpu._private import wire_gen
+
+fl = _native.load_fastlane()
+pytestmark = pytest.mark.skipif(fl is None, reason="fastlane unavailable")
+
+
+TASK_TMPL = {
+    "task_id": "tsk-abc-1",
+    "job_id": "job",
+    "function_id": "fn-1",
+    "name": "noop",
+    "args": b"\x80\x04args",
+    "num_returns": 1,
+    "resources": {"CPU": 1.0},
+    "owner": {"worker_id": "w", "address": ["h", 1]},
+    "runtime_env": {},
+    "scheduling_strategy": None,
+    "max_retries": 0,
+    "retry_exceptions": False,
+    "has_ref_args": False,
+    "cross_language": False,
+    "function_ref": "",
+    "trace_ctx": None,
+}
+
+ACTOR_TMPL = {
+    "seq": 7,
+    "task_id": "tsk-9",
+    "job_id": "job",
+    "actor_id": "act-1",
+    "method": "inc",
+    "name": "act-1.inc",
+    "args": b"AB",
+    "num_returns": 1,
+    "owner": {"worker_id": "w", "address": ["h", 1]},
+    "caller_id": "caller-1",
+    "max_retries": 0,
+    "retry_exceptions": False,
+    "has_ref_args": False,
+    "trace_ctx": None,
+}
+
+
+def test_task_spec_scan_matches_codec():
+    raw = wire_gen.encode_task_spec(TASK_TMPL)
+    tag, conn, msgid, task_id, function_id, name, args, num_returns, raw2 = (
+        fl.probe(b"push_task", raw)
+    )
+    assert tag == 1
+    assert (task_id, function_id, name, args, num_returns) == (
+        "tsk-abc-1", "fn-1", "noop", b"\x80\x04args", 1,
+    )
+    assert raw2 == raw
+
+
+@pytest.mark.parametrize(
+    "patch",
+    [
+        {"has_ref_args": True},
+        {"cross_language": True, "function_ref": "m:f"},
+        {"trace_ctx": {"tid": "x"}},
+    ],
+)
+def test_task_spec_ineligible_bounces(patch):
+    raw = wire_gen.encode_task_spec(dict(TASK_TMPL, **patch))
+    out = fl.probe(b"push_task", raw)
+    assert out[0] == 3  # bounce to the asyncio handler
+    assert out[3] == b"push_task" and out[4] == raw
+
+
+def test_actor_spec_scan_matches_codec():
+    raw = wire_gen.encode_actor_task_spec(ACTOR_TMPL)
+    (tag, conn, msgid, task_id, method, name, caller_id, args, num_returns,
+     seq, raw2) = fl.probe(b"push_actor_task", raw)
+    assert tag == 2
+    assert (task_id, method, name, caller_id, args, num_returns, seq) == (
+        "tsk-9", "inc", "act-1.inc", "caller-1", b"AB", 1, 7,
+    )
+    assert raw2 == raw
+
+
+def test_actor_spec_patched_seq_visible_to_scan():
+    raw = wire_gen.encode_actor_task_spec(ACTOR_TMPL)
+    patched = wire_gen.patch_seq(raw, 123456)
+    out = fl.probe(b"push_actor_task", patched)
+    assert out[0] == 2 and out[9] == 123456
+
+
+def test_unknown_method_bounces():
+    out = fl.probe(b"mystery", b"\x80")
+    assert out[0] == 3 and out[3] == b"mystery"
+
+
+def test_malformed_payload_bounces():
+    out = fl.probe(b"push_task", b"\xde\x00")  # truncated map16 header
+    assert out[0] == 3
+
+
+@pytest.mark.parametrize("n", [0, 4, 300, 70_000])
+def test_reply_encode_byte_parity(n):
+    data = bytes(range(256)) * (n // 256) + b"z" * (n % 256)
+    py = wire_gen.encode_task_reply(
+        {"status": "ok", "returns": [{"kind": "inline", "data": data}]}
+    )
+    assert fl.probe_reply(data) == py
+
+
+def test_reply_scan_classification():
+    simple = wire_gen.encode_task_reply(
+        {"status": "ok", "returns": [{"kind": "inline", "data": b"D"}]}
+    )
+    assert fl.probe_reply_scan(simple) == (1, b"D")
+    for complex_reply in (
+        {"status": "error", "error": b"E"},
+        {"status": "cancelled"},
+        {"status": "ok",
+         "returns": [{"kind": "shm", "size": 10, "location": {"a": 1}}]},
+        {"status": "ok",
+         "returns": [{"kind": "inline", "data": b"a"},
+                     {"kind": "inline", "data": b"b"}]},
+    ):
+        raw = wire_gen.encode_task_reply(complex_reply)
+        tag, payload = fl.probe_reply_scan(raw)
+        assert tag == 2 and payload == raw
+
+
+@pytest.mark.parametrize(
+    "tid,args,seq",
+    [
+        ("tsk-7", b"AB", 12345),
+        ("t" * 40, b"z" * 300, 0),
+        ("x", b"q" * 70_000, 2**31),
+    ],
+)
+def test_splice_parity_actor(tid, args, seq):
+    tmpl = dict(ACTOR_TMPL, task_id="", args=b"", seq=0)
+    p0, p1, p2, so = wire_gen.make_actor_task_spec_parts(tmpl)
+    assert so >= 0
+    c = fl.probe_splice(p0, tid, p1, args, p2, seq, so)
+    assert c == wire_gen.splice((p0, p1, p2, so), tid, args, seq=seq)
+    assert c == wire_gen.encode_actor_task_spec(
+        dict(tmpl, task_id=tid, args=args, seq=seq)
+    )
+
+
+def test_splice_parity_task_with_unknown_keys():
+    tmpl = dict(TASK_TMPL, task_id="", args=b"", custom={"z": [1, 2]})
+    parts = wire_gen.make_task_spec_parts(tmpl)
+    assert parts[3] == -1  # no u32fixed field
+    c = fl.probe_splice(parts[0], "tid-1", parts[1], b"args", parts[2], 0,
+                        parts[3])
+    assert c == wire_gen.encode_task_spec(
+        dict(tmpl, task_id="tid-1", args=b"args")
+    )
+    # and the scanner reads back what the splicer wrote
+    out = fl.probe(b"push_task", c)
+    assert out[0] == 1 and out[3] == "tid-1" and out[6] == b"args"
