@@ -105,10 +105,17 @@ class ConnectorPipelineV2(ConnectorV2):
 # env → module
 # ---------------------------------------------------------------------------
 class FlattenObservations(ConnectorV2):
-    """[B, ...] observations → [B, prod(...)] float32 (fcnet input)."""
+    """[B, ...] observations → [B, prod(...)] float32 (fcnet input).
+
+    Image observations ([B, H, W, C]) pass through UNCHANGED — the vision
+    net consumes them as pixels (and uint8 stays uint8 until the module's
+    in-jit normalize), matching the reference where the flattener serves
+    the fcnet path and conv inputs bypass it."""
 
     def __call__(self, batch, **kwargs):
         obs = np.asarray(batch)
+        if obs.ndim >= 4:  # [B, H, W, C]: conv input, keep shape + dtype
+            return obs
         return obs.reshape(obs.shape[0], -1).astype(np.float32, copy=False)
 
 

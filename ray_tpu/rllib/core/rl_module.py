@@ -22,16 +22,31 @@ class RLModuleSpec:
     def __init__(self, module_class=None, model_config: dict | None = None):
         self.model_config = dict(model_config or {})
         if module_class is None:
-            # catalog selection (reference model-catalog use_lstm flag)
-            module_class = (
-                LSTMModule
-                if self.model_config.get("use_lstm")
-                else MLPModule
-            )
+            # Catalog selection (reference ModelCatalog: use_lstm flag,
+            # conv_filters pick the vision net). Image-shaped observation
+            # spaces also select the vision net, but the space is only
+            # known at build() — module_class stays None until then.
+            if self.model_config.get("use_lstm"):
+                module_class = LSTMModule
+            elif self.model_config.get("conv_filters"):
+                module_class = ConvModule
         self.module_class = module_class
 
     def build(self, observation_space, action_space) -> "RLModule":
-        return self.module_class(
+        module_class = self.module_class
+        if module_class is None:
+            shape = getattr(observation_space, "shape", None)
+            # Auto-route image-SHAPED spaces to the vision net only when
+            # the default filter stack fits (min spatial dim >= 10 for the
+            # small stack); tiny 3-D obs keep training via MLP flatten as
+            # before. Explicit conv_filters always force ConvModule.
+            module_class = (
+                ConvModule
+                if shape is not None and len(shape) == 3
+                and min(int(shape[0]), int(shape[1])) >= 10
+                else MLPModule
+            )
+        return module_class(
             observation_space, action_space, self.model_config
         )
 
@@ -154,6 +169,114 @@ class MLPModule(RLModule):
         )
         entropy = jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
         return logp, entropy, fwd["vf"]
+
+
+class ConvModule(MLPModule):
+    """Vision net (reference: rllib/models :: ModelCatalog conv path /
+    VisionNetwork), TPU-first: a shared NHWC conv trunk — XLA maps the
+    convs straight onto the MXU; NHWC is the TPU-native layout — with a
+    dense projection and separate pi/vf heads (the Atari-standard
+    [[32,8,4],[64,4,2],[64,3,1]] + 512 trunk by default).
+
+    model_config:
+      conv_filters: [[out_channels, kernel, stride], ...] (VALID padding)
+      conv_activation: "relu" (default) | "tanh"
+      post_fcnet_hiddens: (512,) dense trunk after flatten
+      normalize_images: True — scales uint8-style pixel obs by 1/255
+        inside the jitted forward (no host-side preprocessing pass).
+    """
+
+    def __init__(self, observation_space, action_space, model_config):
+        super().__init__(observation_space, action_space, model_config)
+        shape = observation_space.shape
+        if len(shape) != 3:
+            raise ValueError(
+                f"ConvModule needs [H, W, C] observations, got {shape}"
+            )
+        self.obs_shape = tuple(int(s) for s in shape)
+        # Size-aware defaults (reference ModelCatalog picks per-resolution
+        # filter stacks the same way: 84x84 → the Atari stack).
+        if min(self.obs_shape[0], self.obs_shape[1]) >= 60:
+            default_filters = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+        else:
+            default_filters = ((16, 4, 2), (32, 4, 2))
+        self.filters = [
+            tuple(int(x) for x in f)
+            for f in model_config.get("conv_filters", default_filters)
+        ]
+        self.post_hiddens = tuple(
+            model_config.get("post_fcnet_hiddens", (512,))
+        )
+        self.normalize = bool(model_config.get("normalize_images", True))
+        self.activation = (
+            jax.nn.tanh
+            if model_config.get("conv_activation") == "tanh"
+            else jax.nn.relu
+        )
+        # Flattened conv-out size from the VALID-padding shape recurrence
+        # (static — jit sees fixed shapes).
+        h, w = self.obs_shape[0], self.obs_shape[1]
+        for _out, k, s in self.filters:
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+            if h <= 0 or w <= 0:
+                raise ValueError(
+                    f"conv_filters {self.filters} shrink {self.obs_shape} "
+                    "below 1x1 — remove a layer or pad the observations"
+                )
+        self.conv_out_dim = h * w * self.filters[-1][0]
+
+    def init_params(self, rng) -> dict:
+        conv_rng, trunk_rng, pi_rng, vf_rng = jax.random.split(rng, 4)
+        convs = []
+        in_ch = self.obs_shape[2]
+        for i, (out_ch, k, _s) in enumerate(self.filters):
+            key = jax.random.fold_in(conv_rng, i)
+            fan_in = k * k * in_ch
+            convs.append(
+                {
+                    # HWIO kernel layout (jax conv convention for NHWC)
+                    "w": jax.random.normal(key, (k, k, in_ch, out_ch))
+                    * jnp.sqrt(2.0 / fan_in),
+                    "b": jnp.zeros((out_ch,)),
+                }
+            )
+            in_ch = out_ch
+        trunk_sizes = (self.conv_out_dim, *self.post_hiddens)
+        feat = trunk_sizes[-1]
+        return {
+            "conv": convs,
+            "trunk": _mlp_init(trunk_rng, trunk_sizes),
+            "pi": _mlp_init(pi_rng, (feat, self.num_outputs)),
+            "vf": _mlp_init(vf_rng, (feat, 1)),
+        }
+
+    def _features(self, params, obs):
+        x = obs.astype(jnp.float32)
+        if self.normalize:
+            x = x * (1.0 / 255.0)
+        for layer, (_out, _k, s) in zip(params["conv"], self.filters):
+            x = jax.lax.conv_general_dilated(
+                x,
+                layer["w"],
+                window_strides=(s, s),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            x = self.activation(x + layer["b"])
+        x = x.reshape(x.shape[0], -1)
+        for layer in params["trunk"]:
+            x = self.activation(x @ layer["w"] + layer["b"])
+        return x
+
+    def forward_train(self, params, obs) -> dict:
+        feat = self._features(params, obs)
+        out = _mlp_apply(params["pi"], feat)
+        vf = _mlp_apply(params["vf"], feat)[..., 0]
+        if self.discrete:
+            return {"logits": out, "vf": vf}
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return {"mean": mean, "log_std": jnp.clip(log_std, -20, 2), "vf": vf}
 
 
 class LSTMModule(MLPModule):

@@ -1,0 +1,123 @@
+"""BASELINE north star — Atari-shaped PPO throughput + pixel learning.
+
+Reference-equivalent: rllib/tuned_examples/ppo/atari_ppo.py (SURVEY §6
+"RLlib PPO-Atari env-steps/s" north star). ALE ROMs don't exist in this
+image, so the two halves of that benchmark run on envs with the exact
+Atari observation contract (uint8 [84,84,4] / Discrete(6)):
+
+  * throughput: PPO over raytpu/RandomImage-v0 (pre-generated frames, no
+    game logic) — measures rollout+learner machinery and the conv net;
+  * learning: PPO over raytpu/MovingDot-v0 (32x32 pixels) must beat the
+    chance return, proving the vision stack actually learns from pixels.
+
+Prints one JSON line: {"env_steps_per_s": ..., "pixel_best_return": ...,
+"pixel_reached_target": ...}.
+"""
+
+import json
+import sys
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from bench_env import force_cpu
+
+force_cpu()
+
+import time
+
+
+def _throughput(smoke: bool) -> float:
+    import ray_tpu.rllib.env.pixel_envs  # noqa: F401 (registers ids)
+    from ray_tpu.rllib import PPOConfig
+
+    iters = 2 if smoke else 5
+    algo = (
+        PPOConfig()
+        .environment("ray_tpu.rllib.env.pixel_envs:raytpu/RandomImage-v0")
+        .env_runners(
+            num_env_runners=2,
+            num_envs_per_env_runner=4,
+            rollout_fragment_length=32,
+        )
+        .training(
+            lr=3e-4,
+            train_batch_size=256,
+            minibatch_size=128,
+            num_epochs=2,
+        )
+        .debugging(seed=0)
+        .build_algo()
+    )
+    try:
+        algo.train()  # warmup: jit compiles + worker spin-up stay out
+        start = time.perf_counter()
+        steps0 = algo._total_env_steps
+        for _ in range(iters):
+            algo.train()
+        elapsed = time.perf_counter() - start
+        return (algo._total_env_steps - steps0) / elapsed
+    finally:
+        algo.stop()
+
+
+def _pixel_learning(smoke: bool) -> tuple[float, bool]:
+    import numpy as np
+
+    import ray_tpu.rllib.env.pixel_envs  # noqa: F401
+    from ray_tpu.rllib import PPOConfig
+
+    target, iters = (17.0, 5) if smoke else (22.0, 18)
+    algo = (
+        PPOConfig()
+        .environment("ray_tpu.rllib.env.pixel_envs:raytpu/MovingDot-v0")
+        .env_runners(
+            num_env_runners=1,
+            num_envs_per_env_runner=8,
+            rollout_fragment_length=32,
+        )
+        .training(
+            lr=1e-3,
+            train_batch_size=512,
+            minibatch_size=128,
+            num_epochs=6,
+            entropy_coeff=0.003,
+        )
+        .debugging(seed=0)
+        .build_algo()
+    )
+    best = -np.inf
+    try:
+        for _ in range(iters):
+            result = algo.train()
+            ret = result.get("episode_return_mean", np.nan)
+            if not np.isnan(ret):
+                best = max(best, ret)
+            if best >= target:
+                break
+        return float(best), bool(best >= target)
+    finally:
+        algo.stop()
+
+
+def main():
+    import bench_env
+
+    import ray_tpu
+
+    smoke = bench_env.smoke()
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+    start = time.perf_counter()
+    steps_per_s = _throughput(smoke)
+    best, reached = _pixel_learning(smoke)
+    print(json.dumps(
+        {
+            "benchmark": "rllib_ppo_atari_shaped",
+            "env_steps_per_s": steps_per_s,
+            "pixel_best_return": best,
+            "pixel_reached_target": reached,
+            "wall_s": time.perf_counter() - start,
+        }
+    ))
+
+
+if __name__ == "__main__":
+    main()
