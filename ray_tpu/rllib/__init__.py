@@ -11,6 +11,7 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.appo.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc.bc import BC, BCConfig
+from ray_tpu.rllib.algorithms.cql.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil.marwil import MARWIL, MARWILConfig
@@ -29,7 +30,7 @@ from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
-    "IMPALAConfig", "APPO", "APPOConfig", "DQN", "DQNConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig", "SAC", "SACConfig", "Learner",
+    "IMPALAConfig", "APPO", "APPOConfig", "DQN", "DQNConfig", "BC", "BCConfig", "CQL", "CQLConfig", "MARWIL", "MARWILConfig", "SAC", "SACConfig", "Learner",
     "LearnerGroup", "MultiAgentLearnerGroup", "MultiRLModule",
     "MultiRLModuleSpec", "RLModule", "RLModuleSpec", "MLPModule",
     "SingleAgentEnvRunner", "EnvRunnerGroup", "MultiAgentEnv",

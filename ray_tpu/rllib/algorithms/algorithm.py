@@ -22,6 +22,7 @@ from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.learner import LearnerGroup
 from ray_tpu.rllib.core.rl_module import RLModuleSpec
 from ray_tpu.rllib.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rllib.utils.metrics import MetricsLogger
 
 
 class Algorithm:
@@ -32,6 +33,9 @@ class Algorithm:
         self.iteration = 0
         self._total_env_steps = 0
         self._start = time.time()
+        self.metrics = MetricsLogger(
+            window=getattr(config, "metrics_num_episodes_for_smoothing", 100)
+        )
         if config.is_multi_agent:
             self._init_multi_agent(config)
         else:
@@ -172,6 +176,10 @@ class Algorithm:
         raise NotImplementedError
 
     def train(self) -> dict:
+        if not hasattr(self, "metrics"):
+            # offline algorithms (BC/MARWIL/CQL) build their own __init__
+            self.metrics = MetricsLogger()
+        steps_before = self._total_env_steps
         metrics = self.training_step() or {}
         self.iteration += 1
         runner_metrics = self.env_runner_group.get_metrics()
@@ -185,6 +193,17 @@ class Algorithm:
         result["episode_return_mean"] = runner_metrics.get(
             "episode_return_mean", np.nan
         )
+        # Windowed aggregation (rllib/utils/metrics :: MetricsLogger
+        # role): sliding-window return stats, learner-loss windows, and
+        # sampling throughput ride every result under "metrics".
+        self.metrics.log_throughput(
+            "num_env_steps_sampled", self._total_env_steps - steps_before
+        )
+        ret = result["episode_return_mean"]
+        if not np.isnan(ret):
+            self.metrics.log_value("episode_return", float(ret))
+        self.metrics.log_dict(metrics, prefix="learner_")
+        result["metrics"] = self.metrics.reduce()
         if (
             self.config.evaluation_interval
             and self.iteration % self.config.evaluation_interval == 0

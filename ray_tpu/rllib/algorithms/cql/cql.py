@@ -1,0 +1,178 @@
+"""CQL — conservative Q-learning (offline continuous control).
+
+Role-equivalent of rllib/algorithms/cql/ (SURVEY §2.8 offline-RL
+family): SAC's actor/critic/temperature machinery trained purely from an
+offline dataset, with the CQL(H) conservative penalty on both critics —
+
+    alpha_cql * ( E_s[ logsumexp_a Q(s, a) ] - E_(s,a)~D[ Q(s, a) ] )
+
+where the logsumexp is estimated from uniform-random and current-policy
+actions with importance correction (the standard CQL estimator). The
+penalty pushes Q down on out-of-distribution actions, so the recovered
+policy improves on a skewed behavior dataset where naive SAC/BC cannot.
+The whole update stays ONE jitted XLA step (SACLearner's step; the
+penalty rides the `_critic_regularizer` hook inside it).
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.sac.sac import (
+    SACConfig, SACLearner, SACModule,
+)
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.offline.offline_data import OfflineData
+from ray_tpu.rllib.policy.sample_batch import (
+    ACTIONS, NEXT_OBS, OBS, REWARDS, TERMINATEDS,
+)
+
+
+class CQLConfig(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CQL)
+        self.cql_alpha: float = 5.0
+        self.cql_n_actions: int = 10
+        self.updates_per_iteration = 100
+        # offline: no rollout fleet, no replay warmup
+        self.input_: object = None
+        self.num_env_runners = 0
+        self.num_steps_sampled_before_learning_starts = 0
+
+    def offline_data(self, *, input_=None):
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def validate(self) -> None:
+        super().validate()
+        if self.input_ is None:
+            raise ValueError("CQL needs config.offline_data(input_=...)")
+
+
+class CQLLearner(SACLearner):
+    def _critic_regularizer(self, p, batch, rng, q1_data, q2_data):
+        module: SACModule = self.module
+        cfg = self.config
+        n = int(cfg.get("cql_n_actions", 10))
+        alpha_cql = float(cfg.get("cql_alpha", 5.0))
+        sg = jax.lax.stop_gradient
+        obs = batch[OBS]
+        batch_size = obs.shape[0]
+        rng_rand, rng_pi = jax.random.split(rng)
+        # OOD action set: n uniform-random + n current-policy actions.
+        rand_u = jax.random.uniform(
+            rng_rand, (n, batch_size, module.act_dim), minval=-1.0,
+            maxval=1.0,
+        )
+        rand_actions = rand_u * module.scale + module.center
+
+        def sample(key):
+            return module.sample_action(sg(p["pi"]), obs, key)
+
+        pi_actions, pi_logp = jax.vmap(sample)(jax.random.split(rng_pi, n))
+        # importance correction: uniform density over the action box
+        log_unif = -jnp.sum(jnp.log(2.0 * module.scale))
+
+        def penalty(q_params, q_data):
+            def q_of(actions):
+                return jax.vmap(
+                    lambda a: module.q_values(q_params, obs, a)
+                )(actions)  # [n, B]
+
+            stacked = jnp.concatenate(
+                [q_of(rand_actions) - log_unif,
+                 q_of(pi_actions) - sg(pi_logp)],
+                axis=0,
+            )
+            lse = jax.scipy.special.logsumexp(stacked, axis=0) - jnp.log(
+                2.0 * n
+            )
+            return jnp.mean(lse) - jnp.mean(q_data)
+
+        gap1 = penalty(p["q1"], q1_data)
+        gap2 = penalty(p["q2"], q2_data)
+        reg = alpha_cql * (gap1 + gap2)
+        return reg, {"cql_penalty": reg, "cql_gap": 0.5 * (gap1 + gap2)}
+
+
+class _NullRunnerGroup:
+    runners: list = []
+
+    def sync_weights(self, params) -> None:
+        pass
+
+    def get_metrics(self) -> dict:
+        return {"episode_return_mean": np.nan, "episode_len_mean": np.nan,
+                "num_episodes": 0}
+
+    def get_connector_state(self) -> dict:
+        return {}
+
+    def stop(self) -> None:
+        pass
+
+
+class CQL(Algorithm):
+    learner_class = CQLLearner
+
+    def __init__(self, config: CQLConfig):
+        # Offline: spaces + learner, no env-runner fleet (BC's shape).
+        from ray_tpu.rllib.utils.metrics import MetricsLogger
+
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._start = _time.time()
+        self.metrics = MetricsLogger()
+        spec = config.rl_module_spec or RLModuleSpec(
+            SACModule, dict(config.model)
+        )
+        probe_env = gym.make(config.env, **config.env_config) if isinstance(
+            config.env, str
+        ) else config.env(config.env_config)
+        self.observation_space = probe_env.observation_space
+        self.action_space = probe_env.action_space
+        self.module_observation_space = self.observation_space
+        probe_env.close()
+        self.learner_group = LearnerGroup(
+            self.learner_class, spec, self.observation_space,
+            self.action_space, self._learner_config(), num_learners=0,
+        )
+        self.env_runner_group = _NullRunnerGroup()
+        self.offline_data = OfflineData(config.input_)
+        missing = {OBS, ACTIONS, REWARDS, NEXT_OBS, TERMINATEDS} - set(
+            self.offline_data.columns
+        )
+        if missing:
+            raise ValueError(f"offline dataset lacks columns: {missing}")
+
+    def _learner_config(self) -> dict:
+        cfg = super()._learner_config()
+        cfg.update(
+            tau=self.config.tau,
+            target_entropy=self.config.target_entropy,
+            initial_alpha=self.config.initial_alpha,
+            cql_alpha=self.config.cql_alpha,
+            cql_n_actions=self.config.cql_n_actions,
+        )
+        return cfg
+
+    def training_step(self) -> dict:
+        learner = self.learner_group.local_learner
+        assert learner is not None
+        metrics: dict = {}
+        for _ in range(self.config.updates_per_iteration):
+            batch = self.offline_data.sample(self.config.train_batch_size)
+            metrics = learner.update(batch)
+        metrics["num_samples_trained"] = (
+            self.config.updates_per_iteration * self.config.train_batch_size
+        )
+        return metrics

@@ -138,12 +138,17 @@ class SACLearner(Learner):
     def compute_loss(self, params, batch):  # pragma: no cover - unused path
         raise NotImplementedError("SACLearner jits its own combined step")
 
+    def _critic_regularizer(self, p, batch, rng, q1_data, q2_data):
+        """Extra critic-loss term, traced inside the jitted step. SAC
+        adds nothing; CQL overrides with the conservative penalty."""
+        return 0.0, {}
+
     def _jit_sac_step(self, params, target_params, opt_state, batch, rng):
         module: SACModule = self.module
         cfg = self.config
         gamma = cfg.get("gamma", 0.99)
         tau = cfg.get("tau", 0.005)
-        rng_actor, rng_next = jax.random.split(rng)
+        rng_actor, rng_next, rng_reg = jax.random.split(rng, 3)
         obs, actions = batch[OBS], batch[ACTIONS]
         not_done = 1.0 - batch[TERMINATEDS].astype(jnp.float32)
 
@@ -167,6 +172,12 @@ class SACLearner(Learner):
             critic_loss = jnp.mean((q1 - target) ** 2) + jnp.mean(
                 (q2 - target) ** 2
             )
+            # Critic regularizer hook: zero for SAC; CQL adds the
+            # conservative penalty here (rllib/algorithms/cql role).
+            reg_loss, reg_metrics = self._critic_regularizer(
+                p, batch, rng_reg, q1, q2
+            )
+            critic_loss = critic_loss + reg_loss
             # -- actor (grads flow to pi only; critics frozen via sg)
             a_pi, logp_pi = module.sample_action(p["pi"], obs, rng_actor)
             q_pi = jnp.minimum(
@@ -186,6 +197,7 @@ class SACLearner(Learner):
                 "alpha": alpha,
                 "entropy": -jnp.mean(logp_pi),
                 "q_mean": jnp.mean(q1),
+                **reg_metrics,
             }
 
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
