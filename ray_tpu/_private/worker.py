@@ -25,6 +25,7 @@ from ray_tpu._private.object_ref import ObjectRef
 
 _global_ctx: CoreContext | None = None
 _local_cluster: LocalCluster | None = None
+_autoscaler_monitor = None  # AutoscalerMonitor when init(autoscaling=...)
 _is_driver = False
 _lock = threading.RLock()
 _runtime_context_extras: dict = {}
@@ -57,6 +58,7 @@ def init(
     log_to_driver: bool = True,
     namespace: str = "default",
     runtime_env: dict | None = None,
+    autoscaling: "str | dict | None" = None,
     _system_config: dict | None = None,
     ignore_reinit_error: bool = False,
 ) -> dict:
@@ -126,6 +128,21 @@ def init(
         if log_to_driver:
             _subscribe_logs(ctx, job_id)
         atexit.register(shutdown)
+        if autoscaling is not None:
+            # Bootstrap-launched monitor (autoscaler/_private/monitor.py
+            # role): the cluster autoscales with NO user-side autoscaler
+            # construction. "v2"/"v1" or a dict of monitor kwargs. A bad
+            # config must not leak the just-started cluster processes.
+            global _autoscaler_monitor
+            from ray_tpu.autoscaler.monitor import start_monitor_from_config
+
+            try:
+                _autoscaler_monitor = start_monitor_from_config(
+                    autoscaling, local_cluster=_local_cluster
+                )
+            except Exception:
+                shutdown()  # RLock: safe to re-enter from init's lock
+                raise
         return runtime_info()
 
 
@@ -179,8 +196,14 @@ def _subscribe_logs(ctx: CoreContext, job_id: str) -> None:
 
 
 def shutdown() -> None:
-    global _global_ctx, _local_cluster
+    global _global_ctx, _local_cluster, _autoscaler_monitor
     with _lock:
+        if _autoscaler_monitor is not None:
+            try:
+                _autoscaler_monitor.stop()
+            except Exception:
+                pass
+            _autoscaler_monitor = None
         if _global_ctx is not None:
             _global_ctx.shutdown()
             _global_ctx = None

@@ -130,6 +130,7 @@ class StandardAutoscaler:
         self,
         config: AutoscalerConfig,
         provider: NodeProvider,
+        load_fn=None,
     ):
         self.config = config
         self.provider = provider
@@ -137,11 +138,17 @@ class StandardAutoscaler:
         self._idle_since: dict[str, float] = {}
         self._owned_types: dict[str, str] = {}  # node_id -> node_type name
         self._thread: Optional[threading.Thread] = None
+        # Load source: default reads through the driver's global context;
+        # a standalone monitor injects its own controller client.
+        self._load_fn = load_fn
 
     # -- one reconciliation step (pure-ish, test-drivable) ---------------
     def update(self) -> dict:
-        ctx = worker_mod.get_global_context()
-        load = ctx.io.run(ctx.controller.call("get_load", {}))
+        if self._load_fn is not None:
+            load = self._load_fn()
+        else:
+            ctx = worker_mod.get_global_context()
+            load = ctx.io.run(ctx.controller.call("get_load", {}))
         demands = load["pending_demands"]
         alive = [n for n in load["nodes"] if n["alive"]]
         node_avail = [dict(n["resources_available"]) for n in alive]

@@ -1,0 +1,169 @@
+"""Autoscaler monitor — the bootstrap-launched scaling loop.
+
+Role-equivalent of python/ray/autoscaler/_private/monitor.py :: Monitor
+(SURVEY §2.3): the process/thread the HEAD starts so a cluster
+autoscales without any user code constructing an autoscaler. Wired from
+``ray_tpu.init(autoscaling=...)`` and ``ray_tpu start --head
+--autoscaler=v2`` (scripts.py); publishes its status to the controller
+KV (namespace ``_autoscaler``) where the dashboard's /api/autoscaler
+reads it.
+
+Providers: "podslice" (AutoscalerV2 over PodSliceProvider — the TPU
+slice-granular policy) or "v1" (StandardAutoscaler over NodeProvider).
+In this image the capacity backend is the in-process LocalCluster (real
+node agents); a cloud deployment subclasses the provider and everything
+above it is unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Optional
+
+
+class _LocalClusterBackend:
+    """Adapts _private.node.LocalCluster to the add/remove surface the
+    providers expect (cluster_utils.Cluster keeps its own wrapper —
+    this one exists so init() can hand the monitor its OWN head
+    cluster without import cycles)."""
+
+    def __init__(self, local_cluster):
+        self._cluster = local_cluster
+        self._agents: dict[str, Any] = {}
+
+    def add_node(self, resources=None, num_cpus=None, **_kw) -> str:
+        merged = dict(resources or {})
+        if num_cpus is not None and "CPU" not in merged:
+            merged["CPU"] = num_cpus
+        node_id = self._cluster.add_node(resources=merged)
+        self._agents[node_id] = self._cluster.agents[-1]
+        return node_id
+
+    def remove_node(self, node_id: str) -> None:
+        handle = self._agents.pop(node_id, None)
+        if handle is not None:
+            handle.kill()
+
+
+class AutoscalerMonitor:
+    """Runs the chosen autoscaler on an interval + reports its status."""
+
+    def __init__(
+        self,
+        *,
+        version: str = "v2",
+        provider: Any = "podslice",
+        cluster: Any = None,
+        idle_timeout_s: float = 60.0,
+        max_slices: int = 8,
+        update_interval_s: float = 1.0,
+        call_fn=None,
+        node_types: list | None = None,
+    ):
+        self.version = version
+        self.update_interval_s = update_interval_s
+        self._call = call_fn or _driver_call
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_status: dict = {}
+
+        load_fn = lambda: self._call("get_load", {})  # noqa: E731
+        if version == "v2":
+            from ray_tpu.autoscaler.v2 import AutoscalerV2, PodSliceProvider
+
+            if provider == "podslice" or provider is None:
+                provider = PodSliceProvider(cluster=cluster)
+            self.autoscaler = AutoscalerV2(
+                provider,
+                idle_timeout_s=idle_timeout_s,
+                max_slices=max_slices,
+                load_fn=load_fn,
+            )
+        elif version == "v1":
+            from ray_tpu.autoscaler.autoscaler import (
+                AutoscalerConfig, NodeProvider, StandardAutoscaler,
+            )
+
+            if provider in ("podslice", None):
+                provider = NodeProvider(cluster=cluster)
+            config = AutoscalerConfig(
+                node_types=node_types or [],
+                idle_timeout_s=idle_timeout_s,
+            )
+            self.autoscaler = StandardAutoscaler(
+                config, provider, load_fn=load_fn
+            )
+        else:
+            raise ValueError(f"unknown autoscaler version {version!r}")
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "AutoscalerMonitor":
+        self._thread = threading.Thread(
+            target=self._loop, name="autoscaler-monitor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                report = self.autoscaler.update()
+                self.last_status = {
+                    "version": self.version,
+                    "ts": time.time(),
+                    **report,
+                }
+            except Exception as exc:  # cluster shutting down, load race…
+                self.last_status = {
+                    "version": self.version,
+                    "ts": time.time(),
+                    "error": str(exc)[:500],
+                }
+            # Publish error statuses too: an operator watching
+            # /api/autoscaler must see a broken autoscaler, not the last
+            # healthy snapshot with an old timestamp.
+            self._publish(self.last_status)
+            self._stopped.wait(self.update_interval_s)
+
+    def _publish(self, status: dict) -> None:
+        try:
+            self._call(
+                "kv_put",
+                {
+                    "namespace": "_autoscaler",
+                    "key": "status",
+                    "value": json.dumps(status).encode(),
+                },
+            )
+        except Exception:
+            pass
+
+
+def _driver_call(method: str, payload: dict):
+    from ray_tpu._private import worker as worker_mod
+
+    ctx = worker_mod.get_global_context()
+    return ctx.io.run(ctx.controller.call(method, payload))
+
+
+def start_monitor_from_config(
+    autoscaling, local_cluster=None
+) -> AutoscalerMonitor:
+    """Build + start a monitor from init()/scripts bootstrap config:
+    ``autoscaling`` is "v1"/"v2" or a dict of AutoscalerMonitor kwargs
+    (version/provider/idle_timeout_s/max_slices/update_interval_s)."""
+    if isinstance(autoscaling, str):
+        autoscaling = {"version": autoscaling}
+    kwargs = dict(autoscaling or {})
+    kwargs.setdefault("version", "v2")
+    cluster = kwargs.pop("cluster", None)
+    if cluster is None and local_cluster is not None:
+        cluster = _LocalClusterBackend(local_cluster)
+    return AutoscalerMonitor(cluster=cluster, **kwargs).start()

@@ -221,11 +221,16 @@ class AutoscalerV2:
         idle_timeout_s: float = 60.0,
         max_slices: int = 8,
         update_interval_s: float = 1.0,
+        load_fn=None,
     ):
         self.manager = InstanceManagerV2(provider)
         self.idle_timeout_s = idle_timeout_s
         self.max_slices = max_slices
         self.update_interval_s = update_interval_s
+        # Load source: default reads through the driver's global context;
+        # a standalone monitor (bootstrap-launched, no driver) injects its
+        # own controller client here.
+        self._load_fn = load_fn
         self._pg_slices: dict[str, str] = {}  # pg_id -> slice_id
         self._slice_idle_since: dict[str, float] = {}
         self._stopped = threading.Event()
@@ -240,8 +245,11 @@ class AutoscalerV2:
         return None
 
     def update(self) -> dict:
-        ctx = worker_mod.get_global_context()
-        load = ctx.io.run(ctx.controller.call("get_load", {}))
+        if self._load_fn is not None:
+            load = self._load_fn()
+        else:
+            ctx = worker_mod.get_global_context()
+            load = ctx.io.run(ctx.controller.call("get_load", {}))
         alive = {n["node_id"] for n in load["nodes"] if n["alive"]}
         node_info = {n["node_id"]: n for n in load["nodes"] if n["alive"]}
 

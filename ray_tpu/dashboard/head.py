@@ -194,6 +194,7 @@ class DashboardHead:
         app.router.add_get("/api/serve", self._serve_state)
         app.router.add_get("/api/workers", self._workers)
         app.router.add_get("/api/grafana_dashboard", self._grafana)
+        app.router.add_get("/api/autoscaler", self._autoscaler)
         app.router.add_get("/metrics", self._metrics)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
@@ -221,6 +222,31 @@ class DashboardHead:
         total = await asyncio.to_thread(ray_tpu.cluster_resources)
         available = await asyncio.to_thread(ray_tpu.available_resources)
         return web.json_response({"total": total, "available": available})
+
+    async def _autoscaler(self, request):
+        """Latest monitor status (the bootstrap-launched autoscaler
+        publishes to the controller KV, namespace _autoscaler)."""
+        import json as _json
+
+        from aiohttp import web
+
+        def read():
+            from ray_tpu._private import worker as worker_mod
+
+            ctx = worker_mod.get_global_context()
+            resp = ctx.io.run(
+                ctx.controller.call(
+                    "kv_get", {"namespace": "_autoscaler", "key": "status"}
+                )
+            )
+            if resp.get("status") != "ok":
+                return {"enabled": False}
+            value = resp["value"]
+            if isinstance(value, (bytes, bytearray, memoryview)):
+                value = bytes(value).decode()
+            return {"enabled": True, **_json.loads(value)}
+
+        return web.json_response(await asyncio.to_thread(read))
 
     async def _nodes(self, request):
         from aiohttp import web

@@ -29,7 +29,18 @@ def cmd_start(args) -> None:
               "cluster_utils or the autoscaler", file=sys.stderr)
         sys.exit(2)
     resources = json.loads(args.resources) if args.resources else {}
-    ray_tpu.init(num_cpus=args.num_cpus, resources=resources)
+    autoscaling = None
+    if args.autoscaler:
+        autoscaling = {
+            "version": args.autoscaler,
+            "provider": args.provider,
+            "idle_timeout_s": args.autoscaler_idle_timeout,
+        }
+    ray_tpu.init(
+        num_cpus=args.num_cpus, resources=resources, autoscaling=autoscaling
+    )
+    if autoscaling:
+        print(f"autoscaler {args.autoscaler} ({args.provider}) monitoring")
     from ray_tpu._private import worker as worker_mod
 
     controller = worker_mod.get_global_context().controller_addr
@@ -152,6 +163,15 @@ def main(argv=None) -> None:
     p.add_argument("--block", action="store_true")
     p.add_argument("--dashboard", action="store_true")
     p.add_argument("--dashboard-port", type=int, default=8265)
+    p.add_argument(
+        "--autoscaler", choices=["v1", "v2"], default=None,
+        help="launch the autoscaler monitor with the head",
+    )
+    p.add_argument(
+        "--provider", default="podslice",
+        help="autoscaler node provider (default: podslice)",
+    )
+    p.add_argument("--autoscaler-idle-timeout", type=float, default=60.0)
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("status")

@@ -173,3 +173,51 @@ def test_pending_slice_pg_scales_up_then_idle_slice_drains(ray_start_cluster):
             break
         time.sleep(0.5)
     assert len([n for n in ray_tpu.nodes() if n["alive"]]) == 1
+
+
+# ---------- bootstrap wiring (autoscaler/_private/monitor.py role) ----------
+
+def test_bootstrap_autoscaler_scales_pending_slice_pg():
+    """init(autoscaling=...) launches the monitor with the head: a
+    pending pod-slice PG scales up with NO test-side AutoscalerV2
+    construction, and the monitor's status lands in the controller KV
+    (where the dashboard's /api/autoscaler reads it)."""
+    import json
+
+    from ray_tpu._private import worker as worker_mod
+
+    ray_tpu.init(
+        num_cpus=2,
+        autoscaling={"version": "v2", "update_interval_s": 0.25,
+                     "idle_timeout_s": 300.0},
+    )
+    try:
+        assert worker_mod._autoscaler_monitor is not None
+        pg = placement_group(
+            tpu_slice_bundles("v4-8"), strategy="STRICT_SPREAD",
+            name="bootpg",
+        )
+        pg.ready(timeout=120)
+        row = next(
+            r for r in placement_group_table() if r["pg_id"] == pg.id
+        )
+        assert row["state"] == "CREATED"
+        # monitor status published to the controller KV
+        ctx = worker_mod.get_global_context()
+        deadline = time.monotonic() + 10
+        status = None
+        while time.monotonic() < deadline:
+            resp = ctx.io.run(ctx.controller.call(
+                "kv_get", {"namespace": "_autoscaler", "key": "status"}
+            ))
+            if resp.get("status") == "ok":
+                value = resp["value"]
+                if isinstance(value, (bytes, bytearray, memoryview)):
+                    value = bytes(value).decode()
+                status = json.loads(value)
+                break
+            time.sleep(0.2)
+        assert status is not None and status["version"] == "v2"
+        assert "instances" in status
+    finally:
+        ray_tpu.shutdown()
