@@ -438,21 +438,33 @@ class CompiledDAG:
             on_io_loop = asyncio.get_running_loop() is self._ctx.io.loop
         except RuntimeError:
             on_io_loop = False
-        try:
-            if on_io_loop or getattr(self._ctx, "_shutdown", False):
-                # Never block the io loop (a GC-triggered __del__ can run
-                # on ANY thread, including the loop itself): fire and
-                # forget — worker-side teardown is idempotent.
-                self._ctx.io.spawn(self._teardown_async())
-            else:
+        if on_io_loop or getattr(self._ctx, "_shutdown", False):
+            # Never block the io loop (a GC-triggered __del__ can run
+            # on ANY thread, including the loop itself): fire and
+            # forget — worker-side teardown is idempotent.
+            self._spawn_teardown()
+        else:
+            try:
                 self._ctx.io.run(self._teardown_async(), timeout=30)
+            except Exception:
+                pass
+
+    def _spawn_teardown(self) -> None:
+        """Fire-and-forget teardown that never leaks an unawaited
+        coroutine: if the io loop is already gone (interpreter/cluster
+        shutdown), the coroutine is closed instead of dropped — a dropped
+        one surfaces as a 'never awaited' RuntimeWarning, which the test
+        suite escalates to an error."""
+        coro = self._teardown_async()
+        try:
+            self._ctx.io.spawn(coro)
         except Exception:
-            pass
+            coro.close()
 
     def __del__(self):  # best-effort: a dropped DAG must not leak slots
         try:
             if not self._torn_down:
                 self._torn_down = True
-                self._ctx.io.spawn(self._teardown_async())
+                self._spawn_teardown()
         except Exception:
             pass
