@@ -1,0 +1,122 @@
+"""Multi-slice topology — meshes that span ICI domains over DCN.
+
+Role-equivalent of the reference's multi-node process-group layout
+(its NCCL world spanning hosts) re-designed for TPU multi-slice
+(SURVEY §2.9 multi-slice row, §5.8): a pod slice is one ICI domain;
+training across several slices rides the data-center network (DCN).
+The mesh must encode that boundary — collective-heavy axes (tp/sp/...)
+stay INSIDE a slice, cheap axes (dp gradient sync) cross slices — or
+XLA will happily route a tensor-parallel all-reduce over DCN.
+
+``SliceTopology`` builds exactly that mesh from a jax runtime whose
+processes span slices (jax.distributed): DCN axes outermost, ICI axes
+innermost, device order arranged [slice, in-slice] so any collective
+over an ICI axis touches one slice only. On the CPU twin
+(xla_force_host_platform_device_count per process), a process plays
+the role of a slice — the same code path the driver's dryrun and the
+2-process tests exercise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+
+def _group_by_domain(devices: Sequence[Any]) -> dict[int, list]:
+    """Group devices by ICI domain. Real multi-slice TPU runtimes expose
+    a distinguishing slice_index (several host processes share one
+    slice); when slice_index is absent or constant (CPU twin reports 0
+    on every device; single slice), the owning process is the domain."""
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    use_slice = len(slice_ids) > 1 and None not in slice_ids
+    groups: dict[int, list] = {}
+    for d in devices:
+        key = int(d.slice_index) if use_slice else int(d.process_index)
+        groups.setdefault(key, []).append(d)
+    return groups
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """Axis layout for a multi-slice mesh.
+
+    ici_axes — named axes laid out WITHIN a slice (tp/sp/fsdp...).
+    dcn_axes — named axes laid out ACROSS slices (usually {"dp": n}).
+
+    prod(dcn_axes) must equal the number of slices; prod(ici_axes) the
+    devices per slice.
+    """
+
+    ici_axes: Mapping[str, int]
+    dcn_axes: Mapping[str, int]
+
+    def __post_init__(self):
+        overlap = set(self.ici_axes) & set(self.dcn_axes)
+        if overlap:
+            raise ValueError(f"axes on both tiers: {sorted(overlap)}")
+        if not self.ici_axes or not self.dcn_axes:
+            raise ValueError("both ici_axes and dcn_axes must be non-empty")
+
+    @property
+    def num_slices(self) -> int:
+        return math.prod(self.dcn_axes.values())
+
+    @property
+    def devices_per_slice(self) -> int:
+        return math.prod(self.ici_axes.values())
+
+    def axis_names(self) -> tuple[str, ...]:
+        return (*self.dcn_axes.keys(), *self.ici_axes.keys())
+
+    def build_mesh(self, devices: Sequence[Any] | None = None):
+        """Mesh with DCN axes outermost over slice-grouped devices."""
+        import jax
+        from jax.sharding import Mesh
+
+        devices = list(devices if devices is not None else jax.devices())
+        groups = _group_by_domain(devices)
+        if len(groups) != self.num_slices:
+            raise ValueError(
+                f"topology wants {self.num_slices} slices "
+                f"(prod of dcn_axes), runtime has {len(groups)} "
+                f"ICI domains"
+            )
+        per = self.devices_per_slice
+        rows = []
+        for key in sorted(groups):
+            members = sorted(groups[key], key=lambda d: d.id)
+            if len(members) != per:
+                raise ValueError(
+                    f"slice {key} has {len(members)} devices, topology "
+                    f"wants {per} (prod of ici_axes)"
+                )
+            rows.append(members)
+        grid = np.array(rows, dtype=object).reshape(
+            *self.dcn_axes.values(), *self.ici_axes.values()
+        )
+        return Mesh(grid, self.axis_names())
+
+    # -- hierarchical collectives ---------------------------------------
+    def hierarchical_psum(self, x, *, ici: bool = True, dcn: bool = True):
+        """psum placed tier by tier (use inside shard_map over this
+        topology's mesh): reduce within the slice first (ICI), then
+        across slices (DCN) — the two-tier gradient sync. Axis order
+        makes the communication placement explicit instead of leaving
+        one flat psum's decomposition to the compiler."""
+        import jax
+
+        if ici:
+            for name in self.ici_axes:
+                x = jax.lax.psum(x, name)
+        if dcn:
+            for name in self.dcn_axes:
+                x = jax.lax.psum(x, name)
+        return x
+
+    def grad_sync_axes(self) -> tuple[str, ...]:
+        """The DCN axes a data-parallel gradient sync reduces over."""
+        return tuple(self.dcn_axes.keys())

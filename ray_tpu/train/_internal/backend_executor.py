@@ -33,6 +33,7 @@ def _start_session_fn(
     latest_checkpoint: Optional[Checkpoint],
     dataset_shards_per_rank: list[dict],
     mesh_axes: dict,
+    slice_topology=None,
 ) -> bool:
     ctx = TrainContext(
         world_size=gang_ctx.world_size,
@@ -45,6 +46,7 @@ def _start_session_fn(
         latest_checkpoint=latest_checkpoint,
         dataset_shards=dataset_shards_per_rank[gang_ctx.rank],
         mesh=mesh_axes,
+        slice_topology=slice_topology,
         collective_group=gang_ctx.group_name,
     )
     session = init_session(ctx, lambda: train_fn(dict(train_loop_config)))
@@ -99,6 +101,7 @@ class BackendExecutor:
             latest_checkpoint=latest_checkpoint,
             dataset_shards_per_rank=dataset_shards_per_rank,
             mesh_axes=dict(sc.mesh_axes),
+            slice_topology=sc.slice_topology,
         )
 
     def _form_gang(self) -> WorkerGang:
@@ -113,12 +116,18 @@ class BackendExecutor:
         from ray_tpu import exceptions
 
         sc = self.scaling_config
+        # Multi-slice: the gang shares one jax.distributed runtime so the
+        # training step is one XLA program over every slice's devices.
+        coordinator = "auto" if sc.slice_topology is not None else None
+        env_vars = dict(sc.worker_env) or None
         if not sc.elastic:
             return WorkerGang(
                 sc.total_workers,
                 resources_per_worker=sc.worker_resources(),
                 backend=self.backend,
                 placement_strategy=sc.placement_strategy,
+                coordinator=coordinator,
+                env_vars=env_vars,
             )
         last_exc: Exception | None = None
         for size in range(sc.total_workers, sc.min_workers - 1, -1):
@@ -129,6 +138,8 @@ class BackendExecutor:
                     backend=self.backend,
                     placement_strategy=sc.placement_strategy,
                     ready_timeout=sc.elastic_formation_timeout_s,
+                    coordinator=coordinator,
+                    env_vars=env_vars,
                 )
                 if size < sc.total_workers:
                     print(

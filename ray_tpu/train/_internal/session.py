@@ -32,6 +32,9 @@ class TrainContext:
     latest_checkpoint: Optional[Checkpoint] = None
     dataset_shards: dict = field(default_factory=dict)
     mesh: Any = None
+    # SliceTopology when the trainer runs multi-slice (DCN x ICI axes);
+    # worker loops pass it to jax_utils.build_mesh(topology=...).
+    slice_topology: Any = None
     collective_group: str = ""
 
     def get_world_size(self) -> int:

@@ -37,6 +37,17 @@ class ScalingConfig:
     chips_per_worker: int = 0
     topology: str | None = None
     mesh_axes: Mapping[str, int] = field(default_factory=dict)
+    # Multi-slice training (SURVEY §2.9 multi-slice row): a
+    # parallel.topology.SliceTopology composing cross-slice DCN axes
+    # with in-slice ICI axes. Workers read it from the train context
+    # and pass it to jax_utils.build_mesh(topology=...). Setting it
+    # makes the gang share ONE jax.distributed runtime (each worker
+    # process = one slice's host set).
+    slice_topology: Any = None
+    # Extra env vars for every gang worker (e.g. the CPU twin's
+    # XLA_FLAGS=--xla_force_host_platform_device_count=<n> so each
+    # worker process models one slice with n devices).
+    worker_env: Mapping[str, str] = field(default_factory=dict)
     resources_per_worker: Mapping[str, float] = field(default_factory=dict)
     placement_strategy: str = "SPREAD"
     # Bounded elasticity (reference: Train v2 min/max workers, SURVEY

@@ -220,11 +220,23 @@ class JaxTrainer(DataParallelTrainer):
     backend="xla" (default on real slices) assumes gang members joined one
     jax.distributed runtime — collectives happen inside jit on ICI.
     backend="ring" (tests / CPU) gives eager host-memory collectives.
+
+    ``topology=`` (a parallel.topology.SliceTopology) declares a
+    multi-slice layout — cross-slice DCN axes composed with in-slice ICI
+    axes; it reaches the workers via the train context
+    (get_context().slice_topology → jax_utils.build_mesh(topology=...)).
+    Implies the xla backend: the gang shares one jax.distributed runtime
+    whose processes span the slices.
     """
 
     _default_backend = "ring"
 
-    def __init__(self, *args, **kwargs):
+    def __init__(self, *args, topology=None, **kwargs):
         super().__init__(*args, **kwargs)
-        if self.scaling_config.use_tpu and kwargs.get("backend") is None:
+        if topology is not None:
+            self.scaling_config.slice_topology = topology
+        if (
+            self.scaling_config.use_tpu
+            or self.scaling_config.slice_topology is not None
+        ) and kwargs.get("backend") is None:
             self.backend = "xla"

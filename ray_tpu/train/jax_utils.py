@@ -14,13 +14,19 @@ from typing import Any, Iterable, Iterator
 import numpy as np
 
 
-def build_mesh(axes: dict[str, int] | None = None):
+def build_mesh(axes: dict[str, int] | None = None, topology=None):
     """Mesh over THIS jax runtime's devices. On a real multi-host gang
     (jax.distributed initialized) that is the whole slice; on the ring
-    backend it is the process-local devices. axes={} → 1-D "dp" mesh."""
+    backend it is the process-local devices. axes={} → 1-D "dp" mesh.
+
+    With ``topology`` (a parallel.topology.SliceTopology), the mesh
+    composes cross-slice DCN axes with in-slice ICI axes — the
+    multi-slice layout (JaxTrainer's ``topology=`` lands here)."""
     import jax
     from ray_tpu.parallel.mesh import MeshSpec
 
+    if topology is not None:
+        return topology.build_mesh()
     devices = jax.devices()
     if not axes:
         axes = {"dp": len(devices)}

@@ -1,0 +1,227 @@
+"""Multi-slice topology: DCN x ICI meshes over a 2-process CPU twin.
+
+SURVEY §2.9 multi-slice row + §5.8 topology note: a mesh that composes
+a cross-slice (DCN) data-parallel axis with an in-slice (ICI) tensor
+axis, built from a jax runtime whose processes span the slices
+(jax.distributed; each gang worker process models one slice with 4
+virtual CPU devices). Verifies:
+
+  * the mesh's ICI axis never crosses a process (slice) boundary;
+  * training with dp_cross_slice x tp_in_slice sharding produces
+    gradients identical to a single-process run of the same problem;
+  * hierarchical_psum (reduce within slice, then across) matches the
+    flat global sum — inside jit via shard_map.
+
+Reference role: the multi-node NCCL process-group layout tests, rebuilt
+for jax multi-slice meshes.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.gang import WorkerGang
+
+_SLICE_ENV = {
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    "JAX_PLATFORMS": "cpu",
+}
+
+
+@pytest.fixture(scope="module")
+def two_slice_gang(ray_start_shared):
+    gang = WorkerGang(
+        2, backend="xla", coordinator="auto", env_vars=_SLICE_ENV
+    )
+    yield gang
+    gang.shutdown()
+
+
+def _train_problem():
+    """Deterministic toy regression: y = x @ W_true, 16 rows."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    w_true = rng.normal(size=(8, 16)).astype(np.float32)
+    y = x @ w_true
+    w0 = rng.normal(size=(8, 16)).astype(np.float32) * 0.1
+    return x, y, w0
+
+
+def _make_step():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(w, x, y):
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+
+        loss, grad = jax.value_and_grad(loss_fn)(w)
+        return w - 0.05 * grad, loss
+
+    return step
+
+
+def _multislice_train(ctx):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.topology import SliceTopology
+
+    topo = SliceTopology(ici_axes={"tp": 4}, dcn_axes={"dp": 2})
+    mesh = topo.build_mesh()
+    devs = mesh.devices
+    # ICI axis must stay inside one process (slice); DCN axis crosses.
+    per_slice_procs = [
+        {d.process_index for d in devs[i].flat} for i in range(2)
+    ]
+    assert all(len(p) == 1 for p in per_slice_procs), per_slice_procs
+    assert {d.process_index for d in devs[:, 0].flat} == {0, 1}
+
+    x_np, y_np, w0_np = _train_problem()
+
+    def make_global(arr, spec):
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    # batch over the cross-slice dp axis; W's hidden dim over in-slice tp
+    x = make_global(x_np, P("dp", None))
+    y = make_global(y_np, P("dp", "tp"))
+    w = make_global(w0_np, P(None, "tp"))
+    step = _make_step()
+    losses = []
+    for _ in range(5):
+        w, loss = step(w, x, y)
+        losses.append(float(loss))
+    return {
+        "losses": losses,
+        "process_count": jax.process_count(),
+        "mesh_shape": dict(mesh.shape),
+    }
+
+
+def test_multislice_training_matches_single_process(two_slice_gang):
+    results = two_slice_gang.run(_multislice_train, timeout=180)
+    for res in results:
+        assert res["process_count"] == 2
+        assert res["mesh_shape"] == {"dp": 2, "tp": 4}
+
+    # single-process baseline on the driver (same problem, same steps)
+    import jax
+
+    x_np, y_np, w0_np = _train_problem()
+    step = _make_step()
+    w = jax.numpy.asarray(w0_np)
+    expected = []
+    for _ in range(5):
+        w, loss = step(w, jax.numpy.asarray(x_np), jax.numpy.asarray(y_np))
+        expected.append(float(loss))
+    for res in results:
+        np.testing.assert_allclose(res["losses"], expected, rtol=2e-4)
+
+
+def _hier_psum(ctx):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.topology import SliceTopology
+
+    topo = SliceTopology(ici_axes={"tp": 4}, dcn_axes={"dp": 2})
+    mesh = topo.build_mesh()
+    arr = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    x = jax.make_array_from_callback(
+        arr.shape, NamedSharding(mesh, P(("dp", "tp"), None)),
+        lambda idx: arr[idx],
+    )
+
+    @jax.jit
+    def total(x):
+        return shard_map(
+            lambda s: topo.hierarchical_psum(jnp.sum(s, axis=0)),
+            mesh=mesh,
+            in_specs=P(("dp", "tp"), None),
+            out_specs=P(),
+        )(x)
+
+    return np.asarray(jax.device_get(total(x)))
+
+
+def test_hierarchical_psum_matches_flat_sum(two_slice_gang):
+    results = two_slice_gang.run(_hier_psum, timeout=180)
+    expected = np.arange(8 * 3, dtype=np.float32).reshape(8, 3).sum(axis=0)
+    for res in results:
+        np.testing.assert_allclose(res, expected, rtol=1e-6)
+
+
+def test_topology_validation():
+    from ray_tpu.parallel.topology import SliceTopology
+
+    with pytest.raises(ValueError, match="both tiers"):
+        SliceTopology(ici_axes={"tp": 2}, dcn_axes={"tp": 2})
+    with pytest.raises(ValueError, match="non-empty"):
+        SliceTopology(ici_axes={}, dcn_axes={"dp": 2})
+    topo = SliceTopology(ici_axes={"tp": 2, "sp": 2}, dcn_axes={"dp": 2})
+    assert topo.num_slices == 2
+    assert topo.devices_per_slice == 4
+    assert topo.axis_names() == ("dp", "tp", "sp")
+    assert topo.grad_sync_axes() == ("dp",)
+
+
+def test_topology_rejects_mismatched_runtime():
+    """Driver-local: 8 local devices are ONE process → one ICI domain;
+    a 2-slice topology must refuse to build."""
+    from ray_tpu.parallel.topology import SliceTopology
+
+    topo = SliceTopology(ici_axes={"tp": 4}, dcn_axes={"dp": 2})
+    with pytest.raises(ValueError, match="ICI domains"):
+        topo.build_mesh()
+
+
+def test_jax_trainer_accepts_topology(ray_start_shared, tmp_path):
+    """JaxTrainer(topology=...) delivers the SliceTopology to every
+    worker's train context; the 2-worker gang (one process per slice,
+    4 virtual devices each) builds the composed mesh and trains."""
+    from ray_tpu import train
+    from ray_tpu.parallel.topology import SliceTopology
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.train import jax_utils
+
+        ctx = train.get_context()
+        topo = ctx.slice_topology
+        assert topo is not None
+        mesh = jax_utils.build_mesh(topology=topo)
+        assert dict(mesh.shape) == {"dp": 2, "tp": 4}
+        x_np, y_np, w0_np = _train_problem()
+
+        def mk(arr, spec):
+            return jax.make_array_from_callback(
+                arr.shape, NamedSharding(mesh, spec), lambda i: arr[i]
+            )
+
+        step = _make_step()
+        w = mk(w0_np, P(None, "tp"))
+        x = mk(x_np, P("dp", None))
+        y = mk(y_np, P("dp", "tp"))
+        loss = None
+        for _ in range(3):
+            w, loss = step(w, x, y)
+        train.report({"loss": float(loss)})
+
+    trainer = JaxTrainer(
+        loop,
+        topology=SliceTopology(ici_axes={"tp": 4}, dcn_axes={"dp": 2}),
+        scaling_config=ScalingConfig(num_workers=2, worker_env=_SLICE_ENV),
+        run_config=RunConfig(name="mslice", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert np.isfinite(result.metrics["loss"])
