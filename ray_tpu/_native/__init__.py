@@ -140,6 +140,60 @@ def load() -> ctypes.CDLL:
                 ctypes.c_int,
             ]
             lib.rt_list_conns.restype = ctypes.c_int
+            # --- object-transfer plane (push manager, N16) ---
+            lib.rt_push_object.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p,
+                ctypes.c_void_p, ctypes.c_uint64,
+            ]
+            lib.rt_push_object.restype = ctypes.c_int
+            lib.rt_transfer_take.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.rt_transfer_take.restype = ctypes.c_int
+            lib.rt_transfer_free.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            # --- native lease lane (raylet grant path, N9/N10) ---
+            lib.rt_lease_enable.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.rt_lease_adjust.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_int,
+            ]
+            lib.rt_lease_adjust.restype = ctypes.c_int
+            lib.rt_lease_pool_put.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.rt_lease_pool_pop.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            lib.rt_lease_pool_pop.restype = ctypes.c_int
+            lib.rt_lease_pool_remove.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            lib.rt_lease_pool_remove.restype = ctypes.c_int
+            lib.rt_lease_worker_ban.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            lib.rt_lease_worker_unban.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+            ]
+            lib.rt_lease_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_lease_forget.restype = ctypes.c_int
+            lib.rt_lease_next_event.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.rt_lease_next_event.restype = ctypes.c_int
+            lib.rt_lease_available_json.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.rt_lease_available_json.restype = ctypes.c_int
+            lib.rt_lease_stats.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ]
             _lib = lib
     return _lib
 

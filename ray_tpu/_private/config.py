@@ -46,6 +46,17 @@ class RayTpuConfig:
     object_transfer_chunk_bytes: int = _env(
         "object_transfer_chunk_bytes", 5 * 1024 * 1024
     )
+    # Push-based transfer (push_manager.cc role): owners proactively push
+    # large task args toward the consumer's node at submit time; pull
+    # stays the fallback. 0 disables.
+    push_transfers_enabled: int = _env("push_transfers_enabled", 1)
+    push_transfer_min_bytes: int = _env(
+        "push_transfer_min_bytes", 1024 * 1024
+    )
+    # Native lease lane (raylet grant path in C++, N9/N10): the agent's
+    # engine grants simple worker leases on its own thread. 0 disables
+    # (all leases take the asyncio handler).
+    native_lease_lane: int = _env("native_lease_lane", 1)
 
     # --- health / liveness (reference: health_check_* in ray_config_def.h) ---
     health_check_period_ms: int = _env("health_check_period_ms", 1000)

@@ -1651,6 +1651,54 @@ class CoreContext:
     # their done_event is set by _finish_record when the asyncio side
     # settles them.)
 
+    def _maybe_push_args(self, record: PendingTask, worker: LeasedWorker) -> None:
+        """Submit-time locality hints (push_manager.cc role): large SHM
+        args this driver owns that have no copy on the target worker's
+        node are pushed agent→agent (C++ chunk plane) while the task
+        travels — by the time the worker resolves its args, the bytes are
+        usually already local. Fire-and-forget: pull remains the
+        fallback."""
+        if not record.arg_refs:
+            return
+        cfg = global_config()
+        if not cfg.push_transfers_enabled:
+            return
+        target = tuple(worker.agent_addr or ())
+        if len(target) != 2 or target == tuple(self.agent_addr):
+            return
+        for rid in record.arg_refs:
+            state = self._objects.get(rid)
+            if (
+                state is None
+                or state.status != SHM
+                or state.size < cfg.push_transfer_min_bytes
+                or not state.locations
+            ):
+                continue
+            if any(
+                (loc.get("agent_host"), loc.get("agent_port")) == target
+                for loc in state.locations
+            ):
+                continue  # already local to the target node
+            self.io.spawn(self._push_hint(rid, state.locations[0], target))
+
+    async def _push_hint(self, object_id: str, src: dict, target: tuple) -> None:
+        try:
+            client = await self._client_for(
+                (src["agent_host"], src["agent_port"])
+            )
+            await client.call(
+                "push_object",
+                {
+                    "object_id": object_id,
+                    "target_host": target[0],
+                    "target_port": target[1],
+                },
+                timeout=60,
+            )
+        except Exception:
+            pass  # opportunistic: the pull path still serves the object
+
     async def _push_one(
         self, worker: LeasedWorker, queue: asyncio.Queue, record: PendingTask
     ) -> "LeasedWorker | None":
@@ -1662,6 +1710,7 @@ class CoreContext:
         task_id = spec["task_id"]
         record.attempts += 1
         self._running_tasks[task_id] = worker.client
+        self._maybe_push_args(record, worker)
         try:
             reply = await worker.client.call("push_task", spec)
         except (ConnectionLost, RpcError, OSError) as exc:

@@ -202,6 +202,18 @@ class NodeAgent:
         self.log_dir = os.path.join(session_dir, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
         os.makedirs(self.spill_dir, exist_ok=True)
+        # object-transfer plane (N16): agent→agent push clients + counters
+        # (counters surface in store_stats so tests can assert "no pull")
+        self._transfer_clients: dict[tuple, RpcClient] = {}
+        self.pull_chunks_served = 0
+        self.pushes_started = 0
+        self.pushes_received = 0
+        # native lease lane (N9/N10): engine handle when enabled; the C++
+        # table is then the single source of truth for non-bundle node
+        # resources; _native_leases mirrors grants via drained events.
+        self._native_lease = None
+        self._native_leases: dict[str, dict] = {}
+        self._default_env_hash = self._env_hash({})
 
     # ------------------------------------------------------------------
     async def start(self, port: int = 0) -> tuple:
@@ -209,7 +221,27 @@ class NodeAgent:
             self.store_socket, self.store_shm, self.store_capacity, self.spill_dir
         )
         self.server.route_object(self)
+        if hasattr(self.server, "route_push"):
+            # C++ object-transfer plane: the engine reassembles obj_chunk
+            # frames and posts ONE obj_complete per object (N16 push path).
+            self.server.route_push("obj_complete", self._on_obj_complete)
+            # native lease lane: resources freed in C++ wake Python's
+            # blocked lease requests immediately
+            self.server.route_push("lease_freed", self._on_lease_freed)
         bound = await self.server.start("127.0.0.1", port)
+        if global_config().native_lease_lane:
+            # Native lease lane (local_task_manager.cc grant role): the
+            # engine grants simple leases on its own thread; Python keeps
+            # the policy/slow paths and adjusts the same native counters.
+            try:
+                from ray_tpu._private.rpc import _NativeEngine
+
+                engine = _NativeEngine.for_running_loop()
+                self._native_lease = engine
+                self._lease_adjust_native(self.resources_available, +1)
+                engine.lib.rt_lease_enable(engine.handle, 1)
+            except Exception:
+                self._native_lease = None
         self.address = ("127.0.0.1", bound)
         self.controller = RpcClient(
             self.controller_addr, name="agent-to-controller", auto_reconnect=True
@@ -295,6 +327,12 @@ class NodeAgent:
                     continue
                 worker.death_reason = "oom"
                 worker.oom_rss = rss
+                if self._native_lease is not None:
+                    # never pool a dying worker: the engine's return path
+                    # must bounce this worker's lease back to Python
+                    self._native_lease.lib.rt_lease_worker_ban(
+                        self._native_lease.handle, worker.worker_id.encode()
+                    )
                 print(
                     f"[raytpu-agent] memory monitor killing worker "
                     f"{worker.worker_id} (rss={rss >> 20} MiB, "
@@ -376,6 +414,8 @@ class NodeAgent:
         while True:
             await asyncio.sleep(cfg.health_check_period_ms / 1000.0)
             try:
+                self._refresh_available_mirror()
+                self._drain_lease_events()
                 resp = await self.controller.call(
                     "heartbeat",
                     {
@@ -393,9 +433,72 @@ class NodeAgent:
                 await asyncio.sleep(1.0)
 
     # ------------------------------------------------------------------
-    # resource accounting
+    # resource accounting (native lease table when enabled — one source
+    # of truth shared with the engine's grant path)
     # ------------------------------------------------------------------
+    def _lease_adjust_native(
+        self, resources: dict, sign: int, check: bool = False
+    ) -> bool:
+        import ctypes
+
+        engine = self._native_lease
+        items = [(k, float(v)) for k, v in resources.items() if v > 0]
+        if not items:
+            return True
+        names = b"".join(k.encode() + b"\0" for k, _ in items)
+        deltas = (ctypes.c_double * len(items))(
+            *[sign * v for _, v in items]
+        )
+        return bool(
+            engine.lib.rt_lease_adjust(
+                engine.handle, names, deltas, len(items), 1 if check else 0
+            )
+        )
+
+    def _refresh_available_mirror(self) -> None:
+        """Pull the native table into self.resources_available (reporting
+        paths only; accounting always goes through the native adjust)."""
+        engine = self._native_lease
+        if engine is None:
+            return
+        import ctypes
+
+        buf = ctypes.create_string_buffer(16384)
+        n = engine.lib.rt_lease_available_json(engine.handle, buf, 16384)
+        if n > 0:
+            try:
+                native = json.loads(buf.value.decode())
+            except ValueError:
+                return
+            merged = dict(self.resources_available)
+            merged.update(native)
+            self.resources_available = merged
+
+    def _drain_lease_events(self) -> None:
+        """Reconcile native grants/returns into _native_leases (needed by
+        the bounced return path and worker-death cleanup)."""
+        engine = self._native_lease
+        if engine is None:
+            return
+        import ctypes
+
+        buf = ctypes.create_string_buffer(8192)
+        while True:
+            n = engine.lib.rt_lease_next_event(engine.handle, buf, 8192)
+            if n <= 0:
+                return
+            try:
+                event = json.loads(buf.value.decode())
+            except ValueError:
+                continue
+            if event.get("ev") == "grant":
+                self._native_leases[event["lease_id"]] = event
+            else:
+                self._native_leases.pop(event.get("lease_id"), None)
+
     def _try_consume(self, resources: dict, bundle_key: tuple | None) -> bool:
+        if bundle_key is None and self._native_lease is not None:
+            return self._lease_adjust_native(resources, -1, check=True)
         pool = (
             self.bundles[bundle_key]["available"]
             if bundle_key is not None and bundle_key in self.bundles
@@ -410,6 +513,13 @@ class NodeAgent:
         return True
 
     def _give_back(self, resources: dict, bundle_key: tuple | None) -> None:
+        if bundle_key is None and self._native_lease is not None:
+            self._lease_adjust_native(resources, +1)
+            for waiter in self._resource_waiters:
+                if not waiter.done():
+                    waiter.set_result(None)
+            self._resource_waiters.clear()
+            return
         if bundle_key is not None:
             bundle = self.bundles.get(bundle_key)
             # Bundle already released (PG teardown raced this worker/lease
@@ -424,6 +534,14 @@ class NodeAgent:
             for k, v in resources.items():
                 if v > 0:
                     pool[k] = pool.get(k, 0.0) + v
+        for waiter in self._resource_waiters:
+            if not waiter.done():
+                waiter.set_result(None)
+        self._resource_waiters.clear()
+
+    async def _on_lease_freed(self, conn, raw) -> None:
+        """The engine returned a native lease: its freed resources must
+        wake any Python-path request parked in _wait_for_resources."""
         for waiter in self._resource_waiters:
             if not waiter.done():
                 waiter.set_result(None)
@@ -447,6 +565,27 @@ class NodeAgent:
         """Reuse a live idle worker only when it belongs to the SAME job —
         its log-forwarding tasks and RAYTPU_JOB_ID were bound at spawn, so
         a cross-job handout would misroute stdout/err to the old driver."""
+        if (
+            self._native_lease is not None
+            and env_hash == self._default_env_hash
+        ):
+            # default-env idle workers live in the NATIVE pool (shared
+            # with the engine's grant path — one pool, no double-grant)
+            import ctypes
+
+            engine = self._native_lease
+            buf = ctypes.create_string_buffer(128)
+            while engine.lib.rt_lease_pool_pop(
+                engine.handle, job_id.encode(), buf, 128
+            ):
+                worker = self.workers.get(buf.value.decode())
+                if (
+                    worker is not None
+                    and worker.proc.returncode is None
+                    and worker.death_reason is None
+                ):
+                    return worker
+            return None
         pool = self.idle_workers.get(env_hash) or []
         for i in range(len(pool) - 1, -1, -1):
             candidate = pool[i]
@@ -551,6 +690,25 @@ class NodeAgent:
     async def _watch_worker(self, worker: WorkerProcess) -> None:
         code = await worker.proc.wait()
         self.workers.pop(worker.worker_id, None)
+        engine = self._native_lease
+        if engine is not None:
+            # purge from the engine's idle pool and release any native
+            # lease the dead worker still held; the ban (if any) can go —
+            # this worker_id will never be pooled again
+            engine.lib.rt_lease_pool_remove(
+                engine.handle, worker.worker_id.encode()
+            )
+            engine.lib.rt_lease_worker_unban(
+                engine.handle, worker.worker_id.encode()
+            )
+            self._drain_lease_events()
+            for lease_id, event in list(self._native_leases.items()):
+                if event.get("worker_id") == worker.worker_id:
+                    self._native_leases.pop(lease_id, None)
+                    engine.lib.rt_lease_forget(
+                        engine.handle, lease_id.encode()
+                    )
+                    self._give_back(event.get("resources", {}), None)
         self.death_info[worker.worker_id] = {
             "reason": worker.death_reason
             or ("intended" if worker.intended_exit else "crash"),
@@ -664,11 +822,44 @@ class NodeAgent:
     async def rpc_return_worker(self, conn, payload) -> dict:
         lease = self.leases.pop(payload["lease_id"], None)
         if lease is None:
-            return {"status": "unknown_lease"}
+            # Possibly a NATIVE lease bounced here (reusable=False kill
+            # path, or a lease granted by the engine for a worker that
+            # died): reconcile from the engine's event log.
+            self._drain_lease_events()
+            native = self._native_leases.pop(payload["lease_id"], None)
+            if native is None:
+                return {"status": "unknown_lease"}
+            engine = self._native_lease
+            if engine is not None:
+                engine.lib.rt_lease_forget(
+                    engine.handle, payload["lease_id"].encode()
+                )
+            self._give_back(native.get("resources", {}), None)
+            worker = self.workers.get(native.get("worker_id", ""))
+            if worker is not None and worker.proc.returncode is None:
+                # reusable leases never bounce — this is the kill path
+                worker.intended_exit = True
+                self._kill_worker_tree(worker)
+            return {"status": "ok"}
         self._give_back(lease.resources, lease.bundle_key)
         worker = lease.worker
         if worker.proc.returncode is None and not worker.actor_id:
             if payload.get("reusable", True) and worker.death_reason is None:
+                if (
+                    self._native_lease is not None
+                    and worker.env_hash == self._default_env_hash
+                    and worker.address is not None
+                ):
+                    # hand the warm worker to the engine's grant pool —
+                    # the next same-job lease never touches asyncio
+                    engine = self._native_lease
+                    engine.lib.rt_lease_pool_put(
+                        engine.handle, worker.worker_id.encode(),
+                        worker.job_id.encode(),
+                        worker.address[0].encode(),
+                        int(worker.address[1]),
+                    )
+                    return {"status": "ok"}
                 self.idle_workers.setdefault(
                     worker.env_hash, []
                 ).append(worker)
@@ -805,7 +996,8 @@ class NodeAgent:
         return {"status": "ok"}
 
     # ------------------------------------------------------------------
-    # RPC: object plane (chunked pull — object_manager.cc [N16])
+    # RPC: object plane (object_manager.cc [N16]: C++ push + chunked pull
+    # fallback)
     # ------------------------------------------------------------------
     async def rpc_pull_object_chunk(self, conn, payload) -> dict:
         object_id = payload["object_id"]
@@ -813,6 +1005,7 @@ class NodeAgent:
         if view is None:
             return {"status": "missing"}
         try:
+            self.pull_chunks_served += 1
             total = len(view)
             start = payload.get("offset", 0)
             end = min(start + payload.get("chunk", 5 * 1024 * 1024), total)
@@ -820,12 +1013,115 @@ class NodeAgent:
         finally:
             self.store.release(object_id)
 
+    async def rpc_push_object(self, conn, payload) -> dict:
+        """Push one of this node's objects to another node's agent
+        (push_manager.cc role): the C++ sender thread slices it into
+        obj_chunk frames — no per-chunk Python on either side. Replies
+        as soon as the transfer is queued; the pull path remains the
+        fallback if the transfer is dropped (budget/conn loss)."""
+        import ctypes
+
+        import numpy as np
+
+        from ray_tpu._private.rpc import _NativeEngine
+
+        object_id = payload["object_id"]
+        target = (payload["target_host"], payload["target_port"])
+        try:
+            engine = _NativeEngine.for_running_loop()
+        except Exception:
+            return {"status": "unsupported"}
+        view = self.store.get(object_id, timeout_ms=0)
+        if view is None:
+            return {"status": "missing"}
+        try:
+            client = self._transfer_clients.get(target)
+            if client is None or not client.connected:
+                client = RpcClient(
+                    target, name=f"xfer-to-{target[1]}"
+                )
+                await client.connect()
+                self._transfer_clients[target] = client
+            conn_id = getattr(client, "_conn_id", None)
+            if conn_id is None:
+                return {"status": "unsupported"}
+            buf = np.frombuffer(view, dtype=np.uint8)
+            # Executor thread: rt_push_object memcpys the whole object
+            # into the sender's job buffer — a multi-hundred-MB copy must
+            # not stall this event loop (engine.lib is CDLL: GIL released)
+            loop = asyncio.get_running_loop()
+            rc = await loop.run_in_executor(
+                None,
+                engine.lib.rt_push_object,
+                engine.handle, conn_id, object_id.encode(),
+                ctypes.c_void_p(buf.ctypes.data), len(view),
+            )
+            if rc != 0:
+                return {"status": "busy" if rc == -1 else "error"}
+            self.pushes_started += 1
+            return {"status": "ok", "size": len(view)}
+        finally:
+            self.store.release(object_id)
+
+    async def _on_obj_complete(self, conn, raw) -> None:
+        """One inbound object fully reassembled by the engine: land it in
+        this node's store and release the C++ buffer."""
+        import ctypes
+
+        from ray_tpu._private.rpc import _NativeEngine
+
+        object_id = bytes(raw).decode()
+        try:
+            engine = _NativeEngine.for_running_loop()
+            ptr = ctypes.c_void_p()
+            length = ctypes.c_uint64()
+            if engine.lib.rt_transfer_take(
+                engine.handle, object_id.encode(),
+                ctypes.byref(ptr), ctypes.byref(length),
+            ) != 0:
+                return
+            try:
+                data = (
+                    ctypes.c_ubyte * length.value
+                ).from_address(ptr.value)
+                try:
+                    # .cast("B"): ctypes views carry an endian-prefixed
+                    # format that memoryview slice-assign rejects
+                    self.store.put(object_id, memoryview(data).cast("B"))
+                except FileExistsError:
+                    pass
+                self.pushes_received += 1
+            finally:
+                engine.lib.rt_transfer_free(
+                    engine.handle, object_id.encode()
+                )
+        except Exception:
+            pass  # pull fallback still serves the object
+
     async def rpc_delete_object(self, conn, payload) -> dict:
         ok = self.store.delete(payload["object_id"])
         return {"status": "ok" if ok else "missing"}
 
     async def rpc_store_stats(self, conn, payload) -> dict:
-        return self.store.stats()
+        stats = self.store.stats()
+        stats["transfer"] = {
+            "pull_chunks_served": self.pull_chunks_served,
+            "pushes_started": self.pushes_started,
+            "pushes_received": self.pushes_received,
+        }
+        engine = self._native_lease
+        if engine is not None:
+            import ctypes
+
+            out = (ctypes.c_longlong * 4)()
+            engine.lib.rt_lease_stats(engine.handle, out)
+            stats["native_lease"] = {
+                "grants": int(out[0]),
+                "returns": int(out[1]),
+                "idle_workers": int(out[2]),
+                "active": int(out[3]),
+            }
+        return stats
 
     async def rpc_runtime_env_info(self, conn, payload) -> dict:
         return self.runtime_envs.cache_info()
@@ -866,6 +1162,7 @@ class NodeAgent:
         )
 
     async def rpc_node_info(self, conn, payload) -> dict:
+        self._refresh_available_mirror()
         return {
             "node_id": self.node_id,
             "resources_total": self.resources_total,

@@ -291,6 +291,11 @@ class _ServerDispatchMixin:
     def route(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
 
+    def route_push(self, channel: str, handler) -> None:
+        """Register an async handler(conn, raw_payload) for PUSH frames
+        arriving at this server (no reply is sent)."""
+        self._push_handlers[channel] = handler
+
     def route_object(self, obj: Any, prefix: str = "") -> None:
         """Register every ``rpc_<name>`` coroutine method of obj as <name>."""
         for attr in dir(obj):
@@ -358,7 +363,14 @@ class NativeServerConnection:
         if kind == REQ:
             spawn_task(self._server._dispatch(self, msgid, method,
                                               _decode_payload(raw)))
-        # REP/ERR/PUSH toward a server connection have no meaning here.
+            return
+        if kind == PUSH:
+            # Engine-originated notifications (e.g. obj_complete from the
+            # C++ object-transfer plane) and peer pushes toward a server.
+            handler = self._server._push_handlers.get(method)
+            if handler is not None:
+                spawn_task(handler(self, raw))
+        # REP/ERR toward a server connection have no meaning here.
 
 
 class NativeRpcServer(_ServerDispatchMixin):
@@ -367,6 +379,7 @@ class NativeRpcServer(_ServerDispatchMixin):
     def __init__(self, name: str = "rpc"):
         self.name = name
         self._handlers: dict[str, Handler] = {}
+        self._push_handlers: dict[str, Handler] = {}
         self.connections: set[NativeServerConnection] = set()
         self.on_disconnect: Callable[[Any], Awaitable[None]] | None = None
         self._engine: _NativeEngine | None = None
@@ -444,6 +457,7 @@ class AsyncioRpcServer(_ServerDispatchMixin):
     def __init__(self, name: str = "rpc"):
         self.name = name
         self._handlers: dict[str, Handler] = {}
+        self._push_handlers: dict[str, Handler] = {}
         self._server: asyncio.AbstractServer | None = None
         self.connections: set[AsyncioServerConnection] = set()
         self.on_disconnect: Callable[[Any], Awaitable[None]] | None = None

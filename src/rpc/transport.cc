@@ -31,8 +31,10 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -59,10 +61,19 @@ constexpr uint8_t kVersion = 1;
 constexpr uint8_t kReq = 0;
 constexpr uint8_t kRep = 1;
 constexpr uint8_t kErr = 2;
+constexpr uint8_t kPush = 3;
 constexpr uint8_t kInjected = 253;  // synthetic: rt_exec_inject wakeup
 constexpr uint8_t kAccepted = 254;
 constexpr uint8_t kClosed = 255;
 constexpr size_t kMaxFrame = 1u << 30;  // 1 GiB sanity bound
+
+// Object-transfer plane tuning (push_manager.cc / object_buffer_pool.cc
+// role): chunk size balances frame overhead against write batching; the
+// budgets bound memory held by in-flight transfers on each side.
+constexpr size_t kObjChunk = 1u << 20;            // 1 MiB per chunk frame
+constexpr size_t kOutboundBudget = 256u << 20;    // queued push jobs
+constexpr size_t kInboundBudget = 256u << 20;     // reassembly buffers
+constexpr size_t kConnBacklogCap = 32u << 20;     // per-conn wq high water
 
 struct Msg {
   long conn = 0;
@@ -71,6 +82,243 @@ struct Msg {
   std::string method;
   std::vector<uint8_t> payload;
 };
+
+// ---------------------------------------------------------------------------
+// Minimal msgpack scanning/emission for the native lease lane (the subset
+// the generic payload codec produces: maps, str, bin, numbers, bool, nil,
+// arrays). Reads by key, skips unknowns — version-skew safe like the
+// generated codecs.
+// ---------------------------------------------------------------------------
+namespace mp {
+
+struct Cur {
+  const uint8_t *p;
+  const uint8_t *end;
+  bool ok = true;
+  uint8_t take() {
+    if (p >= end) {
+      ok = false;
+      return 0;
+    }
+    return *p++;
+  }
+  uint8_t peek() {
+    if (p >= end) {
+      ok = false;
+      return 0;
+    }
+    return *p;
+  }
+  bool need(size_t n) {
+    if (size_t(end - p) < n) {
+      ok = false;
+      return false;
+    }
+    return true;
+  }
+  uint64_t be(size_t n) {
+    if (!need(n)) return 0;
+    uint64_t v = 0;
+    for (size_t i = 0; i < n; ++i) v = (v << 8) | *p++;
+    return v;
+  }
+};
+
+inline uint32_t map_header(Cur &c) {
+  uint8_t b = c.take();
+  if (!c.ok) return 0;
+  if ((b & 0xF0) == 0x80) return b & 0x0F;
+  if (b == 0xDE) return uint32_t(c.be(2));
+  if (b == 0xDF) return uint32_t(c.be(4));
+  c.ok = false;
+  return 0;
+}
+
+inline bool read_str(Cur &c, std::string *out) {
+  uint8_t b = c.take();
+  if (!c.ok) return false;
+  uint32_t n;
+  if ((b & 0xE0) == 0xA0) n = b & 0x1F;
+  else if (b == 0xD9) n = uint32_t(c.be(1));
+  else if (b == 0xDA) n = uint32_t(c.be(2));
+  else if (b == 0xDB) n = uint32_t(c.be(4));
+  else {
+    c.ok = false;
+    return false;
+  }
+  if (!c.need(n)) return false;
+  out->assign(reinterpret_cast<const char *>(c.p), n);
+  c.p += n;
+  return true;
+}
+
+inline bool read_number(Cur &c, double *out) {
+  uint8_t b = c.take();
+  if (!c.ok) return false;
+  if (b < 0x80) {
+    *out = b;
+    return true;
+  }
+  if (b >= 0xE0) {
+    *out = int8_t(b);
+    return true;
+  }
+  switch (b) {
+    case 0xCA: {
+      uint32_t v = uint32_t(c.be(4));
+      float f;
+      memcpy(&f, &v, 4);
+      *out = f;
+      return c.ok;
+    }
+    case 0xCB: {
+      uint64_t v = c.be(8);
+      double d;
+      memcpy(&d, &v, 8);
+      *out = d;
+      return c.ok;
+    }
+    case 0xCC: *out = double(c.be(1)); return c.ok;
+    case 0xCD: *out = double(c.be(2)); return c.ok;
+    case 0xCE: *out = double(c.be(4)); return c.ok;
+    case 0xCF: *out = double(c.be(8)); return c.ok;
+    case 0xD0: *out = double(int8_t(c.be(1))); return c.ok;
+    case 0xD1: *out = double(int16_t(c.be(2))); return c.ok;
+    case 0xD2: *out = double(int32_t(c.be(4))); return c.ok;
+    case 0xD3: *out = double(int64_t(c.be(8))); return c.ok;
+    default:
+      c.ok = false;
+      return false;
+  }
+}
+
+inline bool skip(Cur &c, int depth = 0) {
+  if (depth > 32) {
+    c.ok = false;
+    return false;
+  }
+  uint8_t b = c.take();
+  if (!c.ok) return false;
+  if (b < 0x80 || b >= 0xE0) return true;
+  if ((b & 0xF0) == 0x80) {
+    uint32_t n = b & 0x0F;
+    for (uint32_t i = 0; i < 2 * n; ++i)
+      if (!skip(c, depth + 1)) return false;
+    return true;
+  }
+  if ((b & 0xF0) == 0x90) {
+    uint32_t n = b & 0x0F;
+    for (uint32_t i = 0; i < n; ++i)
+      if (!skip(c, depth + 1)) return false;
+    return true;
+  }
+  if ((b & 0xE0) == 0xA0) {
+    uint32_t n = b & 0x1F;
+    if (!c.need(n)) return false;
+    c.p += n;
+    return true;
+  }
+  switch (b) {
+    case 0xC0:
+    case 0xC2:
+    case 0xC3:
+      return true;
+    case 0xC4:
+    case 0xD9: {
+      uint64_t n = c.be(1);
+      if (!c.need(n)) return false;
+      c.p += n;
+      return true;
+    }
+    case 0xC5:
+    case 0xDA: {
+      uint64_t n = c.be(2);
+      if (!c.need(n)) return false;
+      c.p += n;
+      return true;
+    }
+    case 0xC6:
+    case 0xDB: {
+      uint64_t n = c.be(4);
+      if (!c.need(n)) return false;
+      c.p += n;
+      return true;
+    }
+    case 0xCA: return c.need(4) && (c.p += 4, true);
+    case 0xCB: return c.need(8) && (c.p += 8, true);
+    case 0xCC:
+    case 0xD0: return c.need(1) && (c.p += 1, true);
+    case 0xCD:
+    case 0xD1: return c.need(2) && (c.p += 2, true);
+    case 0xCE:
+    case 0xD2: return c.need(4) && (c.p += 4, true);
+    case 0xCF:
+    case 0xD3: return c.need(8) && (c.p += 8, true);
+    case 0xDC: {
+      uint64_t n = c.be(2);
+      for (uint64_t i = 0; i < n; ++i)
+        if (!skip(c, depth + 1)) return false;
+      return true;
+    }
+    case 0xDD: {
+      uint64_t n = c.be(4);
+      for (uint64_t i = 0; i < n; ++i)
+        if (!skip(c, depth + 1)) return false;
+      return true;
+    }
+    case 0xDE: {
+      uint64_t n = c.be(2);
+      for (uint64_t i = 0; i < 2 * n; ++i)
+        if (!skip(c, depth + 1)) return false;
+      return true;
+    }
+    case 0xDF: {
+      uint64_t n = c.be(4);
+      for (uint64_t i = 0; i < 2 * n; ++i)
+        if (!skip(c, depth + 1)) return false;
+      return true;
+    }
+    default:
+      c.ok = false;
+      return false;
+  }
+}
+
+inline void emit_str(std::string &out, const std::string &s) {
+  size_t n = s.size();
+  if (n < 32) {
+    out.push_back(char(0xA0 | n));
+  } else if (n < 256) {
+    out.push_back(char(0xD9));
+    out.push_back(char(n));
+  } else {
+    out.push_back(char(0xDA));
+    out.push_back(char(n >> 8));
+    out.push_back(char(n));
+  }
+  out.append(s);
+}
+
+inline void emit_uint(std::string &out, uint64_t v) {
+  if (v < 128) {
+    out.push_back(char(v));
+  } else if (v < 256) {
+    out.push_back(char(0xCC));
+    out.push_back(char(v));
+  } else if (v < 65536) {
+    out.push_back(char(0xCD));
+    out.push_back(char(v >> 8));
+    out.push_back(char(v));
+  } else {
+    out.push_back(char(0xCE));
+    out.push_back(char(v >> 24));
+    out.push_back(char(v >> 16));
+    out.push_back(char(v >> 8));
+    out.push_back(char(v));
+  }
+}
+
+}  // namespace mp
 
 struct Conn {
   long id = 0;
@@ -125,6 +373,11 @@ class Engine {
     if (!running_.compare_exchange_strong(expected, false)) return;
     Wake();
     if (thread_.joinable()) thread_.join();
+    {
+      std::lock_guard<std::mutex> lock(push_mu_);
+      push_cv_.notify_all();
+    }
+    if (push_thread_.joinable()) push_thread_.join();
     {
       std::lock_guard<std::mutex> lock(mu_);
       for (auto &kv : conns_) CloseFd(*kv.second);
@@ -508,11 +761,608 @@ class Engine {
     return it == conn_calls_.end() ? 0 : int(it->second.size());
   }
 
+  // -------------------------------------------------------------------
+  // Object-transfer plane (src/ray/object_manager/{push_manager,
+  // object_buffer_pool}.cc role): whole objects move between nodes as
+  // obj_chunk PUSH frames sliced and reassembled entirely in C++ — the
+  // Python side sees ONE obj_complete message per object, never a
+  // per-chunk callback. A dedicated sender thread paces chunks against
+  // the connection's write backlog; byte budgets bound both pools.
+  // -------------------------------------------------------------------
+  struct PushJob {
+    long conn;
+    std::string oid;
+    std::string data;
+  };
+
+  struct InboundTransfer {
+    std::string data;
+    size_t received = 0;
+    std::chrono::steady_clock::time_point last_update;
+  };
+
+  // Queue one object for push. 0 = accepted; -1 = over budget (caller
+  // falls back to the pull path); -2 = engine stopping.
+  int PushObject(long conn_id, const char *oid, const uint8_t *data,
+                 uint64_t len) {
+    std::lock_guard<std::mutex> lock(push_mu_);
+    if (!running_.load()) return -2;
+    if (outbound_bytes_ + len > kOutboundBudget) return -1;
+    outbound_bytes_ += len;
+    push_jobs_.push_back(
+        PushJob{conn_id, std::string(oid),
+                std::string(reinterpret_cast<const char *>(data), len)});
+    if (!push_thread_.joinable()) {
+      push_thread_ = std::thread([this] { PushLoop(); });
+    }
+    push_cv_.notify_one();
+    return 0;
+  }
+
+  // Hand a completed inbound transfer's buffer to the caller (valid
+  // until TransferFree). 0 = ok, -1 = unknown/incomplete.
+  int TransferTake(const char *oid, const uint8_t **ptr, uint64_t *len) {
+    std::lock_guard<std::mutex> lock(xfer_mu_);
+    auto it = completed_.find(oid);
+    if (it == completed_.end()) return -1;
+    *ptr = reinterpret_cast<const uint8_t *>(it->second.data());
+    *len = it->second.size();
+    return 0;
+  }
+
+  void TransferFree(const char *oid) {
+    std::lock_guard<std::mutex> lock(xfer_mu_);
+    auto it = completed_.find(oid);
+    if (it != completed_.end()) {
+      inbound_bytes_ -= it->second.size();
+      completed_.erase(it);
+    }
+  }
+
+ private:
+  void PushLoop() {
+    while (true) {
+      PushJob job;
+      {
+        std::unique_lock<std::mutex> lock(push_mu_);
+        push_cv_.wait(lock, [&] {
+          return !push_jobs_.empty() || !running_.load();
+        });
+        if (!running_.load()) return;
+        job = std::move(push_jobs_.front());
+        push_jobs_.pop_front();
+      }
+      SendObject(job);
+      {
+        std::lock_guard<std::mutex> lock(push_mu_);
+        outbound_bytes_ -= job.data.size();
+      }
+    }
+  }
+
+  // Slice one object into obj_chunk frames. Payload layout:
+  // [u16 oid_len][oid][u64 offset][u64 total][chunk bytes].
+  void SendObject(const PushJob &job) {
+    const uint64_t total = job.data.size();
+    uint64_t offset = 0;
+    do {
+      uint64_t n = std::min<uint64_t>(kObjChunk, total - offset);
+      std::string payload;
+      payload.reserve(2 + job.oid.size() + 16 + n);
+      uint16_t oid_len = uint16_t(job.oid.size());
+      payload.append(reinterpret_cast<const char *>(&oid_len), 2);
+      payload.append(job.oid);
+      payload.append(reinterpret_cast<const char *>(&offset), 8);
+      payload.append(reinterpret_cast<const char *>(&total), 8);
+      payload.append(job.data.data() + offset, n);
+      // Pace against the conn's write backlog so one huge object cannot
+      // balloon the write queue (the buffer-pool bound on this side).
+      for (int spin = 0; running_.load() && spin < 5000; ++spin) {
+        long long dbg[6];
+        if (ConnDebug(job.conn, dbg) != 0) return;  // conn gone: abort
+        if (size_t(dbg[4]) < kConnBacklogCap) break;
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+      }
+      if (!running_.load()) return;
+      int rc = Send(job.conn, kPush, 0,
+                    reinterpret_cast<const uint8_t *>("obj_chunk"), 9,
+                    reinterpret_cast<const uint8_t *>(payload.data()),
+                    uint32_t(payload.size()),
+                    /*allow_inline=*/false);
+      if (rc != 0) return;  // conn closed mid-transfer: receiver times out
+      offset += n;
+    } while (offset < total);
+  }
+
+  // Engine thread: absorb one obj_chunk frame into the reassembly pool;
+  // returns the completion Msg to enqueue (or nullptr). In-flight
+  // transfers are keyed by (conn, oid): two senders can never interleave
+  // into one buffer, and an offset-0 chunk on an existing entry means
+  // the SAME sender restarted an aborted push (per-conn FIFO ordering),
+  // so the entry resets instead of double-counting.
+  Msg *HandleObjChunk(Msg *m) {
+    const uint8_t *p = m->payload.data();
+    size_t len = m->payload.size();
+    if (len < 18) {
+      delete m;
+      return nullptr;
+    }
+    uint16_t oid_len;
+    memcpy(&oid_len, p, 2);
+    if (size_t(2 + oid_len + 16) > len) {
+      delete m;
+      return nullptr;
+    }
+    std::string oid(reinterpret_cast<const char *>(p + 2), oid_len);
+    uint64_t offset, total;
+    memcpy(&offset, p + 2 + oid_len, 8);
+    memcpy(&total, p + 2 + oid_len + 8, 8);
+    const uint8_t *chunk = p + 2 + oid_len + 16;
+    size_t chunk_len = len - 2 - oid_len - 16;
+    std::string key = std::to_string(m->conn) + "#" + oid;
+    bool done = false;
+    {
+      std::lock_guard<std::mutex> lock(xfer_mu_);
+      auto it = inbound_.find(key);
+      if (it != inbound_.end() && offset == 0 && it->second.received > 0) {
+        // aborted attempt restarted on the same conn: start clean
+        it->second.received = 0;
+      }
+      if (it == inbound_.end()) {
+        if (inbound_bytes_ + total > kInboundBudget || total > kMaxFrame) {
+          delete m;  // over budget: drop; the pull path still works
+          return nullptr;
+        }
+        inbound_bytes_ += total;
+        it = inbound_.emplace(key, InboundTransfer{}).first;
+        it->second.data.resize(total);
+      }
+      InboundTransfer &t = it->second;
+      if (offset + chunk_len > t.data.size()) {
+        delete m;
+        return nullptr;
+      }
+      memcpy(&t.data[offset], chunk, chunk_len);
+      t.received += chunk_len;
+      t.last_update = std::chrono::steady_clock::now();
+      if (t.received >= t.data.size()) {
+        // move to the completed pool (keyed by oid alone — TransferTake's
+        // namespace); budget charge follows the bytes
+        completed_[oid] = std::move(t.data);
+        inbound_.erase(it);
+        done = true;
+      }
+    }
+    long conn = m->conn;
+    delete m;
+    if (!done) return nullptr;
+    auto *note = new Msg();
+    note->conn = conn;
+    note->kind = kPush;
+    note->method = "obj_complete";
+    note->payload.assign(oid.begin(), oid.end());
+    return note;
+  }
+
+  // Engine thread, called from the loop's idle tick: evict in-flight
+  // transfers that stopped making progress (aborted senders) so their
+  // budget charge is refunded — without this, aborted pushes would
+  // permanently consume the inbound budget and silently disable the
+  // push plane.
+  void SweepStaleTransfers() {
+    auto now = std::chrono::steady_clock::now();
+    std::lock_guard<std::mutex> lock(xfer_mu_);
+    for (auto it = inbound_.begin(); it != inbound_.end();) {
+      if (now - it->second.last_update > std::chrono::seconds(60)) {
+        inbound_bytes_ -= it->second.data.size();
+        it = inbound_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  std::mutex push_mu_;  // push_jobs_, outbound_bytes_, push_thread_ start
+  std::condition_variable push_cv_;
+  std::deque<PushJob> push_jobs_;
+  size_t outbound_bytes_ = 0;
+  std::thread push_thread_;
+  std::mutex xfer_mu_;  // inbound_, completed_, inbound_bytes_
+  std::unordered_map<std::string, InboundTransfer> inbound_;  // (conn#oid)
+  std::unordered_map<std::string, std::string> completed_;    // oid -> data
+  size_t inbound_bytes_ = 0;
+
+ public:
+  // -------------------------------------------------------------------
+  // Native lease lane (raylet local_task_manager.cc /
+  // cluster_resource_scheduler.cc grant path, N9/N10): when enabled by
+  // the node agent, simple worker-lease requests (default runtime env,
+  // no placement-group bundle) are granted and replied to ON THE ENGINE
+  // THREAD — resource accounting, idle-pool pop, reply encode — with
+  // zero asyncio involvement per lease. Anything else (spawn needed,
+  // bundles, custom envs, contention) falls through to the Python
+  // handler unchanged; scheduling *policy* stays Python-pluggable.
+  // The availability table is the single source of truth while enabled:
+  // Python's slow paths adjust it through LeaseAdjust.
+  // -------------------------------------------------------------------
+  struct IdleWorker {
+    std::string worker_id;
+    std::string job_id;
+    std::string host;
+    int port = 0;
+  };
+
+  void LeaseEnable(int on) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    lease_on_ = (on != 0);
+    lease_fast_.store(lease_on_, std::memory_order_release);
+  }
+
+  // Atomically apply name/delta pairs. check!=0: apply only if every
+  // resulting value stays >= -1e-9 (grant-style consume); returns 1 on
+  // success, 0 if the check failed.
+  int LeaseAdjust(const char *names, const double *deltas, int n,
+                  int check) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    const char *p = names;
+    std::vector<std::string> keys;
+    keys.reserve(n);
+    for (int i = 0; i < n; ++i) {
+      keys.emplace_back(p);
+      p += keys.back().size() + 1;
+    }
+    if (check) {
+      for (int i = 0; i < n; ++i) {
+        if (deltas[i] < 0 &&
+            lease_avail_[keys[i]] + deltas[i] < -1e-9) {
+          return 0;
+        }
+      }
+    }
+    for (int i = 0; i < n; ++i) lease_avail_[keys[i]] += deltas[i];
+    return 1;
+  }
+
+  void LeasePoolPut(const char *worker_id, const char *job_id,
+                    const char *host, int port) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    lease_idle_.push_back(IdleWorker{worker_id, job_id, host, port});
+  }
+
+  int LeasePoolPop(const char *job_id, char *out, int cap) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    for (auto it = lease_idle_.begin(); it != lease_idle_.end(); ++it) {
+      if (it->job_id == job_id) {
+        snprintf(out, cap, "%s", it->worker_id.c_str());
+        lease_idle_.erase(it);
+        return 1;
+      }
+    }
+    return 0;
+  }
+
+  int LeasePoolRemove(const char *worker_id) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    for (auto it = lease_idle_.begin(); it != lease_idle_.end(); ++it) {
+      if (it->worker_id == worker_id) {
+        lease_idle_.erase(it);
+        return 1;
+      }
+    }
+    return 0;
+  }
+
+  // Mark a worker unpoolable (Python's death_reason invariant: a dying
+  // worker must never be handed out again). The engine's return path
+  // drops banned workers instead of re-pooling them.
+  void LeaseWorkerBan(const char *worker_id) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    lease_banned_.insert(worker_id);
+    for (auto it = lease_idle_.begin(); it != lease_idle_.end(); ++it) {
+      if (it->worker_id == worker_id) {
+        lease_idle_.erase(it);
+        break;
+      }
+    }
+  }
+
+  void LeaseWorkerUnban(const char *worker_id) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    lease_banned_.erase(worker_id);
+  }
+
+  int LeaseForget(const char *lease_id) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    return lease_active_.erase(lease_id) ? 1 : 0;
+  }
+
+  // Drain one reconciliation event (JSON line) into buf; 0 = none.
+  int LeaseNextEvent(char *buf, int cap) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    if (lease_events_.empty()) return 0;
+    const std::string &ev = lease_events_.front();
+    int n = int(std::min(size_t(cap - 1), ev.size()));
+    memcpy(buf, ev.data(), n);
+    buf[n] = 0;
+    lease_events_.pop_front();
+    return n;
+  }
+
+  int LeaseAvailableJson(char *buf, int cap) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    std::string out = "{";
+    bool first = true;
+    for (auto &kv : lease_avail_) {
+      if (!first) out += ",";
+      first = false;
+      out += "\"" + kv.first + "\":" + std::to_string(kv.second);
+    }
+    out += "}";
+    int n = int(std::min(size_t(cap - 1), out.size()));
+    memcpy(buf, out.data(), n);
+    buf[n] = 0;
+    return n;
+  }
+
+  void LeaseStats(long long *out) {
+    std::lock_guard<std::mutex> lock(lease_mu_);
+    out[0] = lease_grants_;
+    out[1] = lease_returns_;
+    out[2] = (long long)lease_idle_.size();
+    out[3] = (long long)lease_active_.size();
+  }
+
+ private:
+  struct ActiveLease {
+    std::string worker_id;
+    std::string job_id;
+    std::string host;
+    int port = 0;
+    std::vector<std::pair<std::string, double>> resources;
+  };
+
+  struct LeaseScan {
+    std::vector<std::pair<std::string, double>> resources;
+    std::string job_id;
+    bool env_empty = true;     // runtime_env absent or {}
+    bool bundle_empty = true;  // bundle absent or nil
+    bool parse_ok = false;
+  };
+
+  static void ScanLeaseRequest(const uint8_t *data, size_t len,
+                               LeaseScan *out) {
+    mp::Cur c{data, data + len};
+    uint32_t n = mp::map_header(c);
+    if (!c.ok) return;
+    for (uint32_t i = 0; i < n && c.ok; ++i) {
+      std::string key;
+      if (!mp::read_str(c, &key)) return;
+      if (key == "resources") {
+        uint32_t rn = mp::map_header(c);
+        if (!c.ok) return;
+        for (uint32_t r = 0; r < rn; ++r) {
+          std::string name;
+          double value;
+          if (!mp::read_str(c, &name) || !mp::read_number(c, &value))
+            return;
+          out->resources.emplace_back(std::move(name), value);
+        }
+      } else if (key == "job_id") {
+        if (!mp::read_str(c, &out->job_id)) return;
+      } else if (key == "runtime_env") {
+        if (c.peek() == 0xC0) {
+          c.take();
+        } else if (c.peek() == 0x80) {
+          c.take();
+        } else {
+          out->env_empty = false;
+          if (!mp::skip(c)) return;
+        }
+      } else if (key == "bundle") {
+        if (c.peek() == 0xC0) {
+          c.take();
+        } else {
+          out->bundle_empty = false;
+          if (!mp::skip(c)) return;
+        }
+      } else {
+        if (!mp::skip(c)) return;
+      }
+    }
+    out->parse_ok = c.ok;
+  }
+
+  static std::string JsonEscape(const std::string &s) {
+    std::string out;
+    for (char ch : s) {
+      if (ch == '"' || ch == '\\') out.push_back('\\');
+      out.push_back(ch);
+    }
+    return out;
+  }
+
+  // Engine thread: try to grant/return natively. True = handled (reply
+  // sent, msg freed; *note may carry a lease_freed push for Python's
+  // resource waiters); false = fall through to the Python handler.
+  bool TryLeaseFast(Msg *m, Msg **note) {
+    if (m->method == "lease_worker") {
+      LeaseScan scan;
+      ScanLeaseRequest(m->payload.data(), m->payload.size(), &scan);
+      if (!scan.parse_ok || !scan.env_empty || !scan.bundle_empty) {
+        return false;
+      }
+      std::string reply;
+      {
+        std::lock_guard<std::mutex> lock(lease_mu_);
+        if (!lease_on_) return false;
+        // all-or-nothing resource check
+        for (auto &kv : scan.resources) {
+          if (kv.second > 0 &&
+              lease_avail_[kv.first] + 1e-9 < kv.second) {
+            return false;  // Python path waits / reports busy
+          }
+        }
+        // job-matched idle worker
+        auto it = lease_idle_.begin();
+        for (; it != lease_idle_.end(); ++it) {
+          if (it->job_id == scan.job_id) break;
+        }
+        if (it == lease_idle_.end()) return false;  // spawn path
+        for (auto &kv : scan.resources) {
+          if (kv.second > 0) lease_avail_[kv.first] -= kv.second;
+        }
+        IdleWorker w = *it;
+        lease_idle_.erase(it);
+        std::string lease_id = "nlease-" + std::to_string(next_lease_++);
+        ActiveLease lease;
+        lease.worker_id = w.worker_id;
+        lease.job_id = scan.job_id;
+        lease.host = w.host;
+        lease.port = w.port;
+        lease.resources = scan.resources;
+        lease_active_[lease_id] = lease;
+        ++lease_grants_;
+        // reconciliation event for the Python agent
+        std::string ev = "{\"ev\":\"grant\",\"lease_id\":\"" + lease_id +
+                         "\",\"worker_id\":\"" + JsonEscape(w.worker_id) +
+                         "\",\"resources\":{";
+        bool first = true;
+        for (auto &kv : scan.resources) {
+          if (!first) ev += ",";
+          first = false;
+          ev += "\"" + JsonEscape(kv.first) +
+                "\":" + std::to_string(kv.second);
+        }
+        ev += "}}";
+        if (lease_events_.size() < 10000) lease_events_.push_back(ev);
+        // reply: {status, lease_id, worker_id, worker_addr:[host, port]}
+        reply.push_back(char(0x84));
+        mp::emit_str(reply, "status");
+        mp::emit_str(reply, "ok");
+        mp::emit_str(reply, "lease_id");
+        mp::emit_str(reply, lease_id);
+        mp::emit_str(reply, "worker_id");
+        mp::emit_str(reply, w.worker_id);
+        mp::emit_str(reply, "worker_addr");
+        reply.push_back(char(0x92));
+        mp::emit_str(reply, w.host);
+        mp::emit_uint(reply, uint64_t(w.port));
+      }
+      Send(m->conn, kRep, m->msgid,
+           reinterpret_cast<const uint8_t *>(m->method.data()),
+           uint32_t(m->method.size()),
+           reinterpret_cast<const uint8_t *>(reply.data()),
+           uint32_t(reply.size()));
+      delete m;
+      return true;
+    }
+    if (m->method == "return_worker") {
+      // parse {lease_id, reusable}
+      mp::Cur c{m->payload.data(), m->payload.data() + m->payload.size()};
+      uint32_t n = mp::map_header(c);
+      if (!c.ok) return false;
+      std::string lease_id;
+      bool reusable = true;
+      for (uint32_t i = 0; i < n && c.ok; ++i) {
+        std::string key;
+        if (!mp::read_str(c, &key)) return false;
+        if (key == "lease_id") {
+          if (!mp::read_str(c, &lease_id)) return false;
+        } else if (key == "reusable") {
+          uint8_t b = c.take();
+          if (b == 0xC2) reusable = false;
+          else if (b != 0xC3) return false;
+        } else {
+          if (!mp::skip(c)) return false;
+        }
+      }
+      if (!c.ok || lease_id.empty() || !reusable) return false;
+      {
+        std::lock_guard<std::mutex> lock(lease_mu_);
+        if (!lease_on_) return false;
+        auto it = lease_active_.find(lease_id);
+        if (it == lease_active_.end()) return false;  // Python-side lease
+        if (lease_banned_.count(it->second.worker_id)) {
+          // dying worker (Python set its death mark): bounce the whole
+          // return to Python, which gives back + kills — never re-pool
+          return false;
+        }
+        for (auto &kv : it->second.resources) {
+          if (kv.second > 0) lease_avail_[kv.first] += kv.second;
+        }
+        lease_idle_.push_back(IdleWorker{
+            it->second.worker_id, it->second.job_id, it->second.host,
+            it->second.port});
+        std::string ev = "{\"ev\":\"return\",\"lease_id\":\"" + lease_id +
+                         "\",\"worker_id\":\"" +
+                         JsonEscape(it->second.worker_id) + "\"}";
+        if (lease_events_.size() < 10000) lease_events_.push_back(ev);
+        lease_active_.erase(it);
+        ++lease_returns_;
+      }
+      std::string reply;
+      reply.push_back(char(0x81));
+      mp::emit_str(reply, "status");
+      mp::emit_str(reply, "ok");
+      // Wake Python's blocked lease requests: the freed resources were
+      // credited entirely in C++, so without this note a contended
+      // Python-path lease would sleep out its full wait timeout.
+      auto *freed = new Msg();
+      freed->conn = m->conn;
+      freed->kind = kPush;
+      freed->method = "lease_freed";
+      *note = freed;
+      Send(m->conn, kRep, m->msgid,
+           reinterpret_cast<const uint8_t *>(m->method.data()),
+           uint32_t(m->method.size()),
+           reinterpret_cast<const uint8_t *>(reply.data()),
+           uint32_t(reply.size()));
+      delete m;
+      return true;
+    }
+    return false;
+  }
+
+  std::mutex lease_mu_;
+  std::atomic<bool> lease_fast_{false};  // lock-free gate for RouteDecoded
+  bool lease_on_ = false;
+  std::map<std::string, double> lease_avail_;
+  std::deque<IdleWorker> lease_idle_;
+  std::unordered_map<std::string, ActiveLease> lease_active_;
+  std::deque<std::string> lease_events_;
+  std::unordered_set<std::string> lease_banned_;
+  uint64_t next_lease_ = 1;
+  long long lease_grants_ = 0;
+  long long lease_returns_ = 0;
+
+ public:
+
  private:
   // Engine thread: route freshly parsed frames. Native-call replies and
   // filtered exec requests are consumed here (never touch the Python
   // inbox); everything else lands in `rest` for the inbox.
   void RouteDecoded(std::vector<Msg *> &decoded, std::vector<Msg *> &rest) {
+    // Object chunks are absorbed here (engine thread) — Python sees one
+    // obj_complete per object, never per-chunk traffic.
+    for (auto *&m : decoded) {
+      if (m != nullptr && m->kind == kPush && m->method == "obj_chunk") {
+        m = HandleObjChunk(m);  // completion note or nullptr
+      }
+    }
+    // Native lease lane: grant/return simple worker leases right here
+    // (engine thread) when the agent enabled the table.
+    if (lease_fast_.load(std::memory_order_acquire)) {
+      for (auto *&m : decoded) {
+        if (m != nullptr && m->kind == kReq &&
+            (m->method == "lease_worker" ||
+             m->method == "return_worker")) {
+          Msg *note = nullptr;
+          if (TryLeaseFast(m, &note)) {
+            m = note;  // lease_freed push (or nullptr) rides to the inbox
+          }
+        }
+      }
+    }
     bool exec_on = exec_filter_on_.load(std::memory_order_acquire);
     std::vector<Msg *> to_exec;
     {
@@ -640,12 +1490,18 @@ class Engine {
 
   void Loop() {
     epoll_event events[128];
+    auto last_sweep = std::chrono::steady_clock::now();
     while (running_) {
       int n = epoll_wait(epfd_, events, 128, 500);
       if (!running_) break;
       if (n < 0) {
         if (errno == EINTR) continue;
         break;
+      }
+      auto now = std::chrono::steady_clock::now();
+      if (now - last_sweep > std::chrono::seconds(10)) {
+        last_sweep = now;
+        SweepStaleTransfers();
       }
       bool notified = false;
       for (int i = 0; i < n; ++i) {
@@ -1081,6 +1937,76 @@ int rt_exec_next(void *e, int timeout_ms, rt_msg_view *out) {
 
 void rt_exec_inject(void *e, uint32_t tag) {
   static_cast<raytpu::rpc::Engine *>(e)->ExecInject(tag);
+}
+
+// ---------------------------------------------------------------------------
+// Object-transfer plane: push whole objects as C++-sliced chunk frames.
+// ---------------------------------------------------------------------------
+int rt_push_object(void *e, long conn, const char *oid, const uint8_t *data,
+                   uint64_t len) {
+  return static_cast<raytpu::rpc::Engine *>(e)->PushObject(conn, oid, data,
+                                                           len);
+}
+
+int rt_transfer_take(void *e, const char *oid, const uint8_t **ptr,
+                     uint64_t *len) {
+  return static_cast<raytpu::rpc::Engine *>(e)->TransferTake(oid, ptr, len);
+}
+
+void rt_transfer_free(void *e, const char *oid) {
+  static_cast<raytpu::rpc::Engine *>(e)->TransferFree(oid);
+}
+
+// ---------------------------------------------------------------------------
+// Native lease lane (raylet grant path, N9/N10).
+// ---------------------------------------------------------------------------
+void rt_lease_enable(void *e, int on) {
+  static_cast<raytpu::rpc::Engine *>(e)->LeaseEnable(on);
+}
+
+int rt_lease_adjust(void *e, const char *names, const double *deltas, int n,
+                    int check) {
+  return static_cast<raytpu::rpc::Engine *>(e)->LeaseAdjust(names, deltas, n,
+                                                            check);
+}
+
+void rt_lease_pool_put(void *e, const char *worker_id, const char *job_id,
+                       const char *host, int port) {
+  static_cast<raytpu::rpc::Engine *>(e)->LeasePoolPut(worker_id, job_id,
+                                                      host, port);
+}
+
+int rt_lease_pool_pop(void *e, const char *job_id, char *out, int cap) {
+  return static_cast<raytpu::rpc::Engine *>(e)->LeasePoolPop(job_id, out,
+                                                             cap);
+}
+
+int rt_lease_pool_remove(void *e, const char *worker_id) {
+  return static_cast<raytpu::rpc::Engine *>(e)->LeasePoolRemove(worker_id);
+}
+
+void rt_lease_worker_ban(void *e, const char *worker_id) {
+  static_cast<raytpu::rpc::Engine *>(e)->LeaseWorkerBan(worker_id);
+}
+
+void rt_lease_worker_unban(void *e, const char *worker_id) {
+  static_cast<raytpu::rpc::Engine *>(e)->LeaseWorkerUnban(worker_id);
+}
+
+int rt_lease_forget(void *e, const char *lease_id) {
+  return static_cast<raytpu::rpc::Engine *>(e)->LeaseForget(lease_id);
+}
+
+int rt_lease_next_event(void *e, char *buf, int cap) {
+  return static_cast<raytpu::rpc::Engine *>(e)->LeaseNextEvent(buf, cap);
+}
+
+int rt_lease_available_json(void *e, char *buf, int cap) {
+  return static_cast<raytpu::rpc::Engine *>(e)->LeaseAvailableJson(buf, cap);
+}
+
+void rt_lease_stats(void *e, long long *out) {
+  static_cast<raytpu::rpc::Engine *>(e)->LeaseStats(out);
 }
 
 }  // extern "C"

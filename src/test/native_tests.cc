@@ -58,6 +58,20 @@ void rt_exec_inject(void *e, uint32_t tag);
 void *raytpu_store_start(const char *socket_path, const char *shm_path,
                          uint64_t capacity, const char *spill_dir);
 void raytpu_store_stop(void *handle);
+int rt_push_object(void *e, long conn, const char *oid, const uint8_t *data,
+                   uint64_t len);
+int rt_transfer_take(void *e, const char *oid, const uint8_t **ptr,
+                     uint64_t *len);
+void rt_transfer_free(void *e, const char *oid);
+void rt_lease_enable(void *e, int on);
+int rt_lease_adjust(void *e, const char *names, const double *deltas, int n,
+                    int check);
+void rt_lease_pool_put(void *e, const char *worker_id, const char *job_id,
+                       const char *host, int port);
+int rt_lease_pool_pop(void *e, const char *job_id, char *out, int cap);
+int rt_lease_pool_remove(void *e, const char *worker_id);
+int rt_lease_next_event(void *e, char *buf, int cap);
+void rt_lease_stats(void *e, long long *out);
 }
 
 namespace {
@@ -344,6 +358,151 @@ void test_call_table_conn_lost_and_stop() {
   std::printf("call table conn-lost + stop: ok\n");
 }
 
+void test_object_transfer_plane() {
+  // Push a multi-chunk object engine→engine; exactly one obj_complete
+  // notification; bytes identical; double-push + free are safe.
+  void *server = rt_engine_new();
+  int port = 0;
+  long listener = rt_listen_tcp(server, "127.0.0.1", 0, &port);
+  assert(listener >= 0);
+  void *client = rt_engine_new();
+  long conn = rt_connect_tcp(client, "127.0.0.1", port);
+  assert(conn > 0);
+
+  std::string data(3 * 1024 * 1024 + 12345, '\0');
+  for (size_t i = 0; i < data.size(); ++i) data[i] = char(i * 31);
+  assert(rt_push_object(client, conn, "oid-a",
+                        reinterpret_cast<const uint8_t *>(data.data()),
+                        data.size()) == 0);
+  rt_msg_view view{};
+  bool complete = false;
+  for (int waited = 0; waited < 10000 && !complete; ++waited) {
+    if (rt_next(server, &view)) {
+      if (view.kind == kAccepted || view.kind == kClosed) {
+        rt_msg_free(view.opaque);
+        continue;
+      }
+      assert(std::string(view.method, view.mlen) == "obj_complete");
+      assert(std::string(view.payload, view.plen) == "oid-a");
+      rt_msg_free(view.opaque);
+      complete = true;
+    } else {
+      usleep(1000);
+    }
+  }
+  assert(complete);
+  const uint8_t *ptr = nullptr;
+  uint64_t len = 0;
+  assert(rt_transfer_take(server, "oid-a", &ptr, &len) == 0);
+  assert(len == data.size());
+  assert(memcmp(ptr, data.data(), len) == 0);
+  rt_transfer_free(server, "oid-a");
+  assert(rt_transfer_take(server, "oid-a", &ptr, &len) == -1);
+  rt_transfer_free(server, "oid-a");  // double free: no-op
+  rt_engine_stop(client);
+  rt_engine_stop(server);
+  std::printf("object transfer plane: ok\n");
+}
+
+void test_lease_table_grant_and_return() {
+  // Drive the native lease lane end-to-end over a socket: enable the
+  // table on the server engine, seed resources + an idle worker, send a
+  // lease_worker REQ from a client and assert the ENGINE replied
+  // (status ok + the pooled worker), then return it and re-grant.
+  void *server = rt_engine_new();
+  int port = 0;
+  long listener = rt_listen_tcp(server, "127.0.0.1", 0, &port);
+  assert(listener >= 0);
+  void *client = rt_engine_new();
+  long conn = rt_connect_tcp(client, "127.0.0.1", port);
+  assert(conn > 0);
+
+  rt_lease_enable(server, 1);
+  const char names[] = "CPU\0";
+  double deltas[] = {4.0};
+  assert(rt_lease_adjust(server, names, deltas, 1, 0) == 1);
+  rt_lease_pool_put(server, "w-1", "job-9", "127.0.0.1", 7777);
+
+  // msgpack {"resources": {"CPU": 1.0}, "job_id": "job-9"}
+  std::string req;
+  req.push_back(char(0x82));
+  auto emit_str = [&](const char *s) {
+    size_t n = strlen(s);
+    req.push_back(char(0xA0 | n));
+    req.append(s, n);
+  };
+  emit_str("resources");
+  req.push_back(char(0x81));
+  emit_str("CPU");
+  req.push_back(char(0xCB));
+  uint64_t bits;
+  double one = 1.0;
+  memcpy(&bits, &one, 8);
+  for (int i = 7; i >= 0; --i) req.push_back(char(bits >> (8 * i)));
+  emit_str("job_id");
+  emit_str("job-9");
+
+  uint64_t h = rt_call_start(
+      client, conn, reinterpret_cast<const uint8_t *>("lease_worker"), 12,
+      reinterpret_cast<const uint8_t *>(req.data()), uint32_t(req.size()));
+  assert(h != 0);
+  rt_msg_view view{};
+  assert(rt_call_wait(client, h, 10000, &view) == 1);
+  std::string reply(view.payload, view.plen);
+  rt_msg_free(view.opaque);
+  assert(reply.find("\xa6status\xa2ok") != std::string::npos);
+  assert(reply.find("w-1") != std::string::npos);
+  // extract "nlease-1" (first grant id)
+  assert(reply.find("nlease-1") != std::string::npos);
+
+  // events: one grant line
+  char ev[512];
+  assert(rt_lease_next_event(server, ev, sizeof(ev)) > 0);
+  assert(strstr(ev, "\"grant\"") && strstr(ev, "nlease-1"));
+
+  // resources consumed
+  long long stats[4];
+  rt_lease_stats(server, stats);
+  assert(stats[0] == 1 && stats[2] == 0 && stats[3] == 1);
+
+  // return it (reusable): {"lease_id": "nlease-1", "reusable": true}
+  std::string ret;
+  ret.push_back(char(0x82));
+  {
+    auto emit2 = [&](const char *s) {
+      size_t n = strlen(s);
+      ret.push_back(char(0xA0 | n));
+      ret.append(s, n);
+    };
+    emit2("lease_id");
+    emit2("nlease-1");
+    emit2("reusable");
+    ret.push_back(char(0xC3));
+  }
+  h = rt_call_start(
+      client, conn, reinterpret_cast<const uint8_t *>("return_worker"), 13,
+      reinterpret_cast<const uint8_t *>(ret.data()), uint32_t(ret.size()));
+  assert(h != 0);
+  assert(rt_call_wait(client, h, 10000, &view) == 1);
+  rt_msg_free(view.opaque);
+  rt_lease_stats(server, stats);
+  assert(stats[1] == 1 && stats[2] == 1 && stats[3] == 0);
+
+  // pool pop by job works (and removes)
+  char out[64];
+  assert(rt_lease_pool_pop(server, "job-9", out, sizeof(out)) == 1);
+  assert(strcmp(out, "w-1") == 0);
+  assert(rt_lease_pool_pop(server, "job-9", out, sizeof(out)) == 0);
+
+  // consume-with-check fails when over budget
+  double too_much[] = {-100.0};
+  assert(rt_lease_adjust(server, names, too_much, 1, 1) == 0);
+
+  rt_engine_stop(client);
+  rt_engine_stop(server);
+  std::printf("lease table grant/return: ok\n");
+}
+
 }  // namespace
 
 int main() {
@@ -353,6 +512,8 @@ int main() {
   test_call_table_multithreaded();
   test_call_table_conn_lost_and_stop();
   test_store_lifecycle_and_garbage();
+  test_object_transfer_plane();
+  test_lease_table_grant_and_return();
   std::printf("ALL NATIVE TESTS PASSED\n");
   return 0;
 }
