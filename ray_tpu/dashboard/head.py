@@ -43,6 +43,7 @@ _INDEX_HTML = """<!doctype html>
  <a href="#" onclick="view='serveView';refresh();return false">serve</a>
  <a href="#" onclick="view='workers';refresh();return false">workers</a>
  <a href="#" onclick="view='logs';refresh();return false">logs</a>
+ <a href="#" onclick="view='autoscaler';refresh();return false">autoscaler</a>
  <a href="#" onclick="view='events';refresh();return false">events</a>
  <a href="/api/timeline">timeline</a>
  <a href="/metrics">metrics</a>
@@ -137,8 +138,26 @@ async function logs() {
   return '<h2>Session logs</h2>' + table(['file'],
     files.map(f => [`<a href="/api/logs/${encodeURIComponent(f)}">${esc(f)}</a>`]));
 }
+async function autoscaler() {
+  const s = await fetch('/api/autoscaler').then(r => r.json());
+  if (!s.enabled) return '<h2>Autoscaler</h2><div class="muted">not running ' +
+    '(start with ray_tpu.init(autoscaling="v2") or ray_tpu start --head --autoscaler=v2)</div>';
+  let html = `<h2>Autoscaler <span class="muted">${esc(s.version ?? '')}</span></h2>`;
+  html += `<div class="muted">last update ${esc(new Date((s.ts ?? 0) * 1000).toLocaleTimeString())}</div>`;
+  if (s.error) return html + `<div>monitor error: <code>${esc(s.error)}</code></div>`;
+  const inst = s.instances ?? {};
+  html += table(['instance state', 'count'],
+    Object.keys(inst).map(k => [esc(k), esc(inst[k])]));
+  html += table(['metric', 'value'],
+    [['slices requested (last update)', esc(s.slices_requested ?? '-')],
+     ['slices drained (last update)', esc(s.slices_drained ?? '-')],
+     ['launched', esc(s.launched ?? '-')], ['terminated', esc(s.terminated ?? '-')],
+     ['pending demands', esc(s.pending_demands ?? '-')]]);
+  return html;
+}
 async function refresh() {
-  const render = {overview, tasks, jobs, serveView, workers, logs, events}[view];
+  const render = {overview, tasks, jobs, serveView, workers, logs, events,
+                  autoscaler}[view];
   try { document.getElementById('content').innerHTML = await render(); }
   catch (err) { document.getElementById('content').innerHTML = 'error: ' + esc(err); }
 }

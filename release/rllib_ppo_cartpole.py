@@ -18,7 +18,7 @@ force_cpu()
 import time
 
 
-def main(target_return: float = 150.0, max_iters: int = 20):
+def main(target_return: float = 150.0, max_iters: int = 30):
     import bench_env
     if bench_env.smoke():
         target_return, max_iters = 40.0, 4

@@ -97,6 +97,11 @@ def main() -> int:
     with open(os.path.join(REPO, "release", "release_tests.yaml")) as fh:
         entries = yaml.safe_load(fh)
     env = dict(os.environ)
+    # Scripts live in release/ — python puts the SCRIPT dir on sys.path,
+    # not the cwd, so the package import needs the repo root explicitly.
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
     if smoke:
         env["RAY_TPU_RELEASE_SMOKE"] = "1"
 

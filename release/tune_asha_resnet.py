@@ -43,7 +43,12 @@ def trainable(config):
     # Learnable synthetic mapping: labels derived from the data so accuracy
     # can actually improve (measures the sweep, not the dataset).
     labels = (images.sum(axis=(1, 2, 3)) > 0).astype(np.int32)
-    for epoch in range(2 if __import__('bench_env').smoke() else 8):
+    import os
+
+    # env var, not bench_env: this function executes in WORKER processes
+    # where release/ is not importable
+    smoke_run = bool(os.environ.get("RAY_TPU_RELEASE_SMOKE"))
+    for epoch in range(2 if smoke_run else 8):
         for _ in range(4):
             params, opt_state, loss, acc = step(params, opt_state, images, labels)
         tune.report({"acc": float(acc), "loss": float(loss)})

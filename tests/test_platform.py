@@ -127,6 +127,10 @@ def test_dashboard_endpoints(ray_start_shared):
     assert isinstance(serve_state, dict)  # {} when nothing deployed
     workers = httpx.get(base + "/api/workers", timeout=30).json()
     assert isinstance(workers, list)
+    # autoscaler status endpoint (monitor not running in this fixture)
+    autoscaler = httpx.get(base + "/api/autoscaler", timeout=30).json()
+    assert autoscaler == {"enabled": False}
+    assert "autoscaler" in index.text  # drill-down nav entry
     # grafana_dashboard_factory role: importable dashboard JSON with one
     # panel per live metric family
     from ray_tpu.util import metrics as metrics_mod
