@@ -1,15 +1,33 @@
 """APPO — asynchronous PPO: IMPALA's pipeline + PPO's clipped surrogate.
 
-Role-equivalent of rllib/algorithms/appo/appo.py (SURVEY §2.8): env
-runners sample continuously (the IMPALA async harvest), V-trace corrects
-the off-policyness of stale fragments, and the policy update applies the
-PPO clipped surrogate over the V-trace advantages instead of IMPALA's
-plain policy gradient — bounded-step updates on an asynchronous data
-path. The whole SGD step remains one jitted XLA function.
+Role-equivalent of rllib/algorithms/appo/appo.py + appo_learner (SURVEY
+§2.8): env runners sample continuously (the IMPALA async harvest),
+V-trace corrects the off-policyness of stale fragments, and the policy
+update applies the PPO clipped surrogate over the V-trace advantages —
+bounded-step updates on an asynchronous data path. The reference APPO's
+stabilizers are both here:
+
+  * a TARGET NETWORK — a periodically-synced copy of the policy
+    (``target_network_update_freq`` updates per hard sync) that anchors
+    the KL regularizer, so many async minibatch steps cannot drift the
+    policy arbitrarily far between syncs;
+  * an ADAPTIVE KL LOSS (``use_kl_loss``/``kl_coeff``/``kl_target``) —
+    KL(target || current) joins the loss; the coefficient grows 1.5x
+    when measured KL exceeds 2x target and halves below 0.5x target
+    (the reference's adaptive schedule). In multi-learner DP mode the
+    KL loss and target sync stay active on the gradient path, but the
+    coefficient keeps its configured value (per-shard metrics don't
+    flow back there).
+
+The whole SGD step remains one jitted XLA function: the target
+network's distribution is computed by a separate jitted forward and
+rides the batch as constants, so the generic Learner step signature
+(params, opt_state, batch) is unchanged.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ray_tpu.rllib.algorithms.impala.impala import (
@@ -25,9 +43,48 @@ class APPOConfig(IMPALAConfig):
         super().__init__(algo_class or APPO)
         self.clip_param: float = 0.3
         self.lr = 5e-4
+        self.use_kl_loss: bool = True
+        self.kl_coeff: float = 0.2
+        self.kl_target: float = 0.01
+        self.target_network_update_freq: int = 4  # learner updates / sync
 
 
 class APPOLearner(IMPALALearner):
+    def __init__(self, module, config: dict, seed: int = 0):
+        super().__init__(module, config, seed)
+        self._use_kl = bool(config.get("use_kl_loss", True))
+        self._updates_since_sync = 0
+        self._kl_coeff = float(config.get("kl_coeff", 0.2))
+        if self._use_kl:
+            self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+            self._target_fwd = jax.jit(self.module.forward_train)
+        else:
+            self.target_params = None
+            self._target_fwd = None
+
+    def _inject_target(self, batch) -> None:
+        """Attach the target network's distribution (and the current KL
+        coefficient) to the batch as constants — shared by the local
+        update() and the DP-mode compute_gradients() path, so the KL
+        regularizer is active under both."""
+        target_out = self._target_fwd(self.target_params, batch[OBS])
+        if "logits" in target_out:
+            batch["target_logits"] = target_out["logits"]
+        else:
+            batch["target_mean"] = target_out["mean"]
+            batch["target_log_std"] = target_out["log_std"]
+        batch["kl_coeff"] = jnp.full((1,), self._kl_coeff)
+
+    def _maybe_sync_target(self) -> None:
+        self._updates_since_sync += 1
+        if self._updates_since_sync >= self.config.get(
+            "target_network_update_freq", 4
+        ):
+            self._updates_since_sync = 0
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params
+            )
+
     def compute_loss(self, params, batch: dict):
         cfg = self.config
         logp, entropy, vf = self.module.action_logp(
@@ -61,12 +118,106 @@ class APPOLearner(IMPALALearner):
             + cfg.get("vf_loss_coeff", 0.5) * vf_loss
             - cfg.get("entropy_coeff", 0.01) * entropy_mean
         )
-        return total, {
+        metrics = {
             "policy_loss": policy_loss,
             "vf_loss": vf_loss,
             "entropy": entropy_mean,
             "mean_ratio": jnp.mean(ratio),
         }
+        if "target_logits" in batch:
+            # KL(target || current) over the batch states (discrete)
+            current = self.module.forward_train(params, batch[OBS])
+            p_t = jax.nn.softmax(batch["target_logits"])
+            kl = jnp.mean(
+                jnp.sum(
+                    p_t
+                    * (
+                        jax.nn.log_softmax(batch["target_logits"])
+                        - jax.nn.log_softmax(current["logits"])
+                    ),
+                    axis=-1,
+                )
+            )
+            total = total + batch["kl_coeff"][0] * kl
+            metrics["kl"] = kl
+        elif "target_mean" in batch:
+            # diagonal-gaussian KL(target || current)
+            current = self.module.forward_train(params, batch[OBS])
+            t_mean, t_log_std = batch["target_mean"], batch["target_log_std"]
+            c_mean, c_log_std = current["mean"], current["log_std"]
+            kl = jnp.mean(
+                jnp.sum(
+                    c_log_std
+                    - t_log_std
+                    + (
+                        jnp.exp(2 * t_log_std)
+                        + (t_mean - c_mean) ** 2
+                    ) / (2 * jnp.exp(2 * c_log_std))
+                    - 0.5,
+                    axis=-1,
+                )
+            )
+            total = total + batch["kl_coeff"][0] * kl
+            metrics["kl"] = kl
+        return total, metrics
+
+    def update(self, batch) -> dict:
+        cfg = self.config
+        if self._use_kl:
+            # target distribution as batch constants (computed by a
+            # separate jitted forward — the main step signature stays
+            # (params, opt_state, batch))
+            self._inject_target(batch)
+        metrics = super().update(batch)
+        if "kl" in metrics:
+            # reference adaptive schedule: grow 1.5x / halve outside the
+            # [0.5, 2] x target band
+            kl = metrics["kl"]
+            target = cfg.get("kl_target", 0.01)
+            if kl > 2.0 * target:
+                self._kl_coeff = min(self._kl_coeff * 1.5, 1e3)
+            elif kl < 0.5 * target:
+                self._kl_coeff = max(self._kl_coeff * 0.5, 1e-6)
+            metrics["kl_coeff"] = self._kl_coeff
+        if self._use_kl:
+            self._maybe_sync_target()
+        return metrics
+
+    # DP mode (num_learners >= 2): shards flow through
+    # compute_gradients/apply_gradients, not update() — keep the KL
+    # regularizer and target sync active on that path too.
+    def compute_gradients(self, batch):
+        if self._use_kl:
+            self._inject_target(batch)
+        return super().compute_gradients(batch)
+
+    def apply_gradients(self, grads) -> None:
+        super().apply_gradients(grads)
+        if self._use_kl:
+            self._maybe_sync_target()
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        if self._use_kl:
+            state["target_params"] = jax.device_get(self.target_params)
+        state["kl_coeff"] = self._kl_coeff
+        state["updates_since_sync"] = self._updates_since_sync
+        return state
+
+    def set_state(self, state: dict) -> None:
+        super().set_state(state)
+        if self._use_kl:
+            if "target_params" in state:
+                self.target_params = jax.device_put(state["target_params"])
+            else:
+                # base-Learner-shaped checkpoint: anchor the target to the
+                # restored params rather than keeping fresh-init values
+                # (which would read as a huge KL until the first sync)
+                self.target_params = jax.tree_util.tree_map(
+                    jnp.copy, self.params
+                )
+        self._kl_coeff = float(state.get("kl_coeff", self._kl_coeff))
+        self._updates_since_sync = int(state.get("updates_since_sync", 0))
 
 
 class APPO(IMPALA):
@@ -74,5 +225,11 @@ class APPO(IMPALA):
 
     def _learner_config(self) -> dict:
         cfg = super()._learner_config()
-        cfg.update(clip_param=self.config.clip_param)
+        cfg.update(
+            clip_param=self.config.clip_param,
+            use_kl_loss=self.config.use_kl_loss,
+            kl_coeff=self.config.kl_coeff,
+            kl_target=self.config.kl_target,
+            target_network_update_freq=self.config.target_network_update_freq,
+        )
         return cfg

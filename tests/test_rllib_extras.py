@@ -868,3 +868,80 @@ def test_cql_requires_input():
 
     with pytest.raises(ValueError, match="offline_data"):
         CQLConfig().environment(_BanditEnv).build_algo()
+
+
+# ---------- APPO stabilizers (target network + adaptive KL) -----------------
+
+def test_appo_target_network_and_adaptive_kl():
+    """The reference APPO's stabilizers: KL(target||current) joins the
+    loss with an adaptively scheduled coefficient, and the target
+    network hard-syncs every target_network_update_freq updates."""
+    import gymnasium as gym
+    import jax
+
+    from ray_tpu.rllib.algorithms.appo.appo import APPOLearner
+    from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+    obs_space = gym.spaces.Box(-1, 1, (4,), dtype=np.float32)
+    act_space = gym.spaces.Discrete(2)
+    module = RLModuleSpec(model_config={"fcnet_hiddens": (16,)}).build(
+        obs_space, act_space
+    )
+    learner = APPOLearner(
+        module,
+        {"lr": 1e-2, "use_kl_loss": True, "kl_coeff": 0.2,
+         "kl_target": 1e-9,  # any post-step drift reads as "too high"
+         "target_network_update_freq": 3},
+    )
+    rng = np.random.default_rng(0)
+    n = 32
+
+    def make_batch():
+        return SampleBatch({
+            OBS: rng.normal(size=(n, 4)).astype(np.float32),
+            ACTIONS: rng.integers(0, 2, size=n),
+            "action_logp": np.full(n, -0.69, np.float32),
+            REWARDS: rng.normal(size=n).astype(np.float32),
+            "terminateds": np.zeros(n, bool),
+            "truncateds": np.zeros(n, bool),
+            "bootstrap_value": np.zeros(n, np.float32),
+        })
+
+    target_before = jax.device_get(learner.target_params)
+    m1 = learner.update(make_batch())
+    # first update: target == pre-step params, so KL is ~0 by construction
+    assert "kl" in m1 and np.isfinite(m1["kl"]) and m1["kl"] < 1e-6
+    # target params unchanged for the first two updates...
+    t_now = jax.device_get(learner.target_params)
+    leaves_a = jax.tree_util.tree_leaves(target_before)
+    leaves_b = jax.tree_util.tree_leaves(t_now)
+    assert all(np.allclose(a, b) for a, b in zip(leaves_a, leaves_b))
+    coeff_before_2 = learner._kl_coeff
+    m2 = learner.update(make_batch())
+    # second update: params drifted from the (stale) target -> kl > 0,
+    # far above the tiny target -> the coefficient grew
+    assert m2["kl"] > 0
+    assert learner._kl_coeff > coeff_before_2
+    learner.update(make_batch())  # 3rd update -> hard sync
+    t_synced = jax.device_get(learner.target_params)
+    p_now = jax.device_get(learner.params)
+    synced = jax.tree_util.tree_leaves(t_synced)
+    current = jax.tree_util.tree_leaves(p_now)
+    assert all(np.allclose(a, b) for a, b in zip(synced, current))
+    # ... and they now differ from the originals (training moved params)
+    assert not all(
+        np.allclose(a, b)
+        for a, b in zip(leaves_a, synced)
+    )
+    # adaptive schedule downward: huge target -> kl far below -> halve
+    learner2 = APPOLearner(
+        module,
+        {"lr": 1e-3, "use_kl_loss": True, "kl_coeff": 0.2,
+         "kl_target": 1e6, "target_network_update_freq": 100},
+    )
+    learner2.update(make_batch())
+    assert learner2._kl_coeff == pytest.approx(0.1)
+    # checkpoint round-trip carries the stabilizer state
+    state = learner.get_state()
+    learner2.set_state(state)
+    assert learner2._kl_coeff == learner._kl_coeff
