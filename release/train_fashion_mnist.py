@@ -73,7 +73,7 @@ def main():
     trainer = JaxTrainer(
         train_loop,
         train_loop_config={"lr": 1e-3, "batch_size": 64,
-                           "steps": bench_env.smoke_scale(30, 4)},
+                           "steps": bench_env.smoke_scale(30, 12)},
         scaling_config=ScalingConfig(num_workers=2),
         run_config=RunConfig(name="fmnist_bench"),
     )
