@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Run the full chaos/fault-injection suite, slow scenarios included.
+#
+# Tier-1 CI runs `pytest -m 'not slow'`, which covers the seeded <60s
+# smoke scenario; this script is the nightly/occasional companion that
+# also executes the long schedules (worker kill + 10s asymmetric
+# partition, partition-then-heal re-registration, typed replica-death
+# errors). Usage: ci/run_chaos.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== chaos suite (tier-1 subset) =="
+python -m pytest tests/test_chaos.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== chaos suite (slow scenarios) =="
+python -m pytest tests/test_chaos.py -q -m 'slow' \
+    -p no:cacheprovider "$@"
+
+echo "chaos suite: PASS"

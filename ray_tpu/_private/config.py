@@ -123,6 +123,10 @@ class RayTpuConfig:
     rpc_retry_max_attempts: int = _env("rpc_retry_max_attempts", 10)
 
     # --- testing / chaos (reference: RAY_testing_asio_delay_us) ---
+    # DEPRECATED alias: kept for compatibility, now interpreted by
+    # ray_tpu._private.chaos as a delay-only FaultSchedule applied
+    # client-side in both RPC backends. Prefer RAY_TPU_chaos (JSON
+    # FaultSchedule) / ray_tpu.util.chaos for anything richer.
     testing_rpc_delay_ms: int = _env("testing_rpc_delay_ms", 0)
 
     # --- metrics ---

@@ -33,10 +33,16 @@ import sys
 import time
 from typing import Any
 
+from ray_tpu._private import chaos
 from ray_tpu._private.config import global_config
 from ray_tpu._private.event_export import EventExporter
 from ray_tpu._private.ids import ActorID, PlacementGroupID
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection, spawn_task
+
+# Bounded dedup window for mutation idempotency tokens: big enough that a
+# client exhausting its chaos/reconnect retry budget is always still inside
+# the window, small enough to never matter for memory.
+MUTATION_CACHE_SIZE = 4096
 
 ACTOR_STATES = ("PENDING", "ALIVE", "RESTARTING", "DEAD")
 PG_STATES = ("PENDING", "CREATED", "REMOVED", "RESCHEDULING")
@@ -165,6 +171,15 @@ class Controller:
         self.pending_demands: dict[str, dict] = {}
         self.events = EventExporter(session_dir)
         self._rr = itertools.count()
+        # Idempotency-token reply cache for mutation RPCs: a client that
+        # retried after a dropped/duplicated reply (or a controller
+        # restart) gets the ORIGINAL reply back instead of re-applying
+        # the mutation (exactly-once effect over at-least-once delivery).
+        # Persisted in the snapshot so dedup survives a restart.
+        self._mutation_replies: collections.OrderedDict[str, dict] = (
+            collections.OrderedDict()
+        )
+        chaos.set_identity("controller")
         # Persistence (role-equivalent of the reference's
         # redis_store_client-backed GCS tables [N7]: restart the control
         # plane and the cluster survives). Snapshots are JSON (bytes
@@ -235,6 +250,28 @@ class Controller:
                 spawn_task(self._schedule_pg(pg))
 
     # ------------------------------------------------------------------
+    # mutation idempotency tokens
+    # ------------------------------------------------------------------
+    def _mutation_cached(self, payload) -> dict | None:
+        token = payload.get("mutation_token") if isinstance(payload, dict) else None
+        if token is None:
+            return None
+        reply = self._mutation_replies.get(token)
+        if reply is not None:
+            self._mutation_replies.move_to_end(token)
+        return reply
+
+    def _mutation_record(self, payload, reply: dict) -> dict:
+        token = payload.get("mutation_token") if isinstance(payload, dict) else None
+        if token is not None:
+            self._mutation_replies[token] = reply
+            self._mutation_replies.move_to_end(token)
+            while len(self._mutation_replies) > MUTATION_CACHE_SIZE:
+                self._mutation_replies.popitem(last=False)
+            self._mark_dirty()
+        return reply
+
+    # ------------------------------------------------------------------
     # persistence [N7]
     # ------------------------------------------------------------------
     def _mark_dirty(self) -> None:
@@ -273,6 +310,10 @@ class Controller:
             },
             "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
             "jobs": self.jobs,
+            # Token cache rides along so mutation dedup spans restarts: a
+            # client retrying across a controller crash still gets its
+            # original reply, not a re-application.
+            "mutations": list(self._mutation_replies.items()),
         }
         return json.dumps(_jsonify(state)).encode()
 
@@ -331,6 +372,8 @@ class Controller:
         for ns, kvs in state.get("kv", {}).items():
             self.kv[ns].update(kvs)
         self.jobs.update(state.get("jobs", {}))
+        for token, reply in state.get("mutations", []):
+            self._mutation_replies[token] = reply
         print(
             f"[controller] restored snapshot: {len(self.actors)} actors, "
             f"{len(self.pgs)} pgs, {sum(len(v) for v in self.kv.values())} kv keys",
@@ -347,6 +390,10 @@ class Controller:
                 continue
             self._dirty = False
             try:
+                # Chaos probe for the dirty-bit retry path below: an armed
+                # "controller.snapshot_save" fail-point loses the write
+                # exactly like a store outage between write and ack would.
+                chaos.failpoint("controller.snapshot_save")
                 blob = self._build_snapshot_blob()  # on-loop: consistent
                 # executor: an external store's socket write must not
                 # stall the control plane's event loop.
@@ -361,6 +408,7 @@ class Controller:
     async def _node_client(self, node: NodeInfo) -> RpcClient:
         if node.client is None or not node.client.connected:
             node.client = RpcClient(node.agent_addr, name=f"to-agent-{node.node_id[:10]}")
+            node.client.chaos_peer = f"node:{node.node_id}"
             await node.client.connect()
         return node.client
 
@@ -405,6 +453,11 @@ class Controller:
         # double-scheduled).
         live_entries = payload.get("live_actors") or []
         live = {e["actor_id"] if isinstance(e, dict) else e for e in live_entries}
+        # Ghost workers: a partitioned-then-healed node re-registers still
+        # hosting actors the controller failed over in the meantime (DEAD,
+        # or ALIVE again on a DIFFERENT node). Tell the agent so it kills
+        # them instead of serving two incarnations of one actor.
+        stale_actors: list[dict] = []
         for entry in live_entries:
             if not isinstance(entry, dict):
                 continue
@@ -417,6 +470,13 @@ class Controller:
                 actor.state = "ALIVE"
                 actor.ready_event.set()
                 self._mark_dirty()
+            elif actor is None or actor.state == "DEAD" or (
+                actor.state == "ALIVE" and actor.node_id != node.node_id
+            ):
+                stale_actors.append(
+                    {"actor_id": entry["actor_id"],
+                     "worker_id": entry.get("worker_id")}
+                )
         for actor in list(self.actors.values()):
             if (
                 actor.node_id == node.node_id
@@ -444,7 +504,7 @@ class Controller:
             spawn_task(self._release_stale_bundles(node, stale))
         await self.publish("node_added", node.snapshot())
         await self._retry_pending()
-        return {"status": "ok"}
+        return {"status": "ok", "stale_actors": stale_actors}
 
     async def _release_stale_bundles(self, node: NodeInfo, stale: list) -> None:
         try:
@@ -461,10 +521,17 @@ class Controller:
         node = self.nodes.get(payload["node_id"])
         if node is None:
             return {"status": "unknown_node"}
+        if not node.alive:
+            # The node was declared dead (partition outlasted the health
+            # timeout): its actors were failed over and its PG bundles
+            # rescheduled. Silently flipping alive=True here would leave
+            # it half-dead — carrying workers the controller no longer
+            # accounts to it and missing everything scheduled since.
+            # Make it re-register: the register path reconciles live
+            # actors/bundles and tells the agent which workers are stale.
+            return {"status": "reregister"}
         node.last_heartbeat = time.monotonic()
         node.resources_available = payload["resources_available"]
-        if not node.alive:
-            node.alive = True
         return {"status": "ok"}
 
     async def _health_check_loop(self) -> None:
@@ -555,13 +622,20 @@ class Controller:
     # KV [N6]
     # ------------------------------------------------------------------
     async def rpc_kv_put(self, conn, payload) -> dict:
+        # Without a token, a retried overwrite=False put whose first reply
+        # was dropped comes back "exists" — the caller can't tell its own
+        # earlier write from a genuine conflict. The cache returns the
+        # original "ok" instead.
+        cached = self._mutation_cached(payload)
+        if cached is not None:
+            return cached
         ns = payload.get("namespace", "default")
         overwrite = payload.get("overwrite", True)
         if not overwrite and payload["key"] in self.kv[ns]:
-            return {"status": "exists"}
+            return self._mutation_record(payload, {"status": "exists"})
         self.kv[ns][payload["key"]] = payload["value"]
         self._mark_dirty()
-        return {"status": "ok"}
+        return self._mutation_record(payload, {"status": "ok"})
 
     async def rpc_kv_get(self, conn, payload) -> dict:
         ns = payload.get("namespace", "default")
@@ -569,11 +643,16 @@ class Controller:
         return {"status": "ok" if value is not None else "missing", "value": value}
 
     async def rpc_kv_del(self, conn, payload) -> dict:
+        cached = self._mutation_cached(payload)
+        if cached is not None:
+            return cached
         ns = payload.get("namespace", "default")
         existed = self.kv[ns].pop(payload["key"], None) is not None
         if existed:
             self._mark_dirty()
-        return {"status": "ok", "existed": existed}
+        return self._mutation_record(
+            payload, {"status": "ok", "existed": existed}
+        )
 
     async def rpc_kv_keys(self, conn, payload) -> list:
         ns = payload.get("namespace", "default")
@@ -727,22 +806,34 @@ class Controller:
     # ------------------------------------------------------------------
     async def rpc_create_actor(self, conn, payload) -> dict:
         spec = payload
-        # Idempotent by actor_id: an auto-reconnect client may re-send a
-        # request the previous controller incarnation (or a dropped reply)
-        # already applied — never double-schedule.
+        # Idempotent twice over: the mutation token catches any re-send
+        # (dropped/duplicated reply, reconnect replay) without touching
+        # state, and the actor_id check backstops token-less callers —
+        # either way a duplicate never double-schedules.
+        cached = self._mutation_cached(payload)
+        if cached is not None:
+            return cached
         existing = self.actors.get(spec["actor_id"])
         if existing is not None:
-            return {"status": "ok", "actor_id": existing.actor_id}
+            return self._mutation_record(
+                payload, {"status": "ok", "actor_id": existing.actor_id}
+            )
         actor = ActorInfo(spec)
         if actor.name:
             key = (spec.get("namespace", "default"), actor.name)
             if key in self.named_actors:
-                return {"status": "name_exists", "actor_id": self.named_actors[key]}
+                return self._mutation_record(
+                    payload,
+                    {"status": "name_exists",
+                     "actor_id": self.named_actors[key]},
+                )
             self.named_actors[key] = actor.actor_id
         self.actors[actor.actor_id] = actor
         self._mark_dirty()
         spawn_task(self._schedule_actor(actor))
-        return {"status": "ok", "actor_id": actor.actor_id}
+        return self._mutation_record(
+            payload, {"status": "ok", "actor_id": actor.actor_id}
+        )
 
     async def _schedule_actor(self, actor: ActorInfo) -> None:
         spec = actor.spec
@@ -911,8 +1002,13 @@ class Controller:
     # placement groups (2-phase commit across agents) [N3]
     # ------------------------------------------------------------------
     async def rpc_create_placement_group(self, conn, payload) -> dict:
+        cached = self._mutation_cached(payload)
+        if cached is not None:
+            return cached
         if payload["pg_id"] in self.pgs:  # idempotent re-send (see create_actor)
-            return {"status": "ok", "pg_id": payload["pg_id"]}
+            return self._mutation_record(
+                payload, {"status": "ok", "pg_id": payload["pg_id"]}
+            )
         pg = PlacementGroupInfo(
             payload["pg_id"],
             payload["bundles"],
@@ -923,7 +1019,9 @@ class Controller:
         self.pgs[pg.pg_id] = pg
         self._mark_dirty()
         spawn_task(self._schedule_pg(pg))
-        return {"status": "ok", "pg_id": pg.pg_id}
+        return self._mutation_record(
+            payload, {"status": "ok", "pg_id": pg.pg_id}
+        )
 
     def _plan_bundles(self, pg: PlacementGroupInfo) -> list[NodeInfo] | None:
         """Pick a node per bundle honoring the strategy. Pure function of the

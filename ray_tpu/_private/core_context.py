@@ -241,8 +241,10 @@ class CoreContext:
         self.controller = RpcClient(
             self.controller_addr, name="to-controller", auto_reconnect=True
         )
+        self.controller.chaos_peer = "controller"
         await self.controller.connect()
         self.agent = RpcClient(self.agent_addr, name="to-agent")
+        self.agent.chaos_peer = f"node:{self.node_id}"
         await self.agent.connect()
         # Replayed after a controller restart (gcs_client reconnect role).
         self.controller.on_reconnect = self._controller_handshake

@@ -156,6 +156,10 @@ class LocalCluster:
         self.controller_handle: ProcessHandle | None = None
         self.controller_addr: tuple | None = None
         self.agents: list[ProcessHandle] = []
+        # Parallel to self.agents: each agent's RPC address and node id
+        # (chaos tooling targets agents by index or node id).
+        self.agent_addrs: list[tuple] = []
+        self.agent_node_ids: list[str] = []
         self.head_store_info: dict | None = None
         self.head_node_id: str | None = None
         self.head_agent_addr: tuple | None = None
@@ -176,6 +180,8 @@ class LocalCluster:
             store_capacity=store_capacity,
         )
         self.agents.append(handle)
+        self.agent_addrs.append(addr)
+        self.agent_node_ids.append(node_id)
         self.head_agent_addr = addr
         self.head_store_info = store
         self.head_node_id = node_id
@@ -204,6 +210,8 @@ class LocalCluster:
             store_capacity=store_capacity,
         )
         self.agents.append(handle)
+        self.agent_addrs.append(addr)
+        self.agent_node_ids.append(node_id)
         return node_id
 
     def shutdown(self) -> None:

@@ -31,6 +31,7 @@ import sys
 import time
 from typing import Any
 
+from ray_tpu._private import chaos
 from ray_tpu._private.config import global_config
 from ray_tpu._private.ids import WorkerID
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreServer
@@ -243,9 +244,11 @@ class NodeAgent:
             except Exception:
                 self._native_lease = None
         self.address = ("127.0.0.1", bound)
+        chaos.set_identity(f"node:{self.node_id}")
         self.controller = RpcClient(
             self.controller_addr, name="agent-to-controller", auto_reconnect=True
         )
+        self.controller.chaos_peer = "controller"
         await self.controller.connect()
         # Survive controller restarts: replay registration on reconnect
         # (reference: raylet re-registers through gcs_client reconnect).
@@ -365,7 +368,7 @@ class NodeAgent:
             pass
 
     async def _register_with_controller(self) -> None:
-        await self.controller.call(
+        resp = await self.controller.call(
             "register_node",
             {
                 "node_id": self.node_id,
@@ -393,6 +396,21 @@ class NodeAgent:
                 ],
             },
         )
+        # Ghost-worker cleanup after a partition heal: the controller
+        # failed these actors over (or they relocated) while we were cut
+        # off — keeping their old incarnations alive here would answer
+        # stale handles alongside the replacement.
+        for entry in (resp or {}).get("stale_actors") or []:
+            worker = self.workers.get(entry.get("worker_id") or "")
+            if worker is None or worker.actor_id != entry.get("actor_id"):
+                continue
+            print(
+                f"[raytpu-agent] killing ghost worker {worker.worker_id} "
+                f"(actor {worker.actor_id} superseded during partition)",
+                file=sys.stderr,
+            )
+            worker.intended_exit = True
+            self._kill_worker_tree(worker)
 
     def store_info(self) -> dict:
         return {
@@ -423,9 +441,13 @@ class NodeAgent:
                         "resources_available": self.resources_available,
                     },
                 )
-                if resp.get("status") == "unknown_node":
-                    # Controller restarted without a snapshot of us (or
-                    # snapshot predates this node): re-register.
+                if resp.get("status") in ("unknown_node", "reregister"):
+                    # unknown_node: controller restarted without a snapshot
+                    # of us. reregister: the controller declared us dead
+                    # (partition outlasted the health timeout) and refuses
+                    # to silently resurrect — a full re-registration
+                    # reconciles live actors/bundles and has the reply name
+                    # any ghost workers we must kill.
                     await self._register_with_controller()
             except Exception:
                 # Controller unreachable: auto_reconnect redials on the
@@ -877,6 +899,22 @@ class NodeAgent:
     # ------------------------------------------------------------------
     async def rpc_start_actor(self, conn, payload) -> dict:
         spec = payload["spec"]
+        # Idempotent by actor_id: a retried start_actor (dropped reply,
+        # duplicated request, controller re-schedule racing a slow ack)
+        # must return the EXISTING incarnation, not spawn a second worker
+        # that double-consumes resources and runs __init__ twice.
+        for worker in self.workers.values():
+            if (
+                worker.actor_id == spec["actor_id"]
+                and worker.proc.returncode is None
+                and worker.address is not None
+            ):
+                return {
+                    "status": "ok",
+                    "worker_id": worker.worker_id,
+                    "worker_addr": list(worker.address),
+                    "pid": worker.proc.pid,
+                }
         resources = spec.get("resources") or {"CPU": 1}
         strategy = spec.get("scheduling_strategy") or {}
         bundle = None
@@ -961,6 +999,29 @@ class NodeAgent:
         except ProcessLookupError:
             pass
         return {"status": "ok"}
+
+    async def rpc_chaos_kill_worker(self, conn, payload) -> dict:
+        """ChaosMonkey hook: SIGKILL one hosted worker, UNintended — the
+        death flows through the normal crash-report path (worker_died →
+        controller restart policy). Deterministic victim selection:
+        workers sorted by worker_id, indexed by the schedule."""
+        candidates = sorted(
+            (w for w in self.workers.values() if w.proc.returncode is None),
+            key=lambda w: w.worker_id,
+        )
+        if payload.get("prefer") == "actor":
+            actor_workers = [w for w in candidates if w.actor_id]
+            candidates = actor_workers or candidates
+        if not candidates:
+            return {"status": "no_workers"}
+        worker = candidates[int(payload.get("index", 0)) % len(candidates)]
+        worker.death_reason = "chaos"
+        self._kill_worker_tree(worker)
+        return {
+            "status": "ok",
+            "worker_id": worker.worker_id,
+            "actor_id": worker.actor_id,
+        }
 
     # ------------------------------------------------------------------
     # RPC: placement group bundles (raylet side of the 2PC [N3])

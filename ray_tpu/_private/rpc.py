@@ -24,10 +24,16 @@ Two interchangeable backends speak this format:
 
 Features mirrored from the reference RPC layer:
   - per-call async completion (ClientCallManager)
-  - retry with exponential backoff on connect failure (retryable clients)
+  - retry with full-jitter exponential backoff on connect failure
+    (retryable clients; jitter breaks the thundering herd of every client
+    redialing on the identical schedule after a controller crash)
   - server push over an established connection (used by pubsub, §N8)
-  - optional injected delay for chaos tests (RAY_testing_asio_delay_us twin:
-    RAY_TPU_testing_rpc_delay_ms).
+  - deterministic fault injection (``ray_tpu._private.chaos``): a seeded
+    FaultSchedule can drop/delay/duplicate/reorder individual messages and
+    partition identity pairs at both the client send point and the server
+    dispatch/reply points. The legacy RAY_TPU_testing_rpc_delay_ms knob
+    (RAY_testing_asio_delay_us twin) is a deprecated alias for a
+    delay-only schedule, now applied uniformly in BOTH client backends.
 """
 
 from __future__ import annotations
@@ -36,6 +42,7 @@ import asyncio
 import ctypes
 import itertools
 import os
+import random
 import struct
 import threading
 import time
@@ -44,6 +51,7 @@ from typing import Any, Awaitable, Callable
 
 import msgpack
 
+from ray_tpu._private import chaos
 from ray_tpu._private.config import global_config
 
 REQ, REP, ERR, PUSH = 0, 1, 2, 3
@@ -303,13 +311,29 @@ class _ServerDispatchMixin:
                 self.route(prefix + attr[4:], getattr(obj, attr))
 
     async def _dispatch(self, conn, msgid: int, method: str, payload: Any) -> None:
-        delay_ms = global_config().testing_rpc_delay_ms
-        if delay_ms:
-            await asyncio.sleep(delay_ms / 1000.0)
+        injector = chaos.get_injector()
         handler = self._handlers.get(method)
         try:
             if handler is None:
                 raise RpcError(f"no handler for method {method!r} on {self.name}")
+            if injector.active:
+                # Duplicated request: deliberately run the handler twice —
+                # the idempotency probe for mutation RPCs. Only the reply
+                # to the second application is sent (the client popped its
+                # future on the first REP anyway).
+                if await injector.on_server_request(method) == "dup":
+                    await handler(conn, payload)
+                result = await handler(conn, payload)
+                reply_fate = await injector.on_server_reply(method)
+                if reply_fate == "drop":
+                    # Reply lost AFTER the mutation applied — the classic
+                    # retry-after-dropped-ack case idempotency tokens
+                    # exist for. The caller times out and re-sends.
+                    return
+                await conn.send(REP, msgid, method, result)
+                if reply_fate == "dup":
+                    await conn.send(REP, msgid, method, result)
+                return
             result = await handler(conn, payload)
             await conn.send(REP, msgid, method, result)
         except ConnectionError:
@@ -528,6 +552,10 @@ class _ClientCallMixin:
         self._pending: dict[int, asyncio.Future] = {}
         self._push_handlers: dict[str, Callable[[Any], Awaitable[None] | None]] = {}
         self.connected = False
+        # Chaos identity of the REMOTE end ("controller", "node:<id>", ...)
+        # — consulted for asymmetric partition matching. None = unmatched
+        # by partitions (message-level faults still apply).
+        self.chaos_peer: str | None = None
 
     def on_push(self, channel: str, handler: Callable[[Any], Any]) -> None:
         self._push_handlers[channel] = handler
@@ -560,6 +588,11 @@ class _ClientCallMixin:
         # frame is on the wire — callers that must order their writes
         # (actor sequence numbers) release the next writer from it while
         # still awaiting this reply concurrently.
+        injector = chaos.get_injector()
+        if injector.active:
+            return await self._call_with_chaos(
+                injector, method, payload, timeout, on_sent
+            )
         for attempt in (0, 1):
             if not self.connected:
                 if self.auto_reconnect and not self._closed:
@@ -571,6 +604,68 @@ class _ClientCallMixin:
             except ConnectionLost:
                 if not self.auto_reconnect or self._closed or attempt:
                     raise
+
+    async def _call_with_chaos(
+        self,
+        injector,
+        method: str,
+        payload: Any,
+        timeout: float | None,
+        on_sent: Callable[[], None] | None,
+    ) -> Any:
+        """Chaos-active twin of call(): each attempt's wait is capped (a
+        dropped message must surface as a timeout, not an eternal hang)
+        and retryable methods are re-sent up to the schedule's budget —
+        which is exactly what makes dropped-reply idempotency real."""
+        eff_timeout = injector.effective_timeout(method, timeout)
+        attempts = injector.max_attempts(method)
+        # The plain path's contract: auto_reconnect clients survive ONE
+        # ConnectionLost per call. Chaos may add retry budget on top but
+        # must never take that away (attempts==1 for delay-only schedules
+        # and non-retryable methods).
+        conn_budget = 1 if self.auto_reconnect else 0
+        attempt = 0
+        last_exc: Exception | None = None
+        while attempt < attempts:
+            if self._closed:
+                raise ConnectionLost(f"{self.name}: closed")
+            if not self.connected:
+                if self.auto_reconnect:
+                    await self._ensure_connected()
+                else:
+                    raise ConnectionLost(f"{self.name}: not connected")
+            fate = await injector.on_client_send(method, self.chaos_peer)
+            if fate == "drop":
+                # Swallowed by the "network": emulate the wait the caller
+                # would experience before its timeout fires.
+                wait = (
+                    eff_timeout
+                    if eff_timeout is not None
+                    else injector.schedule.call_timeout_s
+                )
+                await asyncio.sleep(wait)
+                last_exc = asyncio.TimeoutError(
+                    f"{self.name}: {method} lost to chaos (attempt {attempt})"
+                )
+                attempt += 1
+                continue
+            try:
+                return await self._call_once(method, payload, eff_timeout,
+                                             on_sent)
+            except asyncio.TimeoutError as exc:
+                last_exc = exc
+                attempt += 1
+            except ConnectionLost as exc:
+                last_exc = exc
+                if not self.auto_reconnect or self._closed:
+                    raise
+                if conn_budget > 0:
+                    conn_budget -= 1  # free retry, as in the plain path
+                else:
+                    attempt += 1
+        raise last_exc if last_exc is not None else ConnectionLost(
+            f"{self.name}: {method} exhausted chaos retries"
+        )
 
     def _fail_pending(self) -> None:
         for future in self._pending.values():
@@ -634,7 +729,11 @@ class NativeRpcClient(_ClientCallMixin):
                 _rpc_debug(f"dial ok conn={conn} addr={self.address} name={self.name} eng={id(engine):x}")
                 return
             last_err = -conn
-            await asyncio.sleep(backoff)
+            # Full jitter (AWS-style): sleep U(0, backoff), then double the
+            # ceiling — otherwise every client orphaned by a controller
+            # crash redials on the identical schedule, and the restarted
+            # server eats a synchronized thundering herd each period.
+            await asyncio.sleep(random.uniform(0, backoff))
             backoff = min(backoff * 2, cfg.rpc_retry_max_backoff_s)
         raise ConnectionLost(
             f"{self.name}: cannot connect to {self.address}: errno {last_err}"
@@ -731,7 +830,9 @@ class AsyncioRpcClient(_ClientCallMixin):
                 return
             except (ConnectionError, OSError) as exc:
                 last_exc = exc
-                await asyncio.sleep(backoff)
+                # Full jitter, mirroring the native backend: break the
+                # post-crash redial herd.
+                await asyncio.sleep(random.uniform(0, backoff))
                 backoff = min(backoff * 2, cfg.rpc_retry_max_backoff_s)
         raise ConnectionLost(
             f"{self.name}: cannot connect to {self.address}: {last_exc}"

@@ -1351,6 +1351,9 @@ class WorkerRuntime:
 
 
 def main() -> None:
+    from ray_tpu._private import chaos
+
+    chaos.set_identity(f"worker:{os.environ.get('RAYTPU_WORKER_ID', '')}")
     runtime = WorkerRuntime()
     runtime.start()
     # The main thread is the normal-task execution lane (cancellation via

@@ -138,6 +138,10 @@ class ActorClass:
                             "key": class_id,
                             "value": raw,
                             "overwrite": False,
+                            # Content-addressed, so the token needs no
+                            # randomness: any retry of this export is the
+                            # same logical write.
+                            "mutation_token": f"export:{class_id}",
                         },
                     )
                 )
@@ -182,6 +186,10 @@ class ActorClass:
             "job_id": ctx.job_id,
             "submitter_node": ctx.node_id,
             "creation_args": creation_args,
+            # Idempotency token: the client-random actor_id uniquely
+            # identifies this logical create, so a transport-level retry
+            # after a dropped/duplicated reply is applied exactly once.
+            "mutation_token": f"create-actor:{actor_id}",
         }
         resp = ctx.io.run(ctx.controller.call("create_actor", spec))
         if resp["status"] == "name_exists":

@@ -62,6 +62,35 @@ class Cluster:
         persisted snapshot and the cluster reconnects."""
         self._cluster.restart_controller()
 
+    # -- chaos integration -------------------------------------------------
+    @property
+    def agent_addrs(self) -> list[tuple]:
+        """RPC addresses of every node agent, in start order (chaos
+        tooling sends chaos_kill_worker etc. straight to agents)."""
+        return list(self._cluster.agent_addrs)
+
+    @property
+    def agent_node_ids(self) -> list[str]:
+        return list(self._cluster.agent_node_ids)
+
+    def kill_agent(self, index: int) -> None:
+        """SIGKILL the index-th node agent's process group (workers die
+        with it) without forgetting the node — pair with wait_for_nodes
+        after a heal to observe re-registration."""
+        self._cluster.agents[index].kill()
+
+    def start_chaos(self, schedule, log_dir: str | None = None):
+        """Install a FaultSchedule in this driver process AND the
+        environment (future cluster subprocesses inherit it), then start
+        a ChaosMonkey executing the schedule's kills against this
+        cluster. Returns the started monkey."""
+        from ray_tpu.util.chaos import ChaosMonkey, install
+
+        install(schedule, identity="driver", log_dir=log_dir)
+        monkey = ChaosMonkey(self, schedule)
+        monkey.start()
+        return monkey
+
     def wait_for_nodes(self, expected: int | None = None, timeout: float = 30.0) -> None:
         import ray_tpu
 

@@ -53,6 +53,28 @@ class ActorUnavailableError(RayTpuError):
     """The actor is temporarily unreachable (e.g. restarting)."""
 
 
+class ReplicaDiedError(RayTpuError):
+    """The serve replica backing an in-flight request died mid-call and
+    the request could not be completed on another replica. Raised by
+    DeploymentResponse.result() instead of a bare timeout/actor error so
+    callers can distinguish 'my request is lost' from 'my request is
+    slow' (the handle already retried once against a healthy replica)."""
+
+    def __init__(self, deployment: str, replica: str, detail: str = ""):
+        self.deployment = deployment
+        self.replica = replica
+        message = (
+            f"replica {replica!r} of deployment {deployment!r} died "
+            f"while serving the request"
+        )
+        if detail:
+            message += f": {detail}"
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (ReplicaDiedError, (self.deployment, self.replica))
+
+
 class ObjectLostError(RayTpuError):
     """All copies of the object are gone and it could not be reconstructed."""
 

@@ -18,22 +18,46 @@ import time
 from typing import Any, Optional
 
 import ray_tpu
+from ray_tpu import exceptions
 from ray_tpu.serve._private.common import CONTROLLER_NAME, RequestMetadata
+
+# get()-level failures that mean "the replica process is gone", as opposed
+# to the request being slow or user code raising.
+_REPLICA_DEATH_ERRORS = (
+    exceptions.ActorDiedError,
+    exceptions.ActorUnavailableError,
+    exceptions.WorkerCrashedError,
+)
 
 
 class DeploymentResponse:
     """Future for one deployment call; .result() blocks, passing the
     response into another handle call chains through the object store."""
 
-    def __init__(self, ref, router: "Router", replica_name: str):
+    def __init__(self, ref, router: "Router", replica_name: str,
+                 deployment: str = "", retry=None):
         self._ref = ref
         self._router = router
         self._replica_name = replica_name
+        self._deployment = deployment
+        # Zero-arg callable re-dispatching this request onto a healthy
+        # replica (set by DeploymentHandle.remote; the retried response
+        # carries retry=None so one request retries at most once).
+        self._retry = retry
         self._done = False
 
     def result(self, timeout: Optional[float] = 60.0) -> Any:
         try:
             value = ray_tpu.get(self._ref, timeout=timeout)
+        except _REPLICA_DEATH_ERRORS as exc:
+            return self._on_replica_death(exc, timeout)
+        except exceptions.GetTimeoutError as exc:
+            # A timeout on a DEAD replica is a lost request, not a slow
+            # one — probe liveness before surfacing a bare timeout.
+            if self._replica_alive():
+                self._mark_done()
+                raise
+            return self._on_replica_death(exc, timeout)
         except Exception:
             self._mark_done()
             raise
@@ -45,6 +69,35 @@ class DeploymentResponse:
             return ResponseStream(self, value["__serve_stream__"])
         self._mark_done()
         return value
+
+    def _replica_alive(self) -> bool:
+        try:
+            handle = self._router._replica_handle(self._replica_name)
+            ray_tpu.get(handle.check_health.remote(), timeout=5)
+            return True
+        except Exception:
+            return False
+
+    def _on_replica_death(self, exc: Exception, timeout) -> Any:
+        """The backing replica died mid-call: drop it from the router,
+        retry ONCE against a healthy replica, and if that is impossible
+        surface a typed ReplicaDiedError instead of the raw actor error
+        or a bare timeout."""
+        self._mark_done()
+        self._router.drop_replica(self._replica_name)
+        if self._retry is not None:
+            retry, self._retry = self._retry, None
+            try:
+                fresh = retry()
+            except Exception as retry_exc:
+                raise exceptions.ReplicaDiedError(
+                    self._deployment, self._replica_name,
+                    f"retry dispatch failed: {retry_exc}",
+                ) from exc
+            return fresh.result(timeout=timeout)
+        raise exceptions.ReplicaDiedError(
+            self._deployment, self._replica_name, str(exc)
+        ) from exc
 
     def _mark_done(self):
         if not self._done:
@@ -155,6 +208,10 @@ class Router:
         self._replicas: list[str] = []  # actor names
         self._handles: dict[str, Any] = {}
         self._ongoing: dict[str, int] = {}
+        # Replicas observed dead, banned until the controller's membership
+        # catches up — _refresh would otherwise re-add the corpse from the
+        # stale snapshot and the death-retry path would re-pick it.
+        self._banned: dict[str, float] = {}
         self._max_ongoing = 100
         self._last_refresh = 0.0
         self._lock = threading.Lock()
@@ -175,7 +232,16 @@ class Router:
         info = subscriber.get_replicas(self._qualified)
         with self._lock:
             self._last_refresh = time.monotonic()
-            self._replicas = info["actor_names"]
+            now = time.monotonic()
+            self._banned = {
+                name: until
+                for name, until in self._banned.items()
+                if until > now
+            }
+            self._replicas = [
+                name for name in info["actor_names"]
+                if name not in self._banned
+            ]
             self._max_ongoing = info.get("max_ongoing_requests", 100)
             for name in self._replicas:
                 self._ongoing.setdefault(name, 0)
@@ -276,6 +342,7 @@ class Router:
         with self._lock:
             self._replicas = [r for r in self._replicas if r != actor_name]
             self._handles.pop(actor_name, None)
+            self._banned[actor_name] = time.monotonic() + 10.0
 
 
 class DeploymentHandle:
@@ -325,28 +392,52 @@ class DeploymentHandle:
         )
         last_exc: Exception | None = None
         for _ in range(3):
-            replica_name = router.choose_replica(
-                shape_key=self._shape_key or None
-            )
-            replica = router._replica_handle(replica_name)
             try:
-                ref = replica.handle_request.remote(
-                    {
-                        "request_id": meta.request_id,
-                        "method_name": meta.method_name,
-                        "multiplexed_model_id": meta.multiplexed_model_id,
-                        "shape_key": self._shape_key,
-                    },
-                    args,
-                    kwargs,
-                )
-                return DeploymentResponse(ref, router, replica_name)
+                return self._dispatch_once(router, meta, args, kwargs,
+                                           allow_retry=True)
             except Exception as exc:  # replica died between refresh and call
                 last_exc = exc
-                router.on_request_done(replica_name)
-                router.drop_replica(replica_name)
         raise RuntimeError(
             f"could not dispatch to {self.deployment_name}: {last_exc}"
+        )
+
+    def _dispatch_once(self, router, meta, args, kwargs,
+                       allow_retry: bool) -> DeploymentResponse:
+        replica_name = router.choose_replica(
+            shape_key=self._shape_key or None
+        )
+        try:
+            replica = router._replica_handle(replica_name)
+        except Exception:  # name already unregistered: replica is dead
+            router.on_request_done(replica_name)
+            router.drop_replica(replica_name)
+            raise
+        try:
+            ref = replica.handle_request.remote(
+                {
+                    "request_id": meta.request_id,
+                    "method_name": meta.method_name,
+                    "multiplexed_model_id": meta.multiplexed_model_id,
+                    "shape_key": self._shape_key,
+                },
+                args,
+                kwargs,
+            )
+        except Exception:
+            router.on_request_done(replica_name)
+            router.drop_replica(replica_name)
+            raise
+        # The response can re-dispatch itself ONCE onto another replica if
+        # this one dies mid-call (retry=None on the retried response).
+        retry = (
+            (lambda: self._dispatch_once(router, meta, args, kwargs,
+                                         allow_retry=False))
+            if allow_retry
+            else None
+        )
+        return DeploymentResponse(
+            ref, router, replica_name,
+            deployment=self.deployment_name, retry=retry,
         )
 
     def __reduce__(self):

@@ -1,0 +1,212 @@
+"""Public fault-injection API — ``ray_tpu.util.chaos``.
+
+The deterministic decision engine lives in ``ray_tpu._private.chaos``
+(where the transport can import it without cycles); this module is the
+user-facing face plus the pieces that need the CLUSTER, not just one
+process:
+
+  * :class:`FaultSchedule` / :func:`install` / :func:`get_injector` /
+    :func:`reset` — re-exported from the core.
+  * :class:`ChaosMonkey` — a driver-side thread that executes the
+    schedule's *process-level* faults (SIGKILL workers / agents / the
+    controller at scheduled offsets, optional restarts) against a
+    ``ray_tpu.cluster_utils.Cluster``.
+  * :func:`read_event_log` — collect every process's JSONL chaos events
+    (sorted deterministically) so tests can assert that two runs of the
+    same seed produced the identical fault sequence.
+
+Quick start::
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.chaos import FaultSchedule
+
+    schedule = FaultSchedule(
+        seed=7,
+        drop_request=0.05, drop_reply=0.05, dup_reply=0.2,
+        partitions=[{"src": "node:*", "dst": "controller",
+                     "start_s": 5, "duration_s": 10}],
+        kills=[{"at_s": 3, "target": "worker", "index": 0}],
+    )
+    cluster = Cluster(initialize_head=True)
+    monkey = cluster.start_chaos(schedule, log_dir="/tmp/chaos")
+    ...
+    monkey.stop()
+
+Environment form (equivalent, inherited by every cluster process)::
+
+    RAY_TPU_chaos='{"seed": 7, "drop_request": 0.05, ...}'
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ray_tpu._private.chaos import (  # noqa: F401  (public re-exports)
+    ChaosFault,
+    ChaosInjector,
+    FaultSchedule,
+    failpoint,
+    get_injector,
+    install,
+    reset,
+    set_identity,
+)
+
+__all__ = [
+    "ChaosFault",
+    "ChaosInjector",
+    "ChaosMonkey",
+    "FaultSchedule",
+    "failpoint",
+    "get_injector",
+    "install",
+    "read_event_log",
+    "reset",
+    "set_identity",
+]
+
+
+class ChaosMonkey:
+    """Executes a FaultSchedule's ``kills`` against a live Cluster.
+
+    Each kill entry::
+
+        {"at_s": 3.0,                 # offset from monkey start
+         "target": "controller"       # or "agent:<idx>" or "worker"
+         "index": 0,                  # worker kills: deterministic victim
+         "agent": 0,                  # worker kills: which agent to ask
+         "prefer": "actor",           # worker kills: prefer actor workers
+         "restart_after_s": 2.0}      # controller only: restart delay
+
+    Worker kills go through the agent's ``chaos_kill_worker`` RPC (the
+    agent picks the victim deterministically and reports the death as a
+    crash, not an intended exit). Runs on a daemon thread; every executed
+    kill is appended to ``self.events``.
+    """
+
+    def __init__(self, cluster, schedule: FaultSchedule):
+        self.cluster = cluster
+        self.schedule = schedule
+        self.events: list[dict] = []
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def start(self) -> "ChaosMonkey":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="chaos-monkey", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def join(self, timeout: float | None = None) -> None:
+        """Block until every scheduled kill has executed (or timeout)."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.join(timeout=5)
+
+    # -- internals ---------------------------------------------------------
+    def _run(self) -> None:
+        start = time.monotonic()
+        pending = sorted(
+            self.schedule.kills, key=lambda k: float(k.get("at_s", 0.0))
+        )
+        for kill in pending:
+            delay = float(kill.get("at_s", 0.0)) - (time.monotonic() - start)
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            try:
+                self._execute(kill)
+            except Exception as exc:  # a failed kill must not end the run
+                self.events.append(
+                    {"kill": kill, "status": "error", "error": str(exc)}
+                )
+
+    def _execute(self, kill: dict) -> None:
+        target = kill.get("target", "worker")
+        if target == "controller":
+            self.cluster.kill_controller()
+            self.events.append({"kill": kill, "status": "ok"})
+            restart_after = kill.get("restart_after_s")
+            if restart_after is not None:
+                if self._stop.wait(float(restart_after)):
+                    return
+                self.cluster.restart_controller()
+                self.events.append(
+                    {"kill": kill, "status": "restarted"}
+                )
+            return
+        if target.startswith("agent"):
+            _, _, raw_index = target.partition(":")
+            self.cluster.kill_agent(int(raw_index or 0))
+            self.events.append({"kill": kill, "status": "ok"})
+            return
+        # Worker kill: ask the agent over a blocking wire-v1 client (this
+        # thread has no asyncio loop).
+        from ray_tpu._private.snapshot_store import _SyncWireClient
+
+        agent_index = int(kill.get("agent", 0))
+        host, port = self.cluster.agent_addrs[agent_index]
+        client = _SyncWireClient(host, int(port), timeout=30.0)
+        try:
+            reply = client.call(
+                "chaos_kill_worker",
+                {
+                    "index": int(kill.get("index", 0)),
+                    "prefer": kill.get("prefer", "actor"),
+                },
+            )
+        finally:
+            try:
+                if client._sock is not None:
+                    client._sock.close()
+            except Exception:
+                pass
+        self.events.append(
+            {"kill": kill, "status": reply.get("status"),
+             "worker_id": reply.get("worker_id"),
+             "actor_id": reply.get("actor_id")}
+        )
+
+
+def read_event_log(log_dir: str) -> list[dict]:
+    """Every chaos event from every process, in a deterministic order.
+
+    Events are sorted by (identity, point, method, n) — NOT wall-clock —
+    because per-process decision counters are the reproducible coordinate
+    system; timestamps differ between runs even when the fault sequence
+    is identical. Two runs of the same seed and workload must yield equal
+    lists (minus the "t" timestamps, which this strips).
+    """
+    events: list[dict] = []
+    if not os.path.isdir(log_dir):
+        return events
+    for name in sorted(os.listdir(log_dir)):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(log_dir, name)) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    continue
+                event.pop("t", None)
+                events.append(event)
+    events.sort(
+        key=lambda e: (
+            e.get("id", ""), e.get("point", ""), e.get("method", ""),
+            e.get("n", 0),
+        )
+    )
+    return events

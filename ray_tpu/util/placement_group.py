@@ -80,6 +80,9 @@ def placement_group(
                 "name": name,
                 "lifetime": lifetime,
                 "job_id": ctx.job_id,
+                # Idempotency token (see create_actor): pg_id is
+                # client-random, so it names this logical create.
+                "mutation_token": f"create-pg:{pg_id}",
             },
         )
     )
