@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Control-plane scale smoke: the downsized scale envelope (8 fake nodes /
+# 200 actors / 20 placement groups / 5k leases) on the in-process
+# FakeScaleCluster, sized to finish well inside the tier-1 timeout.
+#
+# Two layers, same envelope:
+#   1. tests/test_scale_smoke.py — fast non-slow pytest markers (these
+#      also run as part of plain tier-1 `pytest -m 'not slow'`), including
+#      the seeded dup/drop mutation-idempotency burst;
+#   2. the four scale_* release entries under --smoke, which enforce the
+#      smoke_criteria floors from release/release_tests.yaml and append
+#      the run to release_history.jsonl.
+#
+# The full-size envelope (32 nodes / 2k actors / 200 pgs / 100k leases)
+# is the release suite proper: python release/run_all.py --only scale_...
+# Usage: ci/run_scale_smoke.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== scale smoke (pytest, downsized envelope) =="
+python -m pytest tests/test_scale_smoke.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== scale smoke (release floors, --smoke) =="
+for name in scale_nodes_32 scale_actors_2000 scale_pgs_200 scale_tasks_100k; do
+    python release/run_all.py --smoke --only "$name"
+done
+
+echo "scale smoke: PASS"

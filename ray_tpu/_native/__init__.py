@@ -194,6 +194,9 @@ def load() -> ctypes.CDLL:
             lib.rt_lease_stats.argtypes = [
                 ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
             ]
+            lib.rt_engine_stats.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+            ]
             _lib = lib
     return _lib
 

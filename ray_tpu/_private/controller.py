@@ -48,6 +48,20 @@ ACTOR_STATES = ("PENDING", "ALIVE", "RESTARTING", "DEAD")
 PG_STATES = ("PENDING", "CREATED", "REMOVED", "RESCHEDULING")
 
 
+class _PendingLease:
+    """One queued request_lease waiting for capacity, parked in the
+    shape-indexed pending queue instead of polling _pick_node."""
+
+    __slots__ = ("future", "resources", "submitter", "strategy", "demand_id")
+
+    def __init__(self, future, resources, submitter, strategy, demand_id):
+        self.future = future
+        self.resources = resources
+        self.submitter = submitter
+        self.strategy = strategy
+        self.demand_id = demand_id
+
+
 def _jsonify(obj):
     """JSON-compatible deep copy; bytes become {"__b64__": ...} (actor
     specs carry pickled creation args, KV values are bytes)."""
@@ -85,6 +99,7 @@ class NodeInfo:
         self.last_heartbeat = time.monotonic()
         self.alive = True
         self.client: RpcClient | None = None
+        self.stats: dict = {}  # piggybacked heartbeat stats (queue depths)
 
     def snapshot(self) -> dict:
         return {
@@ -171,6 +186,24 @@ class Controller:
         self.pending_demands: dict[str, dict] = {}
         self.events = EventExporter(session_dir)
         self._rr = itertools.count()
+        # --- control-plane scale-out machinery ---
+        # Capacity pulse: schedulers park on the CURRENT event; a capacity
+        # gain swaps in a fresh event and sets the old one, so waiters wake
+        # exactly once per gain with no clear() races.
+        self._capacity_event = asyncio.Event()
+        # request_lease queue indexed by (resource shape, strategy key):
+        # infeasibility is decided once per SHAPE per capacity change, not
+        # once per queued request per 200 ms poll. O(1) pop on grant.
+        self._pending_leases: dict[tuple, collections.deque] = {}
+        self._lease_drain_scheduled = False
+        self._demand_seq = itertools.count()
+        # Pubsub outbox: events queue per subscriber connection and flush
+        # as ONE batched push frame per connection per loop tick instead
+        # of one awaited frame per (event x subscriber).
+        self._pub_outbox: dict[ServerConnection, list] = {}
+        self._pub_flush_scheduled = False
+        # Counters the scale suite and /metrics read via controller_stats.
+        self.stats_counters = collections.Counter()
         # Idempotency-token reply cache for mutation RPCs: a client that
         # retried after a dropped/duplicated reply (or a controller
         # restart) gets the ORIGINAL reply back instead of re-applying
@@ -197,6 +230,19 @@ class Controller:
             file=sys.stderr, flush=True,
         )
         self._dirty = False
+        # Incremental snapshot state: per-entry serialized JSON fragments
+        # for the big tables (actors/pgs/kv) are cached and only dirty
+        # keys re-serialize — a 2k-actor table no longer re-encodes in
+        # full every snapshot tick (see _build_snapshot_blob).
+        self._snap_frag: dict[str, dict] = {"actors": {}, "pgs": {}, "kv": {}}
+        self._snap_dirty: dict[str, set] = {
+            "actors": set(), "pgs": set(), "kv": set()
+        }
+        self._snap_all_dirty = True
+        self._snap_stats = {
+            "saves": 0, "last_bytes": 0, "last_build_ms": 0.0,
+            "frags_rebuilt": 0,
+        }
         self._restored = self._load_snapshot()
 
     # ------------------------------------------------------------------
@@ -246,7 +292,7 @@ class Controller:
                 for i, nid in enumerate(pg.bundle_nodes):
                     if nid is not None and nid not in self.nodes:
                         pg.bundle_nodes[i] = None
-                self._mark_dirty()
+                self._mark_dirty("pgs", pg.pg_id)
                 spawn_task(self._schedule_pg(pg))
 
     # ------------------------------------------------------------------
@@ -274,48 +320,132 @@ class Controller:
     # ------------------------------------------------------------------
     # persistence [N7]
     # ------------------------------------------------------------------
-    def _mark_dirty(self) -> None:
+    def _mark_dirty(self, section: str | None = None, key=None) -> None:
+        """Flag state changed. ``section``/``key`` scope the change to one
+        entry of an incrementally-snapshotted table ("actors"/"pgs"/"kv");
+        section=None means only the always-fresh small sections (jobs,
+        named_actors, mutation cache) moved."""
         self._dirty = True
+        if section is not None:
+            self._snap_dirty[section].add(key)
+
+    @staticmethod
+    def _actor_frag(a: ActorInfo) -> str:
+        return json.dumps(_jsonify({
+            "spec": a.spec,
+            "state": a.state,
+            "address": list(a.address) if a.address else None,
+            "node_id": a.node_id,
+            "worker_id": a.worker_id,
+            "restarts_remaining": a.restarts_remaining,
+            "death_cause": a.death_cause,
+        }))
+
+    @staticmethod
+    def _pg_frag(p: PlacementGroupInfo) -> str:
+        return json.dumps(_jsonify({
+            "bundles": p.bundles,
+            "strategy": p.strategy,
+            "name": p.name,
+            "job_id": p.job_id,
+            "state": p.state,
+            "bundle_nodes": p.bundle_nodes,
+        }))
+
+    def _refresh_snapshot_frags(self) -> int:
+        """Bring the cached per-entry fragments up to date; returns how
+        many fragments were re-serialized this pass."""
+        frags = self._snap_frag
+        dirty = self._snap_dirty
+        rebuilt = 0
+        if self._snap_all_dirty:
+            self._snap_all_dirty = False
+            for s in dirty.values():
+                s.clear()
+            frags["actors"] = {
+                aid: self._actor_frag(a) for aid, a in self.actors.items()
+            }
+            frags["pgs"] = {
+                pid: self._pg_frag(p) for pid, p in self.pgs.items()
+            }
+            frags["kv"] = {
+                (ns, k): json.dumps(_jsonify([ns, k, v]))
+                for ns, kvs in self.kv.items()
+                for k, v in kvs.items()
+            }
+            return (
+                len(frags["actors"]) + len(frags["pgs"]) + len(frags["kv"])
+            )
+        for aid in dirty["actors"]:
+            a = self.actors.get(aid)
+            if a is None:
+                frags["actors"].pop(aid, None)
+            else:
+                frags["actors"][aid] = self._actor_frag(a)
+                rebuilt += 1
+        for pid in dirty["pgs"]:
+            p = self.pgs.get(pid)
+            if p is None:
+                frags["pgs"].pop(pid, None)
+            else:
+                frags["pgs"][pid] = self._pg_frag(p)
+                rebuilt += 1
+        for ns_key in dirty["kv"]:
+            ns, k = ns_key
+            v = self.kv.get(ns, {}).get(k)
+            if v is None:
+                frags["kv"].pop(ns_key, None)
+            else:
+                frags["kv"][ns_key] = json.dumps(_jsonify([ns, k, v]))
+                rebuilt += 1
+        for s in dirty.values():
+            s.clear()
+        return rebuilt
 
     def _build_snapshot_blob(self) -> bytes:
         """Runs ON the event loop: the state walk must be atomic w.r.t.
         handlers mutating actors/pgs/kv — only the (pure) store write is
-        pushed to a worker thread."""
-        state = {
-            "actors": {
-                aid: {
-                    "spec": a.spec,
-                    "state": a.state,
-                    "address": list(a.address) if a.address else None,
-                    "node_id": a.node_id,
-                    "worker_id": a.worker_id,
-                    "restarts_remaining": a.restarts_remaining,
-                    "death_cause": a.death_cause,
-                }
-                for aid, a in self.actors.items()
-            },
-            "named_actors": [
-                [ns, name, aid] for (ns, name), aid in self.named_actors.items()
-            ],
-            "pgs": {
-                pid: {
-                    "bundles": p.bundles,
-                    "strategy": p.strategy,
-                    "name": p.name,
-                    "job_id": p.job_id,
-                    "state": p.state,
-                    "bundle_nodes": p.bundle_nodes,
-                }
-                for pid, p in self.pgs.items()
-            },
-            "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
-            "jobs": self.jobs,
+        pushed to a worker thread. Incremental: the big tables assemble
+        from cached per-entry fragments (only dirty keys re-serialize);
+        the small sections (jobs, named actors, mutation-token cache) are
+        serialized fresh each build."""
+        start = time.perf_counter()
+        rebuilt = self._refresh_snapshot_frags()
+        frags = self._snap_frag
+        parts = [
+            '"actors":{'
+            + ",".join(
+                f"{json.dumps(aid)}:{frag}"
+                for aid, frag in frags["actors"].items()
+            )
+            + "}",
+            '"pgs":{'
+            + ",".join(
+                f"{json.dumps(pid)}:{frag}"
+                for pid, frag in frags["pgs"].items()
+            )
+            + "}",
+            '"kv_flat":[' + ",".join(frags["kv"].values()) + "]",
+            '"named_actors":'
+            + json.dumps([
+                [ns, name, aid]
+                for (ns, name), aid in self.named_actors.items()
+            ]),
+            '"jobs":' + json.dumps(_jsonify(self.jobs)),
             # Token cache rides along so mutation dedup spans restarts: a
             # client retrying across a controller crash still gets its
             # original reply, not a re-application.
-            "mutations": list(self._mutation_replies.items()),
-        }
-        return json.dumps(_jsonify(state)).encode()
+            '"mutations":'
+            + json.dumps(_jsonify(list(self._mutation_replies.items()))),
+        ]
+        blob = ("{" + ",".join(parts) + "}").encode()
+        self._snap_stats["last_bytes"] = len(blob)
+        self._snap_stats["last_build_ms"] = (
+            (time.perf_counter() - start) * 1000.0
+        )
+        self._snap_stats["frags_rebuilt"] = rebuilt
+        self._snap_stats["saves"] += 1
+        return blob
 
     def _load_snapshot(self) -> bool:
         blob = None
@@ -369,8 +499,10 @@ class Controller:
             if pg.state == "CREATED":
                 pg.ready_event.set()
             self.pgs[pid] = pg
-        for ns, kvs in state.get("kv", {}).items():
+        for ns, kvs in state.get("kv", {}).items():  # legacy nested format
             self.kv[ns].update(kvs)
+        for ns, k, v in state.get("kv_flat", []):
+            self.kv[ns][k] = v
         self.jobs.update(state.get("jobs", {}))
         for token, reply in state.get("mutations", []):
             self._mutation_replies[token] = reply
@@ -397,7 +529,7 @@ class Controller:
                 blob = self._build_snapshot_blob()  # on-loop: consistent
                 # executor: an external store's socket write must not
                 # stall the control plane's event loop.
-                await loop.run_in_executor(None, self.store.save, blob)
+                await loop.run_in_executor(None, self.store.timed_save, blob)
             except Exception as exc:
                 self._dirty = True  # retry next tick; don't lose the state
                 print(
@@ -426,18 +558,78 @@ class Controller:
         # files (event.cc/N28 role): pubsub reaches connected subscribers,
         # the export reaches external consumers after the fact.
         self.events.emit(channel, message)
+        subs = self.subscribers.get(channel)
+        if not subs:
+            return
+        # Queue per connection; one batched push frame per connection per
+        # loop tick (a 2k-event burst costs each subscriber one frame, not
+        # 2k awaited sends serialized through the handler).
         dead = []
-        for conn in self.subscribers.get(channel, set()):
+        for conn in subs:
             if conn.closed.is_set():
                 dead.append(conn)
                 continue
-            await conn.push(channel, message)
+            self._pub_outbox.setdefault(conn, []).append((channel, message))
         for conn in dead:
-            self.subscribers[channel].discard(conn)
+            subs.discard(conn)
+        if self._pub_outbox and not self._pub_flush_scheduled:
+            self._pub_flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._spawn_pub_flush)
+
+    def _spawn_pub_flush(self) -> None:
+        spawn_task(self._flush_pubsub())
+
+    async def _flush_pubsub(self) -> None:
+        self._pub_flush_scheduled = False
+        outbox = self._pub_outbox
+        if not outbox:
+            return
+        self._pub_outbox = {}
+        for conn, items in outbox.items():
+            if conn.closed.is_set():
+                continue
+            self.stats_counters["pubsub_frames"] += 1
+            self.stats_counters["pubsub_events"] += len(items)
+            try:
+                if len(items) == 1:
+                    await conn.push(items[0][0], items[0][1])
+                else:
+                    # Client-side demux in rpc._ClientCallMixin._handle_push.
+                    await conn.push(
+                        "__pub_batch__", [[c, m] for c, m in items]
+                    )
+            except Exception:
+                pass
 
     async def rpc_publish(self, conn, payload) -> dict:
         await self.publish(payload["channel"], payload["message"])
         return {"status": "ok"}
+
+    # ------------------------------------------------------------------
+    # capacity wakeups (event-driven scheduling, no poll loops)
+    # ------------------------------------------------------------------
+    def _notify_capacity(self) -> None:
+        """Cluster capacity may have grown (node registered, heartbeat
+        reported freed resources, PG became placeable). Pulse the parked
+        schedulers and drain the shape-indexed pending-lease queue —
+        coalesced to one drain per loop tick however many notifications
+        land in a burst."""
+        event = self._capacity_event
+        self._capacity_event = asyncio.Event()
+        event.set()
+        if self._pending_leases and not self._lease_drain_scheduled:
+            self._lease_drain_scheduled = True
+            asyncio.get_running_loop().call_soon(self._drain_pending_leases)
+
+    async def _wait_for_capacity(self, timeout: float) -> None:
+        """Park until the next capacity pulse (or timeout as a safety
+        net). Grab the event BEFORE awaiting: a pulse between the check
+        and the wait must not be lost."""
+        event = self._capacity_event
+        try:
+            await asyncio.wait_for(event.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
 
     # ------------------------------------------------------------------
     # node management [N4] + health checks
@@ -469,7 +661,7 @@ class Controller:
                     actor.address = tuple(entry["addr"])
                 actor.state = "ALIVE"
                 actor.ready_event.set()
-                self._mark_dirty()
+                self._mark_dirty("actors", actor.actor_id)
             elif actor is None or actor.state == "DEAD" or (
                 actor.state == "ALIVE" and actor.node_id != node.node_id
             ):
@@ -503,6 +695,7 @@ class Controller:
         if stale:
             spawn_task(self._release_stale_bundles(node, stale))
         await self.publish("node_added", node.snapshot())
+        self._notify_capacity()
         await self._retry_pending()
         return {"status": "ok", "stale_actors": stale_actors}
 
@@ -531,7 +724,21 @@ class Controller:
             # actors/bundles and tells the agent which workers are stale.
             return {"status": "reregister"}
         node.last_heartbeat = time.monotonic()
-        node.resources_available = payload["resources_available"]
+        prev = node.resources_available
+        fresh = payload["resources_available"]
+        node.resources_available = fresh
+        if payload.get("stats") is not None:
+            # Agents piggyback queue-depth/engine counters on the
+            # heartbeat they already send — no extra stats RPC fan-in.
+            node.stats = payload["stats"]
+        self.stats_counters["heartbeats"] += 1
+        # Wake parked schedulers only on a capacity GAIN: a steady-state
+        # heartbeat from each of N nodes per tick must not trigger N
+        # rescheduling sweeps.
+        for key, value in fresh.items():
+            if value > prev.get(key, 0.0) + 1e-9:
+                self._notify_capacity()
+                break
         return {"status": "ok"}
 
     async def _health_check_loop(self) -> None:
@@ -546,6 +753,14 @@ class Controller:
             for node in list(self.nodes.values()):
                 if node.alive and now - node.last_heartbeat > timeout:
                     await self._on_node_death(node)
+            # Safety-net drain: pending leases are normally woken by
+            # capacity pulses; this sweep bounds the wait if a pulse was
+            # missed (e.g. a heartbeat-less test mutates node state).
+            if self._pending_leases and not self._lease_drain_scheduled:
+                self._lease_drain_scheduled = True
+                asyncio.get_running_loop().call_soon(
+                    self._drain_pending_leases
+                )
 
     async def _on_node_death(self, node: NodeInfo) -> None:
         node.alive = False
@@ -562,6 +777,7 @@ class Controller:
                 for i, nid in enumerate(pg.bundle_nodes):
                     if nid == node.node_id:
                         pg.bundle_nodes[i] = None
+                self._mark_dirty("pgs", pg.pg_id)
                 spawn_task(self._schedule_pg(pg))
 
     async def _on_disconnect(self, conn: ServerConnection) -> None:
@@ -634,7 +850,7 @@ class Controller:
         if not overwrite and payload["key"] in self.kv[ns]:
             return self._mutation_record(payload, {"status": "exists"})
         self.kv[ns][payload["key"]] = payload["value"]
-        self._mark_dirty()
+        self._mark_dirty("kv", (ns, payload["key"]))
         return self._mutation_record(payload, {"status": "ok"})
 
     async def rpc_kv_get(self, conn, payload) -> dict:
@@ -649,7 +865,7 @@ class Controller:
         ns = payload.get("namespace", "default")
         existed = self.kv[ns].pop(payload["key"], None) is not None
         if existed:
-            self._mark_dirty()
+            self._mark_dirty("kv", (ns, payload["key"]))
         return self._mutation_record(
             payload, {"status": "ok", "existed": existed}
         )
@@ -678,12 +894,16 @@ class Controller:
         )
 
     def _utilization(self, node: NodeInfo) -> float:
-        fractions = []
+        # Allocation-free max: this runs per (node x scheduling decision)
+        # and shows up first in 32-node profiles.
+        best = 0.0
+        available = node.resources_available
         for key, total in node.resources_total.items():
             if total > 0:
-                used = total - node.resources_available.get(key, 0.0)
-                fractions.append(used / total)
-        return max(fractions) if fractions else 0.0
+                frac = (total - available.get(key, 0.0)) / total
+                if frac > best:
+                    best = frac
+        return best
 
     def _pick_node(self, resources: dict, submitter_node: str | None, strategy: dict) -> NodeInfo | None:
         alive = [n for n in self.nodes.values() if n.alive]
@@ -768,33 +988,100 @@ class Controller:
             ],
         }
 
+    @staticmethod
+    def _lease_shape(resources: dict, strategy: dict) -> tuple:
+        """Canonical queue key: requests with equal shape+strategy are
+        feasibility-equivalent, so one _pick_node probe decides for the
+        whole bucket."""
+        kind = strategy.get("kind", "")
+        if kind == "pg":
+            extra = ("pg", strategy["pg_id"], strategy.get("bundle_index", -1))
+        elif kind == "node_affinity":
+            extra = ("node", strategy["node_id"], bool(strategy.get("soft")))
+        elif kind:
+            extra = (kind,)
+        else:
+            extra = ()
+        return (
+            tuple(sorted(
+                (k, float(v)) for k, v in resources.items() if v > 0
+            )),
+            extra,
+        )
+
+    def _drain_pending_leases(self) -> None:
+        """One pass over the pending-lease queue, run as a loop callback
+        after a capacity gain. Per SHAPE: one infeasibility probe rejects
+        the whole bucket in O(1); feasible buckets pop waiters until the
+        shape stops fitting."""
+        self._lease_drain_scheduled = False
+        if not self._pending_leases:
+            return
+        for shape in list(self._pending_leases):
+            waiters = self._pending_leases.get(shape)
+            while waiters:
+                req = waiters[0]
+                if req.future.done():  # timed out / disconnected
+                    waiters.popleft()
+                    continue
+                node = self._pick_node(req.resources, req.submitter,
+                                       req.strategy)
+                if node is None:
+                    break  # shape still infeasible: bucket stays parked
+                waiters.popleft()
+                self.pending_demands.pop(req.demand_id, None)
+                self.stats_counters["lease_queue_grants"] += 1
+                req.future.set_result(node)
+            if not waiters:
+                self._pending_leases.pop(shape, None)
+
+    async def _queue_lease_request(
+        self, resources: dict, submitter: str | None, strategy: dict,
+        timeout: float,
+    ) -> NodeInfo | None:
+        """Park an unplaceable lease request until capacity shows up (the
+        reference queues in raylets; we queue here). Queued demand stays
+        visible to the autoscaler via pending_demands."""
+        demand_id = f"lease-{next(self._demand_seq)}"
+        future = asyncio.get_running_loop().create_future()
+        req = _PendingLease(future, resources, submitter, strategy, demand_id)
+        shape = self._lease_shape(resources, strategy)
+        self._pending_leases.setdefault(shape, collections.deque()).append(req)
+        self.pending_demands[demand_id] = dict(resources)
+        self.stats_counters["lease_queue_enqueued"] += 1
+        try:
+            return await asyncio.wait_for(future, timeout)
+        except asyncio.TimeoutError:
+            return None
+        finally:
+            self.pending_demands.pop(demand_id, None)
+
     async def rpc_request_lease(self, conn, payload) -> dict:
         resources = payload["resources"]
         strategy = payload.get("scheduling_strategy") or {}
-        deadline = time.monotonic() + 60.0
-        demand_id = f"lease-{id(payload)}-{time.monotonic()}"
-        while True:
-            node = self._pick_node(resources, payload.get("submitter_node"), strategy)
-            self.pending_demands.pop(demand_id, None)
-            if node is not None:
-                bundle = None
-                if strategy.get("kind") == "pg":
-                    bundle = {
-                        "pg_id": strategy["pg_id"],
-                        "bundle_index": strategy.get("bundle_index", -1),
-                    }
-                return {
-                    "status": "ok",
-                    "node_id": node.node_id,
-                    "agent_addr": list(node.agent_addr),
-                    "bundle": bundle,
-                }
-            if time.monotonic() > deadline:
-                return {"status": "infeasible"}
-            # Wait for capacity/new nodes (the reference queues in raylets;
-            # we queue here). Queued demand is visible to the autoscaler.
-            self.pending_demands[demand_id] = dict(resources)
-            await asyncio.sleep(0.2)
+        self.stats_counters["lease_requests"] += 1
+        node = self._pick_node(
+            resources, payload.get("submitter_node"), strategy
+        )
+        if node is None:
+            node = await self._queue_lease_request(
+                resources, payload.get("submitter_node"), strategy,
+                timeout=60.0,
+            )
+        if node is None:
+            return {"status": "infeasible"}
+        bundle = None
+        if strategy.get("kind") == "pg":
+            bundle = {
+                "pg_id": strategy["pg_id"],
+                "bundle_index": strategy.get("bundle_index", -1),
+            }
+        return {
+            "status": "ok",
+            "node_id": node.node_id,
+            "agent_addr": list(node.agent_addr),
+            "bundle": bundle,
+        }
 
     async def _retry_pending(self) -> None:
         for pg in list(self.pgs.values()):
@@ -829,22 +1116,45 @@ class Controller:
                 )
             self.named_actors[key] = actor.actor_id
         self.actors[actor.actor_id] = actor
-        self._mark_dirty()
+        self._mark_dirty("actors", actor.actor_id)
         spawn_task(self._schedule_actor(actor))
         return self._mutation_record(
             payload, {"status": "ok", "actor_id": actor.actor_id}
         )
 
+    @staticmethod
+    def _debit(node: NodeInfo, resources: dict) -> None:
+        """Optimistic local reservation: decrement the controller's VIEW of
+        a node's availability the moment a placement is chosen, so a burst
+        of concurrent _schedule_* coroutines spreads across the cluster
+        instead of thundering onto the node the last heartbeat said was
+        emptiest. The next heartbeat overwrites with the agent's
+        authoritative value, so drift self-heals within one tick."""
+        avail = node.resources_available
+        for k, v in resources.items():
+            if v > 0:
+                avail[k] = avail.get(k, 0.0) - v
+
+    @staticmethod
+    def _credit(node: NodeInfo, resources: dict) -> None:
+        avail = node.resources_available
+        for k, v in resources.items():
+            if v > 0:
+                avail[k] = avail.get(k, 0.0) + v
+
     async def _schedule_actor(self, actor: ActorInfo) -> None:
         spec = actor.spec
         deadline = time.monotonic() + 120.0
         while True:
+            resources = spec.get("resources", {"CPU": 1})
             node = self._pick_node(
-                spec.get("resources", {"CPU": 1}),
+                resources,
                 spec.get("submitter_node"),
                 spec.get("scheduling_strategy") or {},
             )
             if node is not None:
+                self._debit(node, resources)
+                started = False
                 try:
                     client = await self._node_client(node)
                     resp = await client.call(
@@ -860,13 +1170,14 @@ class Controller:
                         },
                     )
                     if resp["status"] == "ok":
+                        started = True
                         actor.node_id = node.node_id
                         actor.worker_id = resp["worker_id"]
                         actor.spec["pid"] = resp.get("pid")
                         actor.address = tuple(resp["worker_addr"])
                         actor.state = "ALIVE"
                         actor.ready_event.set()
-                        self._mark_dirty()
+                        self._mark_dirty("actors", actor.actor_id)
                         await self.publish("actor_state", actor.snapshot())
                         return
                     print(
@@ -880,14 +1191,19 @@ class Controller:
                         f"error: {type(exc).__name__}: {exc}",
                         file=sys.stderr, flush=True,
                     )
+                finally:
+                    if not started:
+                        self._credit(node, resources)
             if time.monotonic() > deadline:
                 actor.state = "DEAD"
                 actor.death_cause = "unschedulable: no feasible node"
                 actor.ready_event.set()
-                self._mark_dirty()
+                self._mark_dirty("actors", actor.actor_id)
                 await self.publish("actor_state", actor.snapshot())
                 return
-            await asyncio.sleep(0.2)
+            # Event-driven retry: woken by the next capacity gain (node
+            # added, resources freed) instead of a fixed 200 ms poll.
+            await self._wait_for_capacity(1.0)
 
     async def _handle_actor_failure(self, actor: ActorInfo, cause: str) -> None:
         if actor.state == "DEAD":
@@ -898,7 +1214,7 @@ class Controller:
             actor.state = "RESTARTING"
             actor.address = None
             actor.ready_event.clear()
-            self._mark_dirty()
+            self._mark_dirty("actors", actor.actor_id)
             await self.publish("actor_state", actor.snapshot())
             spawn_task(self._schedule_actor(actor))
         else:
@@ -909,7 +1225,7 @@ class Controller:
                 self.named_actors.pop(
                     (actor.spec.get("namespace", "default"), actor.name), None
                 )
-            self._mark_dirty()
+            self._mark_dirty("actors", actor.actor_id)
             await self.publish("actor_state", actor.snapshot())
 
     async def rpc_worker_died(self, conn, payload) -> dict:
@@ -992,7 +1308,7 @@ class Controller:
                 self.named_actors.pop(
                     (actor.spec.get("namespace", "default"), actor.name), None
                 )
-            self._mark_dirty()
+            self._mark_dirty("actors", actor.actor_id)
             await self.publish("actor_state", actor.snapshot())
 
     async def rpc_list_actors(self, conn, payload) -> list:
@@ -1017,7 +1333,7 @@ class Controller:
             payload.get("job_id", ""),
         )
         self.pgs[pg.pg_id] = pg
-        self._mark_dirty()
+        self._mark_dirty("pgs", pg.pg_id)
         spawn_task(self._schedule_pg(pg))
         return self._mutation_record(
             payload, {"status": "ok", "pg_id": pg.pg_id}
@@ -1104,12 +1420,23 @@ class Controller:
         while pg.state in ("PENDING", "RESCHEDULING"):
             placement = self._plan_bundles(pg)
             if placement is not None:
+                # Optimistic reservation at PLAN time (see _debit), before
+                # any await: concurrent PG bursts each plan against the
+                # post-debit view and spread across nodes. Debiting only
+                # after the prepare reply lets every coroutine plan onto
+                # the same emptiest node, partially reserve, collide, and
+                # roll back in lockstep — a livelock under bursts.
+                debited = [
+                    (index, placement[index])
+                    for index in range(len(pg.bundles))
+                    if pg.bundle_nodes[index] is None
+                ]
+                for index, node in debited:
+                    self._debit(node, pg.bundles[index])
                 # Phase 1: prepare (reserve) every missing bundle.
                 prepared: list[tuple[int, NodeInfo]] = []
                 ok = True
-                for index, node in enumerate(placement):
-                    if pg.bundle_nodes[index] is not None:
-                        continue
+                for index, node in debited:
                     try:
                         client = await self._node_client(node)
                         resp = await client.call(
@@ -1147,10 +1474,15 @@ class Controller:
                 if ok:
                     pg.state = "CREATED"
                     pg.ready_event.set()
-                    self._mark_dirty()
+                    self._mark_dirty("pgs", pg.pg_id)
                     await self.publish("pg_state", pg.snapshot())
+                    # pg-strategy leases may be parked waiting for this.
+                    self._notify_capacity()
                     return
-                # Rollback phase-1 reservations (committed ones included).
+                # Rollback: credit every plan-time debit, release the
+                # bundles that actually got reserved (committed included).
+                for index, node in debited:
+                    self._credit(node, pg.bundles[index])
                 for index, node in prepared:
                     try:
                         client = await self._node_client(node)
@@ -1163,7 +1495,7 @@ class Controller:
             if time.monotonic() > deadline:
                 await self.publish("pg_state", pg.snapshot())
                 return  # stays PENDING (autoscaler hint); creator may time out
-            await asyncio.sleep(0.2)
+            await self._wait_for_capacity(1.0)
 
     async def rpc_pg_ready(self, conn, payload) -> dict:
         pg = self.pgs.get(payload["pg_id"])
@@ -1181,7 +1513,7 @@ class Controller:
 
     async def _remove_pg(self, pg: PlacementGroupInfo) -> None:
         pg.state = "REMOVED"
-        self._mark_dirty()
+        self._mark_dirty("pgs", pg.pg_id)
         for index, node_id in enumerate(pg.bundle_nodes):
             node = self.nodes.get(node_id or "")
             if node is None or not node.alive:
@@ -1235,6 +1567,35 @@ class Controller:
 
     async def rpc_list_workers(self, conn, payload) -> list:
         return list(self.clients.values())
+
+    async def rpc_controller_stats(self, conn, payload) -> dict:
+        """Control-plane internals for the scale suite and /metrics: queue
+        depths must drain to zero in a healthy cluster."""
+        states = collections.Counter(a.state for a in self.actors.values())
+        pg_states = collections.Counter(p.state for p in self.pgs.values())
+        return {
+            "counters": dict(self.stats_counters),
+            "pending_lease_shapes": len(self._pending_leases),
+            "pending_lease_depth": sum(
+                len(q) for q in self._pending_leases.values()
+            ),
+            "pending_demands": len(self.pending_demands),
+            "pub_outbox_depth": sum(
+                len(v) for v in self._pub_outbox.values()
+            ),
+            "subscriber_conns": len(
+                {c for s in self.subscribers.values() for c in s}
+            ),
+            "snapshot": dict(self._snap_stats),
+            "snapshot_store": self.store.stats(),
+            "mutation_cache_size": len(self._mutation_replies),
+            "nodes_alive": sum(1 for n in self.nodes.values() if n.alive),
+            "actor_states": dict(states),
+            "pg_states": dict(pg_states),
+            "node_stats": {
+                n.node_id: n.stats for n in self.nodes.values() if n.stats
+            },
+        }
 
 
 def main() -> None:

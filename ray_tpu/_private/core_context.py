@@ -86,7 +86,7 @@ class PendingTask:
     __slots__ = (
         "spec", "attempts", "return_ids", "arg_refs", "done",
         "direct", "native_handle", "direct_worker", "settle_lock",
-        "done_event",
+        "done_event", "queue_key",
     )
 
     def __init__(self, spec, return_ids, arg_refs):
@@ -95,6 +95,7 @@ class PendingTask:
         self.return_ids = return_ids
         self.arg_refs = arg_refs
         self.done = False
+        self.queue_key = None  # precomputed dispatcher-queue key
         # Direct-lane fields (set by the native submitter): the in-flight
         # C++ call handle, the pool worker it rode, and settle coordination
         # (first settler consumes the handle; others wait on done_event,
@@ -1391,6 +1392,12 @@ class CoreContext:
         template["_dkey"] = _resources_key(
             resources or {"CPU": 1}, repr(runtime_env or {})
         )
+        # dispatcher-queue key, also template-static: at 100k queued
+        # tasks the per-submit repr() rebuilds in _enqueue_task dominate
+        # the enqueue path, so pay them once per (function, options).
+        template["_qkey"] = template["_dkey"] + repr(
+            sorted((template["scheduling_strategy"] or {}).items())
+        )
         return template
 
     def submit_task(
@@ -1436,6 +1443,7 @@ class CoreContext:
                 scheduling_strategy=scheduling_strategy,
             )
         direct_key = spec.pop("_dkey", None)
+        queue_key = spec.pop("_qkey", None)
         spec_parts = spec.pop("_parts", None)
         return_ids = [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
@@ -1452,6 +1460,7 @@ class CoreContext:
             with tracing.span(f"submit {spec['name']}", task_id=task_id):
                 spec["trace_ctx"] = tracing.inject()
         record = PendingTask(spec, return_ids, arg_ref_ids)
+        record.queue_key = queue_key
         self._task_records[task_id] = record
         refs = []
         for rid in return_ids:
@@ -1506,10 +1515,12 @@ class CoreContext:
 
     def _enqueue_task(self, record: PendingTask) -> None:
         spec = record.spec
-        strategy = spec.get("scheduling_strategy") or {}
-        key = _resources_key(spec["resources"], repr(spec["runtime_env"])) + repr(
-            sorted(strategy.items())
-        )
+        key = record.queue_key
+        if key is None:
+            strategy = spec.get("scheduling_strategy") or {}
+            key = _resources_key(
+                spec["resources"], repr(spec["runtime_env"])
+            ) + repr(sorted(strategy.items()))
         queue = self._task_queues.get(key)
         if queue is None:
             queue = self._task_queues[key] = asyncio.Queue()

@@ -199,7 +199,10 @@ class NodeAgent:
         self.runtime_envs = RuntimeEnvManager(session_dir)
         self.leases: dict[str, Lease] = {}
         self.bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> {resources, available, committed}
-        self._resource_waiters: list[asyncio.Future] = []
+        # Parked lease requests indexed by resource shape (sorted names):
+        # a freed resource wakes only the shapes it can satisfy instead of
+        # thundering every waiter on every release. Key () = any shape.
+        self._resource_waiters: dict[tuple, list[asyncio.Future]] = {}
         self.log_dir = os.path.join(session_dir, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
         os.makedirs(self.spill_dir, exist_ok=True)
@@ -427,6 +430,37 @@ class NodeAgent:
             )
         return self._store_client
 
+    def _loop_engine(self):
+        """The running loop's native RPC engine, or None (asyncio backend)."""
+        try:
+            from ray_tpu._private.rpc import _NativeEngine
+
+            loop = asyncio.get_event_loop()
+            with _NativeEngine._lock:
+                return _NativeEngine._by_loop.get(id(loop))
+        except Exception:
+            return None
+
+    def _agent_stats(self) -> dict:
+        """Cheap local counters piggybacked on each heartbeat so the
+        controller aggregates cluster health without extra RPC fan-out."""
+        stats = {
+            "workers": len(self.workers),
+            "idle_workers": sum(len(v) for v in self.idle_workers.values()),
+            "leases": len(self.leases) + len(self._native_leases),
+            "bundles": len(self.bundles),
+            "resource_waiters": sum(
+                len(v) for v in self._resource_waiters.values()
+            ),
+        }
+        engine = self._loop_engine()
+        if engine is not None and hasattr(engine, "stats"):
+            try:
+                stats["engine"] = engine.stats()
+            except Exception:
+                pass
+        return stats
+
     async def _heartbeat_loop(self) -> None:
         cfg = global_config()
         while True:
@@ -439,6 +473,7 @@ class NodeAgent:
                     {
                         "node_id": self.node_id,
                         "resources_available": self.resources_available,
+                        "stats": self._agent_stats(),
                     },
                 )
                 if resp.get("status") in ("unknown_node", "reregister"):
@@ -534,13 +569,28 @@ class NodeAgent:
                 pool[k] = pool.get(k, 0.0) - v
         return True
 
+    def _wake_waiters(self, freed: dict | None = None) -> None:
+        """Wake parked lease requests whose resource shape overlaps the
+        freed keys (all shapes when *freed* is None/unknown)."""
+        if not self._resource_waiters:
+            return
+        if freed is None:
+            shapes = list(self._resource_waiters)
+        else:
+            freed_keys = {k for k, v in freed.items() if v > 0}
+            shapes = [
+                s for s in self._resource_waiters
+                if not s or not freed_keys.isdisjoint(s)
+            ]
+        for shape in shapes:
+            for waiter in self._resource_waiters.pop(shape, ()):
+                if not waiter.done():
+                    waiter.set_result(None)
+
     def _give_back(self, resources: dict, bundle_key: tuple | None) -> None:
         if bundle_key is None and self._native_lease is not None:
             self._lease_adjust_native(resources, +1)
-            for waiter in self._resource_waiters:
-                if not waiter.done():
-                    waiter.set_result(None)
-            self._resource_waiters.clear()
+            self._wake_waiters(resources)
             return
         if bundle_key is not None:
             bundle = self.bundles.get(bundle_key)
@@ -556,26 +606,31 @@ class NodeAgent:
             for k, v in resources.items():
                 if v > 0:
                     pool[k] = pool.get(k, 0.0) + v
-        for waiter in self._resource_waiters:
-            if not waiter.done():
-                waiter.set_result(None)
-        self._resource_waiters.clear()
+        self._wake_waiters(resources)
 
     async def _on_lease_freed(self, conn, raw) -> None:
         """The engine returned a native lease: its freed resources must
         wake any Python-path request parked in _wait_for_resources."""
-        for waiter in self._resource_waiters:
-            if not waiter.done():
-                waiter.set_result(None)
-        self._resource_waiters.clear()
+        freed = None
+        if isinstance(raw, dict):
+            freed = raw.get("resources") or None
+        self._wake_waiters(freed)
 
-    async def _wait_for_resources(self) -> None:
+    async def _wait_for_resources(self, resources: dict | None = None) -> None:
+        shape = tuple(sorted(k for k, v in (resources or {}).items() if v > 0))
         future = asyncio.get_running_loop().create_future()
-        self._resource_waiters.append(future)
+        self._resource_waiters.setdefault(shape, []).append(future)
         try:
             await asyncio.wait_for(future, timeout=5.0)
         except asyncio.TimeoutError:
             pass
+        finally:
+            bucket = self._resource_waiters.get(shape)
+            if bucket is not None:
+                if future in bucket:
+                    bucket.remove(future)
+                if not bucket:
+                    self._resource_waiters.pop(shape, None)
 
     # ------------------------------------------------------------------
     # worker pool [N11]
@@ -823,7 +878,7 @@ class NodeAgent:
         while not self._try_consume(resources, bundle_key):
             if time.monotonic() > deadline:
                 return {"status": "busy"}
-            await self._wait_for_resources()
+            await self._wait_for_resources(resources)
         env_hash = self._env_hash(runtime_env)
         worker = self._pop_idle_worker(env_hash, payload.get("job_id", ""))
         if worker is None:
@@ -1170,6 +1225,11 @@ class NodeAgent:
             "pushes_started": self.pushes_started,
             "pushes_received": self.pushes_received,
         }
+        # Leases the PYTHON path still holds (direct-lane workers not yet
+        # past their reuse grace): lets callers detect true quiescence
+        # instead of "at least one worker returned".
+        self._drain_lease_events()
+        stats["leases_outstanding"] = len(self.leases) + len(self._native_leases)
         engine = self._native_lease
         if engine is not None:
             import ctypes
@@ -1182,6 +1242,12 @@ class NodeAgent:
                 "idle_workers": int(out[2]),
                 "active": int(out[3]),
             }
+        loop_engine = self._loop_engine()
+        if loop_engine is not None and hasattr(loop_engine, "stats"):
+            try:
+                stats["engine"] = loop_engine.stats()
+            except Exception:
+                pass
         return stats
 
     async def rpc_runtime_env_info(self, conn, payload) -> dict:

@@ -217,6 +217,12 @@ class _NativeEngine:
 
     def send(self, conn: int, kind: int, msgid: int, method: bytes,
              payload: bytes) -> int:
+        if not self.handle:
+            # Engine already destroyed (loop teardown): a queued dispatch
+            # callback may still try to write its reply. Passing the NULL
+            # handle into rt_send is a segfault; fail the send instead so
+            # the caller takes its ConnectionError path.
+            return -1
         lib = (
             self.pylib if len(payload) < self._PYLIB_MAX_PAYLOAD else self.lib
         )
@@ -229,7 +235,31 @@ class _NativeEngine:
         if self.handle:
             self.lib.rt_close_conn(self.handle, conn)
 
+    def stats(self) -> dict:
+        """Internal engine counters (frames/bytes/chunks/queue depths) —
+        the N27 observability surface for everything native."""
+        if not self.handle:
+            return {}
+        out = (ctypes.c_longlong * 12)()
+        self.lib.rt_engine_stats(self.handle, out)
+        return {
+            "frames_sent": int(out[0]),
+            "frames_received": int(out[1]),
+            "bytes_sent": int(out[2]),
+            "bytes_received": int(out[3]),
+            "chunks_sent": int(out[4]),
+            "chunks_received": int(out[5]),
+            "inbox_depth": int(out[6]),
+            "exec_queue_depth": int(out[7]),
+            "write_queue_frames": int(out[8]),
+            "connections": int(out[9]),
+            "lease_grants": int(out[10]),
+            "calls_inflight": int(out[11]),
+        }
+
     def _drain(self) -> None:
+        if not self.handle:
+            return  # destroyed while this callback was already queued
         try:
             os.read(self.notify_fd, 8)
         except (BlockingIOError, OSError):
@@ -676,6 +706,13 @@ class _ClientCallMixin:
         self._pending.clear()
 
     def _handle_push(self, method: str, payload: Any) -> None:
+        if method == "__pub_batch__":
+            # Controller-side pubsub batching (one push frame per
+            # connection per tick): demux back into per-channel handlers
+            # in publish order.
+            for item in payload:
+                self._handle_push(item[0], item[1])
+            return
         handler = self._push_handlers.get(method)
         if handler is not None:
             result = handler(payload)

@@ -24,6 +24,7 @@ import os
 import socket
 import struct
 import threading
+import time
 
 import msgpack
 
@@ -34,8 +35,32 @@ _SNAPSHOT_KEY = "state"
 
 
 class SnapshotStore:
+    # Save instrumentation (class defaults; first write creates instance
+    # attrs). The scale suite reads these through controller_stats to
+    # prove incremental snapshotting keeps write cost bounded.
+    saves = 0
+    save_bytes = 0
+    save_ms_total = 0.0
+
     def save(self, blob: bytes) -> None:
         raise NotImplementedError
+
+    def timed_save(self, blob: bytes) -> None:
+        """save() plus bookkeeping — the controller's snapshot loop goes
+        through here so every backend gets cost accounting for free."""
+        start = time.perf_counter()
+        self.save(blob)
+        self.saves += 1
+        self.save_bytes += len(blob)
+        self.save_ms_total += (time.perf_counter() - start) * 1e3
+
+    def stats(self) -> dict:
+        return {
+            "saves": self.saves,
+            "bytes": self.save_bytes,
+            "ms_total": round(self.save_ms_total, 3),
+            "where": self.describe(),
+        }
 
     def load(self) -> bytes | None:
         raise NotImplementedError

@@ -105,3 +105,257 @@ class Cluster:
 
     def shutdown(self) -> None:
         self._cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Fake-provider scale harness: a REAL controller + N lightweight fake node
+# agents in ONE process/loop. Each fake agent is a real RPC server+client
+# that registers, heartbeats, and answers the agent-side control RPCs
+# (start_actor / prepare_bundle / commit_bundle / release_bundle /
+# kill_worker) instantly with honest resource accounting — no worker
+# processes, no object stores. This is what lets the scale-envelope suite
+# exercise 32+ nodes / 2k actors / 200 PGs / 100k leases on one machine:
+# the control-plane code paths are the production ones end to end; only
+# the data plane is faked. (Reference: fake_cluster / mock worker
+# patterns in Ray's release scalability tests.)
+# ---------------------------------------------------------------------------
+
+
+class FakeNodeAgent:
+    """One fake node. Talks the full agent<->controller protocol over the
+    real RPC stack; start_actor consumes capacity, kill_worker returns it,
+    heartbeats report honest availability plus piggybacked stats."""
+
+    def __init__(self, index: int, controller_addr: tuple,
+                 resources: dict | None = None):
+        from ray_tpu._private.rpc import RpcClient, RpcServer
+
+        self.index = index
+        self.node_id = f"fake-node-{index:04d}"
+        self.controller_addr = controller_addr
+        self.resources_total = dict(resources or {"CPU": 64.0})
+        self.resources_total.setdefault(f"node:{self.node_id}", 1.0)
+        self.available = dict(self.resources_total)
+        self.server = RpcServer(name=f"fake-agent-{index}")
+        self.client = RpcClient(
+            tuple(controller_addr), name=f"fake-agent-{index}",
+            auto_reconnect=True,
+        )
+        self.addr: tuple | None = None
+        self.workers: dict[str, dict] = {}   # worker_id -> resources
+        self.bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> resources
+        self._worker_seq = 0
+        self._hb_task = None
+        self.heartbeats_sent = 0
+
+    # -- agent-side control RPCs (served to the controller) --------------
+    def _fits(self, resources: dict) -> bool:
+        return all(
+            self.available.get(k, 0.0) + 1e-9 >= v
+            for k, v in resources.items() if v > 0
+        )
+
+    def _consume(self, resources: dict) -> None:
+        for k, v in resources.items():
+            if v > 0:
+                self.available[k] = self.available.get(k, 0.0) - v
+
+    def _restore(self, resources: dict) -> None:
+        for k, v in resources.items():
+            if v > 0:
+                self.available[k] = self.available.get(k, 0.0) + v
+
+    async def rpc_start_actor(self, conn, payload) -> dict:
+        resources = (payload.get("spec") or {}).get("resources") or {"CPU": 1}
+        if not self._fits(resources):
+            return {"status": "busy"}
+        self._consume(resources)
+        self._worker_seq += 1
+        worker_id = f"fw-{self.index:04d}-{self._worker_seq}"
+        self.workers[worker_id] = dict(resources)
+        return {
+            "status": "ok",
+            "worker_id": worker_id,
+            "pid": 0,
+            "worker_addr": list(self.addr),
+        }
+
+    async def rpc_kill_worker(self, conn, payload) -> dict:
+        resources = self.workers.pop(payload.get("worker_id") or "", None)
+        if resources is not None:
+            self._restore(resources)
+        return {"status": "ok"}
+
+    async def rpc_prepare_bundle(self, conn, payload) -> dict:
+        key = (payload["pg_id"], payload["bundle_index"])
+        resources = payload["resources"]
+        if key in self.bundles:
+            return {"status": "ok"}
+        if not self._fits(resources):
+            return {"status": "busy"}
+        self._consume(resources)
+        self.bundles[key] = dict(resources)
+        return {"status": "ok"}
+
+    async def rpc_commit_bundle(self, conn, payload) -> dict:
+        return {"status": "ok"}
+
+    async def rpc_release_bundle(self, conn, payload) -> dict:
+        key = (payload["pg_id"], payload["bundle_index"])
+        resources = self.bundles.pop(key, None)
+        if resources is not None:
+            self._restore(resources)
+        return {"status": "ok"}
+
+    async def rpc_ping(self, conn, payload) -> dict:
+        return {"status": "ok"}
+
+    # -- lifecycle --------------------------------------------------------
+    def _stats(self) -> dict:
+        return {
+            "workers": len(self.workers),
+            "idle_workers": 0,
+            "leases": len(self.workers),
+            "bundles": len(self.bundles),
+            "resource_waiters": 0,
+        }
+
+    async def heartbeat(self) -> dict:
+        self.heartbeats_sent += 1
+        return await self.client.call(
+            "heartbeat",
+            {
+                "node_id": self.node_id,
+                "resources_available": dict(self.available),
+                "stats": self._stats(),
+            },
+        )
+
+    async def start(self, heartbeat_period_s: float = 1.0) -> None:
+        import asyncio
+
+        self.server.route_object(self)
+        port = await self.server.start("127.0.0.1", 0)
+        self.addr = ("127.0.0.1", port)
+        await self.client.connect()
+        await self.client.call(
+            "register_node",
+            {
+                "node_id": self.node_id,
+                "agent_addr": list(self.addr),
+                "resources": self.resources_total,
+                "store_info": {},
+                "labels": {"fake": "1"},
+                "live_actors": [],
+                "held_bundles": [],
+            },
+        )
+        if heartbeat_period_s > 0:
+            self._hb_task = asyncio.ensure_future(
+                self._heartbeat_loop(heartbeat_period_s)
+            )
+
+    async def _heartbeat_loop(self, period: float) -> None:
+        import asyncio
+
+        while True:
+            await asyncio.sleep(period)
+            try:
+                await self.heartbeat()
+            except Exception:
+                await asyncio.sleep(1.0)
+
+    async def stop(self) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            self._hb_task = None
+        try:
+            await self.client.close()
+        except Exception:
+            pass
+        try:
+            await self.server.stop()
+        except Exception:
+            pass
+
+
+class FakeScaleCluster:
+    """In-process control-plane scale rig: real Controller + N FakeNodeAgents
+    on the current event loop, plus a driver RPC client. Used by
+    release/benchmarks_scale.py and ci/run_scale_smoke.sh."""
+
+    def __init__(self, num_nodes: int, cpus_per_node: float = 64.0,
+                 heartbeat_period_s: float = 1.0,
+                 session_dir: str | None = None):
+        self.num_nodes = num_nodes
+        self.cpus_per_node = float(cpus_per_node)
+        self.heartbeat_period_s = heartbeat_period_s
+        self._session_dir = session_dir
+        self._tmpdir = None
+        self.controller = None
+        self.controller_addr: tuple | None = None
+        self.agents: list[FakeNodeAgent] = []
+        self.driver = None
+
+    async def start(self) -> None:
+        import tempfile
+
+        from ray_tpu._private.controller import Controller
+        from ray_tpu._private.rpc import RpcClient
+
+        if self._session_dir is None:
+            self._tmpdir = tempfile.TemporaryDirectory(prefix="raytpu-scale-")
+            self._session_dir = self._tmpdir.name
+        self.controller = Controller(self._session_dir)
+        port = await self.controller.start("127.0.0.1", 0)
+        self.controller_addr = ("127.0.0.1", port)
+        for i in range(self.num_nodes):
+            agent = FakeNodeAgent(
+                i, self.controller_addr, {"CPU": self.cpus_per_node}
+            )
+            await agent.start(self.heartbeat_period_s)
+            self.agents.append(agent)
+        self.driver = RpcClient(self.controller_addr, name="scale-driver")
+        await self.driver.connect()
+        await self.driver.call(
+            "register_client",
+            {"worker_id": "drv-scale", "is_driver": False,
+             "job_id": "scale-bench"},
+        )
+
+    async def add_node(self) -> FakeNodeAgent:
+        agent = FakeNodeAgent(
+            len(self.agents), self.controller_addr,
+            {"CPU": self.cpus_per_node},
+        )
+        await agent.start(self.heartbeat_period_s)
+        self.agents.append(agent)
+        return agent
+
+    async def controller_stats(self) -> dict:
+        return await self.driver.call("controller_stats", {})
+
+    async def stop(self) -> None:
+        if self.driver is not None:
+            try:
+                await self.driver.close()
+            except Exception:
+                pass
+        for agent in self.agents:
+            await agent.stop()
+        self.agents.clear()
+        if self.controller is not None:
+            try:
+                await self.controller.server.stop()
+            except Exception:
+                pass
+        try:
+            import asyncio
+
+            from ray_tpu._private.rpc import _NativeEngine
+
+            _NativeEngine.destroy_for_loop(asyncio.get_running_loop())
+        except Exception:
+            pass
+        if self._tmpdir is not None:
+            self._tmpdir.cleanup()

@@ -157,6 +157,99 @@ class Histogram(_Metric):
         )
 
 
+# ---------------------------------------------------------------------------
+# Native/control-plane observability [N27]: the C++ engine's internal
+# counters and the controller's queue depths surface as first-class
+# Prometheus series, so "is the control plane draining?" is a dashboard
+# query instead of a debugger session.
+# ---------------------------------------------------------------------------
+
+_CONTROLLER_GAUGES = (
+    "pending_lease_shapes",
+    "pending_lease_depth",
+    "pending_demands",
+    "pub_outbox_depth",
+    "subscriber_conns",
+    "mutation_cache_size",
+    "nodes_alive",
+)
+_NODE_GAUGES = ("workers", "idle_workers", "leases", "bundles",
+                "resource_waiters")
+
+
+def local_engine_points() -> list:
+    """(name, tags, value, kind) for every live native engine in THIS
+    process (driver side; node agents report theirs via heartbeat)."""
+    points: list = []
+    try:
+        from ray_tpu._private.rpc import _NativeEngine
+
+        with _NativeEngine._lock:
+            engines = sorted(_NativeEngine._by_loop.items())
+    except Exception:
+        return points
+    for idx, (_loop_id, engine) in enumerate(engines):
+        try:
+            stats = engine.stats()
+        except Exception:
+            continue
+        for field, value in stats.items():
+            points.append(
+                (f"native_engine_{field}", {"engine": str(idx)},
+                 float(value), "gauge")
+            )
+    return points
+
+
+def control_plane_points(ctx) -> list:
+    """(name, tags, value, kind) from the controller's live internals:
+    its own counters/queue depths plus the per-node agent stats (worker
+    pools + native engine counters) piggybacked on heartbeats."""
+    points: list = []
+    try:
+        stats = ctx.io.run(
+            ctx.controller.call("controller_stats", {}, timeout=5.0)
+        )
+    except Exception:
+        return points
+    for name, value in sorted((stats.get("counters") or {}).items()):
+        points.append((f"controller_{name}", {}, float(value), "counter"))
+    for field in _CONTROLLER_GAUGES:
+        if field in stats:
+            points.append(
+                (f"controller_{field}", {}, float(stats[field]), "gauge")
+            )
+    for field, value in sorted((stats.get("snapshot") or {}).items()):
+        points.append(
+            (f"controller_snapshot_{field}", {}, float(value), "gauge")
+        )
+    for node_id, nstats in sorted((stats.get("node_stats") or {}).items()):
+        for field in _NODE_GAUGES:
+            if field in nstats:
+                points.append(
+                    (f"node_{field}", {"node": node_id},
+                     float(nstats[field]), "gauge")
+                )
+        for field, value in sorted((nstats.get("engine") or {}).items()):
+            points.append(
+                (f"native_engine_{field}", {"node": node_id},
+                 float(value), "gauge")
+            )
+    return points
+
+
+def _render_points(points, lines: list, seen_headers: set) -> None:
+    for name, tags, value, kind in points:
+        full = "ray_tpu_" + name
+        if full not in seen_headers:
+            seen_headers.add(full)
+            lines.append(f"# HELP {full} internal {kind}")
+            lines.append(f"# TYPE {full} {kind}")
+        tag_str = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+        label = f"{{{tag_str}}}" if tag_str else ""
+        lines.append(f"{full}{label} {value}")
+
+
 def collect_prometheus_text() -> str:
     """Render every recorded metric in Prometheus exposition format."""
     try:
@@ -201,4 +294,6 @@ def collect_prometheus_text() -> str:
             lines.append(f"{name}_sum{label} {point['sum']}")
         else:
             lines.append(f"{name}{label} {point['value']}")
+    _render_points(local_engine_points(), lines, seen_headers)
+    _render_points(control_plane_points(ctx), lines, seen_headers)
     return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,259 @@
+"""Control-plane scale-envelope benchmarks (release suite).
+
+Drives a REAL controller through the four scale scenarios the control
+plane must sustain — 32+ nodes, 2,000+ concurrent actors, 200+ placement
+groups, 100,000+ lease requests through one driver — on the in-process
+fake cluster (`cluster_utils.FakeScaleCluster`): real RPC stack, real
+scheduler/2PC/pubsub/snapshot paths, fake data plane. Each scenario
+prints ONE JSON line so release_tests.yaml can enforce calibrated
+wall-clock floors; queue-depth metrics prove the controller drains.
+
+Usage:
+    python release/benchmarks_scale.py --scenario nodes|actors|pgs|tasks
+        [--nodes N] [--actors N] [--pgs N] [--tasks N]
+
+RAY_TPU_RELEASE_SMOKE=1 (set by run_all.py --smoke and by
+ci/run_scale_smoke.sh) downsizes the envelope to 8 nodes / 200 actors /
+20 pgs / 5,000 tasks so the suite fits the tier-1 timeout.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from ray_tpu.cluster_utils import FakeScaleCluster  # noqa: E402
+
+SMOKE = os.environ.get("RAY_TPU_RELEASE_SMOKE") == "1"
+
+
+async def _wait(predicate, timeout: float, period: float = 0.1):
+    """Await predicate() (async) truthy; returns its last value."""
+    deadline = time.monotonic() + timeout
+    value = await predicate()
+    while not value and time.monotonic() < deadline:
+        await asyncio.sleep(period)
+        value = await predicate()
+    return value
+
+
+async def bench_nodes(num_nodes: int) -> dict:
+    """Registration + heartbeat fan-in at num_nodes."""
+    cluster = FakeScaleCluster(num_nodes=num_nodes, cpus_per_node=64)
+    t0 = time.perf_counter()
+    await cluster.start()
+    register_wall = time.perf_counter() - t0
+
+    async def all_alive():
+        stats = await cluster.controller_stats()
+        return stats["nodes_alive"] >= num_nodes and stats
+
+    stats = await _wait(all_alive, 30.0)
+    assert stats, "nodes never all came alive"
+    # Heartbeat fan-in window: measure the aggregate processing rate and
+    # that piggybacked stats reach the controller.
+    before = (await cluster.controller_stats())["counters"].get("heartbeats", 0)
+    window = 3.0
+    await asyncio.sleep(window)
+    after_stats = await cluster.controller_stats()
+    after = after_stats["counters"].get("heartbeats", 0)
+    reporting = len(after_stats.get("node_stats") or {})
+    await cluster.stop()
+    return {
+        "nodes": num_nodes,
+        "register_wall_s": round(register_wall, 3),
+        "heartbeats_per_s": round((after - before) / window, 1),
+        "nodes_reporting_stats": reporting,
+    }
+
+
+async def bench_actors(num_nodes: int, num_actors: int) -> dict:
+    """Burst-create actors to ALIVE through one driver, then tear down."""
+    cpus = max(8, (num_actors + num_nodes - 1) // num_nodes + 4)
+    cluster = FakeScaleCluster(num_nodes=num_nodes, cpus_per_node=cpus)
+    await cluster.start()
+    t0 = time.perf_counter()
+    await asyncio.gather(*[
+        cluster.driver.call("create_actor", {
+            "actor_id": f"bench-actor-{i}", "resources": {"CPU": 1},
+            "job_id": "scale-bench", "max_restarts": 0,
+            "creation_args": None,
+        }) for i in range(num_actors)
+    ])
+
+    async def settled():
+        actors = await cluster.driver.call("list_actors", {})
+        alive = sum(1 for a in actors if a["state"] == "ALIVE")
+        dead = sum(1 for a in actors if a["state"] == "DEAD")
+        return (alive, dead) if alive + dead >= num_actors else None
+
+    result = await _wait(settled, 120.0)
+    alive_wall = time.perf_counter() - t0
+    alive, dead = result if result else (0, 0)
+    # Ghosts: more live workers on agents than actors the controller
+    # accounts for (the failure mode duplicated mutations produce).
+    workers_total = sum(len(a.workers) for a in cluster.agents)
+    ghost_actors = max(0, workers_total - alive)
+    # Teardown: kill everything, wait for agent capacity to return.
+    t0 = time.perf_counter()
+    await asyncio.gather(*[
+        cluster.driver.call(
+            "kill_actor", {"actor_id": f"bench-actor-{i}", "no_restart": True}
+        ) for i in range(num_actors)
+    ])
+
+    async def drained():
+        return sum(len(a.workers) for a in cluster.agents) == 0
+
+    assert await _wait(drained, 60.0), "workers never drained after kill"
+    kill_wall = time.perf_counter() - t0
+    await cluster.stop()
+    return {
+        "actors": num_actors,
+        "alive": alive,
+        "dead": dead,
+        "ghost_actors": ghost_actors,
+        "alive_wall_s": round(alive_wall, 3),
+        "actors_per_s": round(num_actors / max(alive_wall, 1e-9), 1),
+        "kill_wall_s": round(kill_wall, 3),
+    }
+
+
+async def bench_pgs(num_nodes: int, num_pgs: int) -> dict:
+    """Placement-group 2PC burst: num_pgs groups of 4 bundles each."""
+    bundles_per_pg = 4
+    need = num_pgs * bundles_per_pg
+    cpus = max(8, (need + num_nodes - 1) // num_nodes + 4)
+    cluster = FakeScaleCluster(num_nodes=num_nodes, cpus_per_node=cpus)
+    await cluster.start()
+    t0 = time.perf_counter()
+    await asyncio.gather(*[
+        cluster.driver.call("create_placement_group", {
+            "pg_id": f"bench-pg-{i}",
+            "bundles": [{"CPU": 1}] * bundles_per_pg,
+            "strategy": "PACK",
+            "job_id": "scale-bench",
+        }) for i in range(num_pgs)
+    ])
+
+    async def created():
+        pgs = await cluster.driver.call("list_placement_groups", {})
+        n = sum(1 for p in pgs if p["state"] == "CREATED")
+        return n if n >= num_pgs else None
+
+    n_created = await _wait(created, 120.0) or 0
+    created_wall = time.perf_counter() - t0
+    # Remove them all; bundle reservations must return to the agents.
+    t0 = time.perf_counter()
+    await asyncio.gather(*[
+        cluster.driver.call(
+            "remove_placement_group", {"pg_id": f"bench-pg-{i}"}
+        ) for i in range(num_pgs)
+    ])
+
+    async def released():
+        return sum(len(a.bundles) for a in cluster.agents) == 0
+
+    bundles_released = bool(await _wait(released, 60.0))
+    remove_wall = time.perf_counter() - t0
+    await cluster.stop()
+    return {
+        "pgs": num_pgs,
+        "created": n_created,
+        "created_wall_s": round(created_wall, 3),
+        "pgs_per_s": round(num_pgs / max(created_wall, 1e-9), 1),
+        "remove_wall_s": round(remove_wall, 3),
+        "bundles_released": int(bundles_released),
+    }
+
+
+async def bench_tasks(num_nodes: int, num_tasks: int) -> dict:
+    """Lease-request storm through ONE driver connection, then a parked
+    burst that must drain via capacity pulses (the shape-indexed queue)."""
+    cluster = FakeScaleCluster(num_nodes=num_nodes, cpus_per_node=64)
+    await cluster.start()
+    sem = asyncio.Semaphore(512)
+
+    async def one():
+        async with sem:
+            r = await cluster.driver.call(
+                "request_lease", {"resources": {"CPU": 0.001}}
+            )
+            assert r["status"] == "ok", r
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[one() for _ in range(num_tasks)])
+    storm_wall = time.perf_counter() - t0
+
+    # Parked burst: requests for a resource NO node offers yet park in the
+    # pending-lease queue; adding one node with that resource must pulse
+    # capacity and drain the whole bucket.
+    parked = 200 if not SMOKE else 50
+    pend = [
+        asyncio.ensure_future(cluster.driver.call(
+            "request_lease", {"resources": {"SCALE_TOKEN": 1.0}}
+        ))
+        for _ in range(parked)
+    ]
+
+    async def queued():
+        stats = await cluster.controller_stats()
+        return stats["pending_lease_depth"] >= parked
+
+    assert await _wait(queued, 30.0), "burst never parked in lease queue"
+    t0 = time.perf_counter()
+    new_agent = await cluster.add_node()
+    new_agent.resources_total["SCALE_TOKEN"] = float(parked)
+    new_agent.available["SCALE_TOKEN"] = float(parked)
+    await new_agent.heartbeat()  # capacity gain -> pulse -> drain
+    replies = await asyncio.gather(*pend)
+    drain_wall = time.perf_counter() - t0
+    granted = sum(1 for r in replies if r["status"] == "ok")
+
+    stats = await cluster.controller_stats()
+    await cluster.stop()
+    return {
+        "leases": num_tasks,
+        "leases_per_s": round(num_tasks / max(storm_wall, 1e-9), 1),
+        "storm_wall_s": round(storm_wall, 3),
+        "parked": parked,
+        "parked_granted": granted,
+        "park_drain_wall_s": round(drain_wall, 3),
+        "pending_after": stats["pending_lease_depth"],
+        "pub_outbox_after": stats["pub_outbox_depth"],
+        "queue_grants": stats["counters"].get("lease_queue_grants", 0),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--scenario", required=True,
+                        choices=["nodes", "actors", "pgs", "tasks"])
+    parser.add_argument("--nodes", type=int, default=8 if SMOKE else 32)
+    parser.add_argument("--actors", type=int, default=200 if SMOKE else 2000)
+    parser.add_argument("--pgs", type=int, default=20 if SMOKE else 200)
+    parser.add_argument("--tasks", type=int,
+                        default=5000 if SMOKE else 100_000)
+    args = parser.parse_args()
+
+    t0 = time.perf_counter()
+    if args.scenario == "nodes":
+        result = asyncio.run(bench_nodes(args.nodes))
+    elif args.scenario == "actors":
+        result = asyncio.run(bench_actors(args.nodes, args.actors))
+    elif args.scenario == "pgs":
+        result = asyncio.run(bench_pgs(args.nodes, args.pgs))
+    else:
+        result = asyncio.run(bench_tasks(args.nodes, args.tasks))
+    result["benchmark"] = f"scale_{args.scenario}"
+    result["total_wall_s"] = round(time.perf_counter() - t0, 3)
+    result["smoke"] = int(SMOKE)
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,9 +51,11 @@ def main(target_return: float = 150.0, max_iters: int = 30):
     best = -np.inf
     start = time.perf_counter()
     steps_before = 0
+    iters_completed = 0
     try:
         for _ in range(max_iters):
             result = algo.train()
+            iters_completed += 1
             steps_before = result["num_env_steps_sampled_lifetime"]
             ret = result.get("episode_return_mean", np.nan)
             if not np.isnan(ret):
@@ -67,6 +69,7 @@ def main(target_return: float = 150.0, max_iters: int = 30):
                 "env_steps_per_s": steps_before / elapsed,
                 "best_return": float(best),
                 "reached_target": bool(best >= target_return),
+                "iters_completed": iters_completed,
                 "wall_s": elapsed,
             }
         ))

@@ -558,13 +558,18 @@ class Engine {
     if (plen) memcpy(p + 12 + mlen, payload, plen);
 
     bool need_arm = false;
+    const long long fbytes = (long long)frame.size();
     {
       std::lock_guard<std::mutex> wlock(conn->wmu);
       if (conn->closed || conn->fd < 0) return -ENOTCONN;
       if (allow_inline && conn->wq.empty()) {
         // Fast path: write inline from the caller thread.
         ssize_t n = ::send(conn->fd, frame.data(), frame.size(), MSG_NOSIGNAL);
-        if (n == ssize_t(frame.size())) return 0;
+        if (n == ssize_t(frame.size())) {
+          frames_sent_.fetch_add(1, std::memory_order_relaxed);
+          bytes_sent_.fetch_add(fbytes, std::memory_order_relaxed);
+          return 0;
+        }
         if (n < 0) {
           if (errno != EAGAIN && errno != EWOULDBLOCK) {
             RequestClose(conn_id);
@@ -590,6 +595,10 @@ class Engine {
       pending_arm_.push_back(conn_id);
       Wake();
     }
+    // Queued frames count as sent at enqueue time: the observable quantity
+    // is engine throughput, and the residue is visible as write_queue depth.
+    frames_sent_.fetch_add(1, std::memory_order_relaxed);
+    bytes_sent_.fetch_add(fbytes, std::memory_order_relaxed);
     return 0;
   }
 
@@ -870,6 +879,7 @@ class Engine {
                     uint32_t(payload.size()),
                     /*allow_inline=*/false);
       if (rc != 0) return;  // conn closed mid-transfer: receiver times out
+      chunks_sent_.fetch_add(1, std::memory_order_relaxed);
       offset += n;
     } while (offset < total);
   }
@@ -924,6 +934,7 @@ class Engine {
       }
       memcpy(&t.data[offset], chunk, chunk_len);
       t.received += chunk_len;
+      chunks_recv_.fetch_add(1, std::memory_order_relaxed);
       t.last_update = std::chrono::steady_clock::now();
       if (t.received >= t.data.size()) {
         // move to the completed pool (keyed by oid alone — TransferTake's
@@ -1110,6 +1121,47 @@ class Engine {
     out[1] = lease_returns_;
     out[2] = (long long)lease_idle_.size();
     out[3] = (long long)lease_active_.size();
+  }
+
+  // 12-slot stats vector consumed by _NativeEngine.stats() in rpc.py:
+  // [frames_sent, frames_received, bytes_sent, bytes_received,
+  //  chunks_sent, chunks_received, inbox_depth, exec_queue_depth,
+  //  write_queue_frames, connections, lease_grants, calls_inflight].
+  // Conn write queues are sampled AFTER releasing mu_ (Send holds wmu
+  // while calling RequestClose→mu_, so mu_→wmu here would be ABBA).
+  void EngineStats(long long *out) {
+    out[0] = frames_sent_.load(std::memory_order_relaxed);
+    out[1] = frames_recv_.load(std::memory_order_relaxed);
+    out[2] = bytes_sent_.load(std::memory_order_relaxed);
+    out[3] = bytes_recv_.load(std::memory_order_relaxed);
+    out[4] = chunks_sent_.load(std::memory_order_relaxed);
+    out[5] = chunks_recv_.load(std::memory_order_relaxed);
+    std::vector<std::shared_ptr<Conn>> snap;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      out[6] = (long long)inbox_.size();
+      out[9] = (long long)conns_.size();
+      snap.reserve(conns_.size());
+      for (auto &kv : conns_) snap.push_back(kv.second);
+    }
+    long long wq = 0;
+    for (auto &c : snap) {
+      std::lock_guard<std::mutex> wlock(c->wmu);
+      wq += (long long)c->wq.size();
+    }
+    out[8] = wq;
+    {
+      std::lock_guard<std::mutex> lock(exec_mu_);
+      out[7] = (long long)execq_.size();
+    }
+    {
+      std::lock_guard<std::mutex> lock(lease_mu_);
+      out[10] = lease_grants_;
+    }
+    {
+      std::lock_guard<std::mutex> lock(call_mu_);
+      out[11] = (long long)calls_.size();
+    }
   }
 
  private:
@@ -1718,6 +1770,8 @@ class Engine {
       m->payload.assign(f + 8 + mlen, f + body);
       out.push_back(m);
       c.rstart += 4 + body;
+      frames_recv_.fetch_add(1, std::memory_order_relaxed);
+      bytes_recv_.fetch_add(4 + (long long)body, std::memory_order_relaxed);
     }
     // Compact the read buffer once the parsed prefix dominates.
     if (c.rstart > 0 && (c.rstart >= c.rbuf.size() || c.rstart > 1 << 20)) {
@@ -1738,6 +1792,15 @@ class Engine {
   std::vector<long> pending_close_;
   std::vector<long> pending_arm_;
   long next_id_ = 1;
+
+  // Observability counters read by EngineStats: relaxed atomics — the hot
+  // paths only add, and the stats reader tolerates momentary skew.
+  std::atomic<long long> frames_sent_{0};
+  std::atomic<long long> frames_recv_{0};
+  std::atomic<long long> bytes_sent_{0};
+  std::atomic<long long> bytes_recv_{0};
+  std::atomic<long long> chunks_sent_{0};
+  std::atomic<long long> chunks_recv_{0};
 
   // native call table (CallStart/CallWait)
   std::mutex call_mu_;
@@ -2007,6 +2070,10 @@ int rt_lease_available_json(void *e, char *buf, int cap) {
 
 void rt_lease_stats(void *e, long long *out) {
   static_cast<raytpu::rpc::Engine *>(e)->LeaseStats(out);
+}
+
+void rt_engine_stats(void *e, long long *out) {
+  static_cast<raytpu::rpc::Engine *>(e)->EngineStats(out);
 }
 
 }  // extern "C"

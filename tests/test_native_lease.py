@@ -38,14 +38,22 @@ def test_native_lease_grants_on_engine_thread(ray_start_shared):
     ray_tpu.get([f.remote(i) for i in range(20)], timeout=120)
     stats = _agent_stats()
     assert "native_lease" in stats, "native lease lane not enabled"
-    # let the direct-lane grace release leases back to the native pool
+    # let the direct-lane grace release EVERY lease back to the native
+    # pool: if the driver still holds even one worker when the churn
+    # starts, back-to-back submits pin it through the reuse grace and no
+    # lease RPC (hence no native grant) ever happens
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
         stats = _agent_stats()
-        if stats["native_lease"]["idle_workers"] > 0:
+        if (
+            stats["native_lease"]["idle_workers"] > 0
+            and stats["native_lease"]["active"] == 0
+            and stats.get("leases_outstanding", 0) == 0
+        ):
             break
         time.sleep(0.5)
     assert stats["native_lease"]["idle_workers"] > 0
+    assert stats.get("leases_outstanding", 0) == 0, stats
 
     grants_before = stats["native_lease"]["grants"]
     # lease churn against the warm pool: these grants ride the engine

@@ -1,0 +1,222 @@
+"""Control-plane scale smoke tests (fast, tier-1).
+
+A downsized version of the release scale envelope (release/
+benchmarks_scale.py: 32 nodes / 2k actors / 200 pgs / 100k leases) that
+runs inside the non-slow tier-1 budget: 8 fake nodes, 200 actors, 20
+placement groups, 5k leases on the in-process FakeScaleCluster (real
+controller + RPC stack, fake data plane). ci/run_scale_smoke.sh runs
+exactly this file plus the --smoke release entries.
+
+Also the mutation-idempotency-under-load probe from the issue: a seeded
+duplicate/drop chaos schedule aimed at create_actor during a 2k-actor
+burst must leave zero ghost actors and a reply cache that answers every
+re-sent token with the original reply.
+"""
+
+import asyncio
+
+import pytest
+
+from ray_tpu._private import chaos as chaos_core
+from ray_tpu.cluster_utils import FakeScaleCluster
+from ray_tpu.util.chaos import FaultSchedule
+
+
+async def _wait_for(predicate, timeout: float, period: float = 0.05):
+    deadline = asyncio.get_event_loop().time() + timeout
+    value = await predicate()
+    while not value and asyncio.get_event_loop().time() < deadline:
+        await asyncio.sleep(period)
+        value = await predicate()
+    return value
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_state(monkeypatch):
+    for var in ("RAY_TPU_chaos", "RAY_TPU_chaos_identity",
+                "RAY_TPU_chaos_log_dir"):
+        monkeypatch.delenv(var, raising=False)
+    chaos_core.reset()
+    yield
+    chaos_core.reset()
+
+
+def test_scale_smoke_envelope():
+    """8 nodes / 200 actors / 20 pgs / 5k leases; queues drain to zero."""
+
+    async def run():
+        cluster = FakeScaleCluster(
+            num_nodes=8, cpus_per_node=32, heartbeat_period_s=0.5
+        )
+        await cluster.start()
+        try:
+            stats = await cluster.controller_stats()
+            assert stats["nodes_alive"] == 8
+
+            # Actor burst to ALIVE, then teardown returns every worker.
+            await asyncio.gather(*[
+                cluster.driver.call("create_actor", {
+                    "actor_id": f"smoke-actor-{i}", "resources": {"CPU": 1},
+                    "job_id": "smoke", "max_restarts": 0,
+                    "creation_args": None,
+                }) for i in range(200)
+            ])
+
+            async def all_alive():
+                actors = await cluster.driver.call("list_actors", {})
+                return sum(1 for a in actors if a["state"] == "ALIVE") == 200
+
+            assert await _wait_for(all_alive, 30.0)
+            assert sum(len(a.workers) for a in cluster.agents) == 200
+            await asyncio.gather(*[
+                cluster.driver.call("kill_actor", {
+                    "actor_id": f"smoke-actor-{i}", "no_restart": True,
+                }) for i in range(200)
+            ])
+
+            async def drained():
+                return sum(len(a.workers) for a in cluster.agents) == 0
+
+            assert await _wait_for(drained, 30.0)
+
+            # Placement-group burst (the 2PC livelock regression check).
+            await asyncio.gather(*[
+                cluster.driver.call("create_placement_group", {
+                    "pg_id": f"smoke-pg-{i}", "bundles": [{"CPU": 1}] * 4,
+                    "strategy": "PACK", "job_id": "smoke",
+                }) for i in range(20)
+            ])
+
+            async def pgs_created():
+                pgs = await cluster.driver.call("list_placement_groups", {})
+                return sum(1 for p in pgs if p["state"] == "CREATED") == 20
+
+            assert await _wait_for(pgs_created, 30.0)
+
+            # Lease storm through the one driver connection.
+            sem = asyncio.Semaphore(256)
+
+            async def one_lease():
+                async with sem:
+                    r = await cluster.driver.call(
+                        "request_lease", {"resources": {"CPU": 0.001}}
+                    )
+                    assert r["status"] == "ok"
+
+            await asyncio.gather(*[one_lease() for _ in range(5000)])
+
+            stats = await cluster.controller_stats()
+            assert stats["pending_lease_depth"] == 0
+            assert stats["pub_outbox_depth"] == 0
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_scale_smoke_parked_lease_drain():
+    """Leases for a not-yet-offered resource park in the shape-indexed
+    queue and drain the moment a node heartbeats that capacity in."""
+
+    async def run():
+        cluster = FakeScaleCluster(num_nodes=2, cpus_per_node=8)
+        await cluster.start()
+        try:
+            pend = [
+                asyncio.ensure_future(cluster.driver.call(
+                    "request_lease", {"resources": {"WIDGET": 1.0}}
+                ))
+                for _ in range(30)
+            ]
+
+            async def parked():
+                stats = await cluster.controller_stats()
+                return stats["pending_lease_depth"] >= 30
+
+            assert await _wait_for(parked, 10.0)
+            agent = cluster.agents[0]
+            agent.resources_total["WIDGET"] = 30.0
+            agent.available["WIDGET"] = 30.0
+            await agent.heartbeat()
+            replies = await asyncio.gather(*pend)
+            assert all(r["status"] == "ok" for r in replies)
+            stats = await cluster.controller_stats()
+            assert stats["pending_lease_depth"] == 0
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
+
+
+def test_mutation_idempotency_under_chaotic_burst():
+    """Seeded dup/drop chaos on create_actor during a 2k-actor burst:
+    every duplicated dispatch and retried (reply-dropped) call must hit
+    the mutation-token reply cache — no ghost actors, agent worker count
+    equal to the controller's ALIVE count, identical replies on re-send."""
+    num_actors = 2000
+    schedule = FaultSchedule(
+        seed=1337,
+        dup_request=0.05,   # server applies the handler twice
+        drop_reply=0.02,    # reply lost AFTER the mutation applied
+        dup_reply=0.05,
+        methods=["create_actor"],
+        call_timeout_s=1.0,
+        max_call_attempts=8,
+    )
+    chaos_core.install(schedule, identity="driver", export_env=False)
+
+    async def run():
+        cluster = FakeScaleCluster(num_nodes=32, cpus_per_node=70)
+        await cluster.start()
+        try:
+            replies = await asyncio.gather(*[
+                cluster.driver.call("create_actor", {
+                    "actor_id": f"chaos-actor-{i}",
+                    "mutation_token": f"chaos-tok-{i}",
+                    "resources": {"CPU": 1}, "job_id": "chaos-burst",
+                    "max_restarts": 0, "creation_args": None,
+                }) for i in range(num_actors)
+            ])
+            assert all(r["status"] == "ok" for r in replies)
+
+            async def settled():
+                actors = await cluster.driver.call("list_actors", {})
+                alive = sum(1 for a in actors if a["state"] == "ALIVE")
+                return actors if alive >= num_actors else None
+
+            actors = await _wait_for(settled, 60.0)
+            assert actors, "burst never settled"
+            # No ghosts in either direction: the controller tracks exactly
+            # num_actors actors, and the agents run exactly that many
+            # workers (a duplicated mutation that double-scheduled would
+            # leave an orphan worker behind).
+            assert len(actors) == num_actors
+            workers_total = sum(len(a.workers) for a in cluster.agents)
+            assert workers_total == num_actors
+
+            # Chaos actually fired — the test is not vacuously green.
+            injector = chaos_core.get_injector()
+            fired = {e["point"] for e in injector.events}
+            assert "dup_request" in fired
+            assert "drop_reply" in fired
+
+            # Green reply cache: re-sending a burst of the same tokens
+            # returns the ORIGINAL replies and creates nothing new.
+            resend = await asyncio.gather(*[
+                cluster.driver.call("create_actor", {
+                    "actor_id": f"chaos-actor-{i}",
+                    "mutation_token": f"chaos-tok-{i}",
+                    "resources": {"CPU": 1}, "job_id": "chaos-burst",
+                    "max_restarts": 0, "creation_args": None,
+                }) for i in range(0, num_actors, 10)
+            ])
+            for i, r in zip(range(0, num_actors, 10), resend):
+                assert r == replies[i], (i, r, replies[i])
+            actors = await cluster.driver.call("list_actors", {})
+            assert len(actors) == num_actors
+            stats = await cluster.controller_stats()
+            assert stats["mutation_cache_size"] >= num_actors
+        finally:
+            await cluster.stop()
+
+    asyncio.run(run())
