@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Tracing-overhead gate: proves the critical-path tracing layer (ISSUE 4)
+# stays cheap enough to ship enabled and FREE when disabled.
+#
+# Three layers:
+#   1. disabled-path smoke — tests/test_tracing_chaos.py includes the
+#      "tracing disabled leaves zero residue" test (no span files, no
+#      context injection), plus the chaos-net JSONL-validity tests that
+#      prove dup/drop RPC faults never corrupt span files or reuse ids;
+#   2. tests/test_observability.py — the full-lifecycle span tree,
+#      summarize_latency percentile math, timeline export, and Serve /
+#      actor context propagation;
+#   3. the tracing_overhead release entry under --smoke, which enforces
+#      the smoke_criteria floors from release/release_tests.yaml
+#      (mainline throughput with tracing off = the <=1%-vs-seed proxy;
+#      paired-window enabled overhead) and appends release_history.jsonl.
+#
+# The full-size measurement (24 paired windows, <=15% gate, measured
+# 8-10%) is the release suite proper:
+#   python release/run_all.py --only tracing_overhead
+# Usage: ci/run_tracing_overhead.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== tracing disabled-path + chaos smoke (pytest) =="
+python -m pytest tests/test_tracing_chaos.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== observability surface (pytest) =="
+python -m pytest tests/test_observability.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== tracing overhead (release floors, --smoke) =="
+python release/run_all.py --smoke --only tracing_overhead
+
+echo "tracing overhead: PASS"

@@ -38,6 +38,7 @@ from ray_tpu._private.config import global_config
 from ray_tpu._private.event_export import EventExporter
 from ray_tpu._private.ids import ActorID, PlacementGroupID
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection, spawn_task
+from ray_tpu.util import tracing
 
 # Bounded dedup window for mutation idempotency tokens: big enough that a
 # client exhausting its chaos/reconnect retry budget is always still inside
@@ -169,6 +170,7 @@ class PlacementGroupInfo:
 class Controller:
     def __init__(self, session_dir: str):
         self.session_dir = session_dir
+        tracing.configure(session_dir)
         self.server = RpcServer(name="controller")
         self.server.on_disconnect = self._on_disconnect
         self.nodes: dict[str, NodeInfo] = {}
@@ -853,6 +855,28 @@ class Controller:
         self._mark_dirty("kv", (ns, payload["key"]))
         return self._mutation_record(payload, {"status": "ok"})
 
+    async def rpc_kv_multi_put(self, conn, payload) -> dict:
+        """Batched kv_put: one RPC carries many entries (the metrics
+        flusher sends its whole tick in one call). Idempotent as a unit
+        via the same mutation-token cache as kv_put."""
+        cached = self._mutation_cached(payload)
+        if cached is not None:
+            return cached
+        ns = payload.get("namespace", "default")
+        overwrite = payload.get("overwrite", True)
+        statuses = []
+        for entry in payload.get("entries", ()):
+            key = entry["key"]
+            if not overwrite and key in self.kv[ns]:
+                statuses.append("exists")
+                continue
+            self.kv[ns][key] = entry["value"]
+            self._mark_dirty("kv", (ns, key))
+            statuses.append("ok")
+        return self._mutation_record(
+            payload, {"status": "ok", "statuses": statuses}
+        )
+
     async def rpc_kv_get(self, conn, payload) -> dict:
         ns = payload.get("namespace", "default")
         value = self.kv[ns].get(payload["key"])
@@ -1060,13 +1084,26 @@ class Controller:
         resources = payload["resources"]
         strategy = payload.get("scheduling_strategy") or {}
         self.stats_counters["lease_requests"] += 1
+        trace_ctx = payload.get("trace_ctx") if tracing.enabled() else None
+        wait_start_ns = time.time_ns() if trace_ctx else 0
+        parked = False
         node = self._pick_node(
             resources, payload.get("submitter_node"), strategy
         )
         if node is None:
+            parked = True
             node = await self._queue_lease_request(
                 resources, payload.get("submitter_node"), strategy,
                 timeout=60.0,
+            )
+        if trace_ctx:
+            # Parked-queue time as seen by the scheduler: ~0 when capacity
+            # was immediately available, the full park otherwise.
+            tracing.emit(
+                "lease_wait", trace_ctx, start_ns=wait_start_ns,
+                status="ok" if node is not None else "error",
+                parked=parked,
+                resources={k: v for k, v in resources.items() if v},
             )
         if node is None:
             return {"status": "infeasible"}
@@ -1542,6 +1579,48 @@ class Controller:
         limit = payload.get("limit", 1000)
         events = list(self.task_events)[-limit:]
         return events
+
+    async def rpc_list_tasks(self, conn, payload) -> list:
+        """Latest state per task, reduced from the task-event log HERE —
+        filters/limit are pushed down so the client never ships 100k raw
+        events over the wire just to keep 1000 rows."""
+        filters = payload.get("filters") or {}
+        limit = payload.get("limit", 1000)
+        latest: dict[str, dict] = {}
+        for event in self.task_events:
+            task_id = event.get("task_id")
+            if not task_id:
+                continue
+            row = latest.setdefault(
+                task_id,
+                {
+                    "task_id": task_id,
+                    "name": event.get("name"),
+                    "state": None,
+                    "node_id": event.get("node_id"),
+                    "start_time": None,
+                    "end_time": None,
+                },
+            )
+            state = event.get("state")
+            row["state"] = state
+            if event.get("name"):
+                row["name"] = event["name"]
+            ts = event.get("ts")
+            if state in ("RUNNING",) and ts:
+                row["start_time"] = ts
+            if event.get("start_ts"):
+                # terminal events carry the span start (single-event form)
+                row["start_time"] = event["start_ts"]
+            if state in ("FINISHED", "FAILED") and ts:
+                row["end_time"] = ts
+        rows = list(latest.values())
+        if filters:
+            rows = [
+                row for row in rows
+                if all(row.get(k) == v for k, v in filters.items())
+            ]
+        return rows[:limit]
 
     # ------------------------------------------------------------------
     # cluster state queries

@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import concurrent.futures
+import contextlib
 import os
 import threading
 import time
@@ -661,7 +662,16 @@ class CoreContext:
                     return view
                 continue
             try:
-                data = await self._pull_remote(object_id, loc)
+                if tracing.enabled():
+                    with tracing.span(
+                        "object_pull", object_id=object_id,
+                        src_node=loc["node_id"],
+                    ) as pspan:
+                        data = await self._pull_remote(object_id, loc)
+                        if pspan is not None and data is not None:
+                            pspan.attributes["bytes"] = len(data)
+                else:
+                    data = await self._pull_remote(object_id, loc)
             except Exception:
                 continue
             if data is not None:
@@ -1454,11 +1464,20 @@ class CoreContext:
         # execution lane (dependency resolution must not block the main
         # lane — see worker_proc).
         spec["has_ref_args"] = bool(arg_ref_ids)
+        submit_span = None
         if tracing.enabled():
             # Submit span: its context rides in the spec so the worker's
-            # execute span becomes this one's child (SURVEY §5.1).
-            with tracing.span(f"submit {spec['name']}", task_id=task_id):
-                spec["trace_ctx"] = tracing.inject()
+            # execute span becomes this one's child (SURVEY §5.1). Uses
+            # the begin/finish fast path — this runs once per task on the
+            # submitting thread, and the span closes after the handoff to
+            # the io loop so it covers the whole client-side submit cost.
+            submit_span = tracing.begin(
+                f"submit {spec['name']}", task_id=task_id
+            )
+            spec["trace_ctx"] = {
+                "trace_id": submit_span.trace_id,
+                "span_id": submit_span.span_id,
+            }
         record = PendingTask(spec, return_ids, arg_ref_ids)
         record.queue_key = queue_key
         self._task_records[task_id] = record
@@ -1496,6 +1515,8 @@ class CoreContext:
             self._submit_scheduled = True
         if need_schedule:
             self.io.loop.call_soon_threadsafe(self._drain_submit_buf)
+        if submit_span is not None:
+            tracing.finish(submit_span)
         return refs
 
     # The submitter keeps a per-(resources, runtime_env) task queue drained by
@@ -1700,15 +1721,24 @@ class CoreContext:
             client = await self._client_for(
                 (src["agent_host"], src["agent_port"])
             )
-            await client.call(
-                "push_object",
-                {
-                    "object_id": object_id,
-                    "target_host": target[0],
-                    "target_port": target[1],
-                },
-                timeout=60,
+            scope = (
+                tracing.span(
+                    "object_push", object_id=object_id,
+                    src_node=src.get("node_id"), dst=f"{target[0]}:{target[1]}",
+                )
+                if tracing.enabled()
+                else contextlib.nullcontext()
             )
+            with scope:
+                await client.call(
+                    "push_object",
+                    {
+                        "object_id": object_id,
+                        "target_host": target[0],
+                        "target_port": target[1],
+                    },
+                    timeout=60,
+                )
         except Exception:
             pass  # opportunistic: the pull path still serves the object
 
@@ -1885,28 +1915,33 @@ class CoreContext:
         key = _resources_key(spec["resources"], repr(spec["runtime_env"]))
         strategy = spec.get("scheduling_strategy") or {}
         assert self.controller is not None
-        resp = await self.controller.call(
-            "request_lease",
-            {
-                "resources": spec["resources"],
-                "job_id": spec["job_id"],
-                "submitter_node": self.node_id,
-                "scheduling_strategy": strategy,
-            },
-        )
+        # Carry the triggering task's trace context into the control plane
+        # so controller lease_wait / agent worker_start spans attach to the
+        # same trace (best-effort causal attribution: the lease is reused
+        # by later tasks, but THIS task paid the wait).
+        trace_ctx = spec.get("trace_ctx") if tracing.enabled() else None
+        lease_payload = {
+            "resources": spec["resources"],
+            "job_id": spec["job_id"],
+            "submitter_node": self.node_id,
+            "scheduling_strategy": strategy,
+        }
+        if trace_ctx:
+            lease_payload["trace_ctx"] = trace_ctx
+        resp = await self.controller.call("request_lease", lease_payload)
         if resp.get("status") != "ok":
             raise RuntimeError(f"lease request failed: {resp.get('status')}")
         agent_addr = tuple(resp["agent_addr"])
         agent = await self._client_for(agent_addr)
-        lease = await agent.call(
-            "lease_worker",
-            {
-                "resources": spec["resources"],
-                "runtime_env": spec["runtime_env"],
-                "job_id": spec["job_id"],
-                "bundle": resp.get("bundle"),
-            },
-        )
+        worker_payload = {
+            "resources": spec["resources"],
+            "runtime_env": spec["runtime_env"],
+            "job_id": spec["job_id"],
+            "bundle": resp.get("bundle"),
+        }
+        if trace_ctx:
+            worker_payload["trace_ctx"] = trace_ctx
+        lease = await agent.call("lease_worker", worker_payload)
         if lease.get("status") != "ok":
             raise RuntimeError(
                 f"worker lease failed: {lease.get('status')} {lease.get('error', '')}"
@@ -2059,8 +2094,18 @@ class CoreContext:
         spec["has_ref_args"] = bool(arg_ref_ids)
         traced = tracing.enabled()
         if traced:
-            with tracing.span(f"submit {spec['name']}", task_id=task_id):
-                spec["trace_ctx"] = tracing.inject()
+            # begin/finish fast path (see submit_task): one span per actor
+            # call on the submitting thread, closed right after creation —
+            # the client-side cost of an actor submit is the seq+send step
+            # below, which stays un-spanned to keep the actor lock short.
+            submit_span = tracing.begin(
+                f"submit {spec['name']}", task_id=task_id
+            )
+            spec["trace_ctx"] = {
+                "trace_id": submit_span.trace_id,
+                "span_id": submit_span.span_id,
+            }
+            tracing.finish(submit_span)
         record = PendingTask(spec, return_ids, arg_ref_ids)
         self._task_records[task_id] = record
         refs = []

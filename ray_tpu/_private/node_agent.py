@@ -37,6 +37,7 @@ from ray_tpu._private.ids import WorkerID
 from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreServer
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConnection, spawn_task
 from ray_tpu._private.runtime_env import RuntimeEnvManager
+from ray_tpu.util import tracing
 
 
 def detect_tpu_resources() -> dict:
@@ -204,6 +205,7 @@ class NodeAgent:
         # thundering every waiter on every release. Key () = any shape.
         self._resource_waiters: dict[tuple, list[asyncio.Future]] = {}
         self.log_dir = os.path.join(session_dir, "logs")
+        tracing.configure(session_dir)
         os.makedirs(self.log_dir, exist_ok=True)
         os.makedirs(self.spill_dir, exist_ok=True)
         # object-transfer plane (N16): agent→agent push clients + counters
@@ -882,11 +884,28 @@ class NodeAgent:
         env_hash = self._env_hash(runtime_env)
         worker = self._pop_idle_worker(env_hash, payload.get("job_id", ""))
         if worker is None:
+            trace_ctx = (
+                payload.get("trace_ctx") if tracing.enabled() else None
+            )
+            spawn_start_ns = time.time_ns() if trace_ctx else 0
             try:
                 worker = await self._spawn_worker(runtime_env, payload.get("job_id", ""))
             except Exception as exc:
+                if trace_ctx:
+                    tracing.emit(
+                        "worker_start", trace_ctx, start_ns=spawn_start_ns,
+                        status="error", node_id=self.node_id,
+                        error_type=type(exc).__name__,
+                    )
                 self._give_back(resources, bundle_key)
                 return {"status": "spawn_failed", "error": str(exc)}
+            if trace_ctx:
+                # Cold-start cost: only emitted when a lease actually
+                # forced a spawn (idle-pool hits are free).
+                tracing.emit(
+                    "worker_start", trace_ctx, start_ns=spawn_start_ns,
+                    node_id=self.node_id, worker_id=worker.worker_id,
+                )
         lease = Lease(worker, resources, bundle_key)
         self.leases[lease.lease_id] = lease
         return {

@@ -280,6 +280,23 @@ def available_resources() -> dict:
     return ctx.io.run(ctx.controller.call("available_resources", {}))
 
 
-def timeline() -> list[dict]:
+def timeline(filename: str | None = None) -> dict:
+    """Chrome-trace JSON (Trace Event Format) for the whole session —
+    spans, task events, and counter snapshots merged onto per-process
+    tracks; loads directly in Perfetto / chrome://tracing."""
+    from ray_tpu.util.timeline import build_chrome_trace
+
     ctx = get_global_context()
-    return ctx.io.run(ctx.controller.call("list_task_events", {}))
+    events = ctx.io.run(
+        ctx.controller.call("list_task_events", {"limit": 100_000})
+    )
+    session_dir = (
+        _local_cluster.session_dir
+        if _local_cluster is not None
+        else os.environ.get("RAYTPU_SESSION_DIR", "")
+    )
+    trace = build_chrome_trace(session_dir, task_events=events)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -679,25 +679,56 @@ class WorkerRuntime:
         if on_main:
             self._main_current_task = task_id
             self._main_executing = True
-        trace_scope = (
-            tracing.span(
-                f"execute {name}", parent=spec.get("trace_ctx"),
+        trace_ctx = spec.get("trace_ctx") if tracing.enabled() else None
+        arrival_ns = spec.pop("_arrival_ns", None)
+        if trace_ctx and arrival_ns:
+            # In-actor queue wait: time between the call frame arriving at
+            # this worker and the method actually starting.
+            tracing.emit(
+                "queue_wait", trace_ctx, start_ns=arrival_ns,
                 task_id=task_id, worker_id=self.ctx.worker_id,
             )
-            if tracing.enabled() and spec.get("trace_ctx")
-            else contextlib.nullcontext()
-        )
-        with trace_scope:
+        if trace_ctx is None:
             return self._execute_inner(
                 spec, fn, preresolved, name, task_id, on_main, start_ts
             )
+        # begin/finish fast path + explicit contextvar write: user code
+        # runs inside, so nested .remote() calls must see this span as
+        # the ambient parent (what span() would have provided), but the
+        # contextmanager machinery is per-task overhead.
+        tspan = tracing.begin(
+            f"execute {name}", parent=trace_ctx,
+            task_id=task_id, worker_id=self.ctx.worker_id,
+        )
+        token = tracing.set_current(tspan)
+        try:
+            return self._execute_inner(
+                spec, fn, preresolved, name, task_id, on_main, start_ts,
+                trace_span=tspan,
+            )
+        except BaseException as exc:
+            tspan.set_error(exc)
+            raise
+        finally:
+            tracing.reset_current(token)
+            tracing.finish(tspan)
 
     def _execute_inner(
-        self, spec, fn, preresolved, name, task_id, on_main, start_ts=None
+        self, spec, fn, preresolved, name, task_id, on_main, start_ts=None,
+        trace_span=None,
     ) -> dict:
         try:
             if preresolved is not None:
                 args, kwargs = preresolved
+            elif trace_span is not None and spec.get("has_ref_args"):
+                # fetch_args times DEPENDENCY resolution; inline-only args
+                # resolve in-place, so the span would only add per-task
+                # overhead without information.
+                with tracing.span(
+                    "fetch_args", parent=spec.get("trace_ctx"),
+                    task_id=task_id,
+                ):
+                    args, kwargs = self._resolve_args(spec["args"])
             else:
                 args, kwargs = self._resolve_args(spec["args"])
             fn_key = getattr(fn, "__func__", fn)
@@ -720,15 +751,33 @@ class WorkerRuntime:
             num_returns = spec.get("num_returns", 1)
             values = [value] if num_returns == 1 else list(value)
             self._record_task_event(spec, "FINISHED", start_ts)
-            return {"status": "ok", "returns": self._package_returns(spec, values)}
+            if trace_span is not None:
+                # begin/finish fast path: parent is explicit and no user
+                # code runs inside, so the contextvar write of span() is
+                # pure per-task overhead here.
+                pspan = tracing.begin(
+                    "put_result", parent=spec.get("trace_ctx"),
+                    task_id=task_id, num_returns=num_returns,
+                )
+                try:
+                    returns = self._package_returns(spec, values)
+                finally:
+                    tracing.finish(pspan)
+            else:
+                returns = self._package_returns(spec, values)
+            return {"status": "ok", "returns": returns}
         except (KeyboardInterrupt, concurrent.futures.CancelledError,
                 asyncio.CancelledError):
             # KeyboardInterrupt: raised by rpc_cancel_task via SIGINT /
             # async-exc (ray.cancel convention — the task sees it).
             # CancelledError: an async task's coroutine was cancelled.
+            if trace_span is not None:
+                trace_span.status = "cancelled"
             self._record_task_event(spec, "CANCELLED", start_ts)
             return {"status": "cancelled"}
-        except Exception:
+        except Exception as exc:
+            if trace_span is not None:
+                trace_span.set_error(exc)
             self._record_task_event(spec, "FAILED", start_ts)
             err = exceptions.TaskError(name, traceback.format_exc())
             payload, _ = serialization.serialize(err)
@@ -904,7 +953,20 @@ class WorkerRuntime:
         # HOLDING the main lane would deadlock against that upstream task
         # queued behind it on this very worker.
         try:
-            preresolved = await self._resolve_args_async(spec["args"])
+            if (
+                tracing.enabled()
+                and spec.get("trace_ctx")
+                and spec.get("has_ref_args")
+            ):
+                # Span only when there are actual dependencies to fetch —
+                # inline-args resolution is a no-op not worth a record.
+                with tracing.span(
+                    "fetch_args", parent=spec["trace_ctx"],
+                    task_id=spec.get("task_id"),
+                ):
+                    preresolved = await self._resolve_args_async(spec["args"])
+            else:
+                preresolved = await self._resolve_args_async(spec["args"])
         except Exception:
             self._record_task_event(spec, "FAILED")
             err = exceptions.TaskError(
@@ -1005,6 +1067,10 @@ class WorkerRuntime:
             return {"status": "error", "error": traceback.format_exc()}
 
     async def rpc_push_actor_task(self, conn, spec) -> dict:
+        if tracing.enabled() and spec.get("trace_ctx"):
+            # Arrival stamp: the gap to actual execution becomes the
+            # in-actor queue_wait span (ordered/concurrency queue time).
+            spec["_arrival_ns"] = _time.time_ns()
         caller = spec.get("caller_id", "?")
         seq = spec.get("seq", 0)
         state = self._order.get(caller)
@@ -1068,35 +1134,61 @@ class WorkerRuntime:
             return {"status": "cancelled"}
         if self._async_sem is None:
             self._async_sem = asyncio.Semaphore(self._actor_concurrency)
+        trace_ctx = spec.get("trace_ctx") if tracing.enabled() else None
+        arrival_ns = spec.pop("_arrival_ns", None)
         async with self._async_sem:
+            if trace_ctx and arrival_ns:
+                tracing.emit(
+                    "queue_wait", trace_ctx, start_ns=arrival_ns,
+                    task_id=task_id, worker_id=self.ctx.worker_id,
+                )
             start_ts = _time.time()
             self._record_task_event(spec, "RUNNING")
-            try:
-                args, kwargs = await self._resolve_args_async(spec["args"])
-                cfut = asyncio.run_coroutine_threadsafe(
-                    method(*args, **kwargs), self._async_exec_loop()
+            if trace_ctx is None:
+                return await self._async_actor_body(
+                    spec, method, name, task_id, start_ts, None
                 )
-                self._running_async[task_id] = cfut
-                try:
-                    value = await asyncio.wrap_future(cfut)
-                finally:
-                    self._running_async.pop(task_id, None)
-                num_returns = spec.get("num_returns", 1)
-                values = [value] if num_returns == 1 else list(value)
-                self._record_task_event(spec, "FINISHED", start_ts)
-                return {
-                    "status": "ok",
-                    "returns": self._package_returns(spec, values),
-                }
-            except (asyncio.CancelledError,
-                    concurrent.futures.CancelledError):
-                self._record_task_event(spec, "CANCELLED", start_ts)
-                return {"status": "cancelled"}
-            except Exception:
-                self._record_task_event(spec, "FAILED", start_ts)
-                err = exceptions.TaskError(name, traceback.format_exc())
-                payload, _ = serialization.serialize(err)
-                return {"status": "error", "error": payload}
+            with tracing.span(
+                f"execute {name}", parent=trace_ctx,
+                task_id=task_id, worker_id=self.ctx.worker_id,
+            ) as tspan:
+                return await self._async_actor_body(
+                    spec, method, name, task_id, start_ts, tspan
+                )
+
+    async def _async_actor_body(
+        self, spec, method, name, task_id, start_ts, trace_span
+    ) -> dict:
+        try:
+            args, kwargs = await self._resolve_args_async(spec["args"])
+            cfut = asyncio.run_coroutine_threadsafe(
+                method(*args, **kwargs), self._async_exec_loop()
+            )
+            self._running_async[task_id] = cfut
+            try:
+                value = await asyncio.wrap_future(cfut)
+            finally:
+                self._running_async.pop(task_id, None)
+            num_returns = spec.get("num_returns", 1)
+            values = [value] if num_returns == 1 else list(value)
+            self._record_task_event(spec, "FINISHED", start_ts)
+            return {
+                "status": "ok",
+                "returns": self._package_returns(spec, values),
+            }
+        except (asyncio.CancelledError,
+                concurrent.futures.CancelledError):
+            if trace_span is not None:
+                trace_span.status = "cancelled"
+            self._record_task_event(spec, "CANCELLED", start_ts)
+            return {"status": "cancelled"}
+        except Exception as exc:
+            if trace_span is not None:
+                trace_span.set_error(exc)
+            self._record_task_event(spec, "FAILED", start_ts)
+            err = exceptions.TaskError(name, traceback.format_exc())
+            payload, _ = serialization.serialize(err)
+            return {"status": "error", "error": payload}
 
     # ------------------------------------------------------------------
     # compiled-graph (aDAG) channels [SURVEY §2.2 "Compiled graphs"]

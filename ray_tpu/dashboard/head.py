@@ -325,7 +325,19 @@ class DashboardHead:
     async def _timeline(self, request):
         from aiohttp import web
 
-        return web.json_response(await asyncio.to_thread(ray_tpu.timeline))
+        from ray_tpu.util.timeline import build_chrome_trace
+
+        def build():
+            try:
+                return ray_tpu.timeline()
+            except Exception:
+                # No driver connection: still render the span layer from
+                # the session dir (task events need the controller).
+                return build_chrome_trace(
+                    self.session_dir, include_counters=False
+                )
+
+        return web.json_response(await asyncio.to_thread(build))
 
     async def _metrics(self, request):
         from aiohttp import web

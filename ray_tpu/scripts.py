@@ -104,10 +104,13 @@ def cmd_timeline(args) -> None:
     _connect(args)
     import ray_tpu
 
-    events = ray_tpu.timeline()
-    with open(args.output, "w") as f:
-        json.dump(events, f)
-    print(f"wrote {len(events)} events to {args.output} (chrome://tracing)")
+    trace = ray_tpu.timeline()
+    out = args.out or args.output
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    n = len(trace.get("traceEvents", []))
+    print(f"wrote {n} events to {out} (load in ui.perfetto.dev "
+          f"or chrome://tracing)")
 
 
 def cmd_microbenchmark(args) -> None:
@@ -194,6 +197,8 @@ def main(argv=None) -> None:
 
     p = sub.add_parser("timeline")
     p.add_argument("--output", default="timeline.json")
+    p.add_argument("--out", default=None,
+                   help="alias for --output (ray_tpu timeline --out trace.json)")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_timeline)
 

@@ -11,6 +11,7 @@ JSON-encoded (bytes/str pass through). Health at /-/healthz, routes at
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import time
 import threading
@@ -19,6 +20,7 @@ from typing import Any
 import ray_tpu
 from ray_tpu.serve._private.common import CONTROLLER_NAME
 from ray_tpu.serve._private.routing import RoutingMixin
+from ray_tpu.util import tracing
 
 
 class HTTPProxy(RoutingMixin):
@@ -85,10 +87,28 @@ class HTTPProxy(RoutingMixin):
         else:
             body = dict(request.query)
         self._num_requests += 1
-        try:
-            result = await asyncio.to_thread(
-                self._call_deployment, app_name, dep_name, body
+        # Incoming trace context rides an X-RayTPU-Trace header
+        # ("<trace_id>:<span_id>"); absent, the proxy starts a new trace.
+        parent = None
+        header = request.headers.get("X-RayTPU-Trace")
+        if header and ":" in header:
+            trace_id, _, span_id = header.partition(":")
+            parent = {"trace_id": trace_id, "span_id": span_id}
+        trace_scope = (
+            tracing.span(
+                f"serve.request {path}", parent=parent,
+                method=request.method, route=qualified,
             )
+            if tracing.enabled()
+            else contextlib.nullcontext()
+        )
+        try:
+            # to_thread copies the contextvars context, so the handle's
+            # dispatch sees this span as the current trace parent.
+            with trace_scope:
+                result = await asyncio.to_thread(
+                    self._call_deployment, app_name, dep_name, body
+                )
         except Exception as exc:
             return web.Response(status=500, text=f"{type(exc).__name__}: {exc}")
         from ray_tpu.serve.handle import ResponseStream

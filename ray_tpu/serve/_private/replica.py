@@ -16,6 +16,8 @@ import inspect
 import time
 from typing import Any
 
+from ray_tpu.util import tracing
+
 _request_context: contextvars.ContextVar = contextvars.ContextVar(
     "serve_request_context", default=None
 )
@@ -65,6 +67,19 @@ class Replica:
 
     # -- request path ---------------------------------------------------
     async def handle_request(self, meta: dict, args: tuple, kwargs: dict) -> Any:
+        if not (tracing.enabled() and meta.get("trace_ctx")):
+            return await self._handle_request_inner(meta, args, kwargs)
+        with tracing.span(
+            f"serve.replica {self.deployment_name}",
+            parent=meta["trace_ctx"],
+            replica_id=self.replica_id,
+            request_id=meta.get("request_id"),
+        ):
+            return await self._handle_request_inner(meta, args, kwargs)
+
+    async def _handle_request_inner(
+        self, meta: dict, args: tuple, kwargs: dict
+    ) -> Any:
         for arg in args:
             if isinstance(arg, dict) and "__serve_stream__" in arg:
                 raise TypeError(

@@ -20,6 +20,7 @@ from typing import Any, Optional
 import ray_tpu
 from ray_tpu import exceptions
 from ray_tpu.serve._private.common import CONTROLLER_NAME, RequestMetadata
+from ray_tpu.util import tracing
 
 # get()-level failures that mean "the replica process is gone", as opposed
 # to the request being slow or user code raising.
@@ -419,6 +420,10 @@ class DeploymentHandle:
                     "method_name": meta.method_name,
                     "multiplexed_model_id": meta.multiplexed_model_id,
                     "shape_key": self._shape_key,
+                    # Serve-level trace propagation: the proxy's (or any
+                    # caller's) current span becomes the replica span's
+                    # parent across the actor-call boundary.
+                    "trace_ctx": tracing.inject(),
                 },
                 args,
                 kwargs,

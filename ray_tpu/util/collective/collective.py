@@ -21,6 +21,7 @@ controller KV [N6].
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import pickle
 import time
 from typing import Any
@@ -28,6 +29,7 @@ from typing import Any
 import numpy as np
 
 from ray_tpu._private import worker as worker_mod
+from ray_tpu.util import tracing
 
 _groups: dict[str, "BaseGroup"] = {}
 
@@ -551,28 +553,57 @@ def get_group(group_name: str = "default") -> BaseGroup:
     return _groups[group_name]
 
 
+def _traced(op: str, group: BaseGroup, array=None):
+    """Span scope for one collective op (bytes + participants as
+    attributes); a plain nullcontext when tracing is off."""
+    if not tracing.enabled():
+        return contextlib.nullcontext()
+    attrs = {
+        "group": group.group_name,
+        "world_size": group.world_size,
+        "rank": group.rank,
+        "backend": type(group).__name__,
+    }
+    nbytes = getattr(array, "nbytes", None)
+    if nbytes is not None:
+        attrs["bytes"] = int(nbytes)
+    return tracing.span(f"collective.{op}", **attrs)
+
+
 def allreduce(array, group_name: str = "default", op: str = SUM):
-    return get_group(group_name).allreduce(array, op=op)
+    group = get_group(group_name)
+    with _traced("allreduce", group, array):
+        return group.allreduce(array, op=op)
 
 
 def allgather(array, group_name: str = "default"):
-    return get_group(group_name).allgather(array)
+    group = get_group(group_name)
+    with _traced("allgather", group, array):
+        return group.allgather(array)
 
 
 def reducescatter(array, group_name: str = "default", op: str = SUM):
-    return get_group(group_name).reducescatter(array, op=op)
+    group = get_group(group_name)
+    with _traced("reducescatter", group, array):
+        return group.reducescatter(array, op=op)
 
 
 def broadcast(array, src_rank: int = 0, group_name: str = "default"):
-    return get_group(group_name).broadcast(array, src_rank=src_rank)
+    group = get_group(group_name)
+    with _traced("broadcast", group, array):
+        return group.broadcast(array, src_rank=src_rank)
 
 
 def barrier(group_name: str = "default"):
-    get_group(group_name).barrier()
+    group = get_group(group_name)
+    with _traced("barrier", group):
+        group.barrier()
 
 
 def send(array, dst_rank: int, group_name: str = "default"):
-    get_group(group_name).send(array, dst_rank)
+    group = get_group(group_name)
+    with _traced("send", group, array):
+        group.send(array, dst_rank)
 
 
 def recv(

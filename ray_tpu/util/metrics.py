@@ -40,7 +40,8 @@ def _ensure_flusher() -> None:
 
 
 def flush() -> None:
-    """Push pending metric points to the controller KV."""
+    """Push pending metric points to the controller KV — the whole tick
+    rides ONE kv_multi_put RPC, not one kv_put per series."""
     with _local_lock:
         points = dict(_pending)
         _pending.clear()
@@ -50,18 +51,20 @@ def flush() -> None:
         ctx = worker_mod.get_global_context()
     except Exception:
         return
-    for key, point in points.items():
-        ctx.io.run(
-            ctx.controller.call(
-                "kv_put",
-                {
-                    "namespace": "metrics",
-                    "key": key,
-                    "value": json.dumps(point).encode(),
-                    "overwrite": True,
-                },
-            )
+    entries = [
+        {"key": key, "value": json.dumps(point).encode()}
+        for key, point in points.items()
+    ]
+    ctx.io.run(
+        ctx.controller.call(
+            "kv_multi_put",
+            {
+                "namespace": "metrics",
+                "entries": entries,
+                "overwrite": True,
+            },
         )
+    )
 
 
 def _record(kind: str, name: str, description: str, tags: Mapping[str, str],

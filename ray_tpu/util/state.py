@@ -9,6 +9,7 @@ limit, like the reference's predicate pushdown.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Optional
 
 from ray_tpu._private import worker as worker_mod
@@ -59,37 +60,13 @@ def list_jobs(filters: dict | None = None, limit: int = 1000) -> list[dict]:
 
 
 def list_tasks(filters: dict | None = None, limit: int = 1000) -> list[dict]:
-    """Latest state per task, reduced from the task-event log."""
-    events = _call("list_task_events", {"limit": 100_000})
-    latest: dict[str, dict] = {}
-    for event in events:
-        task_id = event.get("task_id")
-        if not task_id:
-            continue
-        row = latest.setdefault(
-            task_id,
-            {
-                "task_id": task_id,
-                "name": event.get("name"),
-                "state": None,
-                "node_id": event.get("node_id"),
-                "start_time": None,
-                "end_time": None,
-            },
-        )
-        state = event.get("state")
-        row["state"] = state
-        if event.get("name"):
-            row["name"] = event["name"]
-        ts = event.get("ts")
-        if state in ("RUNNING",) and ts:
-            row["start_time"] = ts
-        if event.get("start_ts"):
-            # terminal events carry the span start (single-event form)
-            row["start_time"] = event["start_ts"]
-        if state in ("FINISHED", "FAILED") and ts:
-            row["end_time"] = ts
-    return _apply_filters(list(latest.values()), filters, limit)
+    """Latest state per task. The event→row reduction, filters, and limit
+    all run controller-side (predicate pushdown) — the client receives at
+    most ``limit`` rows instead of the raw 100k-event log."""
+    return _call(
+        "list_tasks", {"filters": dict(filters) if filters else None,
+                       "limit": limit}
+    )
 
 
 def summarize_tasks() -> dict:
@@ -142,3 +119,115 @@ def get_node(node_id: str) -> Optional[dict]:
         if row.get("node_id") == node_id:
             return row
     return None
+
+
+# ---------------------------------------------------------------------------
+# Latency breakdown over the span store (critical-path tracing, ISSUE 4):
+# spans are reduced into per-phase percentiles so "where does task time go"
+# is one call, not a debugger session.
+# ---------------------------------------------------------------------------
+
+# Lifecycle phases in causal order (for stable presentation; other span
+# kinds — collective.*, serve.*, object_* — group under their own name).
+LIFECYCLE_PHASES = (
+    "submit", "lease_wait", "worker_start", "queue_wait",
+    "fetch_args", "execute", "put_result",
+)
+
+
+def _session_dir() -> str | None:
+    cluster = getattr(worker_mod, "_local_cluster", None)
+    if cluster is not None and getattr(cluster, "session_dir", None):
+        return cluster.session_dir
+    return os.environ.get("RAYTPU_SESSION_DIR")
+
+
+def _phase_of(span_name: str) -> str:
+    return span_name.split(" ", 1)[0]
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending list (0 for empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = int(round(q * (len(sorted_vals) - 1)))
+    return sorted_vals[min(len(sorted_vals) - 1, max(0, idx))]
+
+
+def summarize_latency(session_dir: str | None = None) -> dict:
+    """Per-phase latency breakdown over every recorded span.
+
+    Returns ``{phase: {count, p50_ms, p95_ms, mean_ms, max_ms, errors}}``
+    where phase is the first token of the span name (``submit``,
+    ``lease_wait``, ``execute``, ``collective.allreduce``, …)."""
+    from ray_tpu.util import tracing
+
+    session_dir = session_dir or _session_dir()
+    if not session_dir:
+        return {}
+    by_phase: dict[str, list[float]] = {}
+    errors: dict[str, int] = {}
+    for span in tracing.read_spans(session_dir):
+        if not span.get("end_ns") or not span.get("start_ns"):
+            continue
+        phase = _phase_of(span.get("name", ""))
+        dur_ms = (span["end_ns"] - span["start_ns"]) / 1e6
+        by_phase.setdefault(phase, []).append(dur_ms)
+        if span.get("status") not in (None, "ok"):
+            errors[phase] = errors.get(phase, 0) + 1
+    out: dict[str, dict] = {}
+    ordered = [p for p in LIFECYCLE_PHASES if p in by_phase] + sorted(
+        p for p in by_phase if p not in LIFECYCLE_PHASES
+    )
+    for phase in ordered:
+        durs = sorted(by_phase[phase])
+        out[phase] = {
+            "count": len(durs),
+            "p50_ms": _percentile(durs, 0.50),
+            "p95_ms": _percentile(durs, 0.95),
+            "mean_ms": sum(durs) / len(durs),
+            "max_ms": durs[-1],
+            "errors": errors.get(phase, 0),
+        }
+    return out
+
+
+def get_task_timeline(
+    task_id: str, session_dir: str | None = None
+) -> list[dict]:
+    """Every span of one task's lifecycle, in causal/start order — the
+    single-task drill-down companion of :func:`summarize_latency`."""
+    from ray_tpu.util import tracing
+
+    session_dir = session_dir or _session_dir()
+    if not session_dir:
+        return []
+    all_spans = tracing.read_spans(session_dir)
+    # The task's own spans, plus causally-linked spans of the same traces
+    # that don't carry the task_id attribute (e.g. lease_wait attributed
+    # via trace context only, or a parent serve.request).
+    trace_ids = {
+        s["trace_id"] for s in all_spans
+        if (s.get("attributes") or {}).get("task_id") == task_id
+    }
+    spans = [s for s in all_spans if s.get("trace_id") in trace_ids]
+    spans.sort(key=lambda s: (s.get("start_ns") or 0))
+    out = []
+    for s in spans:
+        out.append(
+            {
+                "phase": _phase_of(s.get("name", "")),
+                "name": s.get("name"),
+                "trace_id": s.get("trace_id"),
+                "span_id": s.get("span_id"),
+                "parent_id": s.get("parent_id"),
+                "start_ns": s.get("start_ns"),
+                "end_ns": s.get("end_ns"),
+                "duration_ms": (
+                    ((s.get("end_ns") or 0) - (s.get("start_ns") or 0)) / 1e6
+                ),
+                "status": s.get("status", "ok"),
+                "attributes": s.get("attributes") or {},
+            }
+        )
+    return out

@@ -1,0 +1,174 @@
+"""Tracing-overhead benchmark (release suite, ISSUE 4 acceptance).
+
+Two measurements on REAL local clusters:
+
+1. ``tasks_per_s_mainline`` — a boot with tracing OFF and the native
+   direct-call lane ON: the everyday hot path. A floor here proves the
+   tracing layer's disabled path costs the fast lane nothing (the
+   <=1%-vs-seed criterion: the only disabled-path additions are
+   ``tracing.enabled()`` attribute checks, so this floor sits at the
+   core_microbenchmark level).
+
+2. ``enabled_overhead_pct`` — one boot with tracing available, then
+   PAIRED alternating passes toggling the driver's ``tracing_enabled``
+   flag. When the driver flag is off no trace_ctx rides in the spec, so
+   every worker-side span gate short-circuits too — an "off" pass is the
+   true disabled path to within a dict lookup per task. Pairing inside
+   one boot matters: boot-to-boot throughput varies ~20% on shared
+   machines, far above the tracing signal, while paired passes share
+   workers, connections, and cache state. Best-of per mode (the
+   core_microbenchmark best-of-3 convention) discards slow-pass
+   outliers.
+
+   Both paired passes run with the direct-call lane OFF because a traced
+   task cannot use the native lane anyway (its spec carries trace_ctx,
+   see core_context.submit_task): comparing lane-on-untraced vs
+   lane-off-traced would measure the lane, not the tracing. The pair
+   isolates what spans cost: context injection, span objects, and the
+   buffered JSONL exporter.
+
+Prints ONE JSON line:
+  {"tasks_per_s_mainline": ..., "tasks_per_s_disabled": ...,
+   "tasks_per_s_enabled": ..., "enabled_overhead_pct": ...,
+   "spans_recorded": ...}
+
+RAY_TPU_RELEASE_SMOKE=1 downsizes the task count so the suite fits the
+tier-1 timeout.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+SMOKE = os.environ.get("RAY_TPU_RELEASE_SMOKE") == "1"
+
+
+def _boot(*, direct_call: bool, traced: bool):
+    """Set the mode env (inherited by spawned workers) and init."""
+    os.environ["RAY_TPU_direct_call"] = "1" if direct_call else "0"
+    os.environ["RAY_TPU_tracing_enabled"] = "1" if traced else "0"
+    from ray_tpu._private.config import global_config
+
+    cfg = global_config()
+    cfg.direct_call = direct_call
+    cfg.tracing_enabled = traced
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+
+    @ray_tpu.remote
+    def _noop(i):
+        return i
+
+    # Warm the worker pool so spawn cost stays out of every window.
+    ray_tpu.get([_noop.remote(i) for i in range(300)], timeout=120)
+    return cfg, _noop
+
+
+def _measure(noop, num_tasks: int) -> float:
+    import ray_tpu
+
+    wave = 500
+    done = 0
+    t0 = time.perf_counter()
+    while done < num_tasks:
+        n = min(wave, num_tasks - done)
+        ray_tpu.get([noop.remote(i) for i in range(n)], timeout=300)
+        done += n
+    return round(num_tasks / max(time.perf_counter() - t0, 1e-9), 1)
+
+
+def bench_mainline(num_tasks: int) -> float:
+    import ray_tpu
+
+    _, noop = _boot(direct_call=True, traced=False)
+    try:
+        return _measure(noop, num_tasks)
+    finally:
+        ray_tpu.shutdown()
+        time.sleep(0.5)
+
+
+def bench_paired(num_tasks: int, rounds: int) -> dict:
+    """Interleave MANY small off/on windows and aggregate wall time per
+    mode: machine drift (CPU contention on shared hosts swings pass
+    throughput +-10%, more than the tracing signal) averages out across
+    windows instead of landing on one mode."""
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu.util import tracing
+
+    cfg, noop = _boot(direct_call=False, traced=True)
+    try:
+        session_dir = worker_mod._local_cluster.session_dir
+        _measure(noop, 2000)  # settle before pairing
+        window = 1000
+        windows = max(2, (num_tasks * rounds) // window)
+        off_s = on_s = 0.0
+        off_n = on_n = 0
+        for i in range(windows):
+            cfg.tracing_enabled = False
+            t0 = time.perf_counter()
+            _measure(noop, window)
+            off_s += time.perf_counter() - t0
+            off_n += window
+            cfg.tracing_enabled = True
+            t0 = time.perf_counter()
+            _measure(noop, window)
+            on_s += time.perf_counter() - t0
+            on_n += window
+        spans = len(tracing.read_spans(session_dir))
+        return {
+            "tasks_per_s_disabled": round(off_n / off_s, 1),
+            "tasks_per_s_enabled": round(on_n / on_s, 1),
+            "windows": windows,
+            "spans_recorded": spans,
+        }
+    finally:
+        cfg.tracing_enabled = True  # leave env/config consistent
+        ray_tpu.shutdown()
+        time.sleep(0.5)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--tasks", type=int, default=2000 if SMOKE else 6000,
+        help="tasks per measured pass",
+    )
+    parser.add_argument(
+        "--rounds", type=int, default=2 if SMOKE else 4,
+        help="paired off/on rounds; best-of per mode is reported",
+    )
+    args = parser.parse_args()
+
+    t0 = time.perf_counter()
+    mainline = bench_mainline(args.tasks)
+    paired = bench_paired(args.tasks, args.rounds)
+
+    base = paired["tasks_per_s_disabled"]
+    overhead_pct = 100.0 * (base - paired["tasks_per_s_enabled"]) / max(
+        base, 1e-9
+    )
+    result = {
+        "benchmark": "tracing_overhead",
+        "tasks": args.tasks,
+        "rounds": args.rounds,
+        "tasks_per_s_mainline": mainline,
+        # Negative overhead (enabled pass beat disabled pass) is machine
+        # noise; the criterion only bounds the positive direction.
+        "enabled_overhead_pct": round(overhead_pct, 2),
+        "total_wall_s": round(time.perf_counter() - t0, 3),
+        "smoke": int(SMOKE),
+        **paired,
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()
