@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Telemetry-overhead gate: proves the resource-telemetry subsystem
+# (ISSUE 5) stays within its <=2% task-storm budget and that the
+# store/attribution/oom_risk surfaces keep working.
+#
+# Two layers:
+#   1. tests/test_telemetry.py — tiered ring-buffer downsampling math,
+#      monotonic/bounded behavior under dup/drop chaos heartbeats,
+#      per-task peak-RSS attribution, the trend-aware oom_risk event,
+#      and the 2-node FakeScaleCluster summary + `top` rendering;
+#   2. the telemetry_overhead release entry under --smoke, which
+#      enforces the smoke_criteria floors from release/
+#      release_tests.yaml (paired off/on boot throughput, 2-node scale
+#      scenario with >=2 tiers populated) and appends
+#      release_history.jsonl.
+#
+# The full-size measurement (3 boot pairs x 4000 tasks, <=5% gate,
+# measured ~0-2%) is the release suite proper:
+#   python release/run_all.py --only telemetry_overhead
+# Usage: ci/run_telemetry_overhead.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== telemetry store + attribution + oom_risk + chaos (pytest) =="
+python -m pytest tests/test_telemetry.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== telemetry overhead (release floors, --smoke) =="
+python release/run_all.py --smoke --only telemetry_overhead
+
+echo "telemetry overhead: PASS"

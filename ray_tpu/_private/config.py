@@ -147,6 +147,29 @@ class RayTpuConfig:
     # --- tracing (reference: RAY_TRACING_ENABLED / OTel hook, SURVEY §5.1) ---
     tracing_enabled: bool = _env("tracing_enabled", False)
 
+    # --- resource telemetry (reference: raylet stats + dashboard
+    # node_head time-series; Podracer-style sustained-utilization view) ---
+    # Master switch for the per-node sampler + controller time-series
+    # store. Cheap enough to ship on by default (one psutil sweep per
+    # sample interval, piggybacked on the existing heartbeat).
+    telemetry_enabled: bool = _env("telemetry_enabled", True)
+    # Seconds between node samples. The memory-monitor loop (which runs
+    # every memory_monitor_interval_s) assembles a telemetry sample at
+    # most this often.
+    telemetry_sample_interval_s: float = _env("telemetry_sample_interval_s", 1.0)
+    # Ring sizes for the controller store's retention tiers:
+    # raw samples (~1 per sample interval), 10s buckets, 60s buckets.
+    # Defaults: ~6 min raw + 1 h of 10s + 24 h of 60s per node, all O(MB).
+    telemetry_raw_capacity: int = _env("telemetry_raw_capacity", 360)
+    telemetry_10s_capacity: int = _env("telemetry_10s_capacity", 360)
+    telemetry_60s_capacity: int = _env("telemetry_60s_capacity", 1440)
+    # Trend-aware OOM early warning: emit an ``oom_risk`` event when a
+    # worker's RSS slope projects past the kill limit within this horizon
+    # (seconds). 0 disables projection.
+    oom_risk_horizon_s: float = _env("oom_risk_horizon_s", 10.0)
+    # Minimum seconds between oom_risk events for the same worker.
+    oom_risk_cooldown_s: float = _env("oom_risk_cooldown_s", 30.0)
+
     # --- event export (reference: RayEvent export files, N28) ---
     event_export_enabled: bool = _env("event_export_enabled", True)
     event_export_max_bytes: int = _env(

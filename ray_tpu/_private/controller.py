@@ -187,6 +187,17 @@ class Controller:
         # Queued-but-unplaceable resource demands, for the autoscaler [N4].
         self.pending_demands: dict[str, dict] = {}
         self.events = EventExporter(session_dir)
+        # Resource-telemetry time-series store (ISSUE 5): node samples
+        # arrive piggybacked on heartbeats and land in bounded, tiered
+        # rings (raw → 10s → 60s) — multi-hour runs stay O(MB).
+        from ray_tpu._private.telemetry import TelemetryStore
+
+        _cfg = global_config()
+        self.telemetry = TelemetryStore(
+            raw_capacity=_cfg.telemetry_raw_capacity,
+            cap_10s=_cfg.telemetry_10s_capacity,
+            cap_60s=_cfg.telemetry_60s_capacity,
+        )
         self._rr = itertools.count()
         # --- control-plane scale-out machinery ---
         # Capacity pulse: schedulers park on the CURRENT event; a capacity
@@ -733,6 +744,10 @@ class Controller:
             # Agents piggyback queue-depth/engine counters on the
             # heartbeat they already send — no extra stats RPC fan-in.
             node.stats = payload["stats"]
+        if payload.get("telemetry"):
+            # Resource samples ride the same beat; the store's monotonic
+            # guard drops chaos-duplicated/replayed samples.
+            self.telemetry.add_many(node.node_id, payload["telemetry"])
         self.stats_counters["heartbeats"] += 1
         # Wake parked schedulers only on a capacity GAIN: a steady-state
         # heartbeat from each of N nodes per tick must not trigger N
@@ -1614,6 +1629,12 @@ class Controller:
                 row["start_time"] = event["start_ts"]
             if state in ("FINISHED", "FAILED") and ts:
                 row["end_time"] = ts
+            # Per-task resource attribution (ISSUE 5): terminal events
+            # carry the worker's peak RSS / RSS delta (and HBM delta on
+            # TPU) across the execution.
+            for key in ("peak_rss", "rss_delta", "hbm_delta"):
+                if event.get(key) is not None:
+                    row[key] = event[key]
         rows = list(latest.values())
         if filters:
             rows = [
@@ -1647,6 +1668,34 @@ class Controller:
     async def rpc_list_workers(self, conn, payload) -> list:
         return list(self.clients.values())
 
+    # ------------------------------------------------------------------
+    # resource telemetry (ISSUE 5)
+    # ------------------------------------------------------------------
+    async def rpc_resource_summary(self, conn, payload) -> dict:
+        """Per-node latest sample + ring depths, plus node liveness — the
+        payload behind util/state.summarize_resources() and `top`."""
+        summary = self.telemetry.summary()
+        for node_id, entry in summary["nodes"].items():
+            node = self.nodes.get(node_id)
+            entry["alive"] = bool(node and node.alive)
+        summary["oom_risk_events"] = self.stats_counters.get(
+            "oom_risk_events", 0
+        )
+        return summary
+
+    async def rpc_resource_timeline(self, conn, payload) -> dict:
+        return self.telemetry.timeline(
+            payload.get("node_id", ""), payload.get("tier")
+        )
+
+    async def rpc_report_oom_risk(self, conn, payload) -> dict:
+        """Trend-aware OOM early warning from a node agent: count it (the
+        metric) and export/publish it (the structured event) so dashboards
+        and subscribers see the risk before any kill fires."""
+        self.stats_counters["oom_risk_events"] += 1
+        await self.publish("oom_risk", payload)
+        return {"status": "ok"}
+
     async def rpc_controller_stats(self, conn, payload) -> dict:
         """Control-plane internals for the scale suite and /metrics: queue
         depths must drain to zero in a healthy cluster."""
@@ -1674,6 +1723,7 @@ class Controller:
             "node_stats": {
                 n.node_id: n.stats for n in self.nodes.values() if n.stats
             },
+            "telemetry": self.telemetry.stats(),
         }
 
 

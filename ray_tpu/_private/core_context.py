@@ -221,7 +221,10 @@ class CoreContext:
 
         # function table cache (worker side)
         self._function_cache: dict[str, Any] = {}
-        self._task_events: list[dict] = []
+        # Slim lifecycle-event tuples buffered by the worker runtime:
+        # (task_id, name, state, start_ts, ts, resources|None) — expanded
+        # into full records at flush (worker_proc._record_task_event).
+        self._task_events: list[tuple] = []
         self._shutdown = False
 
     # ------------------------------------------------------------------
@@ -295,6 +298,34 @@ class CoreContext:
         self.io.stop()
 
     async def _shutdown_async(self) -> None:
+        # Final task-event flush (companion to util/metrics' atexit
+        # flush): a short-lived worker exiting under the size/time batch
+        # thresholds must not drop the tail of its lifecycle + resource-
+        # attribution stream.
+        if self._task_events and self.controller is not None:
+            slim, self._task_events = self._task_events, []
+            events = []
+            for task_id, name, state, start_ts, ts, extras in slim:
+                event = {
+                    "task_id": task_id,
+                    "name": name,
+                    "state": state,
+                    "node_id": self.node_id,
+                    "worker_id": self.worker_id,
+                    "pid": os.getpid(),
+                    "ts": ts,
+                }
+                if start_ts is not None:
+                    event["start_ts"] = start_ts
+                if extras:
+                    event.update(extras)
+                events.append(event)
+            try:
+                await self.controller.call(
+                    "report_task_events", {"events": events}, timeout=2
+                )
+            except Exception:
+                pass
         for addr, owner in list(self._borrowed.items()):
             try:
                 client = await self._client_for(tuple(owner))

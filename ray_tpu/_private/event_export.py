@@ -31,6 +31,9 @@ _CHANNEL_FILES = {
     "job_started": "job",
     "job_finished": "job",
     "task_events": "task",
+    # Trend-aware OOM early warning (ISSUE 5): the memory monitor saw a
+    # worker's RSS slope projecting past the kill limit.
+    "oom_risk": "oom_risk",
 }
 
 

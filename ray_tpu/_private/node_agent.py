@@ -220,6 +220,15 @@ class NodeAgent:
         self._native_lease = None
         self._native_leases: dict[str, dict] = {}
         self._default_env_hash = self._env_hash({})
+        # resource telemetry (ISSUE 5): the memory-monitor loop assembles
+        # node samples here; the heartbeat loop ships them piggybacked on
+        # the existing stats channel. Bounded: a controller outage drops
+        # old samples instead of growing the agent.
+        self._telemetry_buffer: collections.deque = collections.deque(maxlen=64)
+        self._telemetry_last_sample = 0.0
+        # per-worker (t, rss) history for the oom_risk trend projection
+        self._rss_history: dict[str, collections.deque] = {}
+        self._oom_risk_last: dict[str, float] = {}
 
     # ------------------------------------------------------------------
     async def start(self, port: int = 0) -> tuple:
@@ -270,7 +279,14 @@ class NodeAgent:
         worker above memory_worker_rss_limit_mb (absolute cap, also the
         testing knob) is killed outright. The owner of its tasks sees a
         retriable OutOfMemoryError (via worker_death_info), never a
-        whole-node OOM."""
+        whole-node OOM.
+
+        The same psutil sweep doubles as the node's resource-telemetry
+        sampler (ISSUE 5): at most once per telemetry_sample_interval_s it
+        assembles a node sample (CPU%, per-worker RSS, object-store bytes,
+        HBM when available) into _telemetry_buffer for the heartbeat to
+        ship, and feeds the per-worker RSS histories behind the
+        trend-aware ``oom_risk`` early warning."""
         import psutil
 
         cfg = global_config()
@@ -283,11 +299,19 @@ class NodeAgent:
             await asyncio.sleep(interval)
             limit_bytes = cfg.memory_worker_rss_limit_mb * (1 << 20)
             try:
-                node_frac = psutil.virtual_memory().percent / 100.0
+                vmem = psutil.virtual_memory()
+                node_frac = vmem.percent / 100.0
             except Exception:
                 continue
             over_node = node_frac >= cfg.memory_usage_threshold
-            if not over_node and limit_bytes <= 0:
+            now = time.time()
+            want_sample = (
+                cfg.telemetry_enabled
+                and now - self._telemetry_last_sample
+                >= cfg.telemetry_sample_interval_s
+            )
+            want_risk = limit_bytes > 0 and cfg.oom_risk_horizon_s > 0
+            if not over_node and limit_bytes <= 0 and not want_sample:
                 continue
             samples = []
             live_ids = set()
@@ -298,14 +322,33 @@ class NodeAgent:
                 live_ids.add(worker.worker_id)
                 try:
                     proc = procs.get(worker.worker_id)
-                    if proc is None or proc.pid != pid:
+                    # Stale-handle guard: a respawned worker id carries a
+                    # new pid, and a reused pid is a different process
+                    # (is_running() compares create_time) — either way the
+                    # cached handle would read a stranger's RSS.
+                    if proc is not None and (
+                        proc.pid != pid or not proc.is_running()
+                    ):
+                        procs.pop(worker.worker_id, None)
+                        proc = None
+                    if proc is None:
                         proc = procs[worker.worker_id] = psutil.Process(pid)
                     samples.append((proc.memory_info().rss, worker))
+                except psutil.NoSuchProcess:
+                    procs.pop(worker.worker_id, None)
+                    continue
                 except Exception:
                     continue
             for worker_id in list(procs):
                 if worker_id not in live_ids:
                     procs.pop(worker_id, None)
+            if want_sample:
+                self._telemetry_last_sample = now
+                self._telemetry_sample(now, vmem, samples)
+            if want_risk:
+                self._check_oom_risk(now, samples, limit_bytes, cfg)
+            if not over_node and limit_bytes <= 0:
+                continue
             if not samples:
                 continue
             # Kill preference (raylet policy analog): retriable task
@@ -371,6 +414,119 @@ class NodeAgent:
             worker.proc.kill()
         except ProcessLookupError:
             pass
+
+    # ------------------------------------------------------------------
+    # resource telemetry (ISSUE 5)
+    # ------------------------------------------------------------------
+    def _telemetry_sample(self, now: float, vmem, samples: list) -> None:
+        """Assemble one node sample from the monitor sweep and buffer it
+        for the next heartbeat (piggyback channel — no extra RPC)."""
+        import psutil
+
+        worker_rss = {w.worker_id: int(rss) for rss, w in samples}
+        sample: dict[str, Any] = {
+            "ts": now,
+            "mem_used": int(vmem.total - vmem.available),
+            "mem_total": int(vmem.total),
+            "num_workers": len(self.workers),
+            "workers_rss_total": sum(worker_rss.values()),
+            "workers_rss_max": max(worker_rss.values(), default=0),
+            "worker_rss": worker_rss,
+        }
+        try:
+            # Non-blocking since-last-call percent; the first call of a
+            # process returns 0.0 and primes the counter.
+            sample["cpu_percent"] = psutil.cpu_percent(None)
+        except Exception:
+            pass
+        try:
+            store_stats = self.store.stats()
+            sample["object_store_bytes"] = int(store_stats.get("used", 0))
+            sample["object_store_capacity"] = int(
+                store_stats.get("capacity", 0)
+            )
+        except Exception:
+            pass
+        sample.update(self._hbm_stats())
+        self._telemetry_buffer.append(sample)
+
+    def _hbm_stats(self) -> dict:
+        """TPU HBM used/total via jax.local_devices() memory_stats() —
+        only when jax is ALREADY imported in this process. The agent never
+        imports jax itself: initializing the TPU backend here would steal
+        the chip lock from workers (see detect_tpu_resources)."""
+        mod = sys.modules.get("jax")
+        if mod is None:
+            return {}
+        try:
+            used = total = 0
+            for dev in mod.local_devices():
+                if getattr(dev, "platform", "") != "tpu":
+                    continue
+                mem = dev.memory_stats() or {}
+                used += int(mem.get("bytes_in_use", 0))
+                total += int(mem.get("bytes_limit", 0))
+            if total:
+                return {"hbm_used": used, "hbm_total": total}
+        except Exception:
+            pass
+        return {}
+
+    def _check_oom_risk(
+        self, now: float, samples: list, limit_bytes: int, cfg
+    ) -> None:
+        """Trend-aware early warning: when a worker's RSS slope projects
+        past the kill limit within oom_risk_horizon_s while its current
+        RSS is still under it, report ``oom_risk`` to the controller
+        (structured event + metric) BEFORE the point-in-time kill fires."""
+        from ray_tpu._private.telemetry import project_rss
+
+        live = set()
+        for rss, worker in samples:
+            wid = worker.worker_id
+            live.add(wid)
+            hist = self._rss_history.get(wid)
+            if hist is None:
+                hist = self._rss_history[wid] = collections.deque(maxlen=8)
+            hist.append((now, rss))
+            if rss >= limit_bytes:
+                continue  # the kill path owns this case
+            projected = project_rss(hist, cfg.oom_risk_horizon_s)
+            if projected is None or projected < limit_bytes:
+                continue
+            if now - self._oom_risk_last.get(wid, 0.0) < cfg.oom_risk_cooldown_s:
+                continue
+            self._oom_risk_last[wid] = now
+            print(
+                f"[raytpu-agent] oom_risk: worker {wid} rss={rss >> 20} MiB "
+                f"projected={int(projected) >> 20} MiB crosses limit "
+                f"{limit_bytes >> 20} MiB within {cfg.oom_risk_horizon_s:.0f}s",
+                file=sys.stderr,
+            )
+            spawn_task(
+                self._report_oom_risk(
+                    {
+                        "node_id": self.node_id,
+                        "worker_id": wid,
+                        "actor_id": worker.actor_id,
+                        "rss": int(rss),
+                        "projected_rss": int(projected),
+                        "limit_bytes": int(limit_bytes),
+                        "horizon_s": cfg.oom_risk_horizon_s,
+                        "ts": now,
+                    }
+                )
+            )
+        for wid in list(self._rss_history):
+            if wid not in live:
+                self._rss_history.pop(wid, None)
+                self._oom_risk_last.pop(wid, None)
+
+    async def _report_oom_risk(self, payload: dict) -> None:
+        try:
+            await self.controller.call("report_oom_risk", payload)
+        except Exception:
+            pass  # advisory: never let a warning RPC hurt the agent
 
     async def _register_with_controller(self) -> None:
         resp = await self.controller.call(
@@ -470,14 +626,23 @@ class NodeAgent:
             try:
                 self._refresh_available_mirror()
                 self._drain_lease_events()
-                resp = await self.controller.call(
-                    "heartbeat",
-                    {
-                        "node_id": self.node_id,
-                        "resources_available": self.resources_available,
-                        "stats": self._agent_stats(),
-                    },
-                )
+                payload = {
+                    "node_id": self.node_id,
+                    "resources_available": self.resources_available,
+                    "stats": self._agent_stats(),
+                }
+                # Telemetry piggyback: snapshot (don't drain) the buffer so
+                # a failed send retries the same samples next beat — the
+                # controller's monotonic-ts guard dedups any replay.
+                shipped = list(self._telemetry_buffer)
+                if shipped:
+                    payload["telemetry"] = shipped
+                resp = await self.controller.call("heartbeat", payload)
+                for _ in shipped:  # delivered: drop exactly what we sent
+                    try:
+                        self._telemetry_buffer.popleft()
+                    except IndexError:
+                        break
                 if resp.get("status") in ("unknown_node", "reregister"):
                     # unknown_node: controller restarted without a snapshot
                     # of us. reregister: the controller declared us dead

@@ -33,6 +33,20 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.rpc import RpcClient
 
 
+def _peak_rss_bytes() -> int:
+    """Process high-water RSS via getrusage — ~1µs, cheap enough for the
+    per-task attribution hot path (a psutil read here would dominate a
+    no-op task and blow the telemetry overhead budget). Linux reports
+    ru_maxrss in KiB; macOS in bytes."""
+    try:
+        import resource as _resource
+
+        peak = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss
+        return peak if sys.platform == "darwin" else peak * 1024
+    except Exception:
+        return 0
+
+
 class WorkerRuntime:
     def __init__(self) -> None:
         self.ctx = CoreContext(
@@ -94,6 +108,10 @@ class WorkerRuntime:
         # ~3us per call; keyed by __func__ so bound methods hit)
         self._coro_cache: dict = {}
         self._method_cache: dict[str, Any] = {}
+        # Per-task resource attribution (ISSUE 5): tri-state TPU probe —
+        # None = unknown yet, False = jax loaded but no TPU (never probe
+        # again), True = TPU live (read HBM around every task).
+        self._hbm_probe: bool | None = None
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -717,6 +735,8 @@ class WorkerRuntime:
         self, spec, fn, preresolved, name, task_id, on_main, start_ts=None,
         trace_span=None,
     ) -> dict:
+        rss0 = _peak_rss_bytes()
+        hbm0 = self._hbm_used()
         try:
             if preresolved is not None:
                 args, kwargs = preresolved
@@ -750,7 +770,10 @@ class WorkerRuntime:
                 value = fn(*args, **kwargs)
             num_returns = spec.get("num_returns", 1)
             values = [value] if num_returns == 1 else list(value)
-            self._record_task_event(spec, "FINISHED", start_ts)
+            self._record_task_event(
+                spec, "FINISHED", start_ts,
+                self._task_resources(rss0, hbm0, trace_span),
+            )
             if trace_span is not None:
                 # begin/finish fast path: parent is explicit and no user
                 # code runs inside, so the contextvar write of span() is
@@ -778,7 +801,10 @@ class WorkerRuntime:
         except Exception as exc:
             if trace_span is not None:
                 trace_span.set_error(exc)
-            self._record_task_event(spec, "FAILED", start_ts)
+            self._record_task_event(
+                spec, "FAILED", start_ts,
+                self._task_resources(rss0, hbm0, trace_span),
+            )
             err = exceptions.TaskError(name, traceback.format_exc())
             payload, _ = serialization.serialize(err)
             return {"status": "error", "error": payload}
@@ -788,12 +814,58 @@ class WorkerRuntime:
                 self._main_current_task = None
             self._running_exec.pop(task_id, None)
 
+    def _hbm_used(self) -> int | None:
+        """Local-TPU HBM bytes in use, or None when not on TPU. The probe
+        is tri-state cached: once jax is loaded without TPU devices this
+        is a single attribute check per task forever after."""
+        if self._hbm_probe is False:
+            return None
+        mod = sys.modules.get("jax")
+        if mod is None:
+            return None
+        try:
+            devices = [
+                d for d in mod.local_devices()
+                if getattr(d, "platform", "") == "tpu"
+            ]
+            if not devices:
+                self._hbm_probe = False
+                return None
+            self._hbm_probe = True
+            return sum(
+                int((d.memory_stats() or {}).get("bytes_in_use", 0))
+                for d in devices
+            )
+        except Exception:
+            self._hbm_probe = False
+            return None
+
+    def _task_resources(
+        self, rss0: int, hbm0: int | None, trace_span=None
+    ) -> dict:
+        """Per-task resource attribution (ISSUE 5). ru_maxrss is a process
+        high-water mark, so ``rss_delta`` is how much THIS task raised it —
+        the "which task ate the memory" signal — and ``peak_rss`` is the
+        worker's peak during/before the task. Also stamped into the PR-4
+        execute span so traces carry the memory story alongside latency."""
+        peak = _peak_rss_bytes()
+        res = {"peak_rss": peak, "rss_delta": max(0, peak - rss0)}
+        if hbm0 is not None:
+            hbm1 = self._hbm_used()
+            if hbm1 is not None:
+                res["hbm_delta"] = hbm1 - hbm0
+        if trace_span is not None:
+            trace_span.attributes.update(res)
+        return res
+
     def _record_task_event(
-        self, spec: dict, state: str, start_ts: float | None = None
+        self, spec: dict, state: str, start_ts: float | None = None,
+        resources: dict | None = None,
     ) -> None:
         """Task lifecycle events feed the state API + `ray_tpu timeline`
         (reference: profile_event.cc → gcs_task_manager.cc [N5]). Terminal
-        events carry ``start_ts`` so one record describes the whole span."""
+        events carry ``start_ts`` so one record describes the whole span,
+        plus the per-task resource attribution when measured."""
         with self._task_event_lock:
             # Hot path appends a tuple; the flush below expands it into the
             # full record (the reference buffers a ring of slim events and
@@ -801,7 +873,7 @@ class WorkerRuntime:
             # dict per lifecycle event costs more than the task envelope.
             self.ctx._task_events.append(
                 (spec.get("task_id"), spec.get("name"), state, start_ts,
-                 _time.time())
+                 _time.time(), resources)
             )
             # Batch: size- or time-triggered, never per-event.
             now = _time.monotonic()
@@ -818,7 +890,7 @@ class WorkerRuntime:
         worker_id = self.ctx.worker_id
         pid = os.getpid()
         events = []
-        for task_id, name, ev_state, ev_start, ts in slim:
+        for task_id, name, ev_state, ev_start, ts, extras in slim:
             event = {
                 "task_id": task_id,
                 "name": name,
@@ -830,6 +902,8 @@ class WorkerRuntime:
             }
             if ev_start is not None:
                 event["start_ts"] = ev_start
+            if extras:
+                event.update(extras)  # peak_rss / rss_delta / hbm_delta
             events.append(event)
 
         async def _flush():
@@ -1159,6 +1233,8 @@ class WorkerRuntime:
     async def _async_actor_body(
         self, spec, method, name, task_id, start_ts, trace_span
     ) -> dict:
+        rss0 = _peak_rss_bytes()
+        hbm0 = self._hbm_used()
         try:
             args, kwargs = await self._resolve_args_async(spec["args"])
             cfut = asyncio.run_coroutine_threadsafe(
@@ -1171,7 +1247,10 @@ class WorkerRuntime:
                 self._running_async.pop(task_id, None)
             num_returns = spec.get("num_returns", 1)
             values = [value] if num_returns == 1 else list(value)
-            self._record_task_event(spec, "FINISHED", start_ts)
+            self._record_task_event(
+                spec, "FINISHED", start_ts,
+                self._task_resources(rss0, hbm0, trace_span),
+            )
             return {
                 "status": "ok",
                 "returns": self._package_returns(spec, values),
@@ -1185,7 +1264,10 @@ class WorkerRuntime:
         except Exception as exc:
             if trace_span is not None:
                 trace_span.set_error(exc)
-            self._record_task_event(spec, "FAILED", start_ts)
+            self._record_task_event(
+                spec, "FAILED", start_ts,
+                self._task_resources(rss0, hbm0, trace_span),
+            )
             err = exceptions.TaskError(name, traceback.format_exc())
             payload, _ = serialization.serialize(err)
             return {"status": "error", "error": payload}

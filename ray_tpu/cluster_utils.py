@@ -220,6 +220,32 @@ class FakeNodeAgent:
             "resource_waiters": 0,
         }
 
+    def _telemetry_sample(self) -> dict:
+        """Honest telemetry for a fake node: real psutil CPU + this
+        process's memory stand in for the node (all fakes share the
+        process), synthetic per-worker RSS for the fake workers. Keeps
+        the telemetry acceptance path (2-node FakeScaleCluster →
+        summarize_resources) exercising real sampling code."""
+        import time as _time
+
+        sample: dict = {"ts": _time.time(), "num_workers": len(self.workers)}
+        try:
+            import psutil
+
+            vmem = psutil.virtual_memory()
+            sample["cpu_percent"] = psutil.cpu_percent(None)
+            sample["mem_used"] = int(vmem.total - vmem.available)
+            sample["mem_total"] = int(vmem.total)
+            rss = int(psutil.Process().memory_info().rss)
+        except Exception:
+            rss = 0
+        worker_rss = {wid: rss for wid in self.workers}
+        sample["worker_rss"] = worker_rss
+        sample["workers_rss_total"] = sum(worker_rss.values())
+        sample["workers_rss_max"] = max(worker_rss.values(), default=0)
+        sample["object_store_bytes"] = 0
+        return sample
+
     async def heartbeat(self) -> dict:
         self.heartbeats_sent += 1
         return await self.client.call(
@@ -228,6 +254,7 @@ class FakeNodeAgent:
                 "node_id": self.node_id,
                 "resources_available": dict(self.available),
                 "stats": self._stats(),
+                "telemetry": [self._telemetry_sample()],
             },
         )
 

@@ -42,6 +42,7 @@ _INDEX_HTML = """<!doctype html>
  <a href="#" onclick="view='jobs';refresh();return false">jobs</a>
  <a href="#" onclick="view='serveView';refresh();return false">serve</a>
  <a href="#" onclick="view='workers';refresh();return false">workers</a>
+ <a href="#" onclick="view='resources';refresh();return false">resources</a>
  <a href="#" onclick="view='logs';refresh();return false">logs</a>
  <a href="#" onclick="view='autoscaler';refresh();return false">autoscaler</a>
  <a href="#" onclick="view='events';refresh();return false">events</a>
@@ -126,6 +127,53 @@ async function serveView() {
   }
   return html;
 }
+function fmtBytes(b) {
+  if (b === undefined || b === null) return '';
+  const units = ['B', 'KiB', 'MiB', 'GiB', 'TiB'];
+  let i = 0;
+  while (b >= 1024 && i < units.length - 1) { b /= 1024; i++; }
+  return b.toFixed(i ? 1 : 0) + ' ' + units[i];
+}
+function spark(points, key, w = 240, h = 36) {
+  const vals = points.map(p => p[key]).filter(v => typeof v === 'number');
+  if (vals.length < 2) return '<span class="muted">gathering…</span>';
+  const min = Math.min(...vals), max = Math.max(...vals);
+  const span = (max - min) || 1;
+  const pts = vals.map((v, i) =>
+    `${(i / (vals.length - 1) * w).toFixed(1)},` +
+    `${(h - 2 - (v - min) / span * (h - 4)).toFixed(1)}`).join(' ');
+  return `<svg width="${w}" height="${h}"><polyline points="${pts}"` +
+    ` fill="none" stroke="#36c" stroke-width="1.5"/></svg>`;
+}
+async function resources() {
+  const s = await fetch('/api/resources').then(r => r.json());
+  const ids = Object.keys(s.nodes ?? {});
+  let html = '<h2>Resources</h2><div class="muted">' +
+    `ingested ${esc(s.total_ingested ?? 0)} samples · ` +
+    `dropped ${esc(s.total_dropped ?? 0)} · ` +
+    `oom_risk events ${esc(s.oom_risk_events ?? 0)}</div>`;
+  if (!ids.length) return html + '<div class="muted">no telemetry yet</div>';
+  for (const id of ids) {
+    const tl = await fetch('/api/timeseries?node_id=' +
+      encodeURIComponent(id) + '&tier=raw').then(r => r.json());
+    const pts = tl.raw ?? [];
+    const n = s.nodes[id], latest = n.latest ?? {};
+    html += `<h2><code>${esc(id.slice(-12))}</code> ` +
+      `<span class="muted">${n.alive ? 'alive' : 'dead'} · ` +
+      `tiers raw:${esc(n.points?.raw ?? 0)} 10s:${esc(n.points?.['10s'] ?? 0)} ` +
+      `60s:${esc(n.points?.['60s'] ?? 0)}</span></h2>`;
+    const rows = [
+      ['cpu %', esc((latest.cpu_percent ?? 0).toFixed?.(1) ?? ''), spark(pts, 'cpu_percent')],
+      ['node mem', fmtBytes(latest.mem_used) + ' / ' + fmtBytes(latest.mem_total), spark(pts, 'mem_used')],
+      ['workers rss', fmtBytes(latest.workers_rss_total) + ` (${esc(latest.num_workers ?? 0)} workers)`, spark(pts, 'workers_rss_total')],
+      ['object store', fmtBytes(latest.object_store_bytes), spark(pts, 'object_store_bytes')],
+    ];
+    if (latest.hbm_total)
+      rows.push(['TPU HBM', fmtBytes(latest.hbm_used) + ' / ' + fmtBytes(latest.hbm_total), spark(pts, 'hbm_used')]);
+    html += table(['metric', 'now', 'raw history'], rows);
+  }
+  return html;
+}
 async function workers() {
   const rows = await fetch('/api/workers').then(r => r.json());
   return '<h2>Workers</h2>' + table(['worker', 'node', 'pid/state'],
@@ -156,8 +204,8 @@ async function autoscaler() {
   return html;
 }
 async function refresh() {
-  const render = {overview, tasks, jobs, serveView, workers, logs, events,
-                  autoscaler}[view];
+  const render = {overview, tasks, jobs, serveView, workers, resources,
+                  logs, events, autoscaler}[view];
   try { document.getElementById('content').innerHTML = await render(); }
   catch (err) { document.getElementById('content').innerHTML = 'error: ' + esc(err); }
 }
@@ -206,6 +254,8 @@ class DashboardHead:
         app.router.add_get("/api/logs", self._logs)
         app.router.add_get("/api/logs/{name}", self._log_file)
         app.router.add_get("/api/timeline", self._timeline)
+        app.router.add_get("/api/resources", self._resources)
+        app.router.add_get("/api/timeseries", self._timeseries)
         app.router.add_get("/api/tracing", self._tracing)
         app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/stacks", self._stacks)
@@ -316,9 +366,17 @@ class DashboardHead:
         path = os.path.join(self.session_dir or "", "logs", name)
         if not os.path.exists(path):
             return web.Response(status=404, text="no such log")
-        lines = int(request.query.get("lines", "200"))
+        try:
+            lines = int(request.query.get("lines", "200"))
+        except ValueError:
+            return web.Response(
+                status=400, text="?lines= must be an integer"
+            )
         with open(path, "rb") as f:
-            data = f.read()[-200_000:]
+            # Tail without loading the whole file: a multi-GB worker log
+            # must not transit driver memory for a 200-line view.
+            f.seek(max(0, os.fstat(f.fileno()).st_size - 200_000))
+            data = f.read(200_000)
         text = data.decode(errors="replace")
         return web.Response(text="\n".join(text.splitlines()[-lines:]))
 
@@ -338,6 +396,30 @@ class DashboardHead:
                 )
 
         return web.json_response(await asyncio.to_thread(build))
+
+    async def _resources(self, request):
+        """Cluster telemetry summary: per-node latest sample + tier
+        depths (ISSUE 5; backs the 'resources' view and `ray_tpu top`)."""
+        from aiohttp import web
+
+        return web.json_response(
+            await asyncio.to_thread(state_mod.summarize_resources),
+            dumps=_dumps,
+        )
+
+    async def _timeseries(self, request):
+        """GET ?node_id=...[&tier=raw|10s|60s] — one node's resource
+        time-series from the controller's tiered ring-buffer store."""
+        from aiohttp import web
+
+        node_id = request.query.get("node_id", "")
+        tier = request.query.get("tier") or None
+        return web.json_response(
+            await asyncio.to_thread(
+                state_mod.get_node_timeline, node_id, tier
+            ),
+            dumps=_dumps,
+        )
 
     async def _metrics(self, request):
         from aiohttp import web

@@ -100,6 +100,91 @@ def cmd_summary(args) -> None:
     print(json.dumps(fn(), indent=2))
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if value < 1024 or unit == "TiB":
+            return f"{value:.1f}{unit}" if unit != "B" else f"{int(value)}B"
+        value /= 1024
+    return f"{value:.1f}TiB"
+
+
+def _render_top(summary: dict) -> str:
+    """One refresh frame of `ray_tpu top`: per-node utilization lines +
+    the heaviest workers by RSS, from the controller's telemetry store."""
+    lines = [
+        time.strftime("%H:%M:%S")
+        + f"  nodes={len(summary.get('nodes') or {})}"
+        + f"  samples={summary.get('total_ingested', 0)}"
+        + f"  dropped={summary.get('total_dropped', 0)}"
+        + f"  oom_risk={summary.get('oom_risk_events', 0)}",
+        "",
+        f"{'NODE':<14}{'CPU%':>6}{'MEM':>18}{'WORKERS':>9}"
+        f"{'RSS(total)':>12}{'OBJSTORE':>10}{'HBM':>16}  TIERS",
+    ]
+    workers: list[tuple[int, str, str]] = []
+    for node_id, entry in sorted((summary.get("nodes") or {}).items()):
+        latest = entry.get("latest") or {}
+        points = entry.get("points") or {}
+        hbm = (
+            f"{_fmt_bytes(latest.get('hbm_used'))}/"
+            f"{_fmt_bytes(latest.get('hbm_total'))}"
+            if latest.get("hbm_total")
+            else "-"
+        )
+        mem = (
+            f"{_fmt_bytes(latest.get('mem_used'))}/"
+            f"{_fmt_bytes(latest.get('mem_total'))}"
+        )
+        tiers = (
+            f"raw:{points.get('raw', 0)} 10s:{points.get('10s', 0)} "
+            f"60s:{points.get('60s', 0)}"
+        )
+        alive = "" if entry.get("alive", True) else " (dead)"
+        lines.append(
+            f"{node_id[-12:]:<14}"
+            f"{latest.get('cpu_percent', 0):>6.1f}"
+            f"{mem:>18}"
+            f"{latest.get('num_workers', 0):>9}"
+            f"{_fmt_bytes(latest.get('workers_rss_total')):>12}"
+            f"{_fmt_bytes(latest.get('object_store_bytes')):>10}"
+            f"{hbm:>16}  {tiers}{alive}"
+        )
+        for worker_id, rss in (latest.get("worker_rss") or {}).items():
+            workers.append((int(rss), worker_id, node_id))
+    workers.sort(reverse=True)
+    if workers:
+        lines += ["", f"{'WORKER':<28}{'NODE':<14}{'RSS':>12}"]
+        for rss, worker_id, node_id in workers[:15]:
+            lines.append(
+                f"{worker_id[-26:]:<28}{node_id[-12:]:<14}"
+                f"{_fmt_bytes(rss):>12}"
+            )
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> None:
+    """Live cluster utilization (`htop` role): refreshes per-node CPU /
+    memory / worker-RSS / object-store / HBM from the telemetry store."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    while True:
+        frame = _render_top(state.summarize_resources())
+        if args.once:
+            print(frame)
+            return
+        # ANSI clear + home keeps the display in place like top(1).
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return
+
+
 def cmd_timeline(args) -> None:
     _connect(args)
     import ray_tpu
@@ -194,6 +279,13 @@ def main(argv=None) -> None:
     p.add_argument("kind", choices=["tasks", "actors"])
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("top", help="live cluster resource utilization")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (no screen clearing)")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("timeline")
     p.add_argument("--output", default="timeline.json")

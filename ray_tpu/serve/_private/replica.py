@@ -233,12 +233,18 @@ class Replica:
 
     def get_metrics(self) -> dict:
         lat = sorted(self._latencies[-200:])
+        from ray_tpu._private.worker_proc import _peak_rss_bytes
+
         return {
             "replica_id": self.replica_id,
             "ongoing": self._ongoing,
             "total": self._total,
             "p50_ms": 1e3 * lat[len(lat) // 2] if lat else 0.0,
             "p99_ms": 1e3 * lat[int(len(lat) * 0.99)] if lat else 0.0,
+            # Resource telemetry (ISSUE 5): replica memory footprint so
+            # autoscaling/status surfaces see per-replica RSS alongside
+            # latency.
+            "rss_bytes": _peak_rss_bytes(),
         }
 
     def get_num_ongoing(self) -> int:

@@ -9,6 +9,7 @@ metrics agent + OpenCensus pipeline [N27] plays in the reference.
 
 from __future__ import annotations
 
+import atexit
 import json
 import threading
 import time
@@ -37,6 +38,18 @@ def _ensure_flusher() -> None:
         if not _flusher_started:
             _flusher_started = True
             threading.Thread(target=_flush_loop, daemon=True).start()
+            # Final flush at interpreter exit: a short-lived worker or
+            # driver whose last points landed under one flush interval
+            # ago would otherwise silently drop them (the daemon flusher
+            # dies mid-sleep).
+            atexit.register(_flush_at_exit)
+
+
+def _flush_at_exit() -> None:
+    try:
+        flush()
+    except Exception:
+        pass
 
 
 def flush() -> None:
@@ -241,6 +254,57 @@ def control_plane_points(ctx) -> list:
     return points
 
 
+# Node-sample fields exported 1:1 as per-node gauges (ISSUE 5). The
+# full history stays in the controller's time-series store; /metrics
+# exposes the CURRENT sample set the way Prometheus expects (it builds
+# its own history by scraping).
+_TELEMETRY_GAUGES = (
+    "cpu_percent",
+    "mem_used",
+    "mem_total",
+    "num_workers",
+    "workers_rss_total",
+    "workers_rss_max",
+    "object_store_bytes",
+    "object_store_capacity",
+    "hbm_used",
+    "hbm_total",
+)
+
+
+def telemetry_points(ctx) -> list:
+    """(name, tags, value, kind) from each node's latest telemetry
+    sample, plus per-worker RSS gauges and the oom_risk counter."""
+    points: list = []
+    try:
+        summary = ctx.io.run(
+            ctx.controller.call("resource_summary", {}, timeout=5.0)
+        )
+    except Exception:
+        return points
+    for node_id, entry in sorted((summary.get("nodes") or {}).items()):
+        latest = entry.get("latest") or {}
+        tags = {"node": node_id}
+        for field in _TELEMETRY_GAUGES:
+            if field in latest:
+                points.append(
+                    (f"node_{field}", tags, float(latest[field]), "gauge")
+                )
+        for worker_id, rss in sorted(
+            (latest.get("worker_rss") or {}).items()
+        ):
+            points.append(
+                ("worker_rss_bytes",
+                 {"node": node_id, "worker": worker_id},
+                 float(rss), "gauge")
+            )
+    points.append(
+        ("oom_risk_events", {},
+         float(summary.get("oom_risk_events") or 0), "counter")
+    )
+    return points
+
+
 def _render_points(points, lines: list, seen_headers: set) -> None:
     for name, tags, value, kind in points:
         full = "ray_tpu_" + name
@@ -299,4 +363,5 @@ def collect_prometheus_text() -> str:
             lines.append(f"{name}{label} {point['value']}")
     _render_points(local_engine_points(), lines, seen_headers)
     _render_points(control_plane_points(ctx), lines, seen_headers)
+    _render_points(telemetry_points(ctx), lines, seen_headers)
     return "\n".join(lines) + ("\n" if lines else "")

@@ -192,6 +192,50 @@ def summarize_latency(session_dir: str | None = None) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Resource telemetry (ISSUE 5): the controller's tiered time-series store
+# answers "what is the cluster eating" the way summarize_latency answers
+# "where does task time go".
+# ---------------------------------------------------------------------------
+
+
+def summarize_resources() -> dict:
+    """Cluster resource-utilization summary from the controller's
+    telemetry store.
+
+    Returns ``{"nodes": {node_id: {latest, points, last_ts, dropped,
+    alive}}, "total_ingested": N, "total_dropped": N, "oom_risk_events":
+    N}`` where ``latest`` is the node's freshest sample (cpu_percent,
+    mem_used/total, per-worker RSS, object-store bytes, HBM when on TPU)
+    and ``points`` gives the depth of each retention tier
+    (raw / 10s / 60s)."""
+    return _call("resource_summary")
+
+
+def get_node_timeline(node_id: str, tier: str | None = None) -> dict:
+    """One node's resource time-series, per retention tier.
+
+    ``tier`` of ``"raw"``, ``"10s"``, or ``"60s"`` selects one ring;
+    None returns all three. Buckets carry mean for rate-like fields
+    (cpu_percent) and max for footprints (RSS, object-store bytes, HBM),
+    plus a trailing ``partial`` bucket aggregating samples not yet old
+    enough to close."""
+    return _call("resource_timeline", {"node_id": node_id, "tier": tier})
+
+
+def summarize_task_memory(limit: int = 100_000) -> list[dict]:
+    """Which tasks ate the memory: finished/failed tasks ranked by the
+    amount they raised their worker's RSS high-water mark (``rss_delta``,
+    recorded per execution by the worker), with ``peak_rss`` and
+    ``hbm_delta`` alongside when present."""
+    rows = [
+        row for row in list_tasks(limit=limit)
+        if row.get("rss_delta") is not None or row.get("peak_rss") is not None
+    ]
+    rows.sort(key=lambda r: (r.get("rss_delta") or 0), reverse=True)
+    return rows
+
+
 def get_task_timeline(
     task_id: str, session_dir: str | None = None
 ) -> list[dict]:

@@ -1,0 +1,193 @@
+"""Telemetry-overhead benchmark (release suite, ISSUE 5 acceptance).
+
+Two measurements on REAL local clusters:
+
+1. ``enabled_overhead_pct`` — a no-op task storm measured with telemetry
+   OFF vs ON. Unlike the tracing benchmark, the toggle cannot flip
+   inside one boot: ``telemetry_enabled`` is read by the *node agent*
+   process (it gates the 1 Hz sampler inside the memory-monitor loop),
+   and the agent inherits the env at spawn. So the pairing is
+   ALTERNATING BOOTS — each round boots off, measures a window, boots
+   on, measures a window — and wall time is aggregated per mode across
+   all rounds so boot-to-boot machine drift averages out instead of
+   landing on one mode. The ON windows also cover the per-task
+   attribution path (one ``getrusage`` pair per task, ~1 µs) and the
+   heartbeat piggyback.
+
+2. ``scale_*`` — the acceptance scenario: a 2-node FakeScaleCluster
+   (real controller + RPC stack, fake data plane) soaked long enough
+   that ``resource_summary`` shows non-empty per-node series with >=2
+   downsampling tiers populated, and ``resource_timeline`` returns them.
+
+Prints ONE JSON line:
+  {"tasks_per_s_disabled": ..., "tasks_per_s_enabled": ...,
+   "enabled_overhead_pct": ..., "samples_ingested": ...,
+   "scale_nodes": 2, "scale_tiers_populated": ..., ...}
+
+RAY_TPU_RELEASE_SMOKE=1 downsizes task counts and the soak so the suite
+fits the tier-1 timeout.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+SMOKE = os.environ.get("RAY_TPU_RELEASE_SMOKE") == "1"
+
+
+def _boot(*, telemetry: bool):
+    """Set the mode env (inherited by the spawned agent) and init."""
+    os.environ["RAY_TPU_telemetry_enabled"] = "1" if telemetry else "0"
+    # Sample fast enough that ON windows actually exercise the sampler.
+    os.environ["RAY_TPU_telemetry_sample_interval_s"] = "0.5"
+    from ray_tpu._private.config import global_config
+
+    cfg = global_config()
+    cfg.telemetry_enabled = telemetry
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+
+    @ray_tpu.remote
+    def _noop(i):
+        return i
+
+    # Warm the worker pool so spawn cost stays out of the window.
+    ray_tpu.get([_noop.remote(i) for i in range(300)], timeout=120)
+    return _noop
+
+
+def _measure(noop, num_tasks: int) -> float:
+    import ray_tpu
+
+    wave = 500
+    done = 0
+    t0 = time.perf_counter()
+    while done < num_tasks:
+        n = min(wave, num_tasks - done)
+        ray_tpu.get([noop.remote(i) for i in range(n)], timeout=300)
+        done += n
+    return time.perf_counter() - t0
+
+
+def bench_paired_boots(num_tasks: int, rounds: int) -> dict:
+    import ray_tpu
+    from ray_tpu.util import state
+
+    off_s = on_s = 0.0
+    off_n = on_n = 0
+    ingested = 0
+    for _ in range(rounds):
+        for telemetry in (False, True):
+            noop = _boot(telemetry=telemetry)
+            try:
+                _measure(noop, 500)  # settle
+                elapsed = _measure(noop, num_tasks)
+                if telemetry:
+                    on_s += elapsed
+                    on_n += num_tasks
+                    summary = state.summarize_resources()
+                    ingested += summary.get("total_ingested", 0)
+                else:
+                    off_s += elapsed
+                    off_n += num_tasks
+            finally:
+                ray_tpu.shutdown()
+                time.sleep(0.5)
+    return {
+        "tasks_per_s_disabled": round(off_n / off_s, 1),
+        "tasks_per_s_enabled": round(on_n / on_s, 1),
+        "samples_ingested": ingested,
+        "rounds": rounds,
+    }
+
+
+def bench_scale_cluster(soak_s: float) -> dict:
+    """2-node FakeScaleCluster soak: the acceptance check that per-node
+    series accumulate and >=2 retention tiers populate."""
+    from ray_tpu.cluster_utils import FakeScaleCluster
+
+    async def run() -> dict:
+        cluster = FakeScaleCluster(
+            num_nodes=2, cpus_per_node=8, heartbeat_period_s=0.5
+        )
+        await cluster.start()
+        try:
+            deadline = time.monotonic() + soak_s
+            while time.monotonic() < deadline:
+                await asyncio.sleep(0.5)
+            summary = await cluster.driver.call("resource_summary", {})
+            nodes = summary.get("nodes") or {}
+            tiers_populated = 3
+            closed_buckets = 0
+            for node_id in nodes:
+                tl = await cluster.driver.call(
+                    "resource_timeline", {"node_id": node_id}
+                )
+                tiers_populated = min(
+                    tiers_populated,
+                    sum(1 for t in ("raw", "10s", "60s") if tl.get(t)),
+                )
+                closed_buckets += sum(
+                    1 for b in tl.get("10s", []) if not b.get("partial")
+                )
+            return {
+                "scale_nodes": len(nodes),
+                "scale_samples": summary.get("total_ingested", 0),
+                "scale_tiers_populated": tiers_populated,
+                "scale_closed_10s_buckets": closed_buckets,
+                "scale_soak_s": round(soak_s, 1),
+            }
+        finally:
+            await cluster.stop()
+
+    return asyncio.run(run())
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--tasks", type=int, default=1500 if SMOKE else 4000,
+        help="tasks per measured window",
+    )
+    parser.add_argument(
+        "--rounds", type=int, default=2 if SMOKE else 3,
+        help="off/on boot pairs; wall time aggregates per mode",
+    )
+    parser.add_argument(
+        "--soak", type=float, default=4.0 if SMOKE else 13.0,
+        help="FakeScaleCluster soak seconds (>=13 closes a real 10s "
+             "bucket; smoke relies on partial-bucket emission)",
+    )
+    args = parser.parse_args()
+
+    t0 = time.perf_counter()
+    paired = bench_paired_boots(args.tasks, args.rounds)
+    scale = bench_scale_cluster(args.soak)
+
+    base = paired["tasks_per_s_disabled"]
+    overhead_pct = 100.0 * (base - paired["tasks_per_s_enabled"]) / max(
+        base, 1e-9
+    )
+    result = {
+        "benchmark": "telemetry_overhead",
+        "tasks": args.tasks,
+        # Negative overhead (enabled beat disabled) is machine noise;
+        # the criterion only bounds the positive direction.
+        "enabled_overhead_pct": round(overhead_pct, 2),
+        "total_wall_s": round(time.perf_counter() - t0, 3),
+        "smoke": int(SMOKE),
+        **paired,
+        **scale,
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()
