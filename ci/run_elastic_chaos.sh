@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Run the elastic-training / checkpoint-commit suite under churn.
+#
+# Tier-1 CI already runs these modules without markers; this script is
+# the nightly companion for the elasticity work (ISSUE 6): the
+# two-phase commit protocol (including the mid-save kill fail-point),
+# resume-exact ingest parity at equal and shrunken world sizes, the
+# grow-back capacity probe, and the oom_risk preemptive drain.
+# Usage: ci/run_elastic_chaos.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== checkpoint commit protocol + resume-exact ingest =="
+python -m pytest tests/test_checkpoint_commit.py -q \
+    -p no:cacheprovider "$@"
+
+echo "== elasticity: step-down, grow-back, oom_risk drain =="
+python -m pytest tests/test_train_elastic.py -q \
+    -p no:cacheprovider "$@"
+
+echo "elastic chaos suite: PASS"

@@ -53,6 +53,7 @@ import msgpack
 
 from ray_tpu._private import chaos
 from ray_tpu._private.config import global_config
+from ray_tpu.util.backoff import Backoff
 
 REQ, REP, ERR, PUSH = 0, 1, 2, 3
 ACCEPTED, CLOSED = 254, 255  # synthetic engine events, never on the wire
@@ -744,7 +745,10 @@ class NativeRpcClient(_ClientCallMixin):
 
     async def connect(self, retry: bool = True) -> None:
         cfg = global_config()
-        backoff = cfg.rpc_retry_initial_backoff_s
+        backoff = Backoff(
+            initial_backoff_s=cfg.rpc_retry_initial_backoff_s,
+            max_backoff_s=cfg.rpc_retry_max_backoff_s,
+        )
         attempts = cfg.rpc_retry_max_attempts if retry else 1
         engine = _NativeEngine.for_running_loop()
         last_err = 0
@@ -766,12 +770,10 @@ class NativeRpcClient(_ClientCallMixin):
                 _rpc_debug(f"dial ok conn={conn} addr={self.address} name={self.name} eng={id(engine):x}")
                 return
             last_err = -conn
-            # Full jitter (AWS-style): sleep U(0, backoff), then double the
-            # ceiling — otherwise every client orphaned by a controller
-            # crash redials on the identical schedule, and the restarted
-            # server eats a synchronized thundering herd each period.
-            await asyncio.sleep(random.uniform(0, backoff))
-            backoff = min(backoff * 2, cfg.rpc_retry_max_backoff_s)
+            # Full jitter (AWS-style): otherwise every client orphaned by a
+            # controller crash redials on the identical schedule, and the
+            # restarted server eats a synchronized thundering herd.
+            await backoff.async_sleep()
         raise ConnectionLost(
             f"{self.name}: cannot connect to {self.address}: errno {last_err}"
         )
@@ -846,7 +848,10 @@ class AsyncioRpcClient(_ClientCallMixin):
 
     async def connect(self, retry: bool = True) -> None:
         cfg = global_config()
-        backoff = cfg.rpc_retry_initial_backoff_s
+        backoff = Backoff(
+            initial_backoff_s=cfg.rpc_retry_initial_backoff_s,
+            max_backoff_s=cfg.rpc_retry_max_backoff_s,
+        )
         attempts = cfg.rpc_retry_max_attempts if retry else 1
         last_exc: Exception | None = None
         for _ in range(attempts):
@@ -869,8 +874,7 @@ class AsyncioRpcClient(_ClientCallMixin):
                 last_exc = exc
                 # Full jitter, mirroring the native backend: break the
                 # post-crash redial herd.
-                await asyncio.sleep(random.uniform(0, backoff))
-                backoff = min(backoff * 2, cfg.rpc_retry_max_backoff_s)
+                await backoff.async_sleep()
         raise ConnectionLost(
             f"{self.name}: cannot connect to {self.address}: {last_exc}"
         )

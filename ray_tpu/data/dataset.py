@@ -179,8 +179,14 @@ class Dataset:
     def iter_rows(self) -> Iterator[dict]:
         return self.iterator().iter_rows()
 
-    def streaming_split(self, n: int, *, equal: bool = True) -> list[DataIterator]:
-        return streaming_split(self._refs(), n)
+    def streaming_split(
+        self,
+        n: int,
+        *,
+        equal: bool = True,
+        resume_from: dict | None = None,
+    ) -> list[DataIterator]:
+        return streaming_split(self._refs(), n, resume_from=resume_from)
 
     def split(self, n: int) -> list["Dataset"]:
         refs = self._refs()

@@ -1,9 +1,18 @@
-"""DataIterator — consumption-side streaming with prefetch.
+"""DataIterator — consumption-side streaming with prefetch + resumable state.
 
 Role-equivalent of python/ray/data/iterator.py :: DataIterator.iter_batches
 (threaded block prefetch, format conversion) and streaming_split's
 per-consumer iterators (SURVEY §2.7 "ML ingest"). Batches come out as
 numpy dicts (default), pandas, arrow, or torch CPU tensors.
+
+Resume-exact ingest (ISSUE 6): split iterators are *span-based* — a shard
+is an ordered list of ``[block_idx, start, stop]`` spans over the global
+block list — and expose ``state_dict()`` / ``load_state_dict()`` carrying
+(epoch, spans, rows-consumed-this-epoch). ``streaming_split(...,
+resume_from=...)`` rebuilds shards from a set of per-rank states captured
+at a checkpoint, subtracting consumed rows and re-partitioning the
+*remaining* sample space across the new world size — so a restart at any
+world size replays no committed sample and drops none.
 """
 
 from __future__ import annotations
@@ -17,25 +26,128 @@ from ray_tpu.data.block import BlockAccessor
 from ray_tpu.data._internal.map_fn import batch_blocks, format_batch
 
 
+def _span_slice(block, start: int, stop: Optional[int]):
+    """Slice rows [start, stop) out of a block (stop=None → to the end)."""
+    table = BlockAccessor.for_block(block).block
+    if start == 0 and (stop is None or stop >= table.num_rows):
+        return table
+    end = table.num_rows if stop is None else min(stop, table.num_rows)
+    return table.slice(start, end - start)
+
+
 class DataIterator:
-    def __init__(self, ref_iter_factory, owner_name: str = "dataset",
-                 stats=None):
-        """ref_iter_factory: () -> iterator of block refs (fresh each epoch)."""
+    def __init__(self, ref_iter_factory=None, owner_name: str = "dataset",
+                 stats=None, *, block_refs: list | None = None,
+                 spans: list | None = None):
+        """Two construction modes:
+
+        * ``ref_iter_factory``: () -> iterator of block refs (fresh each
+          epoch). Streaming pipelines; position is not resumable.
+        * ``block_refs`` + ``spans``: a materialized global block list plus
+          this consumer's ordered [block_idx, start, stop] spans — the
+          split-shard mode, which supports state_dict/load_state_dict.
+        """
+        if (ref_iter_factory is None) == (block_refs is None):
+            raise ValueError(
+                "exactly one of ref_iter_factory or block_refs is required"
+            )
         self._factory = ref_iter_factory
+        self._block_refs = block_refs
+        self._base_spans = [list(s) for s in spans] if spans is not None else None
         self._owner_name = owner_name
         self._stats = stats
         self._fetch_wait_s = 0.0
+        # Resume position: epoch counter, spans for the *current* pass
+        # (differs from _base_spans only on the first pass after a resume),
+        # rows to skip at the head of the current pass, and rows delivered
+        # so far in the in-flight pass (counted at batch-yield time).
+        self._epoch = 0
+        self._resume_spans: list | None = None
+        self._resume_skip = 0
+        self._pass_rows = 0
+        self._pass_active = False
+
+    # -- resumable-ingest state ----------------------------------------
+    @property
+    def supports_state(self) -> bool:
+        return self._base_spans is not None
+
+    def state_dict(self) -> dict:
+        """Position snapshot: {"epoch", "rows", "spans"}.
+
+        ``rows`` counts rows *delivered to the caller* in the current epoch
+        (a partially-assembled carry batch is not counted — those rows were
+        never seen by user code and will be re-read on resume). ``spans``
+        are the spans of the in-flight pass, so a state taken mid-resume
+        composes: resuming a resumed run subtracts from the right base.
+        """
+        if self._resume_spans is not None:
+            spans = self._resume_spans
+            rows = self._pass_rows if self._pass_active else self._resume_skip
+        else:
+            spans = self._base_spans
+            rows = self._pass_rows
+        return {
+            "epoch": self._epoch,
+            "rows": rows,
+            "spans": [list(s) for s in spans] if spans is not None else None,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Resume this iterator at a position captured by ``state_dict()``
+        (same world size — for cross-size resumes go through
+        ``streaming_split(..., resume_from=...)``)."""
+        if not self.supports_state:
+            raise ValueError(
+                f"{self._owner_name}: streaming (factory-based) iterators "
+                "cannot load ingest state; materialize + split instead"
+            )
+        if state.get("spans") is None:
+            raise ValueError("state has no spans; not a split-shard state")
+        self._epoch = int(state.get("epoch", 0))
+        self._resume_spans = [list(s) for s in state["spans"]]
+        self._resume_skip = int(state.get("rows", 0))
+        self._pass_rows = 0
+        self._pass_active = False
 
     def _block_iter(self, prefetch_blocks: int) -> Iterator:
         """Fetch blocks with a prefetch thread (depth = prefetch_blocks+1)."""
-        refs = self._factory()
+        if self._factory is not None:
+            refs = self._factory()
+            spans = None
+        else:
+            # The resume overlay (cleared by _end_pass when the in-flight
+            # epoch completes) wins over the steady-state base spans.
+            if self._resume_spans is not None:
+                spans = self._resume_spans
+                skip = self._resume_skip
+            else:
+                spans = self._base_spans
+                skip = 0
+            refs = None
         q: queue.Queue = queue.Queue(maxsize=max(1, prefetch_blocks + 1))
         _DONE = object()
 
         def producer():
             try:
-                for ref in refs:
-                    q.put(ray_tpu.get(ref))
+                if spans is None:
+                    for ref in refs:
+                        q.put(ray_tpu.get(ref))
+                else:
+                    remaining_skip = skip
+                    for block_idx, start, stop in spans:
+                        table = _span_slice(
+                            ray_tpu.get(self._block_refs[block_idx]),
+                            start, stop,
+                        )
+                        if remaining_skip:
+                            if table.num_rows <= remaining_skip:
+                                remaining_skip -= table.num_rows
+                                continue
+                            table = table.slice(remaining_skip)
+                            remaining_skip = 0
+                        if table.num_rows:
+                            q.put(table)
             except BaseException as exc:
                 q.put(exc)
                 return
@@ -91,6 +203,23 @@ class DataIterator:
                 wait_s, user_s, batches, local_s=produce_s - wait_s
             )
 
+    def _begin_pass(self) -> None:
+        self._pass_active = True
+        # Skipped rows count as already delivered this epoch so that a
+        # state taken mid-resume records the absolute epoch position.
+        self._pass_rows = (
+            self._resume_skip if self._resume_spans is not None else 0
+        )
+
+    def _end_pass(self) -> None:
+        """A pass ran to exhaustion: advance the epoch and drop any resume
+        overlay — the next pass re-reads this shard's full base spans."""
+        self._pass_active = False
+        self._epoch += 1
+        self._resume_spans = None
+        self._resume_skip = 0
+        self._pass_rows = 0
+
     def _iter_batches_impl(
         self,
         *,
@@ -103,6 +232,7 @@ class DataIterator:
     ) -> Iterator[Any]:
         import numpy as np
 
+        self._begin_pass()
         carry = None
         shuffle_rng = (
             np.random.default_rng(local_shuffle_seed)
@@ -118,7 +248,9 @@ class DataIterator:
                 if batch_size and batch.num_rows < batch_size:
                     carry = batch
                     return
-                yield format_batch(batch, batch_format)
+                formatted = format_batch(batch, batch_format)
+                self._pass_rows += batch.num_rows
+                yield formatted
 
         for block in self._block_iter(prefetch_blocks):
             table = BlockAccessor.for_block(block).block
@@ -148,7 +280,10 @@ class DataIterator:
                 carry = None
             yield from emit(table)
         if carry is not None and (not drop_last or batch_size is None):
-            yield format_batch(carry, batch_format)
+            formatted = format_batch(carry, batch_format)
+            self._pass_rows += carry.num_rows
+            yield formatted
+        self._end_pass()
 
     def iter_rows(self) -> Iterator[dict]:
         for batch in self.iter_batches(batch_size=None, batch_format="pyarrow"):
@@ -173,7 +308,17 @@ class DataIterator:
             yield out
 
     def materialize_refs(self) -> list:
-        return list(self._factory())
+        if self._factory is not None:
+            return list(self._factory())
+        # Span mode: materialize each span as its own (sliced) block ref.
+        out = []
+        for block_idx, start, stop in self._base_spans:
+            ref = self._block_refs[block_idx]
+            if start == 0 and stop is None:
+                out.append(ref)
+            else:
+                out.append(ray_tpu.put(_span_slice(ray_tpu.get(ref), start, stop)))
+        return out
 
 
 @ray_tpu.remote
@@ -191,15 +336,78 @@ class _SplitCoordinator:
         return self._queues[rank]
 
 
-def streaming_split(block_refs: list, n: int) -> list[DataIterator]:
-    """n independent DataIterators over a disjoint partition of blocks."""
-    coordinator = _SplitCoordinator.remote(list(block_refs), n)
+def _block_num_rows(block_refs: list, needed: set) -> dict[int, int]:
+    """Row counts for the given block indices (one remote round trip)."""
+    from ray_tpu.data._internal.streaming_executor import _num_rows
+
+    idxs = sorted(needed)
+    counts = ray_tpu.get([_num_rows.remote(block_refs[i]) for i in idxs])
+    return dict(zip(idxs, counts))
+
+
+def _remaining_spans(state: dict, nrows: dict[int, int]) -> list:
+    """Subtract a rank's consumed-row count from its spans, returning the
+    fragments it had not yet delivered."""
+    rows = int(state.get("rows", 0))
+    out = []
+    for block_idx, start, stop in state["spans"]:
+        end = nrows[block_idx] if stop is None else min(stop, nrows[block_idx])
+        span_len = max(0, end - start)
+        if rows >= span_len:
+            rows -= span_len
+            continue
+        out.append([block_idx, start + rows, end])
+        rows = 0
+    return out
+
+
+def streaming_split(
+    block_refs: list, n: int, *, resume_from: dict | None = None
+) -> list[DataIterator]:
+    """n independent DataIterators over a disjoint partition of blocks.
+
+    ``resume_from`` = ``{"world_size": W, "per_rank": [state, ...]}`` (the
+    per-rank ``state_dict()`` snapshots stamped into a committed
+    checkpoint) resumes mid-epoch at *any* new world size n: every rank's
+    un-consumed span fragments are pooled, re-partitioned across the n new
+    ranks for the in-flight epoch, and subsequent epochs use the fresh
+    n-way split. Rows a rank consumed after the snapshot are re-delivered
+    (duplication bounded to the last uncommitted round); nothing is
+    dropped.
+    """
+    block_refs = list(block_refs)
     iterators = []
+    base = [
+        [[i, 0, None] for i in range(rank, len(block_refs), n)]
+        for rank in range(n)
+    ]
+    resume_per_rank: list | None = None
+    epoch0 = 0
+    if resume_from and resume_from.get("per_rank"):
+        states = [
+            s for s in resume_from["per_rank"]
+            if s and s.get("spans") is not None
+        ]
+        if states:
+            epoch0 = min(int(s.get("epoch", 0)) for s in states)
+            needed = {
+                span[0] for s in states for span in s["spans"]
+            }
+            nrows = _block_num_rows(block_refs, needed) if needed else {}
+            fragments: list = []
+            for s in states:
+                fragments.extend(_remaining_spans(s, nrows))
+            fragments.sort(key=lambda f: (f[0], f[1]))
+            resume_per_rank = [fragments[rank::n] for rank in range(n)]
     for rank in range(n):
-        shard_refs = ray_tpu.get(coordinator.get_blocks.remote(rank))
-
-        def factory(refs=shard_refs):
-            return iter(refs)
-
-        iterators.append(DataIterator(factory, owner_name=f"split[{rank}]"))
+        it = DataIterator(
+            owner_name=f"split[{rank}]",
+            block_refs=block_refs,
+            spans=base[rank],
+        )
+        if resume_per_rank is not None:
+            it._epoch = epoch0
+            it._resume_spans = resume_per_rank[rank]
+            it._resume_skip = 0
+        iterators.append(it)
     return iterators

@@ -24,6 +24,7 @@ from ray_tpu.train.checkpoint import (
     load_pytree_checkpoint,
     save_pytree,
     save_pytree_checkpoint,
+    verify_sharded_checkpoint,
 )
 from ray_tpu.train.config import (
     CheckpointConfig,
@@ -73,4 +74,5 @@ __all__ = [
     "load_pytree",
     "save_pytree_checkpoint",
     "load_pytree_checkpoint",
+    "verify_sharded_checkpoint",
 ]

@@ -186,8 +186,19 @@ class BackendExecutor:
                 for rank in sorted(pending)
             }
             for rank, ref in refs.items():
+                # Per-rank get is bounded by BOTH the local liveness cap and
+                # the caller's remaining round deadline — a 600s poll_round
+                # must not block 120s per rank past its own budget.
+                remaining = deadline - time.monotonic()
+                per_get = max(1.0, min(120.0, remaining))
                 try:
-                    res = ray_tpu.get(ref, timeout=120.0)
+                    res = ray_tpu.get(ref, timeout=per_get)
+                except exceptions.GetTimeoutError as exc:
+                    missing = sorted(pending)
+                    raise TrainingFailedError(
+                        f"train workers stalled: ranks {missing} did not "
+                        f"report within the {timeout}s round deadline"
+                    ) from exc
                 except (
                     exceptions.ActorDiedError,
                     exceptions.ActorUnavailableError,
@@ -203,8 +214,19 @@ class BackendExecutor:
 
     def merge_sharded_checkpoints(self, reported: list[Optional[Checkpoint]]) -> Optional[Checkpoint]:
         """Rank 0's checkpoint dir is canonical; other ranks' `shards/p*`
-        subdirs (written by checkpoint.save_pytree(process_index=rank)) are
-        merged in so a multi-host sharded save arrives whole."""
+        subdirs and `DONE.p<rank>` commit markers (written by
+        checkpoint.save_pytree(process_index=rank)) are merged in so a
+        multi-host sharded save arrives whole.
+
+        The merged manifest's `world_size` is rewritten to the number of
+        commit markers actually present: a replicated save (only rank 0
+        reports a checkpoint) verifies as a one-writer checkpoint, while a
+        sharded save that lost a writer's marker fails inventory
+        verification at persist time and the round is skipped — fail
+        closed, never commit a partial save.
+        """
+        from ray_tpu.train import checkpoint as ckpt_mod
+
         base = reported[0]
         if base is None:
             return None
@@ -219,10 +241,28 @@ class BackendExecutor:
                         shutil.copytree(
                             os.path.join(src_shards, proc_dir), dst
                         )
+            for name in os.listdir(ckpt.path):
+                if name.startswith("DONE.p"):
+                    dst = os.path.join(base.path, name)
+                    if not os.path.exists(dst):
+                        shutil.copy2(os.path.join(ckpt.path, name), dst)
             # Rank temp dir is merged — reclaim /tmp (multi-GB models would
             # otherwise leak a checkpoint per report round per rank).
             if ckpt.path.startswith(tempfile.gettempdir()):
                 shutil.rmtree(ckpt.path, ignore_errors=True)
+        manifest_path = os.path.join(base.path, "manifest.json")
+        if os.path.exists(manifest_path):
+            import json
+
+            try:
+                with open(manifest_path) as f:
+                    manifest = json.load(f)
+            except (OSError, ValueError):
+                manifest = None
+            if manifest is not None:
+                markers = ckpt_mod._done_markers(base.path)
+                manifest["world_size"] = max(1, len(markers))
+                ckpt_mod._atomic_write_json(manifest_path, manifest)
         return base
 
     def shutdown(self) -> None:

@@ -81,9 +81,25 @@ class _Session:
     def report(
         self, metrics: dict, checkpoint: Checkpoint | None = None
     ) -> None:
+        # Snapshot this rank's dataset-iterator positions alongside the
+        # report: the driver stamps them into the committed checkpoint so a
+        # restart (at any world size) resumes ingest exactly (ISSUE 6).
+        ingest: dict[str, dict] = {}
+        for name, shard in (self.ctx.dataset_shards or {}).items():
+            if getattr(shard, "supports_state", False):
+                try:
+                    ingest[name] = shard.state_dict()
+                except Exception:
+                    pass
         self._consumed.wait()
         self._consumed.clear()
-        self._results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+        self._results.put(
+            {
+                "metrics": dict(metrics),
+                "checkpoint": checkpoint,
+                "ingest": ingest or None,
+            }
+        )
 
     # -- called from the actor (poll) -----------------------------------
     def next_result(self, timeout: float = 0.0) -> dict | None:

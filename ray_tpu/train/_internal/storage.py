@@ -6,18 +6,47 @@ Persists worker-reported checkpoint directories into
 latest/best, and enforces CheckpointConfig retention (num_to_keep,
 score-attribute ordering). Local filesystem only in this build; the fs
 boundary is kept narrow (persist/list/delete) so a cloud fs can slot in.
+
+Commit protocol (ISSUE 6): `persist` stages the incoming directory at
+`checkpoint_NNNNNN.staging`, verifies the per-rank shard inventory
+(`checkpoint.verify_sharded_checkpoint`), stamps a `COMMIT.json`, and only
+then atomically renames to the final name — so `checkpoint_NNNNNN` either
+exists complete-and-committed or not at all. `_load_state` reconciles with
+disk on startup: committed dirs missing from the tracker state are adopted
+(crash between rename and state save) and uncommitted / inventory-failing
+leftovers are garbage-collected, so a torn save can never crash-loop the
+trainer — `latest_checkpoint()` only ever returns committed dirs, falling
+back to the previous committed one.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import re
 import shutil
 import tempfile
+import time
 from typing import Optional
 
-from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint import (
+    _COMMIT,
+    Checkpoint,
+    _atomic_write_json,
+    is_committed,
+    verify_sharded_checkpoint,
+)
 from ray_tpu.train.config import CheckpointConfig
+
+logger = logging.getLogger(__name__)
+
+_CKPT_RE = re.compile(r"^checkpoint_(\d{6})$")
+_STAGING_SUFFIX = ".staging"
+
+# Per-rank dataset-iterator state stamped into each committed checkpoint so
+# a restart (at any world size) can resume ingest exactly (ISSUE 6 layer 2).
+INGEST_FILE = "ingest.json"
 
 
 class StorageContext:
@@ -49,32 +78,148 @@ class StorageContext:
 
     def _load_state(self) -> None:
         if os.path.exists(self._state_path):
-            with open(self._state_path) as f:
-                state = json.load(f)
-            self._index = state["index"]
+            try:
+                with open(self._state_path) as f:
+                    state = json.load(f)
+            except (OSError, ValueError) as exc:
+                # Torn state file: fall back to disk reconciliation, which
+                # rebuilds the tracker from committed dirs.
+                logger.warning("unreadable %s (%s); rebuilding from disk",
+                               self._state_path, exc)
+                state = {"index": 0, "kept": []}
+            self._index = state.get("index", 0)
             self._kept = [
-                (p, m) for p, m in state["kept"] if os.path.isdir(p)
+                (p, m)
+                for p, m in state.get("kept", [])
+                if os.path.isdir(p) and is_committed(p)
             ]
+        self._reconcile_disk()
 
     def _save_state(self) -> None:
-        with open(self._state_path, "w") as f:
-            json.dump({"index": self._index, "kept": self._kept}, f)
+        _atomic_write_json(
+            self._state_path, {"index": self._index, "kept": self._kept}
+        )
+
+    def _reconcile_disk(self) -> None:
+        """Adopt committed checkpoints the tracker missed and GC torn ones.
+
+        Covers every crash window: mid-copy (a ``.staging`` leftover),
+        mid-save (a checkpoint dir whose inventory fails), and between the
+        commit rename and the tracker-state write (a committed dir missing
+        from ``_kept``).
+        """
+        known = {p for p, _ in self._kept}
+        try:
+            names = sorted(os.listdir(self.trial_dir))
+        except OSError:
+            return
+        changed = False
+        for name in names:
+            path = os.path.join(self.trial_dir, name)
+            if name.endswith(_STAGING_SUFFIX) and os.path.isdir(path):
+                logger.warning("GCing abandoned staging dir %s", path)
+                shutil.rmtree(path, ignore_errors=True)
+                continue
+            m = _CKPT_RE.match(name)
+            if not m or not os.path.isdir(path) or path in known:
+                continue
+            if not is_committed(path):
+                logger.warning("GCing uncommitted checkpoint dir %s", path)
+                shutil.rmtree(path, ignore_errors=True)
+                continue
+            ok, reason = verify_sharded_checkpoint(path)
+            if not ok:
+                logger.warning(
+                    "GCing committed-but-unverifiable checkpoint %s: %s",
+                    path, reason,
+                )
+                shutil.rmtree(path, ignore_errors=True)
+                continue
+            # Committed + verified but unknown to the tracker: adopt it with
+            # the metrics recorded in its commit stamp.
+            try:
+                with open(os.path.join(path, _COMMIT)) as f:
+                    commit = json.load(f)
+            except (OSError, ValueError):
+                commit = {}
+            self._kept.append((path, commit.get("metrics", {})))
+            changed = True
+        if changed:
+            self._kept.sort(key=lambda pm: pm[0])
+            self._index = max(
+                self._index,
+                max(
+                    int(_CKPT_RE.match(os.path.basename(p)).group(1)) + 1
+                    for p, _ in self._kept
+                ),
+            )
+            self._save_state()
 
     # -- API -------------------------------------------------------------
-    def persist(self, checkpoint: Checkpoint, metrics: dict) -> Checkpoint:
+    def persist(
+        self,
+        checkpoint: Checkpoint,
+        metrics: dict,
+        ingest: dict | None = None,
+    ) -> Checkpoint:
+        """Two-phase commit of a reported checkpoint directory.
+
+        Stage → verify inventory → stamp COMMIT.json → atomic rename.
+        Raises IOError when the staged directory fails inventory
+        verification (torn sharded save); the caller should skip this round
+        and keep the previous committed checkpoint.
+        """
+        from ray_tpu.util import chaos
+
         dest = os.path.join(self.trial_dir, f"checkpoint_{self._index:06d}")
-        self._index += 1
-        if os.path.abspath(checkpoint.path) != dest:
-            if os.path.isdir(dest):
-                shutil.rmtree(dest)
-            shutil.copytree(checkpoint.path, dest)
-            # The merged rank-0 temp dir has been persisted — reclaim /tmp.
-            if checkpoint.path.startswith(tempfile.gettempdir()):
-                shutil.rmtree(checkpoint.path, ignore_errors=True)
         clean_metrics = {
             k: v for k, v in metrics.items()
             if isinstance(v, (int, float, str, bool))
         }
+        if os.path.abspath(checkpoint.path) != dest:
+            staging = dest + _STAGING_SUFFIX
+            if os.path.isdir(staging):
+                shutil.rmtree(staging)
+            if os.path.isdir(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(checkpoint.path, staging)
+            if ingest is not None:
+                _atomic_write_json(os.path.join(staging, INGEST_FILE), ingest)
+            ok, reason = verify_sharded_checkpoint(staging)
+            if not ok:
+                shutil.rmtree(staging, ignore_errors=True)
+                raise IOError(
+                    f"refusing to commit torn checkpoint {checkpoint.path}: "
+                    f"{reason}"
+                )
+            # Kill window under test: shards staged + verified but no
+            # COMMIT.json / final name yet — reconcile must GC this.
+            chaos.failpoint("train.storage.pre_commit")
+            _atomic_write_json(
+                os.path.join(staging, _COMMIT),
+                {
+                    "index": self._index,
+                    "ts": time.time(),
+                    "metrics": clean_metrics,
+                },
+            )
+            os.replace(staging, dest)
+            # The merged rank-0 temp dir has been persisted — reclaim /tmp.
+            if checkpoint.path.startswith(tempfile.gettempdir()):
+                shutil.rmtree(checkpoint.path, ignore_errors=True)
+        else:
+            if ingest is not None:
+                _atomic_write_json(os.path.join(dest, INGEST_FILE), ingest)
+            if not is_committed(dest):
+                _atomic_write_json(
+                    os.path.join(dest, _COMMIT),
+                    {
+                        "index": self._index,
+                        "ts": time.time(),
+                        "metrics": clean_metrics,
+                    },
+                )
+        self._index += 1
         self._kept.append((dest, clean_metrics))
         self._enforce_retention()
         self._save_state()
@@ -107,7 +252,38 @@ class StorageContext:
             shutil.rmtree(path, ignore_errors=True)
 
     def latest_checkpoint(self) -> Optional[Checkpoint]:
-        return Checkpoint(self._kept[-1][0]) if self._kept else None
+        # Walk back from the newest: a kept entry whose dir lost its commit
+        # stamp or inventory since tracking (external tampering, partial
+        # delete) is skipped and GCed so recovery falls back to the
+        # previous committed checkpoint instead of crash-looping.
+        while self._kept:
+            path, _ = self._kept[-1]
+            if os.path.isdir(path) and is_committed(path):
+                ok, reason = verify_sharded_checkpoint(path)
+                if ok:
+                    return Checkpoint(path)
+                logger.warning(
+                    "dropping unverifiable checkpoint %s: %s", path, reason
+                )
+            else:
+                logger.warning("dropping uncommitted checkpoint %s", path)
+            self._kept.pop()
+            shutil.rmtree(path, ignore_errors=True)
+            self._save_state()
+        return None
+
+    def latest_ingest(self) -> Optional[dict]:
+        """The per-rank dataset-iterator state stamped into the newest
+        committed checkpoint, or None when it carries none."""
+        ckpt = self.latest_checkpoint()
+        if ckpt is None:
+            return None
+        path = os.path.join(ckpt.path, INGEST_FILE)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
     def best_checkpoint(self) -> Optional[Checkpoint]:
         cfg = self.checkpoint_config

@@ -6,6 +6,16 @@ with no format opinions), plus what the reference leaves to orbax/tensorstore
 only its addressable shards, a manifest records the global shapes and mesh
 metadata, and restore can re-shard onto a different mesh (load a v4-32
 checkpoint onto a v4-16) because shard files carry their global index.
+
+Commit protocol (ISSUE 6): a sharded save is *two-phase*. Every writer rank
+drops a ``DONE.p<rank>`` marker — an inventory of the files it wrote with
+sizes and CRCs — only after all its shard files are on disk, and every
+small file goes through tmp + ``os.replace``. A checkpoint directory is
+*complete* when every ``shards/p<rank>`` dir has a matching, verifying
+``DONE.p<rank>``; ``StorageContext.persist`` stages, verifies, stamps a
+``COMMIT.json`` and atomically renames — so a SIGKILL anywhere between
+shard write and commit can only ever leave a directory that readers skip,
+never a loadable-but-wrong checkpoint.
 """
 
 from __future__ import annotations
@@ -18,12 +28,15 @@ import re
 import shutil
 import tempfile
 import uuid
+import zlib
 from typing import Any, Iterator
 
 import numpy as np
 
 _MANIFEST = "manifest.json"
 _TREEDEF = "treedef.pkl"
+_COMMIT = "COMMIT.json"
+_DONE_PREFIX = "DONE.p"
 
 
 class Checkpoint:
@@ -59,8 +72,52 @@ class Checkpoint:
 
 
 # ---------------------------------------------------------------------------
+# Atomic small-file writes
+# ---------------------------------------------------------------------------
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp + os.replace so a crash mid-write never leaves a torn file at
+    the final name (readers either see the old content or the new)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    _atomic_write_bytes(path, json.dumps(obj).encode())
+
+
+def _atomic_write_pickle(path: str, obj: Any) -> None:
+    _atomic_write_bytes(path, pickle.dumps(obj))
+
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
+# ---------------------------------------------------------------------------
 # Sharded pytree I/O
 # ---------------------------------------------------------------------------
+
+# Separator / path chars that must never leak into a leaf key: "." is the
+# key-path join char (a dict key "a.b" would collide with nested {"a":
+# {"b": ...}}), "/" and NUL would break shard file paths. "%" escapes the
+# escape char itself so the mapping is injective.
+_KEY_ESCAPES = {"%": "%25", ".": "%2E", "/": "%2F", "\\": "%5C", "\x00": "%00"}
+
+
+def _escape_key_part(part: str) -> str:
+    if not any(ch in part for ch in _KEY_ESCAPES):
+        return part
+    return "".join(_KEY_ESCAPES.get(ch, ch) for ch in part)
+
 
 def _leaf_key(path_parts: tuple) -> str:
     import jax.tree_util as jtu
@@ -68,14 +125,18 @@ def _leaf_key(path_parts: tuple) -> str:
     out = []
     for p in path_parts:
         if isinstance(p, jtu.DictKey):
-            out.append(str(p.key))
+            out.append(_escape_key_part(str(p.key)))
         elif isinstance(p, jtu.SequenceKey):
             out.append(str(p.idx))
         elif isinstance(p, jtu.GetAttrKey):
-            out.append(str(p.name))
+            out.append(_escape_key_part(str(p.name)))
         else:
-            out.append(str(p))
+            out.append(_escape_key_part(str(p)))
     return ".".join(out) or "leaf"
+
+
+def _done_marker_path(directory: str, process_index: int) -> str:
+    return os.path.join(directory, f"{_DONE_PREFIX}{process_index}")
 
 
 def save_pytree(
@@ -83,31 +144,63 @@ def save_pytree(
     tree: Any,
     *,
     process_index: int = 0,
+    world_size: int = 1,
     mesh_metadata: dict | None = None,
 ) -> None:
     """Write this process's addressable shards of a (possibly sharded) jax
-    pytree under `directory`.
+    pytree under `directory`, two-phase.
 
     Layout:
       manifest.json                  — global shapes/dtypes + mesh metadata
-                                       (written by process 0)
+                                       + writer world size (process 0)
       treedef.pkl                    — pickled treedef (process 0)
       shards/p<proc>/<leaf>.s<k>.npy — one file per addressable shard
       shards/p<proc>/<leaf>.s<k>.idx.json — its global index (start/stop per dim)
+      DONE.p<proc>                   — commit marker: inventory of every file
+                                       this rank wrote (relpath → size, crc32),
+                                       written LAST and atomically
 
     Every process calls this with the same tree; on shared storage the union
     of shard files covers every global array exactly once per replica (we
     only write shards whose replica_id == 0, so replicated leaves are written
-    once cluster-wide).
+    once cluster-wide). A reader must treat a shard dir without a verifying
+    DONE marker as torn (``verify_sharded_checkpoint``).
     """
     import jax
     import jax.tree_util as jtu
 
+    from ray_tpu.util import chaos
+
     leaves_with_paths, treedef = jtu.tree_flatten_with_path(tree)
+    # Collision guard: escaping makes key construction injective, but a
+    # tree could still produce duplicate keys through exotic custom nodes —
+    # refuse at save time rather than silently merging two leaves' shards.
+    seen: dict[str, tuple] = {}
+    for path_parts, _leaf in leaves_with_paths:
+        key = _leaf_key(path_parts)
+        if key in seen and seen[key] != path_parts:
+            raise ValueError(
+                f"leaf key collision: tree paths {seen[key]!r} and "
+                f"{path_parts!r} both map to shard key {key!r}"
+            )
+        seen[key] = path_parts
+
     shard_dir = os.path.join(directory, "shards", f"p{process_index}")
     os.makedirs(shard_dir, exist_ok=True)
+    # relpath (from `directory`) → {"size": bytes, "crc32": int}
+    inventory: dict[str, dict] = {}
 
-    manifest: dict[str, Any] = {"leaves": {}, "mesh": mesh_metadata or {}}
+    def _track(path: str) -> None:
+        rel = os.path.relpath(path, directory)
+        inventory[rel] = {
+            "size": os.path.getsize(path), "crc32": _file_crc32(path)
+        }
+
+    manifest: dict[str, Any] = {
+        "leaves": {},
+        "mesh": mesh_metadata or {},
+        "world_size": int(world_size),
+    }
     for path_parts, leaf in leaves_with_paths:
         key = _leaf_key(path_parts)
         if isinstance(leaf, jax.Array):
@@ -119,37 +212,146 @@ def save_pytree(
                 if shard.replica_id != 0:
                     continue
                 data = np.asarray(shard.data)
-                np.save(os.path.join(shard_dir, f"{key}.s{k}.npy"), data)
+                npy_path = os.path.join(shard_dir, f"{key}.s{k}.npy")
+                tmp = f"{npy_path}.tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    np.save(f, data)
+                os.replace(tmp, npy_path)
+                _track(npy_path)
                 index = [
                     [s.start or 0, s.stop if s.stop is not None else dim]
                     for s, dim in zip(shard.index, leaf.shape)
                 ]
-                with open(
-                    os.path.join(shard_dir, f"{key}.s{k}.idx.json"), "w"
-                ) as f:
-                    json.dump(index, f)
+                idx_path = os.path.join(shard_dir, f"{key}.s{k}.idx.json")
+                _atomic_write_json(idx_path, index)
+                _track(idx_path)
         else:
             manifest["leaves"][key] = {"scalar": True}
             if process_index == 0:
-                with open(os.path.join(shard_dir, f"{key}.scalar.pkl"), "wb") as f:
-                    pickle.dump(leaf, f)
+                pkl_path = os.path.join(shard_dir, f"{key}.scalar.pkl")
+                _atomic_write_pickle(pkl_path, leaf)
+                _track(pkl_path)
 
     if process_index == 0:
-        with open(os.path.join(directory, _TREEDEF), "wb") as f:
-            pickle.dump(treedef, f)
-        tmp = os.path.join(directory, _MANIFEST + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-        os.replace(tmp, os.path.join(directory, _MANIFEST))
+        _atomic_write_pickle(os.path.join(directory, _TREEDEF), treedef)
+        _track(os.path.join(directory, _TREEDEF))
+        # The manifest is deliberately NOT inventoried: merge rewrites its
+        # world_size to the actual writer count, and it is protected by its
+        # own atomic write + the COMMIT stamp.
+        _atomic_write_json(os.path.join(directory, _MANIFEST), manifest)
+
+    # The torn-save window under proof: everything above is on disk but the
+    # commit marker is not. A kill here must leave a checkpoint that
+    # verify_sharded_checkpoint rejects and latest_checkpoint() skips.
+    chaos.failpoint("train.checkpoint.mid_save")
+
+    _atomic_write_json(
+        _done_marker_path(directory, process_index),
+        {"rank": int(process_index), "files": inventory},
+    )
+
+
+def _done_markers(directory: str) -> dict[int, dict]:
+    """rank → parsed DONE marker for every marker present in `directory`."""
+    markers: dict[int, dict] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return markers
+    for name in names:
+        if not name.startswith(_DONE_PREFIX):
+            continue
+        suffix = name[len(_DONE_PREFIX):]
+        if not suffix.isdigit():
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                markers[int(suffix)] = json.load(f)
+        except (OSError, ValueError):
+            continue
+    return markers
+
+
+def verify_sharded_checkpoint(directory: str) -> tuple[bool, str]:
+    """Is this directory a *complete* sharded save?
+
+    Rules:
+      * no manifest.json → opaque user directory, nothing to verify → OK;
+      * manifest present → treedef must parse, every ``shards/p<r>`` dir
+        must have a DONE.p<r> marker, the marker count must cover the
+        manifest's world size, and every inventoried file must exist with
+        the recorded size and CRC.
+
+    Returns (ok, reason) — reason describes the first failure found.
+    """
+    manifest_path = os.path.join(directory, _MANIFEST)
+    if not os.path.exists(manifest_path):
+        return True, "opaque (no manifest)"
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as exc:
+        return False, f"unreadable manifest: {exc}"
+    if not os.path.exists(os.path.join(directory, _TREEDEF)):
+        return False, "missing treedef.pkl"
+
+    markers = _done_markers(directory)
+    shards_root = os.path.join(directory, "shards")
+    shard_ranks = set()
+    if os.path.isdir(shards_root):
+        for name in os.listdir(shards_root):
+            if name.startswith("p") and name[1:].isdigit():
+                shard_ranks.add(int(name[1:]))
+    for rank in sorted(shard_ranks):
+        if rank not in markers:
+            return False, f"shards/p{rank} present but DONE.p{rank} missing"
+    world_size = int(manifest.get("world_size", 1) or 1)
+    for rank in range(world_size):
+        if rank not in markers:
+            return False, (
+                f"manifest world_size={world_size} but DONE.p{rank} missing"
+            )
+    for rank, marker in sorted(markers.items()):
+        for rel, meta in (marker.get("files") or {}).items():
+            path = os.path.join(directory, rel)
+            if not os.path.exists(path):
+                return False, f"inventoried file missing: {rel} (rank {rank})"
+            size = os.path.getsize(path)
+            if size != int(meta.get("size", -1)):
+                return False, (
+                    f"size mismatch for {rel}: {size} != {meta.get('size')}"
+                )
+            if "crc32" in meta and _file_crc32(path) != int(meta["crc32"]):
+                return False, f"crc mismatch for {rel}"
+    return True, "ok"
+
+
+def is_committed(directory: str) -> bool:
+    """True when the directory carries a parseable COMMIT.json stamp
+    (written by StorageContext.persist after inventory verification)."""
+    try:
+        with open(os.path.join(directory, _COMMIT)) as f:
+            json.load(f)
+        return True
+    except (OSError, ValueError):
+        return False
 
 
 def load_pytree(directory: str, shardings: Any | None = None) -> Any:
     """Assemble global arrays from shard files and (optionally) place them
     with `shardings` (a pytree of jax shardings matching the saved tree) —
     this is the resharding-restore path: the target mesh need not match the
-    mesh that wrote the checkpoint."""
+    mesh that wrote the checkpoint. Validates the per-rank shard inventory
+    before assembling anything, so a torn save fails fast instead of
+    producing a silently wrong tree."""
     import jax
     import jax.tree_util as jtu
+
+    ok, reason = verify_sharded_checkpoint(directory)
+    if not ok:
+        raise IOError(
+            f"checkpoint {directory} failed inventory verification: {reason}"
+        )
 
     with open(os.path.join(directory, _MANIFEST)) as f:
         manifest = json.load(f)
@@ -211,15 +413,24 @@ def load_pytree(directory: str, shardings: Any | None = None) -> Any:
 
 def save_pytree_checkpoint(tree: Any, *, extra: dict | None = None) -> Checkpoint:
     """Convenience: materialize a pytree (plus pickled `extra` metadata) as a
-    fresh local Checkpoint directory."""
+    fresh local Checkpoint directory. Inside a train session the writer
+    identity (rank / world size) is stamped automatically so multi-rank
+    sharded saves carry per-rank commit markers."""
     path = os.path.join(
         tempfile.gettempdir(), f"ray_tpu_ckpt_{uuid.uuid4().hex[:8]}"
     )
     os.makedirs(path, exist_ok=True)
-    save_pytree(path, tree)
+    process_index, world_size = 0, 1
+    from ray_tpu.train._internal import session as _session_mod
+
+    if _session_mod.in_session():
+        ctx = _session_mod.get_session().ctx
+        process_index, world_size = ctx.world_rank, ctx.world_size
+    save_pytree(
+        path, tree, process_index=process_index, world_size=world_size
+    )
     if extra is not None:
-        with open(os.path.join(path, "extra.pkl"), "wb") as f:
-            pickle.dump(extra, f)
+        _atomic_write_pickle(os.path.join(path, "extra.pkl"), extra)
     return Checkpoint(path)
 
 

@@ -60,6 +60,16 @@ class ScalingConfig:
     # How long one formation attempt at a given size may wait before the
     # executor steps down to the next smaller world size.
     elastic_formation_timeout_s: float = 30.0
+    # Grow-back probe (ISSUE 6): when elastic and running below
+    # num_workers, the driver checks cluster capacity at most every this
+    # many seconds and, when the missing bundles fit, resizes the gang
+    # back up at the next checkpoint boundary. <= 0 disables growing.
+    elastic_grow_probe_period_s: float = 5.0
+    # Preemptive drain: when the resource-telemetry `oom_risk` channel
+    # flags a node hosting a gang worker, checkpoint and re-form the gang
+    # (replacing the worker if capacity exists elsewhere) before the
+    # memory-monitor kill fires. Off by default: it requires telemetry.
+    drain_on_oom_risk: bool = False
 
     def worker_resources(self) -> dict[str, float]:
         resources = {"CPU": 1.0, **dict(self.resources_per_worker)}

@@ -13,10 +13,22 @@ TPU-first (SURVEY §3.3, §7.1 P6):
   * failure recovery is slice-granular (SURVEY §5.3): any member death ⇒
     GangDiedError ⇒ restart the whole gang from the latest persisted
     checkpoint, up to FailureConfig.max_failures.
+
+Elasticity (ISSUE 6): with ``min_workers`` set the trainer *resizes
+instead of restarting*. A gang death re-forms at the surviving size with
+full-jitter backoff; a periodic capacity probe grows the gang back toward
+``num_workers`` at the next checkpoint boundary; and (opt-in) an
+``oom_risk`` telemetry event on a gang node triggers a preemptive
+checkpoint-and-replace before the memory-monitor kill fires. Every
+transition goes checkpoint → re-form → restore — XLA meshes are static —
+and dataset ingest resumes from the per-rank iterator states stamped into
+the committed checkpoint, re-split across the new world size.
 """
 
 from __future__ import annotations
 
+import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
@@ -28,6 +40,9 @@ from ray_tpu.train._internal.backend_executor import (
 from ray_tpu.train._internal.storage import StorageContext
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.util.backoff import Backoff
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -39,20 +54,42 @@ class Result:
     path: str = ""
     error: Optional[Exception] = None
     metrics_history: list = field(default_factory=list)
+    # Every world-size transition the run made: dicts of
+    # {"reason": "gang_died"|"grow"|"oom_risk_drain", "from": k, "to": j}.
+    resizes: list = field(default_factory=list)
 
     @property
     def best_checkpoints(self) -> list:
         return [self.checkpoint] if self.checkpoint else []
 
 
-def _split_datasets(datasets: dict, num_workers: int) -> list[dict]:
+def _split_datasets(
+    datasets: dict, num_workers: int, ingest: dict | None = None
+) -> list[dict]:
     """Per-rank dataset shards. A ray_tpu.data.Dataset splits via
     streaming_split (locality-aware iterators); plain sequences shard by
-    striding; anything else is replicated."""
+    striding; anything else is replicated.
+
+    ``ingest`` is the per-rank iterator state stamped into the committed
+    checkpoint being resumed ({"world_size": W, "datasets": {name:
+    [state, ...]}}); Datasets then resume mid-epoch with the remaining
+    sample space re-split across ``num_workers`` (which may differ from
+    W). Striding of plain sequences is positionless and replays the
+    epoch from the start — only Datasets get resume-exact semantics.
+    """
     shards: list[dict] = [dict() for _ in range(num_workers)]
+    per_ds_states = (ingest or {}).get("datasets", {})
     for name, ds in (datasets or {}).items():
         if hasattr(ds, "streaming_split"):
-            for rank, it in enumerate(ds.streaming_split(num_workers)):
+            resume_from = None
+            if name in per_ds_states:
+                resume_from = {
+                    "world_size": (ingest or {}).get("world_size", 0),
+                    "per_rank": per_ds_states[name],
+                }
+            for rank, it in enumerate(
+                ds.streaming_split(num_workers, resume_from=resume_from)
+            ):
                 shards[rank][name] = it
         elif isinstance(ds, (list, tuple)):
             for rank in range(num_workers):
@@ -61,6 +98,19 @@ def _split_datasets(datasets: dict, num_workers: int) -> list[dict]:
             for rank in range(num_workers):
                 shards[rank][name] = ds
     return shards
+
+
+def _session_events_dir_known() -> str | None:
+    """The cluster session dir, when discoverable from this process."""
+    sd = os.environ.get("RAYTPU_SESSION_DIR")
+    if sd:
+        return sd
+    try:
+        import ray_tpu
+
+        return ray_tpu.runtime_info().get("session_dir")
+    except Exception:
+        return None
 
 
 class DataParallelTrainer:
@@ -105,7 +155,15 @@ class DataParallelTrainer:
         failures = 0
         last_metrics: dict = {}
         history: list[dict] = []
+        resizes: list[dict] = []
         error: Exception | None = None
+        # Full-jitter restart backoff (shared Backoff helper): a node crash
+        # that killed the gang often killed neighbours too — every trainer
+        # re-forming on an identical schedule stampedes the controller.
+        backoff = Backoff(initial_backoff_s=0.1, max_backoff_s=5.0)
+        # oom_risk events are a monotone log; remember how many we have
+        # already acted on so one event triggers one drain.
+        oom_seen = 0
 
         while True:
             executor = BackendExecutor(
@@ -114,19 +172,23 @@ class DataParallelTrainer:
                 experiment_name=self._experiment_name(),
                 trial_dir=storage.trial_dir,
             )
+            resize: dict | None = None
             try:
+                ingest = storage.latest_ingest() if latest_ckpt else None
                 executor.start(
                     self.train_loop_per_worker,
                     self.train_loop_config,
                     latest_ckpt,
                     # Split AFTER gang formation: an elastic restart may
-                    # come up at a smaller world size.
+                    # come up at a smaller world size, and a resume re-splits
+                    # the remaining sample space at whatever size formed.
                     lambda world_size: _split_datasets(
-                        self.datasets, world_size
+                        self.datasets, world_size, ingest=ingest
                     ),
                 )
-                done, last_metrics, error = self._drive(
-                    executor, storage, history, last_metrics
+                backoff.reset()
+                done, last_metrics, error, resize, oom_seen = self._drive(
+                    executor, storage, history, last_metrics, oom_seen
                 )
                 if done:
                     break
@@ -146,8 +208,18 @@ class DataParallelTrainer:
                     raise
                 error = exc
             finally:
+                prev_size = (
+                    executor.gang.num_workers if executor.gang else None
+                )
                 executor.shutdown()
 
+            if resize is not None:
+                # Voluntary transition at a checkpoint boundary (grow-back
+                # or preemptive drain): not a failure, not counted against
+                # max_failures, no backoff.
+                resizes.append(resize)
+                latest_ckpt = storage.latest_checkpoint()
+                continue
             if error is not None:
                 max_failures = run_cfg.failure_config.max_failures
                 if run_cfg.failure_config.fail_fast or (
@@ -155,9 +227,12 @@ class DataParallelTrainer:
                 ):
                     break
                 failures += 1
+                resizes.append(
+                    {"reason": "gang_died", "from": prev_size, "to": None}
+                )
                 latest_ckpt = storage.latest_checkpoint()
                 error = None
-                time.sleep(0.1)
+                backoff.sleep()
                 continue
             break
 
@@ -167,7 +242,74 @@ class DataParallelTrainer:
             path=storage.trial_dir,
             error=error,
             metrics_history=history,
+            resizes=resizes,
         )
+
+    # -- elasticity probes (evaluated at checkpoint boundaries) ----------
+    def _want_grow(self, executor: BackendExecutor, state: dict) -> bool:
+        """Capacity probe: can the gang grow back toward num_workers?
+
+        Throttled to elastic_grow_probe_period_s; a positive answer is
+        best-effort (the re-formed gang steps down again if the capacity
+        evaporated) but only fires when the cluster-wide free resources
+        cover every missing bundle.
+        """
+        sc = self.scaling_config
+        if not sc.elastic or sc.elastic_grow_probe_period_s <= 0:
+            return False
+        current = executor.gang.num_workers if executor.gang else 0
+        missing = sc.total_workers - current
+        if missing <= 0:
+            return False
+        now = time.monotonic()
+        if now - state.get("last_probe", 0.0) < sc.elastic_grow_probe_period_s:
+            return False
+        state["last_probe"] = now
+        try:
+            import ray_tpu
+
+            avail = ray_tpu.available_resources()
+        except Exception:
+            return False
+        need = self.scaling_config.worker_resources()
+        return all(
+            avail.get(res, 0.0) >= amt * missing for res, amt in need.items()
+        )
+
+    def _oom_flagged_ranks(
+        self, executor: BackendExecutor, oom_seen: int
+    ) -> tuple[list[int], int]:
+        """New oom_risk telemetry events matched against gang nodes.
+
+        Returns (flagged ranks, new high-water event count).
+        """
+        if not self.scaling_config.drain_on_oom_risk:
+            return [], oom_seen
+        session_dir = _session_events_dir_known()
+        if not session_dir:
+            return [], oom_seen
+        try:
+            from ray_tpu._private.event_export import read_events
+
+            events = read_events(session_dir, "oom_risk")
+        except Exception:
+            return [], oom_seen
+        fresh = events[oom_seen:]
+        if not fresh:
+            return [], oom_seen
+        try:
+            infos = executor.gang.rank_infos()
+        except Exception:
+            return [], len(events)
+        node_to_rank = {info["node_id"]: info["rank"] for info in infos}
+        flagged = sorted(
+            {
+                node_to_rank[ev["data"]["node_id"]]
+                for ev in fresh
+                if ev.get("data", {}).get("node_id") in node_to_rank
+            }
+        )
+        return flagged, len(events)
 
     def _drive(
         self,
@@ -175,19 +317,22 @@ class DataParallelTrainer:
         storage: StorageContext,
         history: list,
         last_metrics: dict,
-    ) -> tuple[bool, dict, Exception | None]:
-        """Poll rounds until every rank is done, an error surfaces, or a
-        stop criterion is met. Returns (done, last_metrics, error)."""
+        oom_seen: int = 0,
+    ) -> tuple[bool, dict, Exception | None, dict | None, int]:
+        """Poll rounds until every rank is done, an error surfaces, a stop
+        criterion is met, or a checkpoint boundary triggers a voluntary
+        resize. Returns (done, last_metrics, error, resize, oom_seen)."""
         stop = self.run_config.stop or {}
+        probe_state: dict = {}
         while True:
             round_results = executor.poll_round()
             errors = [r for r in round_results if "error" in r]
             if errors:
                 err = errors[0]["error"]
                 err.worker_traceback = errors[0].get("traceback", "")  # type: ignore
-                return True, last_metrics, err
+                return True, last_metrics, err, None, oom_seen
             if all(r.get("done") for r in round_results):
-                return True, last_metrics, None
+                return True, last_metrics, None, None, oom_seen
             reports = [r for r in round_results if "metrics" in r]
             if not reports:
                 continue
@@ -195,9 +340,34 @@ class DataParallelTrainer:
             ckpt = executor.merge_sharded_checkpoints(
                 [r.get("checkpoint") for r in round_results]
             )
+            committed = False
             if ckpt is not None:
-                persisted = storage.persist(ckpt, metrics)
-                metrics["checkpoint_path"] = persisted.path
+                world = executor.gang.num_workers
+                ingest_states = [r.get("ingest") for r in round_results]
+                ingest = None
+                if any(ingest_states):
+                    names = {
+                        n for s in ingest_states if s for n in s
+                    }
+                    ingest = {
+                        "world_size": world,
+                        "datasets": {
+                            name: [
+                                (s or {}).get(name) for s in ingest_states
+                            ]
+                            for name in names
+                        },
+                    }
+                try:
+                    persisted = storage.persist(ckpt, metrics, ingest=ingest)
+                except IOError as exc:
+                    # Torn sharded save (a writer's marker or inventory is
+                    # missing): skip the commit, keep training — recovery
+                    # falls back to the previous committed checkpoint.
+                    logger.warning("skipping uncommittable checkpoint: %s", exc)
+                else:
+                    metrics["checkpoint_path"] = persisted.path
+                    committed = True
             last_metrics = metrics
             history.append(metrics)
             for cb in self.run_config.callbacks:
@@ -208,7 +378,31 @@ class DataParallelTrainer:
                 key in metrics and metrics[key] >= bound
                 for key, bound in stop.items()
             ):
-                return True, last_metrics, None
+                return True, last_metrics, None, None, oom_seen
+            if committed:
+                # Checkpoint boundary: the only safe place for voluntary
+                # transitions (nothing since the commit is lost).
+                flagged, oom_seen = self._oom_flagged_ranks(
+                    executor, oom_seen
+                )
+                cur = executor.gang.num_workers
+                if flagged:
+                    logger.warning(
+                        "oom_risk flagged gang ranks %s; preemptive "
+                        "checkpoint-and-replace", flagged,
+                    )
+                    return False, last_metrics, None, {
+                        "reason": "oom_risk_drain",
+                        "from": cur,
+                        "to": None,
+                        "ranks": flagged,
+                    }, oom_seen
+                if self._want_grow(executor, probe_state):
+                    return False, last_metrics, None, {
+                        "reason": "grow",
+                        "from": cur,
+                        "to": self.scaling_config.total_workers,
+                    }, oom_seen
 
 
 class JaxTrainer(DataParallelTrainer):

@@ -1,0 +1,61 @@
+"""Full-jitter exponential backoff (AWS-style), shared by every retry loop.
+
+One policy object, two consumers with different sleep substrates: the RPC
+clients (`_private/rpc.py`) await it on the event loop, the trainer's
+gang-recovery loop (`train/jax_trainer.py`) blocks a thread. Both need the
+same *shape* — sleep U(0, ceiling) then double the ceiling — because the
+failure they recover from is correlated: a controller crash or gang death
+orphans every client at the same instant, and deterministic schedules turn
+the reconnect into a synchronized thundering herd.
+
+Stdlib-only on purpose: rpc.py sits below every other module, so this
+helper must not import anything from ray_tpu.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Backoff:
+    """Iterative full-jitter backoff state.
+
+    Each `next_delay()` samples U(0, ceiling) and doubles the ceiling up to
+    `max_backoff_s`. `attempts` counts delays handed out; `reset()` rearms
+    after a success.
+    """
+
+    initial_backoff_s: float = 0.1
+    max_backoff_s: float = 10.0
+    _ceiling: float = field(init=False, default=0.0)
+    attempts: int = field(init=False, default=0)
+
+    def __post_init__(self) -> None:
+        self._ceiling = self.initial_backoff_s
+
+    def next_delay(self) -> float:
+        delay = random.uniform(0, self._ceiling)
+        self._ceiling = min(self._ceiling * 2, self.max_backoff_s)
+        self.attempts += 1
+        return delay
+
+    def sleep(self) -> float:
+        """Blocking variant (trainer-side). Returns the delay slept."""
+        delay = self.next_delay()
+        time.sleep(delay)
+        return delay
+
+    async def async_sleep(self) -> float:
+        """Event-loop variant (RPC clients). Returns the delay awaited."""
+        import asyncio
+
+        delay = self.next_delay()
+        await asyncio.sleep(delay)
+        return delay
+
+    def reset(self) -> None:
+        self._ceiling = self.initial_backoff_s
+        self.attempts = 0

@@ -1,0 +1,210 @@
+"""Elastic-training churn benchmark (release suite, ISSUE 6 acceptance).
+
+Two fits of the same deterministic training job on a REAL 4-node
+in-process cluster (cluster_utils.Cluster — real controller, node
+agents, placement groups, gang actors):
+
+1. ``undisturbed`` — 4 workers, no faults, wall clock is the baseline.
+2. ``churn``       — a driver-side callback removes a node mid-run
+   (the SIGKILL emulation every failure test uses) and restores the
+   capacity once the gang has re-formed at 3; the trainer must shrink,
+   grow back to 4 at a checkpoint boundary, and finish with ZERO manual
+   intervention.
+
+The training math is pure gradient descent on a fixed quadratic, so the
+loss at step k is a deterministic function of k: checkpoint → re-form →
+restore must reproduce the undisturbed loss trajectory EXACTLY
+(loss_max_dev == 0), and any drift means restore or ingest math broke.
+
+Prints ONE JSON line:
+  {"steps": ..., "wall_undisturbed_s": ..., "wall_churn_s": ...,
+   "wall_ratio": ..., "loss_max_dev": ..., "resizes": ...,
+   "grew_back": 1, "finished": 1, "final_world_size": 4}
+
+RAY_TPU_RELEASE_SMOKE=1 downsizes steps/step time; formation overhead
+then dominates the short run, so the smoke wall_ratio floor is looser.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+SMOKE = os.environ.get("RAY_TPU_RELEASE_SMOKE") == "1"
+
+STEPS = 10 if SMOKE else 80
+STEP_TIME_S = 0.05 if SMOKE else 0.5
+KILL_STEP = 3 if SMOKE else 10
+
+# Fast failure detection: the default missed-heartbeat window (~10s+)
+# is sized for production flakiness, not a churn benchmark — with the
+# default, node-death declaration alone dwarfs the 1.2x wall budget.
+# Exported BEFORE init so the controller and every spawned agent agree.
+os.environ.setdefault("RAY_TPU_health_check_period_ms", "200")
+os.environ.setdefault("RAY_TPU_health_check_timeout_ms", "300")
+os.environ.setdefault("RAY_TPU_health_check_failure_threshold", "2")
+# Likewise cap how long callers court a dead node's agent before giving
+# up (default: 10 attempts with backoff to 5s ≈ tens of seconds).
+os.environ.setdefault("RAY_TPU_rpc_connect_timeout_s", "1")
+os.environ.setdefault("RAY_TPU_rpc_retry_max_attempts", "3")
+os.environ.setdefault("RAY_TPU_rpc_retry_max_backoff_s", "0.5")
+
+
+def _train_loop(config):
+    import numpy as np
+
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    w = np.zeros(8, dtype=np.float64)
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        state, _ = train.load_pytree_checkpoint(ckpt)
+        w = np.asarray(state["w"], dtype=np.float64)
+        start = int(state["step"]) + 1
+    target = np.arange(8, dtype=np.float64)
+    for step in range(start, config["steps"]):
+        time.sleep(config["step_time_s"])  # emulated step compute
+        loss = float(np.sum((w - target) ** 2))
+        w = w - 0.05 * (2.0 * (w - target))
+        checkpoint = None
+        if ctx.get_world_rank() == 0:
+            checkpoint = train.save_pytree_checkpoint(
+                {"w": w, "step": step}
+            )
+        train.report(
+            {
+                "step": step,
+                "loss": loss,
+                "world_size": ctx.get_world_size(),
+            },
+            checkpoint=checkpoint,
+        )
+
+
+class _Churn:
+    """Remove a node at kill_step; add one back once the gang runs at 3."""
+
+    def __init__(self, cluster, victim, kill_step):
+        self.cluster = cluster
+        self.victim = victim
+        self.kill_step = kill_step
+        self.killed = False
+        self.restored = False
+
+    def on_result(self, metrics):
+        if not self.killed and metrics.get("step", -1) >= self.kill_step:
+            self.killed = True
+            self.cluster.remove_node(self.victim)
+        elif (
+            self.killed
+            and not self.restored
+            and metrics.get("world_size") == 3
+        ):
+            self.restored = True
+            self.cluster.add_node(
+                resources={"trainslot": 1}, num_cpus=2
+            )
+
+
+def _fit(name, storage, callbacks):
+    from ray_tpu.train import (
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    trainer = JaxTrainer(
+        _train_loop,
+        train_loop_config={"steps": STEPS, "step_time_s": STEP_TIME_S},
+        scaling_config=ScalingConfig(
+            num_workers=4,
+            min_workers=2,
+            resources_per_worker={"CPU": 1, "trainslot": 1},
+            placement_strategy="PACK",
+            # Short step-down wait: after the kill the first formation
+            # attempt at 4 can never succeed until capacity returns, and
+            # every second here lands on the churn wall clock.
+            elastic_formation_timeout_s=1.0,
+            elastic_grow_probe_period_s=0.05,
+        ),
+        run_config=RunConfig(
+            name=name,
+            storage_path=storage,
+            failure_config=FailureConfig(max_failures=4),
+            callbacks=callbacks,
+        ),
+    )
+    start = time.monotonic()
+    result = trainer.fit()
+    return result, time.monotonic() - start
+
+
+def _loss_by_step(result):
+    # Replayed steps re-report; the last occurrence is the one that was
+    # followed by a committed round, so keep it.
+    out = {}
+    for m in result.metrics_history:
+        if "loss" in m:
+            out[int(m["step"])] = float(m["loss"])
+    return out
+
+
+def main() -> None:
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"resources": {"CPU": 2}}
+    )
+    ray_tpu.init(address=cluster.address)
+    nodes = [
+        cluster.add_node(resources={"trainslot": 1}, num_cpus=2)
+        for _ in range(4)
+    ]
+    cluster.wait_for_nodes(5)
+    storage = tempfile.mkdtemp(prefix="elastic_bench_")
+
+    base_result, base_wall = _fit("elastic-base", storage, [])
+    assert base_result.error is None, base_result.error
+
+    churn = _Churn(cluster, nodes[-1], KILL_STEP)
+    churn_result, churn_wall = _fit("elastic-churn", storage, [churn])
+
+    base_loss = _loss_by_step(base_result)
+    churn_loss = _loss_by_step(churn_result)
+    covered = sorted(set(base_loss) & set(churn_loss))
+    loss_max_dev = (
+        max(abs(base_loss[s] - churn_loss[s]) for s in covered)
+        if len(covered) == STEPS
+        else float("inf")
+    )
+    finished = int(
+        churn_result.error is None
+        and churn_result.metrics.get("step") == STEPS - 1
+    )
+    reasons = [r["reason"] for r in churn_result.resizes]
+
+    print(json.dumps({
+        "steps": STEPS,
+        "wall_undisturbed_s": round(base_wall, 3),
+        "wall_churn_s": round(churn_wall, 3),
+        "wall_ratio": round(churn_wall / base_wall, 4),
+        "loss_max_dev": loss_max_dev,
+        "resizes": len(churn_result.resizes),
+        "grew_back": int("grow" in reasons),
+        "finished": finished,
+        "final_world_size": churn_result.metrics.get("world_size", 0),
+    }))
+
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

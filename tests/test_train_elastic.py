@@ -4,10 +4,14 @@ Separate module: these use the function-scoped in-process Cluster fixture,
 which cannot coexist with test_train.py's module-scoped shared cluster.
 """
 
+import json
+import os
+
 import pytest
 
 import ray_tpu
 from ray_tpu import train
+from ray_tpu import data as rd
 from ray_tpu.train import (
     CheckpointConfig,
     FailureConfig,
@@ -91,6 +95,247 @@ def test_trainer_elastic_step_down(ray_start_cluster, tmp_path):
     assert result.metrics["world_size"] == 3
     state, _ = train.load_pytree_checkpoint(result.checkpoint)
     assert int(state["step"]) == 7
+
+
+def _ingest_loop(config):
+    """Consume the dataset shard, logging delivered ids per process; rank 0
+    checkpoints every step so a mid-epoch death resumes with ingest state."""
+    ctx = train.get_context()
+    shard = train.get_dataset_shard("train")
+    log = os.path.join(
+        config["log_dir"],
+        f"consumed_r{ctx.get_world_rank()}_{os.getpid()}.jsonl",
+    )
+    step = 0
+    for batch in shard.iter_batches(batch_size=config["batch_size"]):
+        ids = [int(x) for x in batch["id"]]
+        with open(log, "a") as f:
+            f.write(json.dumps(ids) + "\n")
+        checkpoint = None
+        if ctx.get_world_rank() == 0:
+            checkpoint = train.save_pytree_checkpoint({"step": step})
+        train.report(
+            {"step": step, "world_size": ctx.get_world_size()},
+            checkpoint=checkpoint,
+        )
+        step += 1
+    train.report({"step": step, "world_size": ctx.get_world_size(),
+                  "epoch_done": True})
+
+
+def _logged_ids(log_dir):
+    ids = []
+    for name in os.listdir(log_dir):
+        if not name.startswith("consumed_"):
+            continue
+        with open(os.path.join(log_dir, name)) as f:
+            for line in f:
+                ids += json.loads(line)
+    return ids
+
+
+def test_trainer_ingest_resume_exact_shrunken_world(ray_start_cluster, tmp_path):
+    """Node death mid-epoch: the gang re-forms at 3 and the REMAINING
+    sample space is re-split across the smaller world — the union of
+    delivered samples is still exactly the full dataset."""
+    cluster = ray_start_cluster
+    n, batch = 96, 8
+    # Materialize before adding worker nodes so blocks live on the head
+    # node and survive the victim node's removal.
+    ds = rd.range(n, parallelism=4).materialize()
+    nodes = [
+        cluster.add_node(resources={"trainslot": 1}, num_cpus=2)
+        for _ in range(4)
+    ]
+    cluster.wait_for_nodes(5)
+    log_dir = tmp_path / "logs"
+    log_dir.mkdir()
+
+    killer = _KillNodeAt(cluster, trigger_step=1)
+    killer.victim = nodes[-1]
+    trainer = JaxTrainer(
+        _ingest_loop,
+        train_loop_config={"batch_size": batch, "log_dir": str(log_dir)},
+        scaling_config=ScalingConfig(
+            num_workers=4,
+            min_workers=2,
+            resources_per_worker={"CPU": 1, "trainslot": 1},
+            placement_strategy="PACK",
+            elastic_formation_timeout_s=10.0,
+        ),
+        run_config=RunConfig(
+            name="ingest-shrunk",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2),
+            callbacks=[killer],
+        ),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["world_size"] == 3
+    assert result.metrics.get("epoch_done") is True
+    ids = _logged_ids(str(log_dir))
+    # Exact sample-set parity across the shrink: nothing silently dropped.
+    assert sorted(set(ids)) == list(range(n))
+    # Bounded duplication: at most the rounds in flight since the last
+    # committed checkpoint replay (≤ 3 batches per original rank).
+    assert len(ids) - n <= 3 * batch * 4
+
+
+class _ChurnAndRestore(_KillNodeAt):
+    """Kill a node at trigger_step, then restore capacity once the gang has
+    re-formed at the smaller size."""
+
+    def __init__(self, cluster, trigger_step):
+        super().__init__(cluster, trigger_step)
+        self.restored = False
+
+    def on_result(self, metrics):
+        super().on_result(metrics)
+        if (
+            self.fired
+            and not self.restored
+            and metrics.get("world_size") == 3
+        ):
+            self.restored = True
+            self.cluster.add_node(resources={"trainslot": 1}, num_cpus=2)
+
+
+def test_trainer_elastic_grow_back(ray_start_cluster, tmp_path):
+    """After stepping down 4 → 3 on a node death, the capacity probe grows
+    the gang back to 4 at a checkpoint boundary once a node returns."""
+    cluster = ray_start_cluster
+    nodes = [
+        cluster.add_node(resources={"trainslot": 1}, num_cpus=2)
+        for _ in range(4)
+    ]
+    cluster.wait_for_nodes(5)
+
+    churn = _ChurnAndRestore(cluster, trigger_step=1)
+    churn.victim = nodes[-1]
+    trainer = JaxTrainer(
+        _elastic_loop,
+        train_loop_config={"steps": 12},
+        scaling_config=ScalingConfig(
+            num_workers=4,
+            min_workers=2,
+            resources_per_worker={"CPU": 1, "trainslot": 1},
+            placement_strategy="PACK",
+            elastic_formation_timeout_s=10.0,
+            elastic_grow_probe_period_s=0.01,
+        ),
+        run_config=RunConfig(
+            name="grow-back",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2),
+            callbacks=[churn],
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 11
+    # Finished back at full size, via a voluntary grow transition.
+    assert result.metrics["world_size"] == 4
+    reasons = [r["reason"] for r in result.resizes]
+    assert "gang_died" in reasons
+    assert "grow" in reasons
+    grow = next(r for r in result.resizes if r["reason"] == "grow")
+    assert grow["from"] == 3 and grow["to"] == 4
+
+
+def _oom_loop(config):
+    """Like _elastic_loop, but reports rank 0's node id so the driver-side
+    test callback can flag that node on the oom_risk channel."""
+    ctx = train.get_context()
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        state, _ = train.load_pytree_checkpoint(ckpt)
+        start = int(state["step"]) + 1
+    node_id = ray_tpu.get_runtime_context()["node_id"]
+    for step in range(start, config["steps"]):
+        checkpoint = None
+        if ctx.get_world_rank() == 0:
+            checkpoint = train.save_pytree_checkpoint({"step": step})
+        train.report(
+            {
+                "step": step,
+                "world_size": ctx.get_world_size(),
+                "resumed": start > 0,
+                "node_id": node_id,
+            },
+            checkpoint=checkpoint,
+        )
+
+
+class _OomFlagAt:
+    """Driver-side callback: once training reaches the trigger step, write
+    an oom_risk telemetry event naming rank 0's node — the trainer should
+    preemptively checkpoint and re-form."""
+
+    def __init__(self, events_dir, trigger_step):
+        self.events_dir = events_dir
+        self.trigger_step = trigger_step
+        self.fired = False
+
+    def on_result(self, metrics):
+        if self.fired or metrics.get("step", -1) < self.trigger_step:
+            return
+        self.fired = True
+        os.makedirs(self.events_dir, exist_ok=True)
+        record = {
+            "event_id": "test-oom-1",
+            "source_type": "oom_risk",
+            "timestamp": 0.0,
+            "severity": "WARNING",
+            "data": {"node_id": metrics["node_id"]},
+        }
+        with open(
+            os.path.join(self.events_dir, "events_oom_risk.jsonl"), "a"
+        ) as f:
+            f.write(json.dumps(record) + "\n")
+
+
+def test_trainer_oom_risk_drain(ray_start_cluster, tmp_path, monkeypatch):
+    """An oom_risk event on a gang node triggers a preemptive
+    checkpoint-and-replace at the next checkpoint boundary — a voluntary
+    resize, not a failure (max_failures=0 stays intact)."""
+    cluster = ray_start_cluster
+    for _ in range(4):
+        cluster.add_node(resources={"trainslot": 1}, num_cpus=2)
+    cluster.wait_for_nodes(5)
+    monkeypatch.setenv("RAYTPU_SESSION_DIR", cluster.session_dir)
+
+    flagger = _OomFlagAt(
+        os.path.join(cluster.session_dir, "events"), trigger_step=2
+    )
+    trainer = JaxTrainer(
+        _oom_loop,
+        train_loop_config={"steps": 8},
+        scaling_config=ScalingConfig(
+            num_workers=4,
+            min_workers=2,
+            resources_per_worker={"CPU": 1, "trainslot": 1},
+            placement_strategy="PACK",
+            elastic_formation_timeout_s=10.0,
+            drain_on_oom_risk=True,
+        ),
+        run_config=RunConfig(
+            name="oom-drain",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=0),
+            callbacks=[flagger],
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 7
+    assert result.metrics["resumed"] is True
+    assert result.metrics["world_size"] == 4
+    drains = [r for r in result.resizes if r["reason"] == "oom_risk_drain"]
+    assert len(drains) == 1
+    assert drains[0]["from"] == 4 and drains[0]["ranks"] == [0]
 
 
 def test_scaling_config_elastic_validation():
