@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Fast-collectives gate (ISSUE 7): quantized + topology-aware allreduce.
+#
+# Two layers, same subsystem:
+#   1. tests/test_collective.py + tests/test_collective_quant.py — the
+#      functional floor (uneven chunks, wire-dtype regression, codec
+#      bounds, error-feedback drain, chaos on the DCN tier, trainer
+#      backend auto-upgrade + convergence parity). These also run as
+#      part of plain tier-1 `pytest -m 'not slow'`.
+#   2. the collective_microbenchmark release entry under --smoke, which
+#      enforces the ratio gates (quantized >=2x ring bytes/s at >=4MB,
+#      hierarchical >= ring at every size, int8-wire loss parity) and
+#      appends the run to release_history.jsonl.
+#
+# The full-size sweep (64KB -> 64MB, best-of-5) is the release suite
+# proper: python release/run_all.py --only collective_microbenchmark
+# Usage: ci/run_collective_bench.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== collectives (pytest, functional floor) =="
+python -m pytest tests/test_collective.py tests/test_collective_quant.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+
+echo "== collectives (release floors, --smoke) =="
+python release/run_all.py --smoke --only collective_microbenchmark
+
+echo "collective bench: PASS"

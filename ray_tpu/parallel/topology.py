@@ -117,6 +117,18 @@ class SliceTopology:
                 x = jax.lax.psum(x, name)
         return x
 
+    def hierarchical_pmean(self, x, *, ici: bool = True, dcn: bool = True):
+        """Tier-ordered mean: :meth:`hierarchical_psum` divided by the
+        number of participants actually reduced over — the drop-in
+        gradient-averaging form for data-parallel sync."""
+        total = self.hierarchical_psum(x, ici=ici, dcn=dcn)
+        participants = 1
+        if ici:
+            participants *= self.devices_per_slice
+        if dcn:
+            participants *= self.num_slices
+        return total / participants
+
     def grad_sync_axes(self) -> tuple[str, ...]:
         """The DCN axes a data-parallel gradient sync reduces over."""
         return tuple(self.dcn_axes.keys())

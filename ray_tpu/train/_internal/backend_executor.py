@@ -74,9 +74,44 @@ class BackendExecutor:
     ):
         self.scaling_config = scaling_config
         self.backend = backend
+        self.selected_backend = self._resolve_backend(backend)
         self.experiment_name = experiment_name
         self.trial_dir = trial_dir
         self.gang: WorkerGang | None = None
+
+    def _resolve_backend(self, backend: str) -> str:
+        """Topology-aware default (ISSUE 7): a ring-backend gang whose
+        workers each own >1 local device upgrades to the hierarchical
+        group — tier-1 in-jit psum over the local devices, tier-2 DCN
+        ring of per-host partials — so only one partial per host rides
+        the slow tier. Plain host-level allreduce on the hierarchical
+        group delegates to its inner ring, so existing user code is
+        unchanged. RAY_TPU_COLLECTIVE_AUTO_HIER=0 is the kill switch."""
+        if backend != "ring":
+            return backend
+        if os.environ.get("RAY_TPU_COLLECTIVE_AUTO_HIER", "1") == "0":
+            return backend
+        if self._worker_local_devices() > 1:
+            return "hier"
+        return backend
+
+    def _worker_local_devices(self) -> int:
+        """Local device count a gang WORKER will see — from the worker
+        env's host-platform flag (CPU twin) when present, else this
+        process's jax runtime (real TPU hosts: driver and worker see the
+        same per-host chip count)."""
+        import re
+
+        flags = dict(self.scaling_config.worker_env).get("XLA_FLAGS", "")
+        m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+        if m:
+            return int(m.group(1))
+        try:
+            import jax
+
+            return int(jax.local_device_count())
+        except Exception:
+            return 1
 
     def start(
         self,
@@ -124,10 +159,11 @@ class BackendExecutor:
             return WorkerGang(
                 sc.total_workers,
                 resources_per_worker=sc.worker_resources(),
-                backend=self.backend,
+                backend=self.selected_backend,
                 placement_strategy=sc.placement_strategy,
                 coordinator=coordinator,
                 env_vars=env_vars,
+                collective_config=sc.collective_config,
             )
         last_exc: Exception | None = None
         for size in range(sc.total_workers, sc.min_workers - 1, -1):
@@ -135,11 +171,12 @@ class BackendExecutor:
                 gang = WorkerGang(
                     size,
                     resources_per_worker=sc.worker_resources(),
-                    backend=self.backend,
+                    backend=self.selected_backend,
                     placement_strategy=sc.placement_strategy,
                     ready_timeout=sc.elastic_formation_timeout_s,
                     coordinator=coordinator,
                     env_vars=env_vars,
+                    collective_config=sc.collective_config,
                 )
                 if size < sc.total_workers:
                     print(

@@ -70,6 +70,11 @@ class ScalingConfig:
     # (replacing the worker if capacity exists elsewhere) before the
     # memory-monitor kill fires. Off by default: it requires telemetry.
     drain_on_oom_risk: bool = False
+    # Wire-path knobs for the gang's collective group (ISSUE 7): a
+    # ray_tpu.util.collective.CollectiveConfig, e.g.
+    # CollectiveConfig(quantize="int8") to block-quantize DCN gradient
+    # sync with error feedback. None ⇒ exact wire.
+    collective_config: Any = None
 
     def worker_resources(self) -> dict[str, float]:
         resources = {"CPU": 1.0, **dict(self.resources_per_worker)}

@@ -172,6 +172,13 @@ class DataParallelTrainer:
                 experiment_name=self._experiment_name(),
                 trial_dir=storage.trial_dir,
             )
+            if executor.selected_backend != self.backend:
+                logger.info(
+                    "collective backend %r auto-upgraded to %r "
+                    "(>1 local device per worker; set "
+                    "RAY_TPU_COLLECTIVE_AUTO_HIER=0 to keep the flat ring)",
+                    self.backend, executor.selected_backend,
+                )
             resize: dict | None = None
             try:
                 ingest = storage.latest_ingest() if latest_ckpt else None
@@ -337,6 +344,12 @@ class DataParallelTrainer:
             if not reports:
                 continue
             metrics = dict(reports[0]["metrics"])
+            # Surface which collective backend the gang actually runs
+            # (acceptance: the hier auto-upgrade must be observable from
+            # Result.metrics without user code changes).
+            metrics.setdefault(
+                "collective_backend", executor.selected_backend
+            )
             ckpt = executor.merge_sharded_checkpoints(
                 [r.get("checkpoint") for r in round_results]
             )

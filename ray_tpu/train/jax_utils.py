@@ -49,19 +49,19 @@ def shard_params(params: Any, mesh, logical_dims: Any = None):
     )
 
 
-def sync_gradients(grads: Any, group_name: str) -> Any:
-    """Eager cross-worker gradient mean for the ring backend. (On the xla
-    backend gradients sync in-jit via psum — never call this there.)"""
-    from ray_tpu.util.collective import collective
-
-    group = collective.get_group(group_name)
-    if group.world_size == 1:
-        return grads
+def _flatten_tree(grads: Any):
+    """(leaves, treedef, flat f32 vector) for a grad pytree."""
     import jax
 
     leaves, treedef = jax.tree.flatten(grads)
     flat = np.concatenate([np.asarray(x, np.float32).ravel() for x in leaves])
-    flat = np.asarray(group.allreduce(flat)) / group.world_size
+    return leaves, treedef, flat
+
+
+def _unflatten_tree(flat: np.ndarray, leaves, treedef) -> Any:
+    """Inverse of :func:`_flatten_tree`, restoring leaf shapes/dtypes."""
+    import jax
+
     out, offset = [], 0
     for leaf in leaves:
         size = int(np.prod(np.shape(leaf))) or 1
@@ -72,6 +72,67 @@ def sync_gradients(grads: Any, group_name: str) -> Any:
         )
         offset += size
     return jax.tree.unflatten(treedef, out)
+
+
+def sync_gradients(grads: Any, group_name: str) -> Any:
+    """Eager cross-worker gradient mean for the ring backend. (On the xla
+    backend gradients sync in-jit via psum — never call this there.)
+
+    Quantized wire compression is transparent here: it lives in the
+    group's CollectiveConfig (ScalingConfig.collective_config), not in
+    the call site."""
+    from ray_tpu.util.collective import collective
+
+    group = collective.get_group(group_name)
+    if group.world_size == 1:
+        return grads
+    leaves, treedef, flat = _flatten_tree(grads)
+    flat = np.asarray(group.allreduce(flat)) / group.world_size
+    return _unflatten_tree(flat, leaves, treedef)
+
+
+def sync_gradients_sharded(
+    per_device_grads: list, group_name: str
+) -> Any:
+    """Two-tier gradient mean for hierarchical-backend gangs: one grad
+    pytree PER LOCAL DEVICE in, the globally-averaged pytree out.
+
+    Tier 1 reduces the local shards in one jit (psum over ICI); tier 2
+    rides the DCN ring with this group's CollectiveConfig (so int8/fp8
+    wire compression applies only to the cross-host hop). Falls back to
+    host-mean + :func:`sync_gradients` on non-hierarchical groups."""
+    from ray_tpu.util.collective import collective
+
+    group = collective.get_group(group_name)
+    flats = []
+    leaves = treedef = None
+    for grads in per_device_grads:
+        leaves, treedef, flat = _flatten_tree(grads)
+        flats.append(flat)
+    n_local = len(flats)
+    denom = group.world_size * n_local
+    if not hasattr(group, "allreduce_sharded"):
+        total = np.sum(np.stack(flats), axis=0)
+        if group.world_size > 1:
+            total = np.asarray(group.allreduce(total))
+        return _unflatten_tree(total / denom, leaves, treedef)
+    flat = np.asarray(group.allreduce_sharded(flats)) / denom
+    return _unflatten_tree(flat, leaves, treedef)
+
+
+def grad_psum(x, axis: str = "dp", topology=None):
+    """The default in-jit gradient reduce (use inside shard_map/jit).
+
+    Single-slice meshes psum over ``axis``; with a SliceTopology the
+    reduce is placed tier by tier via ``hierarchical_psum`` — ICI first,
+    then DCN — so the compiler never routes a collective-heavy reduce
+    over the slow tier. build_mesh(topology=...) callers pass the same
+    topology here to get the matching reduction order."""
+    import jax
+
+    if topology is not None:
+        return topology.hierarchical_psum(x)
+    return jax.lax.psum(x, axis)
 
 
 def shard_batch(batch: Any, mesh, axis: str = "dp"):

@@ -1,0 +1,12 @@
+"""Collective communication for actors (ring / xla / hierarchical).
+
+Convenience re-exports so callers can write
+``from ray_tpu.util.collective import CollectiveConfig`` without
+reaching into the submodules.
+"""
+
+from ray_tpu.util.collective.quantization import (  # noqa: F401
+    CollectiveConfig,
+    ErrorFeedback,
+    fp8_supported,
+)

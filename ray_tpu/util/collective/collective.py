@@ -21,8 +21,10 @@ controller KV [N6].
 from __future__ import annotations
 
 import asyncio
-import contextlib
+import functools
+import os
 import pickle
+import threading
 import time
 from typing import Any
 
@@ -30,6 +32,11 @@ import numpy as np
 
 from ray_tpu._private import worker as worker_mod
 from ray_tpu.util import tracing
+from ray_tpu.util.collective.quantization import (
+    CollectiveConfig,
+    ErrorFeedback,
+    decode as _q_decode,
+)
 
 _groups: dict[str, "BaseGroup"] = {}
 
@@ -38,13 +45,34 @@ _REDUCERS = {SUM: np.add, PRODUCT: np.multiply, MIN: np.minimum, MAX: np.maximum
 
 
 class BaseGroup:
-    def __init__(self, world_size: int, rank: int, group_name: str):
+    #: short backend label stamped on spans/metrics ("ring"/"xla"/"hier")
+    backend_name = "base"
+
+    def __init__(
+        self,
+        world_size: int,
+        rank: int,
+        group_name: str,
+        config: CollectiveConfig | None = None,
+    ):
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
+        self.config = config or CollectiveConfig()
+        # Cumulative wire accounting (payload bytes actually serialized for
+        # the network; device-mesh backends leave it at zero).
+        self.wire_stats: dict[str, int] = {
+            "bytes_sent": 0,
+            "msgs_sent": 0,
+        }
 
     # subclasses implement: allreduce, allgather, reducescatter, broadcast,
-    # barrier, send, recv, destroy
+    # barrier, send, destroy — and recv with THIS unified signature
+    # (``like`` is the shape/dtype template shape-static backends need;
+    # host-memory backends accept and ignore it).
+    def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0,
+             like=None):
+        raise NotImplementedError
 
     def p2p(self, array, src_rank: int, dst_rank: int):
         """Group-wide p2p entry point: every rank calls with the same
@@ -63,9 +91,18 @@ class BaseGroup:
 # ring backend (host memory over RPC p2p)
 # ---------------------------------------------------------------------------
 class RingGroup(BaseGroup):
-    def __init__(self, world_size: int, rank: int, group_name: str):
-        super().__init__(world_size, rank, group_name)
+    backend_name = "ring"
+
+    def __init__(
+        self,
+        world_size: int,
+        rank: int,
+        group_name: str,
+        config: CollectiveConfig | None = None,
+    ):
+        super().__init__(world_size, rank, group_name, config=config)
         self.ctx = worker_mod.get_global_context()
+        self._ef = ErrorFeedback()
         self._mailbox: dict[tuple, Any] = {}
         self._mailbox_events: dict[tuple, asyncio.Event] = {}
         self.ctx.core_server.route(
@@ -124,23 +161,36 @@ class RingGroup(BaseGroup):
         event.set()
         return {"status": "ok"}
 
-    def send(self, array: np.ndarray, dst_rank: int, tag: str = "") -> None:
+    def send(self, array, dst_rank: int, tag: str = "") -> None:
+        self.send_async(array, dst_rank, tag=tag).result()
+
+    def send_async(self, payload, dst_rank: int, tag: str = ""):
+        """Issue a p2p send and return its concurrent Future — the ring
+        collectives double-buffer hops with this (next chunk's send goes
+        out while the previous recv is still in flight on the shared
+        async RPC lane). Sequence numbers are assigned at ISSUE time, so
+        two in-flight sends to the same (dst, tag) stay ordered for the
+        receiver's mailbox even if their frames interleave. ``payload``
+        is any picklable object: an ndarray or a quantized wire tuple.
+        """
         seq_key = (dst_rank, tag)
         seq = self._send_seq.get(seq_key, 0)
         self._send_seq[seq_key] = seq + 1
+        data = pickle.dumps(
+            np.asarray(payload) if isinstance(payload, (list, int, float))
+            else payload
+        )
+        self.wire_stats["bytes_sent"] += len(data)
+        self.wire_stats["msgs_sent"] += 1
 
         async def _send():
             client = await self.ctx._client_for(self._peer_addrs[dst_rank])
             await client.call(
                 f"coll_send/{self.group_name}",
-                {
-                    "src": self.rank,
-                    "tag": f"{tag}#{seq}",
-                    "data": pickle.dumps(np.asarray(array)),
-                },
+                {"src": self.rank, "tag": f"{tag}#{seq}", "data": data},
             )
 
-        self.ctx.io.run(_send())
+        return asyncio.run_coroutine_threadsafe(_send(), self.ctx.io.loop)
 
     def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0,
              like=None) -> np.ndarray:
@@ -191,7 +241,7 @@ class RingGroup(BaseGroup):
         return self.recv(src_rank, tag=tag)
 
     def allgather(self, array: np.ndarray, tag: str = "__ag") -> list[np.ndarray]:
-        """Ring all-gather: world_size-1 neighbor hops."""
+        """Ring all-gather: world_size-1 double-buffered neighbor hops."""
         if self.world_size == 1:
             return [np.asarray(array)]
         chunks: list[Any] = [None] * self.world_size
@@ -199,61 +249,164 @@ class RingGroup(BaseGroup):
         next_rank = (self.rank + 1) % self.world_size
         prev_rank = (self.rank - 1) % self.world_size
         current = self.rank
+        pending = None
         for _ in range(self.world_size - 1):
-            self.send(chunks[current], next_rank, tag=tag)
+            if pending is not None:
+                pending.result()
+            pending = self.send_async(chunks[current], next_rank, tag=tag)
             current = (current - 1) % self.world_size
             chunks[current] = self.recv(prev_rank, tag=tag)
+        pending.result()
         return chunks
 
+    def _quantized(self, op: str, array: np.ndarray) -> bool:
+        """The quantized wire only applies to SUM over floats (partial
+        sums of dequantized blocks; min/max/product and integer arrays
+        take the exact wire)."""
+        return (
+            self.config.enabled
+            and op == SUM
+            and array.dtype.kind == "f"
+            and self.world_size > 1
+        )
+
     def allreduce(self, array: np.ndarray, op: str = SUM, tag: str = "__ar") -> np.ndarray:
-        """Ring reduce-scatter + all-gather (bandwidth-optimal)."""
-        reducer = _REDUCERS[op]
+        """Ring reduce-scatter + all-gather (bandwidth-optimal).
+
+        The wire carries the INPUT dtype (or the quantized encoding) —
+        never an upcast; wide (f64) accumulation of float partial sums
+        stays local to each hop's reduction.
+        """
         array = np.asarray(array)
         if self.world_size == 1:
             return array
-        flat = array.reshape(-1).astype(np.float64 if array.dtype.kind == "f" else array.dtype)
-        chunks = np.array_split(flat, self.world_size)
-        next_rank = (self.rank + 1) % self.world_size
+        if self._quantized(op, array):
+            return self._allreduce_quantized(array, tag)
+        reducer = _REDUCERS[op]
+        wire_dtype = array.dtype
+        acc_dtype = np.float64 if array.dtype.kind == "f" else array.dtype
+        chunks = np.array_split(array.reshape(-1), self.world_size)
         # reduce-scatter, then all-gather of the reduced chunks
-        self._ring_reduce_scatter(chunks, reducer, f"{tag}/rs", start_idx=self.rank)
+        self._ring_reduce_scatter(
+            chunks, reducer, f"{tag}/rs", start_idx=self.rank,
+            acc_dtype=acc_dtype, wire_dtype=wire_dtype,
+        )
+        next_rank = (self.rank + 1) % self.world_size
         prev_rank = (self.rank - 1) % self.world_size
         send_idx = (self.rank + 1) % self.world_size
+        # The owned chunk goes back to wire dtype BEFORE the all-gather so
+        # every rank reconstructs bitwise-identical values (the owner must
+        # not keep a wider-precision copy the others never saw).
+        chunks[send_idx] = chunks[send_idx].astype(wire_dtype, copy=False)
+        pending = None
         for step in range(self.world_size - 1):
-            self.send(chunks[send_idx], next_rank, tag=f"{tag}/ag")
+            if pending is not None:
+                pending.result()
+            pending = self.send_async(chunks[send_idx], next_rank, tag=f"{tag}/ag")
             recv_idx = (send_idx - 1) % self.world_size
             chunks[recv_idx] = self.recv(prev_rank, tag=f"{tag}/ag")
             send_idx = recv_idx
+        pending.result()
         out = np.concatenate(chunks).astype(array.dtype)
         return out.reshape(array.shape)
 
-    def _ring_reduce_scatter(self, chunks, reducer, tag, start_idx: int) -> int:
-        """N-1 ring rounds; afterwards this rank holds the fully-reduced
-        chunk at index (start_idx + 1) % world_size (returned)."""
+    def _ring_reduce_scatter(
+        self, chunks, reducer, tag, start_idx: int,
+        acc_dtype=None, wire_dtype=None,
+    ) -> int:
+        """N-1 double-buffered ring rounds; afterwards this rank holds the
+        fully-reduced chunk at index (start_idx + 1) % world_size
+        (returned). Outgoing partials are cast to ``wire_dtype``; the
+        local reduction runs in ``acc_dtype`` (wide accumulation never
+        crosses the wire)."""
         next_rank = (self.rank + 1) % self.world_size
         prev_rank = (self.rank - 1) % self.world_size
         send_idx = start_idx
+        pending = None
         for step in range(self.world_size - 1):
-            self.send(chunks[send_idx], next_rank, tag=tag)
+            out = chunks[send_idx]
+            if wire_dtype is not None and out.dtype != wire_dtype:
+                out = out.astype(wire_dtype)
+            if pending is not None:
+                pending.result()
+            pending = self.send_async(out, next_rank, tag=tag)
             recv_idx = (send_idx - 1) % self.world_size
             incoming = self.recv(prev_rank, tag=tag)
-            chunks[recv_idx] = reducer(chunks[recv_idx], incoming)
+            local = chunks[recv_idx]
+            if acc_dtype is not None:
+                local = local.astype(acc_dtype, copy=False)
+                incoming = incoming.astype(acc_dtype, copy=False)
+            chunks[recv_idx] = reducer(local, incoming)
             send_idx = recv_idx
+        if pending is not None:
+            pending.result()
         return send_idx
+
+    def _allreduce_quantized(self, array: np.ndarray, tag: str) -> np.ndarray:
+        """Block-scaled quantized ring allreduce (SUM only, EQuARX-style).
+
+        Reduce-scatter: each hop's outgoing chunk is quantized through
+        the persistent error-feedback residual for that (tag, step) site;
+        the receiver dequantizes and accumulates in f32. All-gather: the
+        owner of each fully-reduced chunk encodes it ONCE (again through
+        error feedback), and downstream ranks forward the encoded tuple
+        VERBATIM — no re-quantization error per hop, and every rank
+        decodes the same bytes, so results are identical group-wide.
+        """
+        next_rank = (self.rank + 1) % self.world_size
+        prev_rank = (self.rank - 1) % self.world_size
+        flat = array.reshape(-1).astype(np.float32)
+        chunks = np.array_split(flat, self.world_size)
+        send_idx = self.rank
+        pending = None
+        for step in range(self.world_size - 1):
+            enc = self._ef.encode(
+                ("rs", tag, step), chunks[send_idx], self.config
+            )
+            if pending is not None:
+                pending.result()
+            pending = self.send_async(enc, next_rank, tag=f"{tag}/rs")
+            recv_idx = (send_idx - 1) % self.world_size
+            incoming = _q_decode(self.recv(prev_rank, tag=f"{tag}/rs"))
+            chunks[recv_idx] = chunks[recv_idx] + incoming
+            send_idx = recv_idx
+        if pending is not None:
+            pending.result()
+            pending = None
+        owned = (self.rank + 1) % self.world_size
+        encoded: dict[int, tuple] = {
+            owned: self._ef.encode(("ag", tag), chunks[owned], self.config)
+        }
+        send_idx = owned
+        for step in range(self.world_size - 1):
+            if pending is not None:
+                pending.result()
+            pending = self.send_async(encoded[send_idx], next_rank, tag=f"{tag}/ag")
+            recv_idx = (send_idx - 1) % self.world_size
+            encoded[recv_idx] = self.recv(prev_rank, tag=f"{tag}/ag")
+            send_idx = recv_idx
+        pending.result()
+        out = np.concatenate(
+            [_q_decode(encoded[i]) for i in range(self.world_size)]
+        )
+        return out.astype(array.dtype).reshape(array.shape)
 
     def reducescatter(self, array: np.ndarray, op: str = SUM) -> np.ndarray:
         """Each rank gets its 1/world_size slice of the reduction. Runs ONLY
         the reduce-scatter phase (half an allreduce's communication)."""
+        array = np.asarray(array)
         if self.world_size == 1:
-            return np.asarray(array).reshape(-1)
+            return array.reshape(-1)
         reducer = _REDUCERS[op]
-        flat = array.reshape(-1).astype(
-            np.float64 if array.dtype.kind == "f" else array.dtype
-        )
-        chunks = np.array_split(flat, self.world_size)
+        wire_dtype = array.dtype
+        acc_dtype = np.float64 if array.dtype.kind == "f" else array.dtype
+        chunks = np.array_split(array.reshape(-1), self.world_size)
         # Starting one chunk earlier makes the fully-reduced chunk land on
         # index == self.rank, matching the allreduce-based semantics.
         owned = self._ring_reduce_scatter(
-            chunks, reducer, "__rsc/rs", start_idx=(self.rank - 1) % self.world_size
+            chunks, reducer, "__rsc/rs",
+            start_idx=(self.rank - 1) % self.world_size,
+            acc_dtype=acc_dtype, wire_dtype=wire_dtype,
         )
         assert owned == self.rank
         return chunks[self.rank].astype(array.dtype)
@@ -280,8 +433,19 @@ class XlaGroup(BaseGroup):
     fusion path, SURVEY §7.0.4).
     """
 
-    def __init__(self, world_size: int, rank: int, group_name: str):
-        super().__init__(world_size, rank, group_name)
+    backend_name = "xla"
+
+    def __init__(
+        self,
+        world_size: int,
+        rank: int,
+        group_name: str,
+        config: CollectiveConfig | None = None,
+    ):
+        # `config` is accepted for signature parity; the XLA data plane
+        # has its own on-wire formats (quantization would fight the
+        # compiler), so it is ignored here.
+        super().__init__(world_size, rank, group_name, config=config)
         import jax
 
         self._jax = jax
@@ -448,15 +612,45 @@ class HierarchicalGroup(BaseGroup):
     """
 
     _TIER1 = {"sum": "psum", "max": "pmax", "min": "pmin"}
+    _TIER1_HOST = {
+        "sum": np.add.reduce,
+        "max": np.maximum.reduce,
+        "min": np.minimum.reduce,
+    }
+    # Below this many TOTAL bytes across the local shards, tier-1 reduces
+    # on host: device dispatch (transfer + program launch) has a fixed
+    # cost that dwarfs the reduction itself for tiny gradients, while the
+    # DCN tier still carries the single collapsed partial either way.
+    _TIER1_HOST_BYTES = int(
+        os.environ.get("RAY_TPU_TIER1_HOST_BYTES", 1 << 20)
+    )
 
-    def __init__(self, world_size: int, rank: int, group_name: str):
-        super().__init__(world_size, rank, group_name)
-        # The DCN tier rides the ring group's controller-KV rendezvous + p2p.
-        self._ring = RingGroup(world_size, rank, group_name + "@dcn")
+    backend_name = "hier"
+
+    def __init__(
+        self,
+        world_size: int,
+        rank: int,
+        group_name: str,
+        config: CollectiveConfig | None = None,
+    ):
+        super().__init__(world_size, rank, group_name, config=config)
+        # The DCN tier rides the ring group's controller-KV rendezvous +
+        # p2p — and inherits this group's CollectiveConfig, so quantized
+        # wire compression applies exactly where bandwidth is scarce
+        # (cross-host), never to the in-jit ICI tier.
+        self._ring = RingGroup(
+            world_size, rank, group_name + "@dcn", config=config
+        )
+        # Surface the DCN tier's wire accounting as this group's own.
+        self.wire_stats = self._ring.wire_stats
+        # Tier-1 programs cached per (ndev, shape, dtype, op): a per-step
+        # gradient sync must not retrace/recompile on every call.
+        self._tier1_cache: dict = {}
 
     def _local_reduce(self, per_device_arrays: list, op: str) -> np.ndarray:
         import jax
-        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         if op not in self._TIER1:
@@ -468,27 +662,41 @@ class HierarchicalGroup(BaseGroup):
             raise ValueError(
                 f"{len(per_device_arrays)} shards for {len(devices)} local devices"
             )
-        mesh = Mesh(np.array(devices), ("local",))
         shape = np.asarray(per_device_arrays[0]).shape
-        shards = [
-            jax.device_put(jnp.asarray(a)[None], d)
-            for a, d in zip(per_device_arrays, devices)
-        ]
-        stacked = jax.make_array_from_single_device_arrays(
-            (len(devices), *shape), NamedSharding(mesh, P("local")), shards
+        dtype = np.asarray(per_device_arrays[0]).dtype
+        total_bytes = int(dtype.itemsize * np.prod(shape)) * len(
+            per_device_arrays
         )
-        prim = getattr(jax.lax, self._TIER1[op])
-        reduced = jax.jit(
-            jax.shard_map(
-                # each device's block is (1, *shape): reduce over the mesh
-                # axis, then drop the block dim.
-                lambda x: prim(x, "local")[0],
-                mesh=mesh,
-                in_specs=P("local"),
-                out_specs=P(),
+        if total_bytes <= self._TIER1_HOST_BYTES:
+            stacked = np.stack(
+                [np.asarray(a) for a in per_device_arrays]
             )
-        )(stacked)
-        return np.asarray(reduced)
+            return self._TIER1_HOST[op](stacked, axis=0)
+        key = (len(devices), shape, dtype.str, op)
+        cached = self._tier1_cache.get(key)
+        if cached is None:
+            mesh = Mesh(np.array(devices), ("local",))
+            sharding = NamedSharding(mesh, P("local"))
+            prim = getattr(jax.lax, self._TIER1[op])
+            jitted = jax.jit(
+                shard_map(
+                    # each device's block is (1, *shape): reduce over the
+                    # mesh axis, then drop the block dim.
+                    lambda x: prim(x, "local")[0],
+                    mesh=mesh,
+                    in_specs=P("local"),
+                    out_specs=P(),
+                )
+            )
+            cached = (devices, sharding, jitted)
+            self._tier1_cache[key] = cached
+        devices, sharding, jitted = cached
+        # ONE sharded transfer (the sharding routes each row to its
+        # device) — far cheaper than a device_put per shard.
+        stacked = jax.device_put(
+            np.stack([np.asarray(a) for a in per_device_arrays]), sharding
+        )
+        return np.asarray(jitted(stacked))
 
     def allreduce_sharded(self, per_device_arrays: list, op: str = SUM) -> np.ndarray:
         """Reduce one shard per local device across ALL hosts' devices:
@@ -518,7 +726,10 @@ class HierarchicalGroup(BaseGroup):
 
     def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0,
              like=None):
-        return self._ring.recv(src_rank, tag=tag, timeout=timeout)
+        # Forward `like` too: the parameter is part of the unified
+        # BaseGroup signature and backend-portable call sites pass it
+        # positionally-equivalently on every backend.
+        return self._ring.recv(src_rank, tag=tag, timeout=timeout, like=like)
 
     def destroy(self):
         self._ring.destroy()
@@ -532,19 +743,21 @@ def init_collective_group(
     rank: int,
     backend: str = "ring",
     group_name: str = "default",
+    config: CollectiveConfig | None = None,
 ) -> None:
     if group_name in _groups:
         raise ValueError(f"collective group {group_name!r} already initialized")
     if backend in ("ring", "gloo"):
-        _groups[group_name] = RingGroup(world_size, rank, group_name)
+        cls = RingGroup
     elif backend == "xla":
-        _groups[group_name] = XlaGroup(world_size, rank, group_name)
+        cls = XlaGroup
     elif backend in ("hier", "hierarchical"):
-        _groups[group_name] = HierarchicalGroup(world_size, rank, group_name)
+        cls = HierarchicalGroup
     else:
         raise ValueError(
             f"unknown backend {backend!r} (use 'ring', 'xla', or 'hier')"
         )
+    _groups[group_name] = cls(world_size, rank, group_name, config=config)
 
 
 def get_group(group_name: str = "default") -> BaseGroup:
@@ -553,57 +766,111 @@ def get_group(group_name: str = "default") -> BaseGroup:
     return _groups[group_name]
 
 
-def _traced(op: str, group: BaseGroup, array=None):
-    """Span scope for one collective op (bytes + participants as
-    attributes); a plain nullcontext when tracing is off."""
-    if not tracing.enabled():
-        return contextlib.nullcontext()
-    attrs = {
-        "group": group.group_name,
-        "world_size": group.world_size,
-        "rank": group.rank,
-        "backend": type(group).__name__,
-    }
-    nbytes = getattr(array, "nbytes", None)
-    if nbytes is not None:
-        attrs["bytes"] = int(nbytes)
-    return tracing.span(f"collective.{op}", **attrs)
+_op_tls = threading.local()
+
+
+def _instrumented(op: str, group: BaseGroup, array, call):
+    """Run one collective op with full observability: the collective.*
+    span carries op + backend + logical bytes + measured wire bytes, and
+    the op feeds the rt_collective_* Prometheus series (bytes total +
+    latency histogram) so summarize_latency()/summarize_comm() can break
+    out comm time per backend.
+
+    Reentrant calls (module wrapper -> group method, hierarchical ->
+    inner DCN ring, broadcast -> send/recv) record NOTHING — one span
+    and one metrics sample per user-visible op, attributed to the
+    outermost backend."""
+    if getattr(_op_tls, "active", False):
+        return call()
+    _op_tls.active = True
+    try:
+        return _instrumented_outer(op, group, array, call)
+    finally:
+        _op_tls.active = False
+
+
+def _instrumented_outer(op: str, group: BaseGroup, array, call):
+    backend = getattr(group, "backend_name", type(group).__name__)
+    if isinstance(array, (list, tuple)):  # allreduce_sharded: shard list
+        nbytes = sum(getattr(a, "nbytes", 0) for a in array) or None
+    else:
+        nbytes = getattr(array, "nbytes", None)
+    wire = getattr(group, "wire_stats", None)
+    wire_before = wire["bytes_sent"] if wire else 0
+    start = time.perf_counter()
+    if tracing.enabled():
+        attrs = {
+            "group": group.group_name,
+            "world_size": group.world_size,
+            "rank": group.rank,
+            "backend": backend,
+            "op": op,
+        }
+        if nbytes is not None:
+            attrs["bytes"] = int(nbytes)
+        with tracing.span(f"collective.{op}", **attrs) as span:
+            result = call()
+            if span is not None and wire is not None:
+                span.attributes["wire_bytes"] = (
+                    wire["bytes_sent"] - wire_before
+                )
+    else:
+        result = call()
+    elapsed = time.perf_counter() - start
+    wire_delta = (wire["bytes_sent"] - wire_before) if wire else 0
+    from ray_tpu.util import metrics
+
+    metrics.record_collective_op(
+        op=op,
+        backend=backend,
+        # Ring-family backends report true serialized wire bytes; the
+        # device-mesh backend reports the logical payload instead.
+        nbytes=wire_delta if wire_delta else int(nbytes or 0),
+        seconds=elapsed,
+    )
+    return result
 
 
 def allreduce(array, group_name: str = "default", op: str = SUM):
     group = get_group(group_name)
-    with _traced("allreduce", group, array):
-        return group.allreduce(array, op=op)
+    return _instrumented(
+        "allreduce", group, array, lambda: group.allreduce(array, op=op)
+    )
 
 
 def allgather(array, group_name: str = "default"):
     group = get_group(group_name)
-    with _traced("allgather", group, array):
-        return group.allgather(array)
+    return _instrumented(
+        "allgather", group, array, lambda: group.allgather(array)
+    )
 
 
 def reducescatter(array, group_name: str = "default", op: str = SUM):
     group = get_group(group_name)
-    with _traced("reducescatter", group, array):
-        return group.reducescatter(array, op=op)
+    return _instrumented(
+        "reducescatter", group, array,
+        lambda: group.reducescatter(array, op=op),
+    )
 
 
 def broadcast(array, src_rank: int = 0, group_name: str = "default"):
     group = get_group(group_name)
-    with _traced("broadcast", group, array):
-        return group.broadcast(array, src_rank=src_rank)
+    return _instrumented(
+        "broadcast", group, array,
+        lambda: group.broadcast(array, src_rank=src_rank),
+    )
 
 
 def barrier(group_name: str = "default"):
     group = get_group(group_name)
-    with _traced("barrier", group):
-        group.barrier()
+    return _instrumented("barrier", group, None, group.barrier)
 
 
 def send(array, dst_rank: int, group_name: str = "default"):
     group = get_group(group_name)
-    with _traced("send", group, array):
-        group.send(array, dst_rank)
+    return _instrumented(
+        "send", group, array, lambda: group.send(array, dst_rank)
+    )
 
 
 def recv(
@@ -614,6 +881,32 @@ def recv(
     if like is not None:
         return group.recv(src_rank, timeout=timeout, like=like)
     return group.recv(src_rank, timeout=timeout)
+
+
+def _traced_method(op: str, fn):
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        payload = args[0] if args else None
+        return _instrumented(
+            op, self, payload, lambda: fn(self, *args, **kwargs)
+        )
+    return wrapper
+
+
+# Instrument the GROUP methods themselves, not just the module-level
+# wrappers above: trainers and gang code hold the group object
+# (ctx.collective(), sync_gradients) and call it directly, and those
+# calls must land in the same collective.* spans / rt_collective_*
+# series. The thread-local guard in _instrumented collapses the nesting
+# to one span per user-visible op.
+for _cls in (RingGroup, XlaGroup, HierarchicalGroup):
+    for _op in (
+        "allreduce", "allreduce_sharded", "allgather", "reducescatter",
+        "broadcast", "barrier", "send", "recv",
+    ):
+        _fn = _cls.__dict__.get(_op)
+        if _fn is not None:
+            setattr(_cls, _op, _traced_method(_op, _fn))
 
 
 def destroy_collective_group(group_name: str = "default") -> None:

@@ -54,6 +54,7 @@ class _GangMember:
         backend: str,
         env_vars: dict | None,
         coordinator: str | None,
+        collective_config=None,
     ):
         for key, value in (env_vars or {}).items():
             os.environ[str(key)] = str(value)
@@ -71,7 +72,8 @@ class _GangMember:
         from ray_tpu.util.collective import collective
 
         collective.init_collective_group(
-            world_size, rank, backend=backend, group_name=group_name
+            world_size, rank, backend=backend, group_name=group_name,
+            config=collective_config,
         )
         self.gang_ctx = GangContext(
             rank, world_size, group_name,
@@ -104,8 +106,10 @@ class WorkerGang:
         env_vars: dict | None = None,
         coordinator: str | None = None,
         ready_timeout: float = 120.0,
+        collective_config=None,
     ):
         self.num_workers = num_workers
+        self.backend = backend
         self.group_name = group_name or f"gang-{os.urandom(4).hex()}"
         if coordinator == "auto":
             # Single-host twin convenience: allocate a free port for the
@@ -146,7 +150,7 @@ class WorkerGang:
                 ),
             ).remote(
                 i, num_workers, self.group_name, backend, env_vars,
-                self.coordinator,
+                self.coordinator, collective_config,
             )
             for i in range(num_workers)
         ]

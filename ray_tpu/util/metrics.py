@@ -174,6 +174,40 @@ class Histogram(_Metric):
 
 
 # ---------------------------------------------------------------------------
+# Collective-layer series (ISSUE 7): every ring/xla/hierarchical op feeds a
+# bytes counter + latency histogram tagged by op and backend, so comm time
+# and wire volume are dashboard queries (and summarize_comm() fodder).
+# ---------------------------------------------------------------------------
+
+_collective_bytes: Counter | None = None
+_collective_latency: Histogram | None = None
+
+
+def record_collective_op(
+    op: str, backend: str, nbytes: int, seconds: float
+) -> None:
+    """One completed collective op: rt_collective_bytes_total (wire bytes
+    where the backend measures them, logical payload otherwise) and
+    rt_collective_op_latency_s, both tagged {op, backend}."""
+    global _collective_bytes, _collective_latency
+    if _collective_bytes is None:
+        _collective_bytes = Counter(
+            "rt_collective_bytes_total",
+            description="Bytes moved by collective ops",
+            tag_keys=("op", "backend"),
+        )
+        _collective_latency = Histogram(
+            "rt_collective_op_latency_s",
+            description="Collective op latency (seconds)",
+            boundaries=(0.001, 0.01, 0.1, 1, 10),
+            tag_keys=("op", "backend"),
+        )
+    tags = {"op": op, "backend": backend}
+    _collective_bytes.inc(max(0, int(nbytes)), tags=tags)
+    _collective_latency.observe(float(seconds), tags=tags)
+
+
+# ---------------------------------------------------------------------------
 # Native/control-plane observability [N27]: the C++ engine's internal
 # counters and the controller's queue depths surface as first-class
 # Prometheus series, so "is the control plane draining?" is a dashboard

@@ -192,6 +192,56 @@ def summarize_latency(session_dir: str | None = None) -> dict:
     return out
 
 
+def summarize_comm(session_dir: str | None = None) -> dict:
+    """Communication breakdown over ``collective.*`` spans.
+
+    Returns ``{(op, backend) -> {count, total_ms, p50_ms, p95_ms,
+    bytes, wire_bytes, bytes_per_s}}`` keyed as ``"op/backend"`` —
+    the comm-time complement to :func:`summarize_latency`'s per-phase
+    view. ``bytes`` is the logical payload; ``wire_bytes`` is what the
+    backend actually serialized (smaller under quantization, zero for
+    in-device-mesh backends)."""
+    from ray_tpu.util import tracing
+
+    session_dir = session_dir or _session_dir()
+    if not session_dir:
+        return {}
+    acc: dict[str, dict] = {}
+    for span in tracing.read_spans(session_dir):
+        name = span.get("name", "")
+        if not name.startswith("collective."):
+            continue
+        if not span.get("end_ns") or not span.get("start_ns"):
+            continue
+        attrs = span.get("attributes") or {}
+        op = attrs.get("op", name.split(".", 1)[1])
+        backend = attrs.get("backend", "?")
+        key = f"{op}/{backend}"
+        entry = acc.setdefault(
+            key, {"durs": [], "bytes": 0, "wire_bytes": 0}
+        )
+        entry["durs"].append((span["end_ns"] - span["start_ns"]) / 1e6)
+        entry["bytes"] += int(attrs.get("bytes") or 0)
+        entry["wire_bytes"] += int(attrs.get("wire_bytes") or 0)
+    out: dict[str, dict] = {}
+    for key in sorted(acc):
+        durs = sorted(acc[key]["durs"])
+        total_ms = sum(durs)
+        nbytes = acc[key]["bytes"]
+        out[key] = {
+            "count": len(durs),
+            "total_ms": total_ms,
+            "p50_ms": _percentile(durs, 0.50),
+            "p95_ms": _percentile(durs, 0.95),
+            "bytes": nbytes,
+            "wire_bytes": acc[key]["wire_bytes"],
+            "bytes_per_s": (
+                nbytes / (total_ms / 1e3) if total_ms > 0 else 0.0
+            ),
+        }
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Resource telemetry (ISSUE 5): the controller's tiered time-series store
 # answers "what is the cluster eating" the way summarize_latency answers

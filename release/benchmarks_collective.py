@@ -1,0 +1,215 @@
+"""Collective microbenchmark (release suite, ISSUE 7 acceptance).
+
+Sweeps gradient sizes 64KB→64MB across three gradient-sync paths on a
+REAL local cluster (gang workers over the framework's RPC p2p — the
+CPU twin of the DCN tier; each worker models one 8-device host):
+
+  * ring      — the flat, topology-UNAWARE ring: every local device's
+                partial gradient crosses the DCN tier (the ring carries
+                the concatenation of all 8 per-device partials — the
+                layout a device-level ring imposes on the host link).
+  * hier      — HierarchicalGroup.allreduce_sharded: tier-1 in-jit psum
+                over the 8 local devices collapses the partials ON
+                DEVICE, so the DCN ring carries ONE gradient-sized
+                message per host (8x less cross-host traffic).
+  * quantized — the hier path with CollectiveConfig(quantize="int8"):
+                block-scaled int8 wire + error feedback shrink that one
+                message ~4x further.
+
+The x-axis is the GRADIENT size; throughput is effective sync
+bytes/s = gradient_bytes / wall (best-of-N, slowest rank), so backends
+moving fewer wire bytes for the same logical sync score higher — the
+quantity a trainer step actually waits on. A convergence-parity
+sub-run (the ISSUE 7d gate) checks a deterministic 2-worker SGD run
+under the int8 wire lands on the fp32 loss floor within tolerance.
+
+Prints ONE JSON line with per-size throughputs and the derived gate
+metrics:
+  {"quantized_vs_ring_at_4mb": ..., "hier_vs_ring_min_ratio": ...,
+   "parity_loss_dev": ..., "parity_fp32_loss": ..., ...}
+
+RAY_TPU_RELEASE_SMOKE=1 shrinks sizes/iterations so the suite fits CI.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+SMOKE = os.environ.get("RAY_TPU_RELEASE_SMOKE") == "1"
+LOCAL_DEVICES = 8
+
+# Workers model one 8-device host each; the driver stays tiny.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={LOCAL_DEVICES}"
+    ).strip()
+
+SIZES = (
+    [64 << 10, 1 << 20, 4 << 20]
+    if SMOKE
+    else [64 << 10, 1 << 20, 4 << 20, 16 << 20, 64 << 20]
+)
+BEST_OF = 3 if SMOKE else 5
+WORLD = 2
+
+
+def _bench_fn(ctx, sizes, best_of, mode):
+    """Runs on every gang member; returns {size: best_seconds}."""
+    import numpy as np
+
+    coll = ctx.collective()
+    timings = {}
+    for size in sizes:
+        n = size // 4  # f32 elements making up `size` message bytes
+        shard = n // LOCAL_DEVICES
+        rng = np.random.default_rng(ctx.rank * 1000 + size % 997)
+        partials = [
+            rng.standard_normal(shard).astype(np.float32)
+            for _ in range(LOCAL_DEVICES)
+        ]
+        full = np.concatenate(partials)
+
+        def op():
+            if mode == "hier":
+                # Two-tier: in-jit psum over the local shards, then the
+                # DCN ring carries ONE per-host partial (shard-sized).
+                return coll.allreduce_sharded(partials)
+            # Flat host path: pre-sum locally, allreduce the full vector.
+            return coll.allreduce(full)
+
+        op()  # warm (jit traces, RPC connections, mailboxes)
+        coll.barrier()
+        best = float("inf")
+        for _ in range(best_of):
+            t0 = time.perf_counter()
+            op()
+            best = min(best, time.perf_counter() - t0)
+            coll.barrier()
+        timings[size] = best
+    return timings
+
+
+def _run_backend(backend, config=None):
+    from ray_tpu.util.gang import WorkerGang
+
+    gang = WorkerGang(WORLD, backend=backend, collective_config=config)
+    try:
+        mode = "hier" if backend == "hier" else "ring"
+        per_rank = gang.run(
+            _bench_fn, timeout=1200, sizes=SIZES, best_of=BEST_OF, mode=mode
+        )
+        # The op is collective: wall clock is the slowest rank's.
+        return {
+            size: max(r[size] for r in per_rank) for size in SIZES
+        }
+    finally:
+        gang.shutdown()
+
+
+def _parity_run():
+    """Deterministic 2-worker SGD: int8 wire vs exact wire loss floors."""
+    import tempfile
+
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu.util.collective import CollectiveConfig
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from ray_tpu import train
+        from ray_tpu.train.jax_utils import sync_gradients
+
+        ctx = train.get_context()
+        rng = np.random.default_rng(7)
+        true_w = rng.standard_normal(16).astype(np.float32)
+        x = rng.standard_normal((128, 16)).astype(np.float32)
+        y = x @ true_w
+        xs = x[ctx.get_world_rank() :: ctx.get_world_size()]
+        ys = y[ctx.get_world_rank() :: ctx.get_world_size()]
+        w = jnp.zeros(16)
+
+        def loss_fn(w, x, y):
+            return jnp.mean((x @ w - y) ** 2)
+
+        grad_fn = jax.jit(jax.grad(loss_fn))
+        for _ in range(config["steps"]):
+            grads = sync_gradients(grad_fn(w, xs, ys), ctx.collective_group)
+            w = w - 0.1 * jnp.asarray(grads)
+        train.report({"loss": float(loss_fn(w, x, y))})
+
+    steps = 15 if SMOKE else 40
+    losses = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        for tag, cfg in (
+            ("fp32", None),
+            ("int8", CollectiveConfig(quantize="int8", block_size=64)),
+        ):
+            result = JaxTrainer(
+                loop,
+                train_loop_config={"steps": steps},
+                scaling_config=ScalingConfig(
+                    num_workers=2, collective_config=cfg
+                ),
+                run_config=RunConfig(name=f"parity-{tag}", storage_path=tmp),
+            ).fit()
+            if result.error is not None:
+                raise result.error
+            losses[tag] = result.metrics["loss"]
+    return losses
+
+
+def main() -> None:
+    import ray_tpu
+
+    from ray_tpu.util.collective import CollectiveConfig
+
+    ray_tpu.init(num_cpus=16)
+    try:
+        ring = _run_backend("ring")
+        hier = _run_backend("hier")
+        # The shipped default: hierarchical with the int8 DCN wire.
+        quant = _run_backend(
+            "hier", config=CollectiveConfig(quantize="int8", block_size=256)
+        )
+        losses = _parity_run()
+    finally:
+        ray_tpu.shutdown()
+
+    def bps(timings):
+        return {size: size / t for size, t in timings.items()}
+
+    ring_bps, hier_bps, quant_bps = bps(ring), bps(hier), bps(quant)
+    big = [s for s in SIZES if s >= (4 << 20)]
+    out = {
+        "world_size": WORLD,
+        "local_devices": LOCAL_DEVICES,
+        "sizes": SIZES,
+        "ring_bytes_per_s": {str(s): round(ring_bps[s]) for s in SIZES},
+        "hier_bytes_per_s": {str(s): round(hier_bps[s]) for s in SIZES},
+        "quantized_bytes_per_s": {
+            str(s): round(quant_bps[s]) for s in SIZES
+        },
+        # Gates: quantized must be ≥2x ring at ≥4MB; hier ≥ ring at
+        # every size (tier-1 rides the devices, DCN carries 1/8 bytes).
+        "quantized_vs_ring_at_4mb": min(
+            quant_bps[s] / ring_bps[s] for s in big
+        ),
+        "hier_vs_ring_min_ratio": min(
+            hier_bps[s] / ring_bps[s] for s in SIZES
+        ),
+        "parity_fp32_loss": losses["fp32"],
+        "parity_int8_loss": losses["int8"],
+        "parity_loss_dev": abs(losses["int8"] - losses["fp32"]),
+        "smoke": int(SMOKE),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -96,6 +96,95 @@ def test_send_recv(gang):
     assert results[2] == 123.0
 
 
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+@pytest.mark.parametrize("n_elems", [1, 5])
+def test_uneven_chunks(ray_start_shared, world_size, n_elems):
+    """np.array_split with size < world_size produces EMPTY chunks — the
+    ring collectives must survive 1-element and non-divisible arrays."""
+    g = WorkerGang(world_size, backend="ring")
+    try:
+        def fn(ctx, n):
+            coll = ctx.collective()
+            arr = np.arange(n, dtype=np.float32) + float(ctx.rank)
+            reduced = coll.allreduce(arr)
+            scattered = coll.reducescatter(arr, op="sum")
+            gathered = coll.allgather(arr)
+            return (
+                reduced.tolist(),
+                scattered.tolist(),
+                [p.tolist() for p in gathered],
+            )
+
+        results = g.run(fn, timeout=120, n=n_elems)
+        world = g.num_workers
+        expected = (
+            np.arange(n_elems, dtype=np.float32) * world
+            + sum(range(world))
+        )
+        expected_chunks = np.array_split(expected, world)
+        for rank, (reduced, scattered, gathered) in enumerate(results):
+            assert reduced == expected.tolist()
+            assert scattered == expected_chunks[rank].tolist()
+            assert gathered == [
+                (np.arange(n_elems, dtype=np.float32) + r).tolist()
+                for r in range(world)
+            ]
+    finally:
+        g.shutdown()
+
+
+def test_wire_carries_input_dtype_no_upcast(gang):
+    """Regression for the f64 wire upcast: an f32 allreduce must put ~f32
+    bytes on the wire (2x fewer than the old f64 wire), measured by the
+    group's own serialized-byte counters."""
+    def fn(ctx, n):
+        coll = ctx.collective()
+        coll.wire_stats["bytes_sent"] = 0
+        coll.wire_stats["msgs_sent"] = 0
+        arr = np.ones(n, dtype=np.float32)
+        out = coll.allreduce(arr)
+        assert out.dtype == np.float32
+        return dict(coll.wire_stats)
+
+    n = 30_000
+    results = gang.run(fn, timeout=120, n=n)
+    world = gang.num_workers
+    # Ring allreduce: 2*(N-1) messages of ~n/N elements each per rank.
+    ideal = 2 * (world - 1) * (n // world) * 4
+    for stats in results:
+        assert stats["msgs_sent"] == 2 * (world - 1)
+        # Within pickle-framing overhead of the f32 ideal — an f64 wire
+        # would be ~2x and fail this bound.
+        assert ideal <= stats["bytes_sent"] <= ideal * 1.25
+
+
+def test_hier_backend_delegates_and_forwards_like(ray_start_shared):
+    """backend="hier" without device shards behaves like the ring (host
+    collectives delegate) and recv forwards the unified `like=` param."""
+    g = WorkerGang(2, backend="hier")
+    try:
+        def fn(ctx):
+            coll = ctx.collective()
+            assert coll.backend_name == "hier"
+            total = coll.allreduce(np.array([1.0 + ctx.rank]))
+            if ctx.rank == 0:
+                coll.send(np.array([42.0]), 1)
+                got = None
+            else:
+                # `like` is accepted (and ignored) on host-memory tiers —
+                # the unified BaseGroup signature.
+                got = float(
+                    coll.recv(0, like=np.zeros(1, np.float64))[0]
+                )
+            return float(total[0]), got
+
+        results = g.run(fn, timeout=120)
+        assert results[0][0] == 3.0 and results[1][0] == 3.0
+        assert results[1][1] == 42.0
+    finally:
+        g.shutdown()
+
+
 def test_gang_member_death_raises(ray_start_shared):
     doomed = WorkerGang(2, backend="ring")
 

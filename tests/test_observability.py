@@ -406,3 +406,44 @@ def test_chrome_trace_export(traced_cluster):
     import json as _json
 
     _json.dumps(trace)
+
+
+def test_collective_spans_from_direct_group_calls(traced_cluster):
+    """Regression: trainers call the GROUP object directly (ctx.collective(),
+    sync_gradients), not the module-level wrappers — those calls must still
+    produce collective.* spans with op/backend/bytes/wire_bytes, exactly ONE
+    span per user-visible op (the hier->DCN-ring nesting must not double-
+    record), and summarize_comm() must break them out."""
+    import numpy as np
+    from ray_tpu.util.gang import WorkerGang
+    from ray_tpu.util.state import summarize_comm
+
+    g = WorkerGang(2, backend="hier")
+    try:
+        def fn(ctx):
+            import time as _time
+
+            coll = ctx.collective()
+            coll.allreduce(np.ones(1000, np.float32))
+            _time.sleep(0.4)  # outlive one flusher tick: span hits disk
+            return "ok"
+
+        assert g.run(fn, timeout=120) == ["ok", "ok"]
+    finally:
+        g.shutdown()
+
+    deadline = time.monotonic() + 30
+    comm = {}
+    while time.monotonic() < deadline:
+        comm = summarize_comm(traced_cluster)
+        if "allreduce/hier" in comm:
+            break
+        time.sleep(0.5)
+    entry = comm.get("allreduce/hier")
+    assert entry, f"no allreduce/hier entry in {sorted(comm)}"
+    # One span per rank — the inner DCN ring must NOT add allreduce/ring.
+    assert entry["count"] == 2
+    assert "allreduce/ring" not in comm
+    assert entry["bytes"] == 2 * 4000  # 1000 f32 per rank
+    assert entry["wire_bytes"] > 0  # DCN tier's serialized bytes attributed
+    assert entry["total_ms"] >= 0

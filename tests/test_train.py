@@ -212,3 +212,88 @@ def test_trainer_jax_dp(ray_start_shared, tmp_path):
     assert result.error is None
     assert result.metrics["loss"] < 1.0
     assert len(result.metrics_history) == 3
+
+
+def test_trainer_default_backend_is_hierarchical(ray_start_shared, tmp_path):
+    """Acceptance (ISSUE 7b): a ring-backend gang whose workers see >1
+    local device auto-upgrades to the hierarchical group with NO user
+    code changes, and Result.metrics records the selected backend."""
+    trainer = JaxTrainer(
+        _allreduce_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="autohier", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    # conftest pins 8 virtual devices per process → hier is the default.
+    assert result.metrics["collective_backend"] == "hier"
+    assert result.metrics["g0"] == pytest.approx(1.5)
+
+
+def test_trainer_backend_auto_hier_kill_switch(
+    ray_start_shared, tmp_path, monkeypatch
+):
+    monkeypatch.setenv("RAY_TPU_COLLECTIVE_AUTO_HIER", "0")
+    trainer = JaxTrainer(
+        _allreduce_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="nohier", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["collective_backend"] == "ring"
+
+
+def _sgd_loop(config):
+    """Deterministic little linear-regression run whose loss trajectory
+    the convergence-parity test compares across wire configs."""
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.train.jax_utils import sync_gradients
+
+    ctx = train.get_context()
+    rng = np.random.default_rng(7)
+    true_w = rng.standard_normal(8).astype(np.float32)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    y = x @ true_w
+    # Per-rank batch split (deterministic).
+    xs = x[ctx.get_world_rank() :: ctx.get_world_size()]
+    ys = y[ctx.get_world_rank() :: ctx.get_world_size()]
+    w = jnp.zeros(8)
+
+    def loss_fn(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    grad_fn = jax.jit(jax.grad(loss_fn))
+    for _ in range(config["steps"]):
+        grads = sync_gradients(grad_fn(w, xs, ys), ctx.collective_group)
+        w = w - 0.1 * jnp.asarray(grads)
+        train.report({"loss": float(loss_fn(w, x, y))})
+
+
+def test_convergence_parity_quantized_vs_fp32(ray_start_shared, tmp_path):
+    """Acceptance (ISSUE 7d): with error feedback on, the int8-wire run
+    reaches the same loss floor as the exact-wire run within tolerance."""
+    from ray_tpu.util.collective import CollectiveConfig
+
+    def run(tag, collective_config):
+        trainer = JaxTrainer(
+            _sgd_loop,
+            train_loop_config={"steps": 20},
+            scaling_config=ScalingConfig(
+                num_workers=2, collective_config=collective_config
+            ),
+            run_config=RunConfig(name=tag, storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        return [m["loss"] for m in result.metrics_history]
+
+    fp32 = run("parity-fp32", None)
+    quant = run(
+        "parity-int8", CollectiveConfig(quantize="int8", block_size=64)
+    )
+    assert fp32[-1] < 0.05  # the run itself converges
+    # Same floor within tolerance, and no trajectory blow-up mid-run.
+    assert abs(quant[-1] - fp32[-1]) <= max(0.02, fp32[-1] * 0.5)
+    assert max(quant) <= max(fp32) * 1.5 + 0.05
