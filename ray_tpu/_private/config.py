@@ -163,6 +163,15 @@ class RayTpuConfig:
     telemetry_raw_capacity: int = _env("telemetry_raw_capacity", 360)
     telemetry_10s_capacity: int = _env("telemetry_10s_capacity", 360)
     telemetry_60s_capacity: int = _env("telemetry_60s_capacity", 1440)
+    # --- workload flight recorder (ISSUE 8) ---
+    # Per-step StepStats on train workers (phase breakdown, tokens/FLOPs)
+    # + driver-side goodput accounting + serve route histograms. The
+    # disabled path is a single attribute check per report/request.
+    workload_stats_enabled: bool = _env("workload_stats_enabled", True)
+    # Straggler detector: flag ranks persistently > k*MAD above the gang
+    # median step time.
+    straggler_mad_k: float = _env("straggler_mad_k", 3.0)
+
     # Trend-aware OOM early warning: emit an ``oom_risk`` event when a
     # worker's RSS slope projects past the kill limit within this horizon
     # (seconds). 0 disables projection.

@@ -1688,6 +1688,35 @@ class Controller:
             payload.get("node_id", ""), payload.get("tier")
         )
 
+    # ------------------------------------------------------------------
+    # workload flight recorder (ISSUE 8)
+    # ------------------------------------------------------------------
+    async def rpc_workload_ingest(self, conn, payload) -> dict:
+        """Batched flight-recorder samples from a train driver or serve
+        proxy: ``{"series": [{"key": ..., "samples": [...]}, ...]}``. The
+        store's monotonic guard makes re-delivery (chaos dup/replay, or a
+        driver retrying a push) idempotent."""
+        ingested = 0
+        for entry in payload.get("series", []) or []:
+            if not isinstance(entry, dict):
+                continue
+            samples = entry.get("samples", [])
+            if not isinstance(samples, list):
+                continue
+            ingested += self.telemetry.add_workload_many(
+                entry.get("key", ""), samples
+            )
+        self.stats_counters["workload_ingests"] += 1
+        return {"status": "ok", "ingested": ingested}
+
+    async def rpc_workload_summary(self, conn, payload) -> dict:
+        return self.telemetry.workload_summary()
+
+    async def rpc_workload_timeline(self, conn, payload) -> dict:
+        return self.telemetry.workload_timeline(
+            payload.get("key", ""), payload.get("tier")
+        )
+
     async def rpc_report_oom_risk(self, conn, payload) -> dict:
         """Trend-aware OOM early warning from a node agent: count it (the
         metric) and export/publish it (the structured event) so dashboards

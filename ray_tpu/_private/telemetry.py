@@ -152,12 +152,21 @@ class TelemetryStore:
         cap_10s: int = 360,
         cap_60s: int = 1440,
         max_nodes: int = 1024,
+        max_workload_series: int = 4096,
     ):
         self._caps = {"raw": raw_capacity, "10s": cap_10s, "60s": cap_60s}
         self._max_nodes = max_nodes
         self._nodes: dict[str, _NodeSeries] = {}
         self.total_ingested = 0
         self.total_dropped = 0
+        # Workload flight-recorder series (ISSUE 8): same tiered rings +
+        # monotonic guard, keyed by series name instead of node id
+        # ("train/<exp>", "train/<exp>/rank<k>", "train/<exp>/goodput",
+        # "serve/<route>").
+        self._max_workload_series = max_workload_series
+        self._workloads: dict[str, _NodeSeries] = {}
+        self.workload_ingested = 0
+        self.workload_dropped = 0
 
     def add(self, node_id: str, sample: dict[str, Any]) -> bool:
         series = self._nodes.get(node_id)
@@ -203,16 +212,78 @@ class TelemetryStore:
             "total_dropped": self.total_dropped,
         }
 
+    # -- workload series (ISSUE 8) --------------------------------------
+    def add_workload(self, key: str, sample: dict[str, Any]) -> bool:
+        """One flight-recorder sample for series ``key``. Same chaos
+        rules as node samples: the ts monotonic guard drops duplicated or
+        replayed batches, so a re-delivered round can never double-count
+        a step."""
+        if not isinstance(key, str) or not key or not isinstance(sample, dict):
+            self.workload_dropped += 1
+            return False
+        series = self._workloads.get(key)
+        if series is None:
+            if len(self._workloads) >= self._max_workload_series:
+                self.workload_dropped += 1
+                return False
+            series = self._workloads[key] = _NodeSeries(self._caps)
+        ok = series.add(sample)
+        if ok:
+            self.workload_ingested += 1
+        else:
+            self.workload_dropped += 1
+        return ok
+
+    def add_workload_many(
+        self, key: str, samples: Iterable[dict[str, Any]]
+    ) -> int:
+        return sum(1 for s in samples if self.add_workload(key, s))
+
+    def workload_keys(self) -> list[str]:
+        return sorted(self._workloads)
+
+    def workload_timeline(
+        self, key: str, tier: str | None = None
+    ) -> dict[str, list]:
+        series = self._workloads.get(key)
+        return series.timeline(tier) if series else {}
+
+    def workload_summary(self) -> dict[str, Any]:
+        """Per-series latest sample + tier depths — behind
+        ``util.state.summarize_workload()`` and ``/api/workload``."""
+        series_out: dict[str, Any] = {}
+        for key, series in self._workloads.items():
+            series_out[key] = {
+                "latest": series.latest(),
+                "points": {name: len(ring) for name, ring in series.rings.items()},
+                "last_ts": series.last_ts,
+                "dropped": series.dropped,
+            }
+        return {
+            "series": series_out,
+            "total_ingested": self.workload_ingested,
+            "total_dropped": self.workload_dropped,
+        }
+
     def stats(self) -> dict[str, int]:
         """Bound-check counters for controller_stats / tests."""
         points = sum(
             len(ring) for s in self._nodes.values() for ring in s.rings.values()
+        )
+        workload_points = sum(
+            len(ring)
+            for s in self._workloads.values()
+            for ring in s.rings.values()
         )
         return {
             "telemetry_nodes": len(self._nodes),
             "telemetry_points": points,
             "telemetry_ingested": self.total_ingested,
             "telemetry_dropped": self.total_dropped,
+            "workload_series": len(self._workloads),
+            "workload_points": workload_points,
+            "workload_ingested": self.workload_ingested,
+            "workload_dropped": self.workload_dropped,
         }
 
 

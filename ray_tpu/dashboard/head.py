@@ -43,6 +43,7 @@ _INDEX_HTML = """<!doctype html>
  <a href="#" onclick="view='serveView';refresh();return false">serve</a>
  <a href="#" onclick="view='workers';refresh();return false">workers</a>
  <a href="#" onclick="view='resources';refresh();return false">resources</a>
+ <a href="#" onclick="view='workload';refresh();return false">workload</a>
  <a href="#" onclick="view='logs';refresh();return false">logs</a>
  <a href="#" onclick="view='autoscaler';refresh();return false">autoscaler</a>
  <a href="#" onclick="view='events';refresh();return false">events</a>
@@ -174,6 +175,49 @@ async function resources() {
   }
   return html;
 }
+async function workload() {
+  const s = await fetch('/api/workload').then(r => r.json());
+  const keys = Object.keys(s.series ?? {});
+  let html = '<h2>Workload flight recorder</h2><div class="muted">' +
+    `ingested ${esc(s.total_ingested ?? 0)} samples · ` +
+    `dropped ${esc(s.total_dropped ?? 0)}</div>`;
+  if (!keys.length) return html + '<div class="muted">no workload series yet ' +
+    '(train a model or send serve traffic)</div>';
+  const pct = v => (typeof v === 'number' ? (100 * v).toFixed(1) + '%' : '');
+  for (const key of keys.sort()) {
+    const entry = s.series[key], latest = entry.latest ?? {};
+    const tl = await fetch('/api/workload?key=' +
+      encodeURIComponent(key) + '&tier=raw').then(r => r.json());
+    const pts = tl.raw ?? [];
+    html += `<h2><code>${esc(key)}</code></h2>`;
+    let rows;
+    if (key.endsWith('/goodput')) {
+      rows = [
+        ['goodput', pct(latest.goodput_fraction), spark(pts, 'goodput_fraction')],
+        ['wall s', esc((latest.wall_s ?? 0).toFixed?.(1) ?? ''), spark(pts, 'wall_s')],
+        ['checkpoint s', esc((latest.checkpoint_s ?? 0).toFixed?.(1) ?? ''), spark(pts, 'checkpoint_s')],
+        ['restart s', esc((latest.restart_s ?? 0).toFixed?.(1) ?? ''), spark(pts, 'restart_s')],
+      ];
+    } else if (key.startsWith('serve/')) {
+      rows = [
+        ['p50 ms', esc((latest.p50_ms ?? 0).toFixed?.(1) ?? ''), spark(pts, 'p50_ms')],
+        ['p99 ms', esc((latest.p99_ms ?? 0).toFixed?.(1) ?? ''), spark(pts, 'p99_ms')],
+        ['qps', esc((latest.qps ?? 0).toFixed?.(1) ?? ''), spark(pts, 'qps')],
+        ['errors', esc(latest.errors ?? 0), spark(pts, 'errors')],
+      ];
+    } else {
+      rows = [
+        ['tokens/s', esc((latest.tokens_per_s ?? 0).toFixed?.(0) ?? ''), spark(pts, 'tokens_per_s')],
+        ['MFU', pct(latest.mfu), spark(pts, 'mfu')],
+        ['data-wait', pct(latest.data_wait_frac), spark(pts, 'data_wait_frac')],
+        ['collective', pct(latest.collective_frac), spark(pts, 'collective_frac')],
+        ['steps', esc(latest.steps ?? 0), spark(pts, 'steps')],
+      ];
+    }
+    html += table(['metric', 'now', 'raw history'], rows);
+  }
+  return html;
+}
 async function workers() {
   const rows = await fetch('/api/workers').then(r => r.json());
   return '<h2>Workers</h2>' + table(['worker', 'node', 'pid/state'],
@@ -205,7 +249,7 @@ async function autoscaler() {
 }
 async function refresh() {
   const render = {overview, tasks, jobs, serveView, workers, resources,
-                  logs, events, autoscaler}[view];
+                  workload, logs, events, autoscaler}[view];
   try { document.getElementById('content').innerHTML = await render(); }
   catch (err) { document.getElementById('content').innerHTML = 'error: ' + esc(err); }
 }
@@ -256,6 +300,7 @@ class DashboardHead:
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/resources", self._resources)
         app.router.add_get("/api/timeseries", self._timeseries)
+        app.router.add_get("/api/workload", self._workload)
         app.router.add_get("/api/tracing", self._tracing)
         app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/stacks", self._stacks)
@@ -407,19 +452,59 @@ class DashboardHead:
             dumps=_dumps,
         )
 
+    _TIERS = ("raw", "10s", "60s")
+
     async def _timeseries(self, request):
         """GET ?node_id=...[&tier=raw|10s|60s] — one node's resource
-        time-series from the controller's tiered ring-buffer store."""
+        time-series from the controller's tiered ring-buffer store.
+        Unknown node or tier is a 404 with a JSON error body, not an
+        unhandled 500 (ISSUE 8 satellite)."""
         from aiohttp import web
 
         node_id = request.query.get("node_id", "")
         tier = request.query.get("tier") or None
-        return web.json_response(
-            await asyncio.to_thread(
-                state_mod.get_node_timeline, node_id, tier
-            ),
-            dumps=_dumps,
+        if tier is not None and tier not in self._TIERS:
+            return web.json_response(
+                {"error": f"unknown tier {tier!r}",
+                 "tiers": list(self._TIERS)},
+                status=404,
+            )
+        timeline = await asyncio.to_thread(
+            state_mod.get_node_timeline, node_id, tier
         )
+        if not timeline:
+            return web.json_response(
+                {"error": f"unknown node_id {node_id!r}"}, status=404
+            )
+        return web.json_response(timeline, dumps=_dumps)
+
+    async def _workload(self, request):
+        """Workload flight recorder (ISSUE 8). No params: summary of all
+        series. ?key=train/<exp>[&tier=...]: one series' timeline.
+        Unknown key/tier → 404 JSON error body."""
+        from aiohttp import web
+
+        key = request.query.get("key")
+        tier = request.query.get("tier") or None
+        if tier is not None and tier not in self._TIERS:
+            return web.json_response(
+                {"error": f"unknown tier {tier!r}",
+                 "tiers": list(self._TIERS)},
+                status=404,
+            )
+        if key is None:
+            return web.json_response(
+                await asyncio.to_thread(state_mod.summarize_workload),
+                dumps=_dumps,
+            )
+        timeline = await asyncio.to_thread(
+            state_mod.get_workload_timeline, key, tier
+        )
+        if not timeline:
+            return web.json_response(
+                {"error": f"unknown workload series {key!r}"}, status=404
+            )
+        return web.json_response(timeline, dumps=_dumps)
 
     async def _metrics(self, request):
         from aiohttp import web

@@ -67,6 +67,14 @@ class DataIterator:
         self._pass_rows = 0
         self._pass_active = False
 
+    @property
+    def fetch_wait_s(self) -> float:
+        """Cumulative seconds the consumer spent blocked on producers —
+        the flight recorder's data-wait clock (ISSUE 8): each
+        ``train.report()`` interval attributes the delta to the step's
+        ``data_wait_s`` phase."""
+        return self._fetch_wait_s
+
     # -- resumable-ingest state ----------------------------------------
     @property
     def supports_state(self) -> bool:

@@ -171,6 +171,19 @@ def cmd_top(args) -> None:
     _connect(args)
     from ray_tpu.util import state
 
+    if args.json:
+        # One machine-readable shot (ISSUE 8 satellite): the raw
+        # summaries scripts would otherwise scrape from the rendered
+        # frame.
+        print(json.dumps(
+            {
+                "resources": state.summarize_resources(),
+                "workload": state.summarize_workload(),
+                "goodput": state.summarize_goodput(),
+            },
+            indent=2, default=str,
+        ))
+        return
     while True:
         frame = _render_top(state.summarize_resources())
         if args.once:
@@ -183,6 +196,26 @@ def cmd_top(args) -> None:
             time.sleep(args.interval)
         except KeyboardInterrupt:
             return
+
+
+def cmd_diagnose(args) -> None:
+    """`ray_tpu diagnose` — ranked findings over every observability
+    surface (ISSUE 8): training phase balance (data/comm/checkpoint
+    bound), stragglers cross-referenced with node telemetry, elastic-run
+    goodput, serve SLOs, and node hot spots."""
+    _connect(args)
+    from ray_tpu._private import workload as workload_mod
+    from ray_tpu.util import state
+
+    snapshot = state.collect_diagnose_snapshot()
+    findings = workload_mod.diagnose(snapshot)
+    if args.json:
+        print(json.dumps({"findings": findings}, indent=2, default=str))
+        return
+    tags = {"crit": "CRIT", "warn": "WARN", "info": "info"}
+    print(f"ray_tpu diagnose — {len(findings)} finding(s)")
+    for f in findings:
+        print(f"  [{tags.get(f['severity'], '????'):<4}] {f['message']}")
 
 
 def cmd_timeline(args) -> None:
@@ -284,8 +317,20 @@ def main(argv=None) -> None:
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--once", action="store_true",
                    help="print one frame and exit (no screen clearing)")
+    p.add_argument("--json", action="store_true",
+                   help="print one machine-readable snapshot "
+                        "(resources + workload + goodput) and exit")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "diagnose",
+        help="ranked findings: phase balance, stragglers, goodput, "
+             "serve SLOs",
+    )
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_diagnose)
 
     p = sub.add_parser("timeline")
     p.add_argument("--output", default="timeline.json")

@@ -18,6 +18,7 @@ import threading
 from typing import Any
 
 import ray_tpu
+from ray_tpu._private.workload import LatencyHistogram
 from ray_tpu.serve._private.common import CONTROLLER_NAME
 from ray_tpu.serve._private.routing import RoutingMixin
 from ray_tpu.util import tracing
@@ -27,6 +28,9 @@ class HTTPProxy(RoutingMixin):
     """Runs inside a ray_tpu actor; owns an aiohttp server on `port`."""
 
     ROUTE_REFRESH_S = 1.0
+    # Flight-recorder snapshots (p50/p95/p99 per route) ride to the
+    # controller workload store at most this often (ISSUE 8).
+    STATS_FLUSH_S = 2.0
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self.host = host
@@ -35,6 +39,14 @@ class HTTPProxy(RoutingMixin):
         self._handles: dict[str, Any] = {}
         self._last_refresh = 0.0
         self._num_requests = 0
+        # Per-route SLO accounting (ISSUE 8): bounded log-spaced
+        # histograms + error counts, flushed as serve/<route> workload
+        # series and recorded into the Prometheus pipeline per request.
+        self._route_hist: dict[str, LatencyHistogram] = {}
+        self._route_errors: dict[str, int] = {}
+        self._route_flushed_count: dict[str, int] = {}
+        self._last_stats_flush = time.monotonic()
+        self._stats_lock = threading.Lock()
         self._started = threading.Event()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread = threading.Thread(target=self._serve_forever, daemon=True)
@@ -102,6 +114,7 @@ class HTTPProxy(RoutingMixin):
             if tracing.enabled()
             else contextlib.nullcontext()
         )
+        req_t0 = time.perf_counter()
         try:
             # to_thread copies the contextvars context, so the handle's
             # dispatch sees this span as the current trace parent.
@@ -110,7 +123,19 @@ class HTTPProxy(RoutingMixin):
                     self._call_deployment, app_name, dep_name, body
                 )
         except Exception as exc:
+            self._observe_route(
+                qualified, time.perf_counter() - req_t0, error=True
+            )
             return web.Response(status=500, text=f"{type(exc).__name__}: {exc}")
+        # For streams this is time-to-first-dispatch, not full-body time:
+        # a token stream's lifetime measures the client's read speed, not
+        # the serving SLO.
+        self._observe_route(qualified, time.perf_counter() - req_t0, error=False)
+        if time.monotonic() - self._last_stats_flush >= self.STATS_FLUSH_S:
+            self._last_stats_flush = time.monotonic()
+            asyncio.get_running_loop().create_task(
+                asyncio.to_thread(self._flush_route_stats)
+            )
         from ray_tpu.serve.handle import ResponseStream
 
         if isinstance(result, ResponseStream):
@@ -172,6 +197,83 @@ class HTTPProxy(RoutingMixin):
     def _call_deployment(self, app_name: str, dep_name: str, body: Any) -> Any:
         handle = self._handle_for(f"{app_name}_{dep_name}")
         return handle.remote(body).result(timeout=120)
+
+    # -- SLO accounting (ISSUE 8) ---------------------------------------
+    def _observe_route(self, route: str, seconds: float, error: bool) -> None:
+        with self._stats_lock:
+            hist = self._route_hist.get(route)
+            if hist is None:
+                hist = self._route_hist[route] = LatencyHistogram()
+                self._route_last_flush_wall = getattr(
+                    self, "_route_last_flush_wall", time.monotonic()
+                )
+            hist.observe(seconds)
+            if error:
+                self._route_errors[route] = (
+                    self._route_errors.get(route, 0) + 1
+                )
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+
+            metrics_mod.record_serve_request(
+                route, seconds, "500" if error else "200"
+            )
+        except Exception:
+            pass
+
+    def get_route_stats(self) -> dict:
+        """Per-route SLO snapshot: {route: {count, p50_ms, p95_ms,
+        p99_ms, mean_ms, max_ms, errors}}."""
+        with self._stats_lock:
+            out = {}
+            for route, hist in self._route_hist.items():
+                snap = hist.snapshot()
+                snap["errors"] = self._route_errors.get(route, 0)
+                out[route] = snap
+            return out
+
+    def _flush_route_stats(self) -> None:
+        """Push one serve/<route> workload sample per route to the
+        controller flight-recorder store (best-effort: a flush lost to a
+        controller blip only delays the next snapshot)."""
+        now_wall = time.monotonic()
+        last = getattr(self, "_route_last_flush_wall", now_wall)
+        interval = max(now_wall - last, 1e-9)
+        self._route_last_flush_wall = now_wall
+        series = []
+        ts = time.time()
+        with self._stats_lock:
+            for route, hist in self._route_hist.items():
+                snap = hist.snapshot()
+                prev = self._route_flushed_count.get(route, 0)
+                self._route_flushed_count[route] = snap["count"]
+                sample = {
+                    "ts": ts,
+                    "count": snap["count"],
+                    "qps": (snap["count"] - prev) / interval,
+                    "p50_ms": snap["p50_ms"],
+                    "p95_ms": snap["p95_ms"],
+                    "p99_ms": snap["p99_ms"],
+                    "mean_ms": snap["mean_ms"],
+                    "max_ms": snap["max_ms"],
+                    "errors": self._route_errors.get(route, 0),
+                }
+                series.append(
+                    {"key": f"serve/{route}", "samples": [sample]}
+                )
+        if not series:
+            return
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            ctx = worker_mod.get_global_context()
+            ctx.io.run(
+                ctx.controller.call(
+                    "workload_ingest", {"series": series}, timeout=5.0
+                )
+            )
+        except Exception:
+            pass
 
     # -- control --------------------------------------------------------
     def ready(self) -> str:

@@ -16,6 +16,7 @@ import inspect
 import time
 from typing import Any
 
+from ray_tpu._private.workload import LatencyHistogram
 from ray_tpu.util import tracing
 
 _request_context: contextvars.ContextVar = contextvars.ContextVar(
@@ -47,7 +48,10 @@ class Replica:
         self.version = version
         self._ongoing = 0
         self._total = 0
-        self._latencies: list[float] = []
+        # Bounded log-spaced histogram (ISSUE 8) instead of a raw latency
+        # list: O(1) memory for any request volume, p50/p95/p99 over the
+        # replica's WHOLE life rather than the last 200 samples.
+        self._latency_hist = LatencyHistogram()
         self._streams: dict[str, tuple] = {}
         self._stream_counter = 0
         # Shape keys served here (explicit request shape_keys); unioned
@@ -120,9 +124,7 @@ class Replica:
         finally:
             _request_context.reset(token)
             self._ongoing -= 1
-            self._latencies.append(time.perf_counter() - start)
-            if len(self._latencies) > 1000:
-                del self._latencies[:500]
+            self._latency_hist.observe(time.perf_counter() - start)
 
     # -- streaming ------------------------------------------------------
     STREAM_IDLE_TTL_S = 120.0
@@ -232,20 +234,50 @@ class Replica:
         return "ok"
 
     def get_metrics(self) -> dict:
-        lat = sorted(self._latencies[-200:])
         from ray_tpu._private.worker_proc import _peak_rss_bytes
+        from ray_tpu.serve import batching
 
-        return {
+        lat = self._latency_hist.snapshot()
+        batch_stats = batching.queue_stats()
+        out = {
             "replica_id": self.replica_id,
             "ongoing": self._ongoing,
             "total": self._total,
-            "p50_ms": 1e3 * lat[len(lat) // 2] if lat else 0.0,
-            "p99_ms": 1e3 * lat[int(len(lat) * 0.99)] if lat else 0.0,
+            "p50_ms": lat["p50_ms"],
+            "p95_ms": lat["p95_ms"],
+            "p99_ms": lat["p99_ms"],
+            # Batching occupancy (ISSUE 8): how full the padded TPU
+            # batches actually are, plus requests parked waiting for a
+            # flush.
+            "queue_depth": batch_stats["queue_depth"],
+            "batch_occupancy": batch_stats["batch_occupancy"],
+            "avg_batch_occupancy": batch_stats["avg_occupancy"],
             # Resource telemetry (ISSUE 5): replica memory footprint so
             # autoscaling/status surfaces see per-replica RSS alongside
             # latency.
             "rss_bytes": _peak_rss_bytes(),
         }
+        # Push the occupancy gauges on the controller's metric-poll tick:
+        # the poll cadence IS the gauge cadence, no extra timer needed.
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+
+            metrics_mod.set_serve_replica_gauge(
+                "ongoing_requests", self.deployment_name, self.replica_id,
+                self._ongoing,
+            )
+            metrics_mod.set_serve_replica_gauge(
+                "queue_depth", self.deployment_name, self.replica_id,
+                batch_stats["queue_depth"],
+            )
+            if batch_stats["batch_occupancy"] is not None:
+                metrics_mod.set_serve_replica_gauge(
+                    "batch_occupancy", self.deployment_name,
+                    self.replica_id, batch_stats["batch_occupancy"],
+                )
+        except Exception:
+            pass
+        return out
 
     def get_num_ongoing(self) -> int:
         return self._ongoing

@@ -15,6 +15,7 @@ import asyncio
 import functools
 import inspect
 import threading
+import weakref
 from typing import Any, Callable, Optional, Sequence
 
 # Shape keys this PROCESS has compiled for (one replica per process):
@@ -22,6 +23,45 @@ from typing import Any, Callable, Optional, Sequence
 # warm-shape report for compile-cache-aware routing (SURVEY §3.4).
 _WARM_SHAPES: set[str] = set()
 _WARM_LOCK = threading.Lock()
+
+# Live batch queues in this process (ISSUE 8): weak refs so a replica
+# teardown doesn't leak queues, read by queue_stats() for the replica's
+# occupancy/queue-depth gauges.
+_QUEUES: "weakref.WeakSet[_BatchQueue]" = weakref.WeakSet()
+
+
+def queue_stats() -> dict:
+    """Aggregate batching stats across this process's live queues.
+
+    ``queue_depth`` is the number of requests waiting for a flush right
+    now; ``batch_occupancy`` is real/padded items of the last flushed
+    batch (1.0 when bucket padding is off), ``avg_occupancy`` the
+    lifetime ratio. A padded TPU batch at 0.3 occupancy means 70% of the
+    XLA step fed duplicated filler — the serve-side analogue of a
+    data-bound train step."""
+    depth = 0
+    batches = 0
+    real = 0
+    padded = 0
+    last_occ = None
+    for queue in list(_QUEUES):
+        depth += len(queue.queue)
+        batches += queue.batches
+        real += queue.items_real
+        padded += queue.items_padded
+        if queue.last_occupancy is not None:
+            last_occ = (
+                queue.last_occupancy if last_occ is None
+                else min(last_occ, queue.last_occupancy)
+            )
+    return {
+        "queue_depth": depth,
+        "batches": batches,
+        "items_real": real,
+        "items_padded": padded,
+        "avg_occupancy": (real / padded) if padded else None,
+        "batch_occupancy": last_occ,
+    }
 
 
 def note_warm_shape(key: str) -> None:
@@ -54,6 +94,12 @@ class _BatchQueue:
         self.queue: list[tuple[Any, asyncio.Future]] = []
         self._flusher: asyncio.Task | None = None
         self._lock = asyncio.Lock()
+        # Flight-recorder counters (ISSUE 8): read by queue_stats().
+        self.batches = 0
+        self.items_real = 0
+        self.items_padded = 0
+        self.last_occupancy: float | None = None
+        _QUEUES.add(self)
 
     def _pad(self, items: list) -> tuple[list, int]:
         real = len(items)
@@ -95,6 +141,10 @@ class _BatchQueue:
         items = [item for item, _ in batch]
         futures = [future for _, future in batch]
         padded, real = self._pad(items)
+        self.batches += 1
+        self.items_real += real
+        self.items_padded += len(padded)
+        self.last_occupancy = real / len(padded) if padded else None
         try:
             result = self.fn(padded)
             if inspect.iscoroutine(result):

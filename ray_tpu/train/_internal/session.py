@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train._internal import step_stats as step_stats_mod
 
 
 @dataclass
@@ -61,6 +62,15 @@ class _Session:
         self._consumed.set()
         self.error: Exception | None = None
         self.finished = threading.Event()
+        # Workload flight recorder (ISSUE 8): one StepStats record per
+        # report. Off → None, and the phase accumulator stays inactive.
+        self._recorder = (
+            step_stats_mod.StepRecorder(ctx)
+            if step_stats_mod.enabled()
+            else None
+        )
+        if self._recorder is not None:
+            step_stats_mod.activate()
         self._thread = threading.Thread(
             target=self._run, args=(fn,), daemon=True
         )
@@ -91,6 +101,14 @@ class _Session:
                     ingest[name] = shard.state_dict()
                 except Exception:
                     pass
+        # Cut the StepStats record BEFORE blocking on the driver: the
+        # step interval must cover the user's work, not the driver's
+        # poll latency (which would smear data/compute attribution).
+        step_stats = (
+            self._recorder.on_report(metrics)
+            if self._recorder is not None
+            else None
+        )
         self._consumed.wait()
         self._consumed.clear()
         self._results.put(
@@ -98,6 +116,7 @@ class _Session:
                 "metrics": dict(metrics),
                 "checkpoint": checkpoint,
                 "ingest": ingest or None,
+                "step_stats": step_stats,
             }
         )
 
@@ -144,4 +163,5 @@ def in_session() -> bool:
 
 def shutdown_session() -> None:
     global _session
+    step_stats_mod.deactivate()
     _session = None

@@ -1,0 +1,325 @@
+"""StepStats recording — worker and driver halves of the flight recorder.
+
+Worker half (runs inside each train worker process):
+  * a per-process phase accumulator — the collective layer and the
+    checkpoint writers call :func:`record_phase` with measured wall time;
+    ``activate()``/``deactivate()`` gate it so a non-train worker pays a
+    single bool check.
+  * :class:`StepRecorder` — the session calls ``on_report()`` once per
+    ``train.report()``; it cuts one StepStats record covering the
+    interval since the previous report: wall time, data-wait (delta of
+    the dataset iterators' fetch-wait clocks), collective + checkpoint
+    time (drained from the accumulator), compute as the remainder, plus
+    tokens/FLOPs when the user's metrics carry them (keys ``tokens`` and
+    ``flops``, per rank per step).
+
+Driver half:
+  * :class:`FlightRecorder` — one per ``fit()``. Ingests every rank's
+    records each poll round into the
+    :class:`~ray_tpu._private.workload.StepStatsAggregator`, pushes
+    batched samples to the controller workload store (ONE throttled RPC,
+    never per-record), and owns the goodput wall-clock buckets
+    (checkpoint / restart / stalled; productive is the remainder, so the
+    buckets always sum to wall).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+_PUSH_INTERVAL_S = 1.0
+_MAX_PENDING = 4096  # per-series driver-side buffer bound
+
+
+def enabled() -> bool:
+    try:
+        from ray_tpu._private.config import global_config
+
+        return bool(global_config().workload_stats_enabled)
+    except Exception:
+        return True
+
+
+# -- worker-side phase accumulator --------------------------------------
+_phase_lock = threading.Lock()
+_phase_acc: dict[str, float] = {}
+_active = False
+
+
+def activate() -> None:
+    global _active
+    with _phase_lock:
+        _phase_acc.clear()
+    _active = True
+
+
+def deactivate() -> None:
+    global _active
+    _active = False
+    with _phase_lock:
+        _phase_acc.clear()
+
+
+def record_phase(phase: str, seconds: float) -> None:
+    """Attribute ``seconds`` of the current step to ``phase``. Hot-path
+    safe: outside an active train session this is one bool check."""
+    if not _active:
+        return
+    if seconds <= 0:
+        return
+    with _phase_lock:
+        _phase_acc[phase] = _phase_acc.get(phase, 0.0) + float(seconds)
+
+
+def _drain_phases() -> dict[str, float]:
+    with _phase_lock:
+        out = dict(_phase_acc)
+        _phase_acc.clear()
+    return out
+
+
+def _device_info() -> tuple[str, int]:
+    """(device_kind, local device count) — probed from jax only when the
+    worker already imported it (never force a jax init for telemetry)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "", 1
+    try:
+        devices = jax.local_devices()
+        return devices[0].device_kind, len(devices)
+    except Exception:
+        return "", 1
+
+
+class StepRecorder:
+    """Cuts one StepStats record per ``train.report()`` on a worker."""
+
+    def __init__(self, ctx: Any):
+        self.ctx = ctx
+        self.step = -1
+        self._last = time.perf_counter()
+        self._last_wait = 0.0
+        self._device_kind: str | None = None
+        self._devices = 1
+
+    def _data_wait_total(self) -> float:
+        total = 0.0
+        for shard in (self.ctx.dataset_shards or {}).values():
+            wait = getattr(shard, "fetch_wait_s", None)
+            if isinstance(wait, (int, float)):
+                total += float(wait)
+        return total
+
+    def on_report(self, metrics: dict) -> dict:
+        now = time.perf_counter()
+        wall = max(0.0, now - self._last)
+        self._last = now
+        wait_total = self._data_wait_total()
+        data_wait = min(wall, max(0.0, wait_total - self._last_wait))
+        self._last_wait = wait_total
+        phases = _drain_phases()
+        collective = min(wall, phases.get("collective", 0.0))
+        checkpoint = min(wall, phases.get("checkpoint", 0.0))
+        compute = max(0.0, wall - data_wait - collective - checkpoint)
+        if self._device_kind is None:
+            self._device_kind, self._devices = _device_info()
+        self.step += 1
+        rec = {
+            "step": self.step,
+            "ts": time.time(),
+            "rank": self.ctx.world_rank,
+            "node_id": self.ctx.node_id,
+            "wall_s": wall,
+            "data_wait_s": data_wait,
+            "compute_s": compute,
+            "collective_s": collective,
+            "checkpoint_s": checkpoint,
+        }
+        tokens = metrics.get("tokens")
+        if isinstance(tokens, (int, float)) and not isinstance(tokens, bool):
+            rec["tokens"] = float(tokens)
+        flops = metrics.get("flops")
+        if isinstance(flops, (int, float)) and not isinstance(flops, bool):
+            rec["flops"] = float(flops)
+        if self._device_kind:
+            rec["device_kind"] = self._device_kind
+            rec["devices"] = self._devices
+        return rec
+
+
+async def _swallow(coro) -> None:
+    """Await a fire-and-forget push; a failed push is a delayed snapshot,
+    not an error (and must not leave 'exception never retrieved' noise)."""
+    try:
+        await coro
+    except Exception:
+        logger.debug("workload_ingest push failed", exc_info=True)
+
+
+# -- driver side ---------------------------------------------------------
+class FlightRecorder:
+    """Driver-side aggregator + goodput accountant + store uplink."""
+
+    def __init__(self, experiment: str, enabled_: bool | None = None):
+        from ray_tpu._private.workload import StepStatsAggregator
+
+        self.experiment = experiment
+        self.enabled = enabled() if enabled_ is None else enabled_
+        self.agg = StepStatsAggregator()
+        self._t0 = time.monotonic()
+        self.buckets = {
+            "checkpoint_s": 0.0,
+            "restart_s": 0.0,
+            "stalled_s": 0.0,
+        }
+        self._last_progress: float | None = None
+        self._pending: dict[str, list[dict]] = {}
+        self._last_push = 0.0
+        self._summary: dict | None = None
+        self._last_summary = 0.0
+        self.stragglers: list[dict] = []
+
+    # -- goodput wall-clock buckets -------------------------------------
+    def note_restart(self, seconds: float) -> None:
+        self.buckets["restart_s"] += max(0.0, seconds)
+
+    def note_checkpoint(self, seconds: float) -> None:
+        self.buckets["checkpoint_s"] += max(0.0, seconds)
+
+    def note_progress(self) -> None:
+        self._last_progress = time.monotonic()
+
+    def note_stalled_since_progress(self) -> None:
+        """The failure path: everything since the last committed round is
+        lost work + detection time — the 'stalled' bucket."""
+        if self._last_progress is not None:
+            self.buckets["stalled_s"] += max(
+                0.0, time.monotonic() - self._last_progress
+            )
+            self._last_progress = None
+
+    def goodput(self) -> dict:
+        from ray_tpu._private.workload import goodput_buckets
+
+        return goodput_buckets(
+            time.monotonic() - self._t0, **self.buckets
+        )
+
+    # -- per-round ingest -----------------------------------------------
+    def on_round(self, round_results: list) -> dict | None:
+        """Ingest one poll round's per-rank StepStats. Returns the rolling
+        gang summary (tokens/s, MFU, phase fractions) or None when the
+        recorder is off or the round carried no records."""
+        self.note_progress()
+        if not self.enabled:
+            return None
+        max_ckpt = 0.0
+        saw = False
+        for result in round_results:
+            rec = result.get("step_stats") if isinstance(result, dict) else None
+            if not isinstance(rec, dict):
+                continue
+            if self.agg.add(rec):
+                saw = True
+                max_ckpt = max(max_ckpt, float(rec.get("checkpoint_s") or 0.0))
+                rank = rec.get("rank", 0)
+                self._queue(f"train/{self.experiment}/rank{rank}", rec)
+        if not saw:
+            return self._summary
+        # Workers save sharded checkpoints inside the step; the slowest
+        # rank's save time is wall clock the gang spent checkpointing.
+        self.buckets["checkpoint_s"] += max_ckpt
+        # The rolling summary + straggler scan walk the whole window
+        # (O(window x ranks)); at ms-scale steps doing that every
+        # lockstep round is measurable overhead, so it runs on the push
+        # cadence and rounds in between reuse the cached (<=1s stale)
+        # summary. Raw per-rank records are still queued every round.
+        now = time.monotonic()
+        if self._summary is None or now - self._last_summary >= _PUSH_INTERVAL_S:
+            self._last_summary = now
+            self._summary = self._cut_gang_sample()
+            self._maybe_push()
+        return self._summary
+
+    def _cut_gang_sample(self) -> dict:
+        """Compute the rolling gang summary + straggler scan and queue it
+        as one ``train/<experiment>`` sample."""
+        summary = self.agg.summary()
+        self.stragglers = self.agg.straggler_report(k=self._mad_k())
+        if self.stragglers:
+            summary["stragglers"] = [s["rank"] for s in self.stragglers]
+        self._queue(
+            f"train/{self.experiment}",
+            {"ts": time.time(), **summary},
+        )
+        return summary
+
+    @staticmethod
+    def _mad_k() -> float:
+        try:
+            from ray_tpu._private.config import global_config
+
+            return float(global_config().straggler_mad_k)
+        except Exception:
+            return 3.0
+
+    # -- controller uplink ----------------------------------------------
+    def _queue(self, key: str, sample: dict) -> None:
+        pending = self._pending.setdefault(key, [])
+        pending.append(sample)
+        if len(pending) > _MAX_PENDING:
+            del pending[: len(pending) - _MAX_PENDING]
+
+    def _maybe_push(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_push < _PUSH_INTERVAL_S:
+            return
+        if not self._pending:
+            return
+        series = [
+            {"key": key, "samples": samples}
+            for key, samples in self._pending.items()
+        ]
+        self._pending = {}
+        self._last_push = now
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            ctx = worker_mod.get_global_context()
+            call = ctx.controller.call(
+                "workload_ingest", {"series": series}, timeout=10.0
+            )
+            if force:
+                # finalize(): the goodput sample must land before fit()
+                # returns, so the last push is synchronous.
+                ctx.io.run(call)
+            else:
+                # Steady state: fire-and-forget on the io loop — the
+                # driver's poll round must not block on the controller
+                # round trip (a lost push only delays the next snapshot).
+                ctx.io.spawn(_swallow(call))
+        except Exception:
+            logger.debug("workload_ingest push failed", exc_info=True)
+
+    def finalize(self) -> dict:
+        """End of fit(): compute final goodput, push it + any pending
+        samples, and return the goodput buckets for ``Result.goodput``."""
+        g = self.goodput()
+        if self.enabled:
+            if self.agg.records_ingested:
+                # One fresh gang sample: the throttled cadence may have
+                # left the last <1s of steps out of the stored series.
+                self._summary = self._cut_gang_sample()
+            self._queue(
+                f"train/{self.experiment}/goodput",
+                {"ts": time.time(), **g},
+            )
+            self._maybe_push(force=True)
+        return g

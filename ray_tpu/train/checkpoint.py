@@ -27,6 +27,7 @@ import pickle
 import re
 import shutil
 import tempfile
+import time
 import uuid
 import zlib
 from typing import Any, Iterator
@@ -171,6 +172,7 @@ def save_pytree(
 
     from ray_tpu.util import chaos
 
+    _save_t0 = time.perf_counter()
     leaves_with_paths, treedef = jtu.tree_flatten_with_path(tree)
     # Collision guard: escaping makes key construction injective, but a
     # tree could still produce duplicate keys through exotic custom nodes —
@@ -249,6 +251,11 @@ def save_pytree(
         _done_marker_path(directory, process_index),
         {"rank": int(process_index), "files": inventory},
     )
+    # Flight recorder (ISSUE 8): a committed save's wall time is the
+    # step's "checkpoint" phase (no-op outside an active train session).
+    from ray_tpu.train._internal import step_stats
+
+    step_stats.record_phase("checkpoint", time.perf_counter() - _save_t0)
 
 
 def _done_markers(directory: str) -> dict[int, dict]:

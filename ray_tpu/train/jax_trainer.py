@@ -57,6 +57,10 @@ class Result:
     # Every world-size transition the run made: dicts of
     # {"reason": "gang_died"|"grow"|"oom_risk_drain", "from": k, "to": j}.
     resizes: list = field(default_factory=list)
+    # Goodput accounting (ISSUE 8): the run's wall clock classified into
+    # productive / checkpoint / restart / stalled buckets (they sum to
+    # wall_s by construction) plus goodput_fraction.
+    goodput: dict = field(default_factory=dict)
 
     @property
     def best_checkpoints(self) -> list:
@@ -164,6 +168,11 @@ class DataParallelTrainer:
         # oom_risk events are a monotone log; remember how many we have
         # already acted on so one event triggers one drain.
         oom_seen = 0
+        # Workload flight recorder (ISSUE 8): per-round StepStats ingest +
+        # goodput wall-clock buckets for this run.
+        from ray_tpu.train._internal.step_stats import FlightRecorder
+
+        recorder = FlightRecorder(self._experiment_name())
 
         while True:
             executor = BackendExecutor(
@@ -182,20 +191,28 @@ class DataParallelTrainer:
             resize: dict | None = None
             try:
                 ingest = storage.latest_ingest() if latest_ckpt else None
-                executor.start(
-                    self.train_loop_per_worker,
-                    self.train_loop_config,
-                    latest_ckpt,
-                    # Split AFTER gang formation: an elastic restart may
-                    # come up at a smaller world size, and a resume re-splits
-                    # the remaining sample space at whatever size formed.
-                    lambda world_size: _split_datasets(
-                        self.datasets, world_size, ingest=ingest
-                    ),
-                )
+                form_t0 = time.monotonic()
+                try:
+                    executor.start(
+                        self.train_loop_per_worker,
+                        self.train_loop_config,
+                        latest_ckpt,
+                        # Split AFTER gang formation: an elastic restart may
+                        # come up at a smaller world size, and a resume re-splits
+                        # the remaining sample space at whatever size formed.
+                        lambda world_size: _split_datasets(
+                            self.datasets, world_size, ingest=ingest
+                        ),
+                    )
+                finally:
+                    # Gang (re)formation is restart-resharding time whether
+                    # it succeeded or died mid-form.
+                    recorder.note_restart(time.monotonic() - form_t0)
+                recorder.note_progress()
                 backoff.reset()
                 done, last_metrics, error, resize, oom_seen = self._drive(
-                    executor, storage, history, last_metrics, oom_seen
+                    executor, storage, history, last_metrics, oom_seen,
+                    recorder,
                 )
                 if done:
                     break
@@ -228,6 +245,9 @@ class DataParallelTrainer:
                 latest_ckpt = storage.latest_checkpoint()
                 continue
             if error is not None:
+                # Wall clock since the last committed round is lost work +
+                # detection latency: the "stalled" goodput bucket.
+                recorder.note_stalled_since_progress()
                 max_failures = run_cfg.failure_config.max_failures
                 if run_cfg.failure_config.fail_fast or (
                     0 <= max_failures <= failures
@@ -239,7 +259,9 @@ class DataParallelTrainer:
                 )
                 latest_ckpt = storage.latest_checkpoint()
                 error = None
+                sleep_t0 = time.monotonic()
                 backoff.sleep()
+                recorder.note_restart(time.monotonic() - sleep_t0)
                 continue
             break
 
@@ -250,6 +272,7 @@ class DataParallelTrainer:
             error=error,
             metrics_history=history,
             resizes=resizes,
+            goodput=recorder.finalize(),
         )
 
     # -- elasticity probes (evaluated at checkpoint boundaries) ----------
@@ -325,6 +348,7 @@ class DataParallelTrainer:
         history: list,
         last_metrics: dict,
         oom_seen: int = 0,
+        recorder=None,
     ) -> tuple[bool, dict, Exception | None, dict | None, int]:
         """Poll rounds until every rank is done, an error surfaces, a stop
         criterion is met, or a checkpoint boundary triggers a voluntary
@@ -344,6 +368,26 @@ class DataParallelTrainer:
             if not reports:
                 continue
             metrics = dict(reports[0]["metrics"])
+            # Flight recorder (ISSUE 8): fold every rank's StepStats into
+            # the rolling gang view; surface throughput + stragglers in
+            # the user-visible metrics stream.
+            if recorder is not None:
+                step_summary = recorder.on_round(round_results)
+                if step_summary:
+                    metrics.setdefault(
+                        "tokens_per_s", step_summary["tokens_per_s"]
+                    )
+                    if step_summary.get("mfu") is not None:
+                        metrics.setdefault("mfu", step_summary["mfu"])
+                    if recorder.stragglers:
+                        ranks = [s["rank"] for s in recorder.stragglers]
+                        metrics["stragglers"] = ranks
+                        if probe_state.get("stragglers_logged") != ranks:
+                            probe_state["stragglers_logged"] = ranks
+                            logger.warning(
+                                "straggling ranks detected: %s",
+                                recorder.stragglers,
+                            )
             # Surface which collective backend the gang actually runs
             # (acceptance: the hier auto-upgrade must be observable from
             # Result.metrics without user code changes).
@@ -371,6 +415,7 @@ class DataParallelTrainer:
                             for name in names
                         },
                     }
+                persist_t0 = time.monotonic()
                 try:
                     persisted = storage.persist(ckpt, metrics, ingest=ingest)
                 except IOError as exc:
@@ -381,6 +426,13 @@ class DataParallelTrainer:
                 else:
                     metrics["checkpoint_path"] = persisted.path
                     committed = True
+                finally:
+                    if recorder is not None:
+                        # Driver-side commit time is the checkpoint goodput
+                        # bucket (spent either way, committed or torn).
+                        recorder.note_checkpoint(
+                            time.monotonic() - persist_t0
+                        )
             last_metrics = metrics
             history.append(metrics)
             for cb in self.run_config.callbacks:

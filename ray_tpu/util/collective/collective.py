@@ -818,6 +818,11 @@ def _instrumented_outer(op: str, group: BaseGroup, array, call):
         result = call()
     elapsed = time.perf_counter() - start
     wire_delta = (wire["bytes_sent"] - wire_before) if wire else 0
+    # Flight recorder (ISSUE 8): inside a train session this wall time is
+    # the step's "collective" phase; outside one it's a no-op bool check.
+    from ray_tpu.train._internal import step_stats
+
+    step_stats.record_phase("collective", elapsed)
     from ray_tpu.util import metrics
 
     metrics.record_collective_op(

@@ -208,6 +208,59 @@ def record_collective_op(
 
 
 # ---------------------------------------------------------------------------
+# Serve SLO series (ISSUE 8): every proxied request feeds a per-route
+# latency histogram + status counter; replicas push occupancy gauges.
+# These are the Prometheus half of the flight recorder's serve view (the
+# p50/p95/p99 snapshots ride the controller workload store).
+# ---------------------------------------------------------------------------
+
+_serve_latency: Histogram | None = None
+_serve_requests: Counter | None = None
+_serve_gauges: dict[str, Gauge] = {}
+
+# SLO-shaped bounds: sub-5ms cache hits through multi-second tail.
+SERVE_LATENCY_BOUNDARIES = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0)
+
+
+def record_serve_request(route: str, seconds: float, status: str) -> None:
+    """One completed HTTP/handle request through the serve proxy:
+    rt_serve_request_latency_s{route} + rt_serve_requests_total{route,
+    status} where status is the HTTP class ("200", "404", "500", ...)."""
+    global _serve_latency, _serve_requests
+    if _serve_latency is None:
+        _serve_latency = Histogram(
+            "rt_serve_request_latency_s",
+            description="Serve request latency through the proxy (seconds)",
+            boundaries=SERVE_LATENCY_BOUNDARIES,
+            tag_keys=("route",),
+        )
+        _serve_requests = Counter(
+            "rt_serve_requests_total",
+            description="Serve requests by route and status",
+            tag_keys=("route", "status"),
+        )
+    _serve_latency.observe(float(seconds), tags={"route": route})
+    _serve_requests.inc(1, tags={"route": route, "status": str(status)})
+
+
+def set_serve_replica_gauge(
+    name: str, deployment: str, replica_id: str, value: float
+) -> None:
+    """Replica-side occupancy gauges: rt_serve_<name>{deployment,
+    replica}. Used for queue_depth, batch_occupancy, ongoing_requests."""
+    gauge = _serve_gauges.get(name)
+    if gauge is None:
+        gauge = _serve_gauges[name] = Gauge(
+            f"rt_serve_{name}",
+            description=f"Serve replica {name.replace('_', ' ')}",
+            tag_keys=("deployment", "replica"),
+        )
+    gauge.set(
+        float(value), tags={"deployment": deployment, "replica": replica_id}
+    )
+
+
+# ---------------------------------------------------------------------------
 # Native/control-plane observability [N27]: the C++ engine's internal
 # counters and the controller's queue depths surface as first-class
 # Prometheus series, so "is the control plane draining?" is a dashboard

@@ -165,9 +165,15 @@ def summarize_latency(session_dir: str | None = None) -> dict:
     session_dir = session_dir or _session_dir()
     if not session_dir:
         return {}
+    try:
+        spans = tracing.read_spans(session_dir)
+    except Exception:
+        # Fresh cluster / tracing disabled / span file unreadable: an
+        # empty breakdown, not a stack trace (ISSUE 8 satellite).
+        return {}
     by_phase: dict[str, list[float]] = {}
     errors: dict[str, int] = {}
-    for span in tracing.read_spans(session_dir):
+    for span in spans:
         if not span.get("end_ns") or not span.get("start_ns"):
             continue
         phase = _phase_of(span.get("name", ""))
@@ -206,8 +212,12 @@ def summarize_comm(session_dir: str | None = None) -> dict:
     session_dir = session_dir or _session_dir()
     if not session_dir:
         return {}
+    try:
+        spans = tracing.read_spans(session_dir)
+    except Exception:
+        return {}
     acc: dict[str, dict] = {}
-    for span in tracing.read_spans(session_dir):
+    for span in spans:
         name = span.get("name", "")
         if not name.startswith("collective."):
             continue
@@ -325,3 +335,117 @@ def get_task_timeline(
             }
         )
     return out
+
+
+# ---------------------------------------------------------------------------
+# Workload flight recorder (ISSUE 8): per-run training breakdown, goodput
+# accounting, and serve SLO series land in the controller's workload store;
+# these are the read-side entry points for `diagnose`, the dashboard, and
+# user code.
+# ---------------------------------------------------------------------------
+
+
+def summarize_workload() -> dict:
+    """All workload flight-recorder series known to the controller.
+
+    Returns ``{"series": {key: {latest, points, last_ts, dropped}},
+    "total_ingested": N, "total_dropped": N}`` where keys look like
+    ``train/<experiment>`` (gang-level StepStats rollup),
+    ``train/<experiment>/rank<k>`` (per-rank step records),
+    ``train/<experiment>/goodput`` (wall-clock bucket snapshots), and
+    ``serve/<route>`` (latency histogram snapshots). Empty structure —
+    never an exception — on a fresh cluster."""
+    try:
+        summary = _call("workload_summary")
+    except Exception:
+        summary = None
+    if not isinstance(summary, dict):
+        return {"series": {}, "total_ingested": 0, "total_dropped": 0}
+    summary.setdefault("series", {})
+    summary.setdefault("total_ingested", 0)
+    summary.setdefault("total_dropped", 0)
+    return summary
+
+
+def get_workload_timeline(key: str, tier: str | None = None) -> dict:
+    """One workload series' tiered time-series (same raw/10s/60s rings
+    and partial-bucket semantics as :func:`get_node_timeline`). Unknown
+    keys return ``{}``."""
+    try:
+        out = _call("workload_timeline", {"key": key, "tier": tier})
+    except Exception:
+        return {}
+    return out if isinstance(out, dict) else {}
+
+
+def summarize_goodput() -> dict:
+    """Wall-clock goodput accounting per training run.
+
+    Returns ``{"runs": {experiment: {wall_s, productive_s, checkpoint_s,
+    restart_s, stalled_s, goodput_fraction, ts}}}`` from the latest
+    ``train/<experiment>/goodput`` sample each run pushed (finalized runs
+    push once more on exit, so completed runs keep their final numbers).
+    ``{"runs": {}}`` on a fresh cluster — never an exception."""
+    runs: dict[str, dict] = {}
+    try:
+        series = summarize_workload().get("series", {})
+        for key, entry in series.items():
+            if not key.startswith("train/") or not key.endswith("/goodput"):
+                continue
+            experiment = key[len("train/"):-len("/goodput")]
+            latest = (entry or {}).get("latest")
+            if isinstance(latest, dict):
+                runs[experiment] = dict(latest)
+    except Exception:
+        return {"runs": {}}
+    return {"runs": runs}
+
+
+def collect_diagnose_snapshot(session_dir: str | None = None) -> dict:
+    """Assemble the cross-subsystem snapshot that feeds
+    ``ray_tpu._private.workload.diagnose`` (and the `ray_tpu diagnose`
+    CLI): span latency + comm breakdowns, node resource telemetry,
+    goodput buckets, workload series, and the raw per-rank step records
+    needed for straggler attribution. Every section degrades to an empty
+    structure independently, so a partially-up cluster still diagnoses
+    whatever it has."""
+    snapshot: dict[str, Any] = {
+        "latency": {},
+        "comm": {},
+        "resources": {},
+        "goodput": {"runs": {}},
+        "workload": {"series": {}},
+        "rank_records": {},
+    }
+    try:
+        snapshot["latency"] = summarize_latency(session_dir)
+    except Exception:
+        pass
+    try:
+        snapshot["comm"] = summarize_comm(session_dir)
+    except Exception:
+        pass
+    try:
+        snapshot["resources"] = summarize_resources()
+    except Exception:
+        pass
+    snapshot["workload"] = summarize_workload()
+    snapshot["goodput"] = summarize_goodput()
+    # Raw per-rank step records, grouped by experiment, for the
+    # straggler detector's replay in diagnose().
+    try:
+        for key in snapshot["workload"].get("series", {}):
+            if not key.startswith("train/") or "/rank" not in key:
+                continue
+            experiment = key[len("train/"):].rsplit("/rank", 1)[0]
+            timeline = get_workload_timeline(key, "raw")
+            records = [
+                r for r in timeline.get("raw", []) if isinstance(r, dict)
+            ]
+            if records:
+                snapshot["rank_records"].setdefault(
+                    experiment, []
+                ).extend(records)
+    except Exception:
+        pass
+    return snapshot

@@ -120,6 +120,33 @@ def test_store_rejects_malformed_and_isolates_nodes():
     assert store.node_ids() == ["n2"]
 
 
+def test_workload_series_tiered_and_guarded_like_node_series():
+    """The flight-recorder workload series (ISSUE 8) ride the same tiered
+    rings + ts-monotonic guard as node telemetry, keyed by series name."""
+    store = TelemetryStore(raw_capacity=16, cap_10s=4, cap_60s=2)
+    t0 = 5000.0
+    batch = [{"ts": t0 + i, "tokens_per_s": 100.0 + i} for i in range(30)]
+    assert store.add_workload_many("train/exp", batch) == 30
+    assert store.add_workload_many("train/exp", batch) == 0  # replay
+    tl = store.workload_timeline("train/exp")
+    assert len(tl["raw"]) == 16  # bounded, newest kept
+    assert tl["raw"][-1]["tokens_per_s"] == 129.0
+    ts = [p["ts"] for p in tl["raw"]]
+    assert ts == sorted(set(ts))
+    # Downsampling applies to workload series too.
+    assert any(not b.get("partial") for b in tl["10s"])
+    stats = store.stats()
+    assert stats["workload_series"] == 1
+    assert stats["workload_ingested"] == 30
+    assert stats["workload_dropped"] == 30
+    assert stats["workload_points"] <= 16 + 4 + 2
+    # Node counters are untouched by workload traffic.
+    assert stats["telemetry_ingested"] == 0
+    assert store.workload_keys() == ["train/exp"]
+    assert store.workload_summary()["series"]["train/exp"]["latest"][
+        "tokens_per_s"] == 129.0
+
+
 def test_project_rss_slope_math():
     # 10 MB/s ramp: projection 10 s out lands ~100 MB above the last point.
     hist = [(float(t), 10e6 * t) for t in range(5)]
