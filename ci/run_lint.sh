@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# rtlint gate: framework-aware static analysis over the whole repo.
+#
+# Fails on any finding NOT in the committed baseline
+# (.rtlint-baseline.json) and on stale baseline entries — new
+# distributed-system hazards (blocking calls on async paths,
+# rank-divergent collectives, non-atomic state-file writes, swallowed
+# exceptions, lock-order cycles, host syncs in step functions) cannot
+# land, while the documented-debt ledger only shrinks. A SARIF artifact
+# is written next to the human report for code-scanning ingestion.
+# Usage: ci/run_lint.sh [extra `ray_tpu lint` args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+ARTIFACT_DIR="${RTLINT_ARTIFACT_DIR:-/tmp/rtlint}"
+mkdir -p "$ARTIFACT_DIR"
+
+echo "== rtlint (baseline-diff gate) =="
+# Always emit the SARIF artifact, even on a failing run — code scanning
+# wants the findings, not just the exit code. The human pass below gates.
+python -m ray_tpu lint --format sarif --out "$ARTIFACT_DIR/rtlint.sarif" "$@" \
+    || true
+python -m ray_tpu lint "$@"
+
+echo "rtlint gate: PASS (sarif: $ARTIFACT_DIR/rtlint.sarif)"

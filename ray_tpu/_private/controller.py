@@ -611,7 +611,7 @@ class Controller:
                     await conn.push(
                         "__pub_batch__", [[c, m] for c, m in items]
                     )
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - dead subscriber conn; pruned on disconnect
                 pass
 
     async def rpc_publish(self, conn, payload) -> dict:
@@ -720,7 +720,7 @@ class Controller:
                     "release_bundle",
                     {"pg_id": entry["pg_id"], "bundle_index": entry["index"]},
                 )
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - node unreachable: its death frees the bundles anyway
             pass
 
     async def rpc_heartbeat(self, conn, payload) -> dict:
@@ -1350,7 +1350,7 @@ class Controller:
                     {"worker_id": actor.worker_id, "actor_id": actor.actor_id,
                      "intended": no_restart},
                 )
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - best-effort kill; death reconciliation owns the state
                 pass
         if no_restart:
             actor.state = "DEAD"
@@ -1542,7 +1542,7 @@ class Controller:
                             "release_bundle",
                             {"pg_id": pg.pg_id, "bundle_index": index},
                         )
-                    except Exception:
+                    except Exception:  # rtlint: disable=swallowed-exception - rollback of a failed placement; node death frees bundles
                         pass
             if time.monotonic() > deadline:
                 await self.publish("pg_state", pg.snapshot())
@@ -1575,7 +1575,7 @@ class Controller:
                 await client.call(
                     "release_bundle", {"pg_id": pg.pg_id, "bundle_index": index}
                 )
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - node gone: nothing left to release
                 pass
         await self.publish("pg_state", pg.snapshot())
 
@@ -1766,9 +1766,14 @@ def main() -> None:
     async def run() -> None:
         controller = Controller(args.session_dir)
         port = await controller.start(args.host, args.port)
-        # Write the bound port for the parent to discover.
-        with open(os.path.join(args.session_dir, "controller.addr"), "w") as f:
-            f.write(json.dumps({"host": args.host, "port": port}))
+        # Write the bound port for the parent to discover. Atomic: the
+        # parent polls for this file and must never read a torn half.
+        from ray_tpu._private.atomic_io import atomic_write_json
+
+        atomic_write_json(
+            os.path.join(args.session_dir, "controller.addr"),
+            {"host": args.host, "port": port},
+        )
         await asyncio.Event().wait()
 
     asyncio.run(run())

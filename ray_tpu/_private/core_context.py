@@ -293,7 +293,7 @@ class CoreContext:
         self._shutdown = True
         try:
             self.io.run(self._shutdown_async(), timeout=5)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - shutdown must not raise; io loop may already be gone
             pass
         self.io.stop()
 
@@ -324,13 +324,13 @@ class CoreContext:
                 await self.controller.call(
                     "report_task_events", {"events": events}, timeout=2
                 )
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - final task-event flush is advisory at shutdown
                 pass
         for addr, owner in list(self._borrowed.items()):
             try:
                 client = await self._client_for(tuple(owner))
                 await client.call("remove_borrower", {"object_id": addr, "borrower": self.worker_id}, timeout=1)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - owner may be gone at shutdown; borrow GC is advisory
                 pass
         if self.controller is not None:
             await self.controller.close()
@@ -347,7 +347,7 @@ class CoreContext:
         for dw in direct_workers:
             try:
                 await self._release_lease(dw.leased, reusable=True)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - lease release at shutdown; agent may be gone
                 pass
         peers = list(self._clients.values())
         for leases in self._idle_leases.values():
@@ -355,7 +355,7 @@ class CoreContext:
         for client in peers:
             try:
                 await client.close()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - peer close at shutdown
                 pass
         self._clients.clear()
         self._idle_leases.clear()
@@ -389,7 +389,7 @@ class CoreContext:
         if stale is not None:
             try:
                 await stale.close()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - closing a stale superseded connection
                 pass
         return client
 
@@ -459,7 +459,7 @@ class CoreContext:
             await client.call(
                 "remove_borrower", {"object_id": object_id, "borrower": self.worker_id}
             )
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - owner death invalidates the borrow anyway
             pass
 
     async def _delete_shm_object(self, object_id: str, locations: list) -> None:
@@ -467,7 +467,7 @@ class CoreContext:
             try:
                 client = await self._client_for((loc["agent_host"], loc["agent_port"]))
                 await client.call("delete_object", {"object_id": object_id})
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - delete fan-out; a dead agent holds no object
                 pass
 
     # ------------------------------------------------------------------
@@ -703,7 +703,7 @@ class CoreContext:
                             pspan.attributes["bytes"] = len(data)
                 else:
                     data = await self._pull_remote(object_id, loc)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - location failed: try the next replica
                 continue
             if data is not None:
                 try:
@@ -764,7 +764,7 @@ class CoreContext:
         if pinned:
             try:
                 self.store.release(object_id)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - release of a ref the store may have evicted
                 pass
         return value
 
@@ -782,7 +782,7 @@ class CoreContext:
             await client.call(
                 "add_borrower", {"object_id": object_id, "borrower": self.worker_id}
             )
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - owner death invalidates the borrow anyway
             pass
 
     def wait(
@@ -943,7 +943,7 @@ class CoreContext:
             for dw in to_release:
                 try:
                     await self._release_lease(dw.leased, reusable=True)
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - idle lease release; agent may be gone
                     pass
 
     def _direct_note_dead(self, dw: DirectWorker) -> None:
@@ -1770,7 +1770,7 @@ class CoreContext:
                     },
                     timeout=60,
                 )
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - opportunistic push; pull path still serves the object
             pass  # opportunistic: the pull path still serves the object
 
     async def _push_one(
@@ -1863,7 +1863,7 @@ class CoreContext:
                 if info.get("alive"):
                     break  # no death, no tombstone coming — stop polling
                 await asyncio.sleep(0.25)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - death-info poll only enriches the error message
             pass
         if reason == "oom":
             mib = f" (rss {rss >> 20} MiB)" if rss else ""
@@ -1930,7 +1930,7 @@ class CoreContext:
                     "cancel_task", {"task_id": task_id, "force": force},
                     timeout=5,
                 )
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - worker died (force) or finished concurrently
                 pass  # worker died (force) or finished concurrently
             return
         record = self._task_records.get(task_id)
@@ -1999,7 +1999,7 @@ class CoreContext:
                 "return_worker",
                 {"lease_id": worker.lease_id, "reusable": reusable},
             )
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - agent gone: the lease died with it
             pass
 
     def _set_state_event(self, state: ObjectState) -> None:
@@ -2447,7 +2447,7 @@ class CoreContext:
 def _release_pinned(store: ObjectStoreClient, object_id: str) -> None:
     try:
         store.unpin(object_id)
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - unpin of an object the store may have dropped
         pass
 
 

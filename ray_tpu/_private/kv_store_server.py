@@ -19,6 +19,7 @@ import json
 import os
 import sys
 
+from ray_tpu._private import atomic_io
 from ray_tpu._private.rpc import RpcServer
 
 
@@ -47,7 +48,6 @@ class KVStoreServer:
     def _flush(self) -> None:
         if not self.data_path:
             return
-        tmp = self.data_path + ".tmp"
         raw = {
             ns: {
                 key: base64.b64encode(value).decode()
@@ -55,9 +55,7 @@ class KVStoreServer:
             }
             for ns, entries in self.kv.items()
         }
-        with open(tmp, "w") as fh:
-            json.dump(raw, fh)
-        os.replace(tmp, self.data_path)
+        atomic_io.atomic_write_json(self.data_path, raw)
 
     async def rpc_kv_put(self, conn, payload) -> dict:
         ns = payload.get("namespace", "default")
@@ -98,8 +96,10 @@ async def run(host: str, port: int, data_path: str | None,
     bound = await server.start(host, port)
     print(f"[raytpu-kv] listening on {host}:{bound}", flush=True)
     if ready_file:
-        with open(ready_file, "w") as fh:
-            json.dump({"host": host, "port": bound}, fh)
+        # Atomic: the parent polls for this file to learn the bound port.
+        atomic_io.atomic_write_json(
+            ready_file, {"host": host, "port": bound}
+        )
     while True:
         await asyncio.sleep(3600)
 

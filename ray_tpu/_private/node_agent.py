@@ -80,7 +80,7 @@ def detect_tpu_resources() -> dict:
             if kinds:
                 kind = kinds[0].replace(" ", "-")
                 return {"TPU": float(len(kinds)), f"TPU-{kind}": float(len(kinds))}
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - TPU probe: any failure means no TPUs to advertise
             pass
     return {}
 
@@ -301,7 +301,7 @@ class NodeAgent:
             try:
                 vmem = psutil.virtual_memory()
                 node_frac = vmem.percent / 100.0
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - psutil sampling hiccup; retry next interval
                 continue
             over_node = node_frac >= cfg.memory_usage_threshold
             now = time.time()
@@ -337,7 +337,7 @@ class NodeAgent:
                 except psutil.NoSuchProcess:
                     procs.pop(worker.worker_id, None)
                     continue
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - per-proc sampling race; skip this worker this tick
                     continue
             for worker_id in list(procs):
                 if worker_id not in live_ids:
@@ -406,9 +406,9 @@ class NodeAgent:
             ):
                 try:
                     child.kill()
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - child already exited
                     pass
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - process tree gone mid-walk
             pass
         try:
             worker.proc.kill()
@@ -437,7 +437,7 @@ class NodeAgent:
             # Non-blocking since-last-call percent; the first call of a
             # process returns 0.0 and primes the counter.
             sample["cpu_percent"] = psutil.cpu_percent(None)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - cpu sampling is advisory telemetry
             pass
         try:
             store_stats = self.store.stats()
@@ -445,7 +445,7 @@ class NodeAgent:
             sample["object_store_capacity"] = int(
                 store_stats.get("capacity", 0)
             )
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - store stats are advisory telemetry
             pass
         sample.update(self._hbm_stats())
         self._telemetry_buffer.append(sample)
@@ -468,7 +468,7 @@ class NodeAgent:
                 total += int(mem.get("bytes_limit", 0))
             if total:
                 return {"hbm_used": used, "hbm_total": total}
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - hbm stats are advisory telemetry
             pass
         return {}
 
@@ -525,7 +525,7 @@ class NodeAgent:
     async def _report_oom_risk(self, payload: dict) -> None:
         try:
             await self.controller.call("report_oom_risk", payload)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - advisory: never let a warning RPC hurt the agent
             pass  # advisory: never let a warning RPC hurt the agent
 
     async def _register_with_controller(self) -> None:
@@ -596,7 +596,7 @@ class NodeAgent:
             loop = asyncio.get_event_loop()
             with _NativeEngine._lock:
                 return _NativeEngine._by_loop.get(id(loop))
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - native engine optional; asyncio backend has none
             return None
 
     def _agent_stats(self) -> dict:
@@ -615,7 +615,7 @@ class NodeAgent:
         if engine is not None and hasattr(engine, "stats"):
             try:
                 stats["engine"] = engine.stats()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - engine stats are advisory telemetry
                 pass
         return stats
 
@@ -906,6 +906,7 @@ class NodeAgent:
         path = os.path.join(
             self.log_dir, f"worker-{worker.worker_id[-12:]}.{kind}"
         )
+        # rtlint: disable=blocking-in-async - unbuffered append of single lines to a local log; a thread hop per line would cost more than the write
         with open(path, "ab", buffering=0) as sink:
             while True:
                 try:
@@ -928,7 +929,7 @@ class NodeAgent:
                             },
                         },
                     )
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - log forwarding is best-effort during controller restart
                     pass
 
     async def _watch_worker(self, worker: WorkerProcess) -> None:
@@ -993,8 +994,14 @@ class NodeAgent:
                     "reason": worker.death_reason,
                 },
             )
-        except Exception:
-            pass
+        except Exception as exc:
+            # The controller missing a death report delays actor restart
+            # until its own liveness probe fires — worth a breadcrumb.
+            print(
+                f"[raytpu-agent] worker_died report for "
+                f"{worker.worker_id} failed: {exc!r}",
+                file=sys.stderr, flush=True,
+            )
 
     async def rpc_worker_death_info(self, conn, payload) -> dict:
         """Why a worker died (owner-side OOM attribution, N15). `alive`
@@ -1395,7 +1402,7 @@ class NodeAgent:
                 engine.lib.rt_transfer_free(
                     engine.handle, object_id.encode()
                 )
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - pull fallback still serves the object
             pass  # pull fallback still serves the object
 
     async def rpc_delete_object(self, conn, payload) -> dict:
@@ -1430,7 +1437,7 @@ class NodeAgent:
         if loop_engine is not None and hasattr(loop_engine, "stats"):
             try:
                 stats["engine"] = loop_engine.stats()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - engine stats are advisory telemetry
                 pass
         return stats
 
@@ -1513,12 +1520,13 @@ def main() -> None:
             store_capacity=args.store_capacity,
         )
         addr = await agent.start(args.port)
-        with open(
-            os.path.join(args.session_dir, f"agent-{args.node_id[-8:]}.addr"), "w"
-        ) as f:
-            f.write(
-                json.dumps({"addr": list(addr), "store": agent.store_info()})
-            )
+        # Atomic: the head polls for this discovery file.
+        from ray_tpu._private.atomic_io import atomic_write_json
+
+        atomic_write_json(
+            os.path.join(args.session_dir, f"agent-{args.node_id[-8:]}.addr"),
+            {"addr": list(addr), "store": agent.store_info()},
+        )
         await asyncio.Event().wait()
 
     asyncio.run(run())

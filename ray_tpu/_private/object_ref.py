@@ -32,7 +32,7 @@ class ObjectRef:
         if runtime is not None:
             try:
                 runtime.remove_local_ref(self.id)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - __del__ during interpreter teardown
                 pass
 
     def __hash__(self):

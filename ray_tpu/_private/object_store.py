@@ -225,5 +225,5 @@ class ObjectStoreClient:
     def close(self) -> None:
         try:
             self._sock.close()
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - close of an already-dead socket
             pass

@@ -205,7 +205,7 @@ class _NativeEngine:
         _rpc_debug(f"engine-stopped eng={id(self):x}")
         try:
             self.loop.remove_reader(self.notify_fd)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - reader may already be removed from a dead loop
             pass
         if self.handle:
             self.lib.rt_engine_stop(self.handle)
@@ -531,7 +531,7 @@ class AsyncioRpcServer(_ServerDispatchMixin):
         for conn in list(self.connections):
             try:
                 conn.writer.close()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - closing client conns at server stop
                 pass
 
     async def _on_client(
@@ -557,7 +557,7 @@ class AsyncioRpcServer(_ServerDispatchMixin):
                     traceback.print_exc()
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - peer already closed the transport
                 pass
 
 
@@ -931,7 +931,7 @@ class AsyncioRpcClient(_ClientCallMixin):
         if self._writer is not None:
             try:
                 self._writer.close()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - transport already closed
                 pass
 
 
@@ -1004,5 +1004,5 @@ class IoThread:
         try:
             asyncio.run_coroutine_threadsafe(_shutdown(), self.loop)
             self._thread.join(timeout=2)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - loop already stopped at interpreter exit
             pass

@@ -261,7 +261,7 @@ class RuntimeEnvManager:
                 proc.kill()
                 try:
                     await proc.wait()
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - pip already reaped after kill
                     pass
                 shutil.rmtree(tmp, ignore_errors=True)
                 raise

@@ -63,7 +63,7 @@ def _maybe_register_by_value(obj: Any) -> None:
         return
     try:
         cloudpickle.register_pickle_by_value(module)
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - module rejects by-value: fall back to by-reference
         pass
 
 

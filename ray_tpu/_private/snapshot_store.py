@@ -28,6 +28,8 @@ import time
 
 import msgpack
 
+from ray_tpu._private import atomic_io
+
 _LEN = struct.Struct("<I")
 _HDR = struct.Struct("<BBIH")  # ver, kind, msgid, method_len
 _SNAPSHOT_NS = "controller_snapshots"
@@ -74,10 +76,7 @@ class FileSnapshotStore(SnapshotStore):
         self.path = path
 
     def save(self, blob: bytes) -> None:
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as fh:
-            fh.write(blob)
-        os.replace(tmp, self.path)
+        atomic_io.atomic_write_bytes(self.path, blob)
 
     def load(self) -> bytes | None:
         try:

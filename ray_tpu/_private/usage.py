@@ -15,6 +15,8 @@ import os
 import threading
 import time
 
+from ray_tpu._private import atomic_io
+
 _lock = threading.Lock()
 _features: set[str] = set()
 _flushed_dir: str | None = None
@@ -70,23 +72,17 @@ def _flush_locked() -> None:
                 merged.update(json.load(fh).get("features", []))
         except (OSError, json.JSONDecodeError):
             pass
-        tmp = path + f".tmp.{os.getpid()}"
         try:
-            with open(tmp, "w") as fh:
-                json.dump(
-                    {
-                        "features": sorted(merged),
-                        "updated_at": time.time(),
-                        "transmitted": False,  # never — local record only
-                    },
-                    fh,
-                )
-            os.replace(tmp, path)
-        except OSError:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+            atomic_io.atomic_write_json(
+                path,
+                {
+                    "features": sorted(merged),
+                    "updated_at": time.time(),
+                    "transmitted": False,  # never — local record only
+                },
+            )
+        except OSError:  # rtlint: disable=swallowed-exception - telemetry must never crash user code
+            pass
     finally:
         try:
             fcntl.flock(lock_fh, fcntl.LOCK_UN)

@@ -176,7 +176,7 @@ class CoreContextProbe:
     def close(self) -> None:
         try:
             self.io.run(self.client.close())
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - close of a dead controller conn at shutdown
             pass
         self.io.stop()
 
@@ -201,7 +201,7 @@ def shutdown() -> None:
         if _autoscaler_monitor is not None:
             try:
                 _autoscaler_monitor.stop()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - monitor already stopped
                 pass
             _autoscaler_monitor = None
         if _global_ctx is not None:
@@ -297,6 +297,7 @@ def timeline(filename: str | None = None) -> dict:
     )
     trace = build_chrome_trace(session_dir, task_events=events)
     if filename:
-        with open(filename, "w") as f:
-            json.dump(trace, f)
+        from ray_tpu._private.atomic_io import atomic_write_json
+
+        atomic_write_json(filename, trace)
     return trace

@@ -43,7 +43,7 @@ def _peak_rss_bytes() -> int:
 
         peak = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss
         return peak if sys.platform == "darwin" else peak * 1024
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - no resource module (non-posix): report zero
         return 0
 
 
@@ -545,7 +545,7 @@ class WorkerRuntime:
                         conn, REP, msgid, method,
                         wire_gen.encode_task_reply(reply),
                     )
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - conn died: nothing more to tell the peer
                     pass  # conn died: nothing more to tell the peer
             finally:
                 if actor:
@@ -911,7 +911,7 @@ class WorkerRuntime:
                 await self.ctx.controller.call(
                     "report_task_events", {"events": events}
                 )
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - task-event uplink is advisory telemetry
                 pass
 
         self.ctx.io.spawn(_flush())
@@ -939,7 +939,7 @@ class WorkerRuntime:
                     f"{f.f_code.co_filename}:{f.f_lineno} {f.f_code.co_name}"
                     for f in tb
                 ]
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - stack introspection is advisory debug info
             pass
         return {
             "status": "ok",
@@ -1303,7 +1303,7 @@ class WorkerRuntime:
                 for i in range(depth):
                     try:
                         self.ctx.store.delete(f"{base}-{i}")
-                    except Exception:
+                    except Exception:  # rtlint: disable=swallowed-exception - consumer-owned slot may already be deleted
                         pass
         for key in [k for k in self._dag_results if k[0] == dag_id]:
             self._dag_results.pop(key, None)
@@ -1401,7 +1401,7 @@ class WorkerRuntime:
                         out_base, seq, stage.get("depth", 8), parts, total
                     )
                     result = ("__dagchan__", out_base)
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - fall back to the inline result path
                     pass  # fall back to inline result
             self._dag_results[key] = result
             self._dag_events.setdefault(key, asyncio.Event()).set()

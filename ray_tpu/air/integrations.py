@@ -23,6 +23,7 @@ import os
 import time
 from typing import Any
 
+from ray_tpu._private import atomic_io
 from ray_tpu.tune.logger import LoggerCallback
 
 
@@ -112,21 +113,19 @@ class FileTrackerCallback(TrackerCallback):
 
     def _backend_start_run(self, run_id, name, params) -> None:
         d = self._run_dir(run_id)
-        with open(os.path.join(d, "run.json"), "w") as f:
-            json.dump(
-                {
-                    "run_id": run_id,
-                    "name": name,
-                    "status": "RUNNING",
-                    "start_time": time.time(),
-                },
-                f,
-            )
-        with open(os.path.join(d, "params.json"), "w") as f:
-            json.dump(
-                {k: v if _jsonable(v) else repr(v) for k, v in params.items()},
-                f,
-            )
+        atomic_io.atomic_write_json(
+            os.path.join(d, "run.json"),
+            {
+                "run_id": run_id,
+                "name": name,
+                "status": "RUNNING",
+                "start_time": time.time(),
+            },
+        )
+        atomic_io.atomic_write_json(
+            os.path.join(d, "params.json"),
+            {k: v if _jsonable(v) else repr(v) for k, v in params.items()},
+        )
 
     def _backend_log_metrics(self, run_id, step, metrics) -> None:
         with open(
@@ -144,8 +143,7 @@ class FileTrackerCallback(TrackerCallback):
             run = {"run_id": run_id}
         run["status"] = status
         run["end_time"] = time.time()
-        with open(path, "w") as f:
-            json.dump(run, f)
+        atomic_io.atomic_write_json(path, run)
 
 
 def _jsonable(value) -> bool:

@@ -13,6 +13,7 @@ resource dicts ({"TPU": 4, "tpu-slice-v4-8": 1}).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -217,7 +218,12 @@ class StandardAutoscaler:
                 try:
                     self.update()
                 except Exception:
-                    pass
+                    # One failed reconcile must not kill the loop, but an
+                    # autoscaler that is silently broken every tick is a
+                    # stuck cluster — log each failure.
+                    logging.getLogger(__name__).warning(
+                        "autoscaler update failed", exc_info=True
+                    )
                 self._stopped.wait(self.config.update_interval_s)
 
         self._thread = threading.Thread(target=loop, daemon=True)

@@ -142,7 +142,7 @@ class AutoscalerMonitor:
                     "value": json.dumps(status).encode(),
                 },
             )
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - status push is advisory; retried next tick
             pass
 
 

@@ -23,6 +23,7 @@ exactly like the reference's InstanceManager transition tests.
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -121,7 +122,7 @@ class PodSliceProvider:
             if self.cluster is not None:
                 try:
                     self.cluster.remove_node(node_id)
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - node already removed
                     pass
 
     def non_terminated_slices(self) -> dict[str, list[str]]:
@@ -345,7 +346,12 @@ class AutoscalerV2:
                 try:
                     self.update()
                 except Exception:
-                    pass
+                    # One failed reconcile must not kill the loop, but an
+                    # autoscaler that is silently broken every tick is a
+                    # stuck cluster — log each failure.
+                    logging.getLogger(__name__).warning(
+                        "autoscaler update failed", exc_info=True
+                    )
                 self._stopped.wait(self.update_interval_s)
 
         self._thread = threading.Thread(target=loop, daemon=True)

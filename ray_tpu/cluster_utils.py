@@ -298,11 +298,11 @@ class FakeNodeAgent:
             self._hb_task = None
         try:
             await self.client.close()
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - client close at shutdown
             pass
         try:
             await self.server.stop()
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - server stop at shutdown
             pass
 
 
@@ -366,7 +366,7 @@ class FakeScaleCluster:
         if self.driver is not None:
             try:
                 await self.driver.close()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - driver conn close at teardown
                 pass
         for agent in self.agents:
             await agent.stop()
@@ -374,7 +374,7 @@ class FakeScaleCluster:
         if self.controller is not None:
             try:
                 await self.controller.server.stop()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - controller already stopped
                 pass
         try:
             import asyncio
@@ -382,7 +382,7 @@ class FakeScaleCluster:
             from ray_tpu._private.rpc import _NativeEngine
 
             _NativeEngine.destroy_for_loop(asyncio.get_running_loop())
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - no running loop or engine already destroyed
             pass
         if self._tmpdir is not None:
             self._tmpdir.cleanup()

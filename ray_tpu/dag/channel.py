@@ -50,11 +50,11 @@ def try_write(store, name: str, parts, total: int) -> bool:
 def _free_slot(store, name: str) -> None:
     try:
         store.release(name)
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - slot may be unreferenced already
         pass
     try:
         store.delete(name)
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - slot may already be deleted by the peer
         pass
 
 

@@ -154,7 +154,7 @@ class CompiledDAG:
                 ),
                 timeout=70,
             )
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - placement unknown: caller treats None as no co-location
             return None
         return info.get("node_id")
 
@@ -415,7 +415,7 @@ class CompiledDAG:
                 await client.call(
                     "dag_teardown", {"dag_id": self.dag_id}, timeout=10
                 )
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - actor may be dead; teardown is idempotent
                 pass
         # Driver-owned output ring: freed here too, so the __del__ path
         # (which can only fire-and-forget this coroutine) leaks nothing.
@@ -423,7 +423,7 @@ class CompiledDAG:
             for i in range(self.CHANNEL_DEPTH):
                 try:
                     self._ctx.store.delete(f"{self._out_channel}-{i}")
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - ring slot already freed
                     pass
 
     def teardown(self) -> None:
@@ -446,7 +446,7 @@ class CompiledDAG:
         else:
             try:
                 self._ctx.io.run(self._teardown_async(), timeout=30)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - teardown race with shutdown; worker side is idempotent
                 pass
 
     def _spawn_teardown(self) -> None:
@@ -466,5 +466,5 @@ class CompiledDAG:
             if not self._torn_down:
                 self._torn_down = True
                 self._spawn_teardown()
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - __del__ during interpreter teardown
             pass

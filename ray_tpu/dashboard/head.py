@@ -417,11 +417,16 @@ class DashboardHead:
             return web.Response(
                 status=400, text="?lines= must be an integer"
             )
-        with open(path, "rb") as f:
-            # Tail without loading the whole file: a multi-GB worker log
-            # must not transit driver memory for a 200-line view.
-            f.seek(max(0, os.fstat(f.fileno()).st_size - 200_000))
-            data = f.read(200_000)
+        def _tail() -> bytes:
+            with open(path, "rb") as f:
+                # Tail without loading the whole file: a multi-GB worker
+                # log must not transit driver memory for a 200-line view.
+                f.seek(max(0, os.fstat(f.fileno()).st_size - 200_000))
+                return f.read(200_000)
+
+        # Off the event loop: a cold-cache read from a slow disk must not
+        # stall every other dashboard request.
+        data = await asyncio.to_thread(_tail)
         text = data.decode(errors="replace")
         return web.Response(text="\n".join(text.splitlines()[-lines:]))
 

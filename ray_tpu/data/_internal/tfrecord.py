@@ -68,6 +68,7 @@ def read_records(path: str) -> Iterator[bytes]:
 
 def write_records(path: str, records: Iterator[bytes]) -> int:
     n = 0
+    # rtlint: disable=non-atomic-write - streaming record file of unbounded size; readers detect truncation via per-record CRC framing
     with open(path, "wb") as f:
         for data in records:
             header = _U64.pack(len(data))

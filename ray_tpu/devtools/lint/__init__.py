@@ -1,0 +1,22 @@
+"""rtlint — framework-aware static analysis for ray_tpu.
+
+A pluggable AST + CFG-lite analysis suite encoding the distributed-
+systems invariants the runtime layers fight for (idempotent mutations,
+atomic state writes, rank-uniform collective order, non-blocking async
+lanes) as review-time checks instead of must-hit-the-bug tests.
+
+Entry points:
+  * ``ray_tpu lint`` (CLI, see ``ray_tpu/scripts.py``)
+  * :func:`ray_tpu.devtools.lint.runner.run_paths` (programmatic)
+
+Rule catalog and suppression syntax: ``docs/devtools.md``.
+"""
+
+from ray_tpu.devtools.lint.core import (  # noqa: F401
+    Finding,
+    Rule,
+    Severity,
+    all_rules,
+    register_rule,
+)
+from ray_tpu.devtools.lint.runner import run_paths  # noqa: F401

@@ -1,0 +1,78 @@
+"""Committed-baseline workflow for rtlint.
+
+The baseline is the *documented debt ledger*: every entry is a known
+finding with an in-file ``justification`` explaining why it stays. The
+gate (`ci/run_lint.sh`, the `lint_clean` release entry) fails on any
+finding NOT in the baseline — new hazards cannot land — and reports
+stale entries so the ledger shrinks instead of rotting.
+
+Matching is by content fingerprint (see core.assign_fingerprints), so
+entries survive unrelated edits and line drift.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from ray_tpu.devtools.lint.core import Finding
+
+DEFAULT_BASELINE = ".rtlint-baseline.json"
+
+
+@dataclass
+class Baseline:
+    path: str | None = None
+    # fingerprint -> entry dict (rule/path/justification/...)
+    entries: dict[str, dict] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str | None) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return cls(path=path)
+        with open(path) as fh:
+            data = json.load(fh)
+        entries = {e["fingerprint"]: e for e in data.get("entries", [])}
+        return cls(path=path, entries=entries)
+
+    def save(self, path: str, findings: list[Finding],
+             justification: str = "") -> None:
+        """Write the given findings as the new baseline. Existing
+        justifications are preserved; new entries get ``justification``
+        (or a TODO marker that the self-check test rejects until a real
+        reason is written)."""
+        from ray_tpu._private.atomic_io import atomic_write_json
+
+        entries = []
+        for f in sorted(findings, key=Finding.sort_key):
+            old = self.entries.get(f.fingerprint, {})
+            entries.append({
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,          # advisory; matching is by print
+                "summary": f.message.split(":")[0],
+                "fingerprint": f.fingerprint,
+                "justification": old.get("justification")
+                or justification
+                or "TODO: justify or fix",
+            })
+        atomic_write_json(
+            path,
+            {"version": 1, "tool": "rtlint", "entries": entries},
+            indent=2, sort_keys=False,
+        )
+
+    def split(self, findings: list[Finding]):
+        """(new, baselined, stale_entries): findings not in the ledger,
+        findings matched by it, and ledger entries nothing matched."""
+        new, matched = [], []
+        seen: set[str] = set()
+        for f in findings:
+            if f.fingerprint in self.entries:
+                matched.append(f)
+                seen.add(f.fingerprint)
+            else:
+                new.append(f)
+        stale = [e for fp, e in self.entries.items() if fp not in seen]
+        return new, matched, stale

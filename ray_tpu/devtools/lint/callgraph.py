@@ -1,0 +1,266 @@
+"""CFG-lite interprocedural helpers: module-local call graph, async
+reachability, and the two-pass lockset analysis.
+
+Deliberately *module-local*: ray_tpu's hazard surfaces (rpc lane,
+controller, node agent, serve internals) each live in one module, so a
+per-module graph catches the real bugs without whole-program aliasing —
+the same scoping trade-off clang-tidy's bugprone-* checks make.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from ray_tpu.devtools.lint.core import call_name
+
+
+def collect_functions(tree: ast.Module) -> dict[str, ast.AST]:
+    """Qualified name -> def node for every function in the module."""
+    out: dict[str, ast.AST] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                out.setdefault(qual, child)
+                visit(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _own_statements(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body, NOT descending into nested defs (their
+    bodies execute on *their* call, not this one)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def local_callees(fn: ast.AST, functions: dict[str, ast.AST],
+                  owner_class: str | None) -> set[str]:
+    """Qualified names of module-local functions this function calls.
+
+    ``self.m()`` / ``cls.m()`` resolve against the owning class;
+    ``name()`` resolves to a module-level def of that name.
+    """
+    out: set[str] = set()
+    for node in _own_statements(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if not name:
+            continue
+        head, _, tail = name.partition(".")
+        if head in ("self", "cls") and tail and owner_class:
+            cand = f"{owner_class}.{tail}"
+            if cand in functions:
+                out.add(cand)
+        elif name in functions:
+            out.add(name)
+    return out
+
+
+def owner_class_of(qual: str) -> str | None:
+    """'Cls.method' -> 'Cls'; bare functions -> None."""
+    head, _, _tail = qual.rpartition(".")
+    return head or None
+
+
+def async_reachable(functions: dict[str, ast.AST]) -> dict[str, str]:
+    """Map qualified-name -> the async entry point it is reachable from.
+
+    Seeds every ``async def``; propagates over module-local *sync* calls
+    (an awaited async callee runs on the loop too, but is flagged at its
+    own seed). Value is the root async function's qualified name, for
+    diagnostics.
+    """
+    reach: dict[str, str] = {}
+    work: list[str] = []
+    for qual, node in functions.items():
+        if isinstance(node, ast.AsyncFunctionDef):
+            reach[qual] = qual
+            work.append(qual)
+    while work:
+        cur = work.pop()
+        node = functions[cur]
+        for callee in local_callees(node, functions, owner_class_of(cur)):
+            if callee in reach:
+                continue
+            callee_node = functions[callee]
+            if isinstance(callee_node, ast.AsyncFunctionDef):
+                continue  # its own seed
+            reach[callee] = reach[cur]
+            work.append(callee)
+    return reach
+
+
+# ---------------------------------------------------------------------------
+# Lockset analysis (two-pass)
+# ---------------------------------------------------------------------------
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "asyncio.Lock", "asyncio.Condition",
+}
+
+
+@dataclass
+class LockSite:
+    lock: str       # canonical lock id, e.g. "Controller.self._lock"
+    line: int
+    node: ast.AST
+
+
+@dataclass
+class LockOrderEdge:
+    first: str
+    second: str
+    path: str
+    line: int       # acquisition site of ``second`` while ``first`` held
+    via: str        # human-readable chain, e.g. "A.f -> with a -> with b"
+
+
+@dataclass
+class ModuleLocks:
+    """Pass 1 result: the module's named locks + every ordered pair."""
+    locks: set[str] = field(default_factory=set)
+    edges: list[LockOrderEdge] = field(default_factory=list)
+
+
+def _lock_names(tree: ast.Module) -> set[str]:
+    """Canonical ids of every variable/attribute assigned a lock ctor.
+
+    ``self._lock = threading.Lock()`` inside class C -> ``C.self._lock``;
+    module-level ``_LOCK = threading.Lock()`` -> ``_LOCK``.
+    """
+    names: set[str] = set()
+
+    def canon(target: ast.AST, cls: str | None) -> str | None:
+        try:
+            txt = ast.unparse(target)
+        except (ValueError, RecursionError):  # unparse of odd targets
+            return None
+        if cls and txt.startswith("self."):
+            return f"{cls}.{txt}"
+        if "." in txt and not txt.startswith("self."):
+            return None  # foreign-object attr: not ours to track
+        return txt if not txt.startswith("self.") else None
+
+    def visit(node: ast.AST, cls: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+                continue
+            if isinstance(child, ast.Assign):
+                val = child.value
+                if isinstance(val, ast.Call) and \
+                        call_name(val) in _LOCK_CTORS:
+                    for tgt in child.targets:
+                        c = canon(tgt, cls)
+                        if c:
+                            names.add(c)
+            visit(child, cls)
+
+    visit(tree, None)
+    return names
+
+
+def _as_lock(expr: ast.AST, cls: str | None, locks: set[str]) -> str | None:
+    """Resolve a with-item / .acquire() receiver to a canonical lock id."""
+    try:
+        txt = ast.unparse(expr)
+    except (ValueError, RecursionError):
+        return None
+    if cls and txt.startswith("self."):
+        cand = f"{cls}.{txt}"
+        return cand if cand in locks else None
+    return txt if txt in locks else None
+
+
+def analyze_locks(tree: ast.Module, path: str) -> ModuleLocks:
+    """Two-pass lockset: (1) find lock objects and record, per function,
+    the ordered pairs of nested acquisitions — including one level of
+    same-class calls made while a lock is held; (2) callers diff the
+    edge set for inconsistent orderings (see the lockset-order rule).
+    """
+    result = ModuleLocks(locks=_lock_names(tree))
+    if not result.locks:
+        return result
+    functions = collect_functions(tree)
+
+    # Locks acquired anywhere inside each function (for call propagation).
+    acquired_in: dict[str, list[LockSite]] = {}
+    for qual, fn in functions.items():
+        cls = owner_class_of(qual)
+        sites: list[LockSite] = []
+        for node in _own_statements(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lock = _as_lock(item.context_expr, cls, result.locks)
+                    if lock:
+                        sites.append(LockSite(lock, node.lineno, node))
+            elif isinstance(node, ast.Call) and \
+                    call_name(node).endswith(".acquire"):
+                recv = node.func.value  # type: ignore[attr-defined]
+                lock = _as_lock(recv, cls, result.locks)
+                if lock:
+                    sites.append(LockSite(lock, node.lineno, node))
+        acquired_in[qual] = sites
+
+    def walk_holding(node: ast.AST, held: list[str], qual: str,
+                     cls: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                inner = [
+                    _as_lock(i.context_expr, cls, result.locks)
+                    for i in child.items
+                ]
+                inner = [l for l in inner if l]
+                for lock in inner:
+                    for h in held:
+                        if h != lock:
+                            result.edges.append(LockOrderEdge(
+                                h, lock, path, child.lineno,
+                                via=f"{qual}: with {h} -> with {lock}",
+                            ))
+                walk_holding(child, held + inner, qual, cls)
+                continue
+            if isinstance(child, ast.Call) and held:
+                name = call_name(child)
+                head, _, tail = name.partition(".")
+                callee = None
+                if head in ("self", "cls") and tail and cls and \
+                        f"{cls}.{tail}" in functions:
+                    callee = f"{cls}.{tail}"
+                elif name in functions:
+                    callee = name
+                if callee:
+                    for site in acquired_in.get(callee, ()):
+                        for h in held:
+                            if h != site.lock:
+                                result.edges.append(LockOrderEdge(
+                                    h, site.lock, path, site.line,
+                                    via=(f"{qual}: holds {h}, calls "
+                                         f"{callee} which takes "
+                                         f"{site.lock}"),
+                                ))
+            walk_holding(child, held, qual, cls)
+
+    for qual, fn in functions.items():
+        walk_holding(fn, [], qual, owner_class_of(qual))
+    return result

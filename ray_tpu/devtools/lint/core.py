@@ -1,0 +1,252 @@
+"""rtlint core: findings, the rule registry, and per-file analysis context.
+
+Design mirrors what large distributed codebases run in review (custom
+clang-tidy / ErrorProne style): every rule is a small visitor over a
+shared parsed context, findings carry *content-based* fingerprints so a
+committed baseline survives line drift, and inline suppressions are
+first-class so intentional exceptions are documented where they live.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+
+    ORDER = {ERROR: 0, WARNING: 1}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-indexed
+    col: int
+    severity: str
+    message: str
+    # Filled by the runner: sha1 over (rule, path, normalized source line,
+    # occurrence index among identical lines) — stable across unrelated
+    # edits elsewhere in the file.
+    fingerprint: str = ""
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "severity": self.severity,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+#
+#   x = foo()  # rtlint: disable=rule-a,rule-b - reason text
+#   # rtlint: disable=rule-a - reason          (suppresses the next line)
+#   # rtlint: disable-file=rule-a - reason     (suppresses the whole file)
+#
+# The free-form reason after the rule list is *expected*: a suppression
+# is a documented decision, not an escape hatch.
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*rtlint:\s*disable(-file)?=([\w\-,]+)")
+
+
+class Suppressions:
+    def __init__(self, lines: list[str]):
+        self.file_wide: set[str] = set()
+        # line number -> set of rule names suppressed on that line
+        self.by_line: dict[int, set[str]] = {}
+        for i, text in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+            if m.group(1):  # disable-file
+                self.file_wide |= rules
+                continue
+            self.by_line.setdefault(i, set()).update(rules)
+            # A standalone comment line suppresses the next source line.
+            if text.lstrip().startswith("#"):
+                self.by_line.setdefault(i + 1, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_wide or "all" in self.file_wide:
+            return True
+        rules = self.by_line.get(line, ())
+        return rule in rules or "all" in rules
+
+
+# ---------------------------------------------------------------------------
+# Per-file context shared by all rules (parse once, analyze many).
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FileContext:
+    path: str                       # repo-relative
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+    suppressions: Suppressions = None  # type: ignore[assignment]
+    # lazily-built shared analyses (see callgraph.py)
+    _functions: dict = None         # type: ignore[assignment]
+    _parents: dict = None           # type: ignore[assignment]
+
+    @classmethod
+    def parse(cls, path: str, source: str) -> "FileContext":
+        tree = ast.parse(source)
+        lines = source.splitlines()
+        ctx = cls(path=path, source=source, tree=tree, lines=lines,
+                  suppressions=Suppressions(lines))
+        return ctx
+
+    # -- shared analyses ------------------------------------------------
+
+    def functions(self) -> dict:
+        """Qualified name -> (Async)FunctionDef for every def in the file.
+
+        Qualified as ``ClassName.method`` for methods, bare name for
+        module-level functions, ``outer.inner`` for nested defs.
+        """
+        if self._functions is None:
+            from ray_tpu.devtools.lint import callgraph
+
+            self._functions = callgraph.collect_functions(self.tree)
+        return self._functions
+
+    def parent_map(self) -> dict:
+        """ast node -> parent node, for lexical-enclosure queries."""
+        if self._parents is None:
+            parents: dict = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            self._parents = parents
+        return self._parents
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest enclosing (Async)FunctionDef, or None at module level."""
+        parents = self.parent_map()
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    def in_path(self, *fragments: str) -> bool:
+        """True when any fragment appears in the repo-relative path."""
+        return any(frag in self.path for frag in fragments)
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+class Rule:
+    """Base class. Subclasses set ``name``/``severity``/``description``
+    and implement ``check(ctx) -> Iterable[Finding]`` (per-file) or
+    ``check_project(ctxs) -> Iterable[Finding]`` for cross-file passes.
+    """
+
+    name: str = ""
+    severity: str = Severity.WARNING
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(
+        self, ctxs: list[FileContext]
+    ) -> Iterable[Finding]:
+        for ctx in ctxs:
+            yield from self.check(ctx)
+
+    def finding(self, ctx: FileContext, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=ctx.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            severity=self.severity,
+            message=message,
+        )
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register_rule(cls: type) -> type:
+    assert cls.name and cls.name not in _REGISTRY, cls
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_rules() -> dict[str, type]:
+    """name -> rule class, importing the built-in rule modules once."""
+    from ray_tpu.devtools.lint import rules as _rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints
+# ---------------------------------------------------------------------------
+
+_WS_RE = re.compile(r"\s+")
+
+
+def assign_fingerprints(findings: list[Finding],
+                        sources: dict[str, list[str]]) -> None:
+    """Content-based identity: hash of rule + path + the normalized text
+    of the flagged line + its occurrence index among identical
+    (rule, path, line-text) findings. Line *numbers* are deliberately
+    excluded so baselines survive edits elsewhere in the file.
+    """
+    seen: dict[tuple, int] = {}
+    for f in sorted(findings, key=Finding.sort_key):
+        lines = sources.get(f.path, [])
+        text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        norm = _WS_RE.sub(" ", text).strip()
+        key = (f.rule, f.path, norm)
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        raw = f"{f.rule}|{f.path}|{norm}|{idx}".encode()
+        f.fingerprint = hashlib.sha1(raw).hexdigest()[:16]
+
+
+def iter_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def call_name(call: ast.Call) -> str:
+    """Dotted name of a call target, '' when not a simple name/attribute
+    chain (subscripts, calls-of-calls)."""
+    parts: list[str] = []
+    cur: ast.AST = call.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif parts:
+        parts.append("<expr>")
+    else:
+        return ""
+    return ".".join(reversed(parts))

@@ -1,0 +1,108 @@
+"""rtlint output formats: human (default), JSON, and SARIF 2.1.0.
+
+SARIF is the interchange format CI systems (GitHub code scanning,
+Gerrit checks) ingest natively — `ci/run_lint.sh` uploads it as the
+build artifact so findings annotate the diff, not a log file.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ray_tpu.devtools.lint.core import Finding, Severity, all_rules
+
+_SARIF_LEVEL = {Severity.ERROR: "error", Severity.WARNING: "warning"}
+
+
+def render_human(new: list[Finding], baselined: list[Finding],
+                 stale: list[dict], stats: dict) -> str:
+    out = []
+    for f in sorted(new, key=Finding.sort_key):
+        out.append(
+            f"{f.path}:{f.line}:{f.col}: {f.severity}: "
+            f"[{f.rule}] {f.message}"
+        )
+    if stale:
+        out.append("")
+        for e in stale:
+            out.append(
+                f"stale baseline entry: {e['rule']} @ {e['path']} "
+                f"({e['fingerprint']}) — finding is gone, prune it"
+            )
+    out.append("")
+    out.append(
+        f"rtlint: {stats['files']} files, {stats['rules']} rules, "
+        f"{len(new)} new finding(s), {len(baselined)} baselined, "
+        f"{len(stale)} stale baseline entr(y/ies)"
+    )
+    return "\n".join(out)
+
+
+def render_json(new: list[Finding], baselined: list[Finding],
+                stale: list[dict], stats: dict) -> str:
+    return json.dumps({
+        "tool": "rtlint",
+        "stats": stats,
+        "findings": [f.to_dict() for f in sorted(new, key=Finding.sort_key)],
+        "baselined": [
+            f.to_dict() for f in sorted(baselined, key=Finding.sort_key)
+        ],
+        "stale_baseline_entries": stale,
+    }, indent=2)
+
+
+def render_sarif(new: list[Finding], baselined: list[Finding],
+                 stale: list[dict], stats: dict) -> str:
+    rules_meta = [
+        {
+            "id": name,
+            "shortDescription": {"text": cls.description},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVEL[cls.severity],
+            },
+        }
+        for name, cls in sorted(all_rules().items())
+    ]
+    results = []
+    for f in sorted(new, key=Finding.sort_key):
+        results.append({
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL[f.severity],
+            "message": {"text": f.message},
+            "partialFingerprints": {"rtlint/v1": f.fingerprint},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": f.line, "startColumn": f.col,
+                    },
+                },
+            }],
+        })
+    sarif = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "rtlint",
+                    "informationUri":
+                        "docs/devtools.md",
+                    "rules": rules_meta,
+                },
+            },
+            "results": results,
+            "properties": {"stats": stats,
+                           "baselined": len(baselined),
+                           "stale_baseline_entries": len(stale)},
+        }],
+    }
+    return json.dumps(sarif, indent=2)
+
+
+RENDERERS = {
+    "human": render_human,
+    "json": render_json,
+    "sarif": render_sarif,
+}

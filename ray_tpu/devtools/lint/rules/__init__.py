@@ -1,0 +1,10 @@
+"""Built-in rtlint rules. Importing this package registers them all."""
+
+from ray_tpu.devtools.lint.rules import (  # noqa: F401
+    blocking_in_async,
+    host_sync_in_step,
+    lockset_order,
+    non_atomic_write,
+    rank_divergent_collective,
+    swallowed_exception,
+)

@@ -1,0 +1,91 @@
+"""blocking-in-async: blocking work reachable from the async RPC lane.
+
+One ``time.sleep`` in a coroutine stalls *every* connection multiplexed
+on that event loop — heartbeats miss, leases expire, and the failure
+detector declares healthy nodes dead. The same goes for synchronous
+subprocess spawns and unbounded file reads inside async handlers.
+
+Scope: framework async code (``_private/``, ``serve/_private/``,
+``dashboard/``, ``data/_internal/``). Hard-blocking primitives
+(``time.sleep``, ``subprocess.*``, blocking socket dials, ``requests``)
+are flagged even when reached *transitively* through module-local sync
+helpers; plain ``open()`` is only flagged lexically inside an
+``async def`` (helpers that touch files have legitimate sync callers).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.lint import callgraph
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Rule,
+    Severity,
+    call_name,
+    iter_calls,
+    register_rule,
+)
+
+_BLOCKING = {
+    "time.sleep": "blocks the event loop; use `await asyncio.sleep(...)`",
+    "subprocess.run": "blocks the loop; use `asyncio.create_subprocess_exec`",
+    "subprocess.call": "blocks the loop; use `asyncio.create_subprocess_exec`",
+    "subprocess.check_call":
+        "blocks the loop; use `asyncio.create_subprocess_exec`",
+    "subprocess.check_output":
+        "blocks the loop; use `asyncio.create_subprocess_exec`",
+    "socket.create_connection":
+        "blocking dial on the loop; use `asyncio.open_connection`",
+    "urllib.request.urlopen":
+        "blocking HTTP on the loop; move to a thread or aiohttp",
+    "requests.get": "blocking HTTP on the loop; move to a thread or aiohttp",
+    "requests.post": "blocking HTTP on the loop; move to a thread or aiohttp",
+    "requests.request":
+        "blocking HTTP on the loop; move to a thread or aiohttp",
+}
+
+# Only flagged lexically inside `async def` (not via the call graph).
+_LEXICAL_ONLY = {
+    "open": "sync file I/O on the event loop; use `asyncio.to_thread(...)`",
+}
+
+_SCOPE = ("_private/", "dashboard/", "data/_internal/")
+
+
+@register_rule
+class BlockingInAsync(Rule):
+    name = "blocking-in-async"
+    severity = Severity.ERROR
+    description = (
+        "time.sleep / sync subprocess / blocking I/O reachable from an "
+        "async def in framework rpc/controller/agent/serve/dashboard code"
+    )
+
+    def check(self, ctx: FileContext):
+        if not ctx.in_path(*_SCOPE):
+            return
+        functions = ctx.functions()
+        reach = callgraph.async_reachable(functions)
+        for qual, fn in functions.items():
+            root = reach.get(qual)
+            direct_async = isinstance(fn, ast.AsyncFunctionDef)
+            if root is None and not direct_async:
+                continue
+            for node in callgraph._own_statements(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                hint = _BLOCKING.get(name)
+                if hint is None and direct_async:
+                    hint = _LEXICAL_ONLY.get(name)
+                if hint is None:
+                    continue
+                where = (
+                    f"`async def {qual}`" if direct_async
+                    else f"`{qual}`, reachable from `async def {root}`"
+                )
+                yield self.finding(
+                    ctx, node,
+                    f"`{name}` inside {where}: {hint}",
+                )

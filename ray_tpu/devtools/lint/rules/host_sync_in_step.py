@@ -1,0 +1,102 @@
+"""host-sync-in-step: device→host synchronization inside the step hot loop.
+
+``block_until_ready()``, ``np.asarray(device_array)``, ``.item()``,
+``float(loss)`` and ``jax.device_get`` all stall the host until the
+device queue drains. Inside the per-step training loop that turns the
+async dispatch pipeline into lock-step execution — the flight recorder
+(PR 8) shows it as compute-bound when it is actually host-bound.
+
+Scope: the training/model/parallel layers. Fires inside functions whose
+name marks them as the per-step body (``*step*``) and inside ``for``/
+``while`` loops of the driving loops (``fit``/``*loop*``/``*epoch*``).
+End-of-run barriers (timing, final metrics) live outside the loop and
+do not fire.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Rule,
+    Severity,
+    call_name,
+    register_rule,
+)
+
+_SCOPE = ("train/", "models/", "parallel/", "ops/")
+
+_STEP_FN_RE = re.compile(r"(^|_)step($|_)|^step")
+_LOOP_FN_RE = re.compile(r"(^|_)(fit|loop|epoch)s?($|_)")
+
+_SYNC_TAILS = {
+    "block_until_ready": "forces a device sync",
+    "item": "device->host copy + sync",
+    "device_get": "device->host copy + sync",
+}
+_SYNC_FULL = {
+    "np.asarray": "materializes the device array on host",
+    "numpy.asarray": "materializes the device array on host",
+    "jax.device_get": "device->host copy + sync",
+    "float": "scalar device->host sync",
+    "int": "scalar device->host sync",
+}
+
+
+def _in_loop(ctx: FileContext, node: ast.AST, fn: ast.AST) -> bool:
+    parents = ctx.parent_map()
+    cur = parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+@register_rule
+class HostSyncInStep(Rule):
+    name = "host-sync-in-step"
+    severity = Severity.WARNING
+    description = (
+        "block_until_ready()/.item()/float()/np.asarray on device values "
+        "inside the training-step hot loop — stalls dispatch pipelining"
+    )
+
+    def check(self, ctx: FileContext):
+        if not ctx.in_path(*_SCOPE):
+            return
+        for qual, fn in ctx.functions().items():
+            leaf = qual.rsplit(".", 1)[-1]
+            is_step = bool(_STEP_FN_RE.search(leaf))
+            is_loop = bool(_LOOP_FN_RE.search(leaf))
+            if not (is_step or is_loop):
+                continue
+            from ray_tpu.devtools.lint.callgraph import _own_statements
+
+            for node in _own_statements(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                tail = name.rsplit(".", 1)[-1]
+                why = _SYNC_FULL.get(name) or _SYNC_TAILS.get(tail)
+                if why is None:
+                    continue
+                # float()/int() only matter on non-literal args.
+                if name in ("float", "int") and (
+                    not node.args
+                    or isinstance(node.args[0], ast.Constant)
+                ):
+                    continue
+                # Inside a loop-driver function, only the loop body is
+                # hot; inside a *step* function everything is.
+                if is_loop and not is_step and \
+                        not _in_loop(ctx, node, fn):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"`{name}` in `{qual}` {why} inside the step hot "
+                    f"loop — move it outside the loop or onto the "
+                    f"metrics/report path",
+                )

@@ -1,0 +1,65 @@
+"""lockset-order: inconsistent lock-acquisition orderings (deadlock risk).
+
+Classic two-pass lockset analysis: pass 1 (callgraph.analyze_locks)
+records every ordered pair "lock B acquired while lock A held" — via
+lexical ``with`` nesting *and* one level of same-class calls made under
+a lock. Pass 2 (here) flags cycles in that order graph: if one code
+path takes A→B and another B→A, two threads can each hold one and wait
+forever on the other.
+
+Module-local on purpose: ray_tpu keeps each subsystem's locks in one
+module, and cross-process "locks" are leases/tokens with their own
+protocols (checked at runtime by the chaos suite, not here).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.devtools.lint import callgraph
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Finding,
+    Rule,
+    Severity,
+    register_rule,
+)
+
+
+@register_rule
+class LocksetOrder(Rule):
+    name = "lockset-order"
+    severity = Severity.ERROR
+    description = (
+        "two code paths acquire the same pair of locks in opposite "
+        "orders — a textbook AB/BA deadlock"
+    )
+
+    def check(self, ctx: FileContext):
+        result = callgraph.analyze_locks(ctx.tree, ctx.path)
+        if not result.edges:
+            return
+        # first-seen edge per ordered pair (for the report site).
+        by_pair: dict[tuple[str, str], callgraph.LockOrderEdge] = {}
+        for e in result.edges:
+            by_pair.setdefault((e.first, e.second), e)
+        reported: set[frozenset] = set()
+        for (a, b), edge in sorted(by_pair.items()):
+            rev = by_pair.get((b, a))
+            if rev is None:
+                continue
+            pair = frozenset((a, b))
+            if pair in reported:
+                continue
+            reported.add(pair)
+            yield Finding(
+                rule=self.name,
+                path=ctx.path,
+                line=edge.line,
+                col=1,
+                severity=self.severity,
+                message=(
+                    f"inconsistent lock order: `{a}` -> `{b}` here "
+                    f"({edge.via}) but `{b}` -> `{a}` at line "
+                    f"{rev.line} ({rev.via}) — pick one global order "
+                    f"or merge the critical sections"
+                ),
+            )

@@ -1,0 +1,102 @@
+"""non-atomic-write: state files written without tmp-then-rename.
+
+A crash (or chaos SIGKILL) between ``open(path, "w")`` and the final
+``write`` leaves a *torn* file at the real name — the PR-6 checkpoint
+work made every manifest/marker write go tmp + ``os.replace`` so
+readers see old-or-new, never garbage. This rule keeps it that way:
+any write-mode ``open`` in framework code must either target a temp
+that is later ``os.replace``d inside the same function, or go through
+``ray_tpu._private.atomic_io``.
+
+Streaming writers (multi-GB record files, log appends) cannot be
+small-file atomic — suppress with a reason at those sites.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Rule,
+    Severity,
+    call_name,
+    register_rule,
+)
+
+_WRITE_MODES = {"w", "wb", "wt", "w+", "wb+", "x", "xb"}
+
+
+def _open_write_target(call: ast.Call) -> ast.AST | None:
+    """The path expression of a write-mode builtin open(), else None."""
+    if call_name(call) != "open" or not call.args:
+        return None
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+            and mode.value in _WRITE_MODES:
+        return call.args[0]
+    return None
+
+
+@register_rule
+class NonAtomicWrite(Rule):
+    name = "non-atomic-write"
+    severity = Severity.WARNING
+    description = (
+        "open(path, 'w') state write without the tmp-then-os.replace "
+        "idiom — use ray_tpu._private.atomic_io so crashes never leave "
+        "torn files"
+    )
+
+    def check(self, ctx: FileContext):
+        parents = ctx.parent_map()
+
+        # Pass 1: per enclosing function, the unparsed first args of
+        # every os.replace() call.
+        replaced: dict[ast.AST | None, set[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    call_name(node) in ("os.replace", "os.rename") \
+                    and node.args:
+                fn = ctx.enclosing_function(node)
+                try:
+                    src = ast.unparse(node.args[0])
+                except (ValueError, RecursionError):
+                    continue
+                replaced.setdefault(fn, set()).add(src)
+
+        # Pass 2: every write-mode open must have its path os.replace'd
+        # within the same function.
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = _open_write_target(node)
+            if target is None:
+                continue
+            fn = ctx.enclosing_function(node)
+            try:
+                target_src = ast.unparse(target)
+            except (ValueError, RecursionError):
+                continue
+            safe = replaced.get(fn, set()) | replaced.get(None, set())
+            if target_src in safe:
+                continue
+            # A variable holding the temp name may be replaced under a
+            # different spelling; treat `X` as safe when any replace
+            # source *contains* X's name (e.g. `tmp` vs `tmp`).
+            if isinstance(target, ast.Name) and any(
+                    target.id == s or target.id in s for s in safe):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"`open({target_src}, 'w')` without a matching "
+                f"`os.replace` in the same function: a crash mid-write "
+                f"leaves a torn file — use atomic_io.atomic_write_* "
+                f"(tmp + rename), or suppress with a reason if this is "
+                f"a streaming/scratch write",
+            )

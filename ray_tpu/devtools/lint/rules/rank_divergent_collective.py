@@ -1,0 +1,102 @@
+"""rank-divergent-collective: a collective op under a rank-dependent branch.
+
+Collectives are rendezvous points: *every* rank of the gang must reach
+the same collective in the same order, or the gang deadlocks — or
+worse, with the PR-7 quantized wire path, ranks pair mismatched
+messages and training silently desyncs. A branch conditioned on
+``rank`` (which differs per process) guarding a ``psum``/``allreduce``
+is the canonical way to write that bug. Branching on ``world_size`` is
+fine — it is uniform across the gang.
+
+The point-to-point ops (``send``/``recv``/``p2p``) are intentionally
+excluded: rank-conditional send/recv is how p2p is *supposed* to look.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Rule,
+    Severity,
+    call_name,
+    iter_calls,
+    register_rule,
+)
+
+# Group-wide ops: every rank must call them.
+_COLLECTIVE_TAILS = {
+    "psum", "pmean", "pmax", "pmin", "allreduce", "all_reduce",
+    "allgather", "all_gather", "reduce_scatter", "barrier", "broadcast",
+    "allreduce_sharded", "sync_gradients", "sync_gradients_sharded",
+    "hierarchical_psum", "hierarchical_pmean",
+}
+
+# Names that vary per process. `world_size`/`num_workers` are uniform
+# and deliberately absent.
+_RANK_NAME_RE = re.compile(
+    r"(^|[._])(rank|local_rank|world_rank|node_rank|process_index|"
+    r"host_id|is_coordinator|is_main|is_leader)($|[._(])"
+)
+
+
+def _test_is_rank_dependent(test: ast.AST) -> str | None:
+    """Return the offending sub-expression text, or None if uniform."""
+    for node in ast.walk(test):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            try:
+                txt = ast.unparse(node)
+            except (ValueError, RecursionError):
+                continue
+            if _RANK_NAME_RE.search(txt):
+                return txt
+        elif isinstance(node, ast.Call):
+            name = call_name(node)
+            if _RANK_NAME_RE.search(name):
+                return name
+    return None
+
+
+@register_rule
+class RankDivergentCollective(Rule):
+    name = "rank-divergent-collective"
+    severity = Severity.ERROR
+    description = (
+        "collective op (psum/allreduce/barrier/...) guarded by a branch "
+        "conditioned on rank-derived values — gangs deadlock or silently "
+        "desync when ranks disagree on collective call order"
+    )
+
+    def check(self, ctx: FileContext):
+        parents = ctx.parent_map()
+        for call in iter_calls(ctx.tree):
+            name = call_name(call)
+            tail = name.rsplit(".", 1)[-1]
+            if tail not in _COLLECTIVE_TAILS:
+                continue
+            # Walk outward; stop at the function boundary (a whole
+            # function only entered on one rank is a call-site decision
+            # we cannot see locally).
+            cur = parents.get(call)
+            child = call
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if isinstance(cur, (ast.If, ast.While)):
+                    # Only flag when the call lives in the *body/orelse*,
+                    # not in the test expression itself.
+                    if child is not cur.test:
+                        offender = _test_is_rank_dependent(cur.test)
+                        if offender:
+                            yield self.finding(
+                                ctx, call,
+                                f"collective `{name}` under a branch on "
+                                f"`{offender}` (line {cur.lineno}): ranks "
+                                f"that skip it desync the gang — hoist "
+                                f"the collective out of the branch or "
+                                f"make the condition rank-uniform",
+                            )
+                            break
+                child = cur
+                cur = parents.get(cur)

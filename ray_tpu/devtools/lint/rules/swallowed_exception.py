@@ -1,0 +1,100 @@
+"""swallowed-exception: broad except that silently discards the error.
+
+``except Exception: pass`` in a dashboard handler hides the stack trace
+that would have explained the next incident; in a reconnect path it
+hides the *reason* a node never came back. A broad handler must do at
+least one of: re-raise, log, record to a span, or be explicitly
+suppressed with a reason (best-effort cleanup like ``sock.close()`` is
+legitimate — say so at the site).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Rule,
+    Severity,
+    call_name,
+    register_rule,
+)
+
+# A call whose target ends with one of these counts as "handled".
+_HANDLER_TAILS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log", "print", "print_exc", "format_exc", "record_exception",
+    "set_status", "record_error", "fail",
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in ("Exception", "BaseException")
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name)
+                   and e.id in ("Exception", "BaseException")
+                   for e in t.elts)
+    return False
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            tail = name.rsplit(".", 1)[-1]
+            if tail in _HANDLER_TAILS or tail.startswith("_log") or \
+                    tail.endswith("_debug") or tail.endswith("_log"):
+                return True
+    return False
+
+
+def _does_anything(handler: ast.ExceptHandler) -> bool:
+    """False when the body is pure pass/continue/`...` — the fully
+    silent swallow this rule targets. Handlers that compute a fallback
+    value are a different (lesser) smell and stay out of scope.
+    """
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant):
+            continue  # docstring / `...`
+        if isinstance(stmt, ast.Return) and (
+            stmt.value is None or isinstance(stmt.value, ast.Constant)
+        ):
+            continue  # `return None` / `return ""` — still silent
+        return True
+    return False
+
+
+@register_rule
+class SwallowedException(Rule):
+    name = "swallowed-exception"
+    severity = Severity.WARNING
+    description = (
+        "bare/broad except whose body neither re-raises, logs, nor "
+        "records to a span — failures vanish without a trace"
+    )
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _does_anything(node) or _handles(node):
+                continue
+            kind = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}"
+            yield self.finding(
+                ctx, node,
+                f"`{kind}` silently swallows the error: log it, narrow "
+                f"the type, re-raise — or suppress here with the reason "
+                f"this is safe to ignore",
+            )

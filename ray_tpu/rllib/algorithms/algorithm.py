@@ -18,6 +18,7 @@ from typing import Any, Optional
 import gymnasium as gym
 import numpy as np
 
+from ray_tpu._private import atomic_io
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.core.learner import LearnerGroup
 from ray_tpu.rllib.core.rl_module import RLModuleSpec
@@ -341,8 +342,9 @@ class Algorithm:
             "config": self.config.to_dict(),
             "algo_class": type(self).__name__,
         }
-        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "wb") as f:
-            pickle.dump(state, f)
+        atomic_io.atomic_write_pickle(
+            os.path.join(checkpoint_dir, "algorithm_state.pkl"), state
+        )
         return checkpoint_dir
 
     def restore(self, checkpoint_dir: str) -> None:

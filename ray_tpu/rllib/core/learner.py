@@ -235,7 +235,7 @@ class LearnerGroup:
         for actor in self.actors:
             try:
                 ray_tpu.kill(actor)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - actor already dead
                 pass
 
 

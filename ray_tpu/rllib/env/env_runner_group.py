@@ -130,5 +130,5 @@ class EnvRunnerGroup:
         for runner in self.runners:
             try:
                 ray_tpu.kill(runner)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - runner already dead
                 pass

@@ -224,8 +224,9 @@ def cmd_timeline(args) -> None:
 
     trace = ray_tpu.timeline()
     out = args.out or args.output
-    with open(out, "w") as f:
-        json.dump(trace, f)
+    from ray_tpu._private.atomic_io import atomic_write_json
+
+    atomic_write_json(out, trace)
     n = len(trace.get("traceEvents", []))
     print(f"wrote {n} events to {out} (load in ui.perfetto.dev "
           f"or chrome://tracing)")
@@ -341,6 +342,16 @@ def main(argv=None) -> None:
 
     p = sub.add_parser("microbenchmark")
     p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser(
+        "lint",
+        help="framework-aware static analysis (distributed-hazard rules "
+             "+ lockset deadlock checks); see docs/devtools.md",
+    )
+    from ray_tpu.devtools.lint.runner import add_lint_arguments, cmd_lint
+
+    add_lint_arguments(p)
+    p.set_defaults(fn=lambda a: sys.exit(cmd_lint(a)))
 
     p = sub.add_parser("job")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

@@ -13,6 +13,7 @@ the reconcile.
 from __future__ import annotations
 
 import hashlib
+import logging
 import pickle
 import threading
 import time
@@ -31,6 +32,8 @@ from ray_tpu.serve._private.common import (
 from ray_tpu.serve._private.replica import Replica
 
 RECONCILE_PERIOD_S = 0.25
+
+logger = logging.getLogger(__name__)
 
 
 def _kv_call(method: str, payload: dict) -> Any:
@@ -113,7 +116,13 @@ class ServeController:
                             try:
                                 actor.reconfigure.remote(info.config.user_config)
                             except Exception:
-                                pass
+                                # Replica death is handled by the health
+                                # check; the new config lands on its
+                                # replacement.
+                                logger.debug(
+                                    "reconfigure push to %s failed",
+                                    rep.actor_name, exc_info=True,
+                                )
             # Remove deployments dropped from the app.
             for qname in self._app_deployments.get(app_name, []):
                 if qname not in new_names:
@@ -193,7 +202,7 @@ class ServeController:
         for loop, event in list(self._pollers):
             try:
                 loop.call_soon_threadsafe(event.set)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - poller loop may be closed; next poll re-registers
                 pass
 
     def _membership_snapshot(self) -> dict:
@@ -300,7 +309,7 @@ class ServeController:
                         metrics.append(
                             ray_tpu.get(handle.get_metrics.remote(), timeout=5)
                         )
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - metrics fetch from a dying replica; skip it
                     pass
             out[qname] = metrics
         return out
@@ -413,11 +422,11 @@ class ServeController:
         def _drain():
             try:
                 ray_tpu.get(actor.prepare_to_drain.remote(), timeout=10)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - replica hung in drain; kill follows
                 pass
             try:
                 ray_tpu.kill(actor)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - actor already dead
                 pass
             rep.state = "DEAD"
 
@@ -444,7 +453,7 @@ class ServeController:
                 self._actor_handles.pop(rep.actor_name, None)
                 try:
                     ray_tpu.kill(actor)
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - kill of an already-dead replica
                     pass
         self._replicas[qname] = [r for r in replicas if r.state != "DEAD"]
 
@@ -464,7 +473,7 @@ class ServeController:
                 total_ongoing += ray_tpu.get(
                     actor.get_num_ongoing.remote(), timeout=5
                 )
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - queue-depth probe failed; autoscale on what we have
                 pass
         current = self._autoscale_counts.get(
             qname, info.config.autoscaling_config.min_replicas
@@ -494,7 +503,9 @@ class ServeController:
                 },
             )
         except Exception:
-            pass
+            # A lost checkpoint only bites on controller restart — which is
+            # exactly when nobody is watching. Make the gap visible now.
+            logger.warning("controller checkpoint save failed", exc_info=True)
 
     def _restore_checkpoint(self) -> None:
         try:
@@ -513,7 +524,10 @@ class ServeController:
                             info.config.autoscaling_config
                         )
         except Exception:
-            pass
+            logger.warning(
+                "controller checkpoint restore failed; starting with empty "
+                "target state", exc_info=True,
+            )
 
     @staticmethod
     def _version_of(spec: dict) -> str:

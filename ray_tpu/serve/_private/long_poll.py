@@ -81,7 +81,7 @@ class UpdateSubscriber:
                 controller.poll_update.remote(-1, 0.0), timeout=30
             )
             self._apply(update)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - opportunistic snapshot; the push path catches up
             pass
 
     def stop(self) -> None:

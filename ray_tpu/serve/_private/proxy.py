@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import logging
 import time
 import threading
 from typing import Any
@@ -22,6 +23,8 @@ from ray_tpu._private.workload import LatencyHistogram
 from ray_tpu.serve._private.common import CONTROLLER_NAME
 from ray_tpu.serve._private.routing import RoutingMixin
 from ray_tpu.util import tracing
+
+logger = logging.getLogger(__name__)
 
 
 class HTTPProxy(RoutingMixin):
@@ -219,7 +222,8 @@ class HTTPProxy(RoutingMixin):
                 route, seconds, "500" if error else "200"
             )
         except Exception:
-            pass
+            # The request already succeeded; only the metric is lost.
+            logger.debug("serve request metric record failed", exc_info=True)
 
     def get_route_stats(self) -> dict:
         """Per-route SLO snapshot: {route: {count, p50_ms, p95_ms,
@@ -273,7 +277,10 @@ class HTTPProxy(RoutingMixin):
                 )
             )
         except Exception:
-            pass
+            logger.debug(
+                "route-stats flush to controller failed; next interval "
+                "re-sends cumulative counts", exc_info=True,
+            )
 
     # -- control --------------------------------------------------------
     def ready(self) -> str:

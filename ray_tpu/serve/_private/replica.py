@@ -275,7 +275,7 @@ class Replica:
                     "batch_occupancy", self.deployment_name,
                     self.replica_id, batch_stats["batch_occupancy"],
                 )
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - metric export must never fail a request
             pass
         return out
 

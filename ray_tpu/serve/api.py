@@ -211,7 +211,7 @@ def _kill_quietly(handle) -> None:
     if handle is not None:
         try:
             ray_tpu.kill(handle)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - actor already dead
             pass
 
 
@@ -352,7 +352,7 @@ def shutdown() -> None:
     try:
         ray_tpu.get(controller.shutdown.remote(), timeout=60)
         ray_tpu.kill(controller)
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - controller already dead at shutdown
         pass
     _kill_quietly(_proxy_handle)
     _kill_quietly(_grpc_handle)

@@ -76,7 +76,7 @@ class DeploymentResponse:
             handle = self._router._replica_handle(self._replica_name)
             ray_tpu.get(handle.check_health.remote(), timeout=5)
             return True
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - health probe: any failure counts as dead
             return False
 
     def _on_replica_death(self, exc: Exception, timeout) -> Any:
@@ -192,7 +192,7 @@ class ResponseStream:
                 ray_tpu.get(
                     replica.stream_cancel.remote(self._stream_id), timeout=30
                 )
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - replica died; the stream is already torn down
                 pass
             self._response._mark_done()
 
@@ -275,7 +275,7 @@ class Router:
                 refs[name] = self._replica_handle(
                     name
                 ).get_warm_shapes.remote()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - dead replica: the collect loop below skips it
                 pass
         deadline = time.monotonic() + 2.0
         updates: dict[str, set | None] = {}

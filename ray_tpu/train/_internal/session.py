@@ -99,7 +99,7 @@ class _Session:
             if getattr(shard, "supports_state", False):
                 try:
                     ingest[name] = shard.state_dict()
-                except Exception:
+                except Exception:  # rtlint: disable=swallowed-exception - iterator snapshot is best-effort; resume falls back
                     pass
         # Cut the StepStats record BEFORE blocking on the driver: the
         # step interval must cover the user's work, not the driver's

@@ -41,7 +41,7 @@ def enabled() -> bool:
         from ray_tpu._private.config import global_config
 
         return bool(global_config().workload_stats_enabled)
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - config unreachable outside a cluster: default on
         return True
 
 
@@ -267,7 +267,7 @@ class FlightRecorder:
             from ray_tpu._private.config import global_config
 
             return float(global_config().straggler_mad_k)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - config unreachable: default MAD k
             return 3.0
 
     # -- controller uplink ----------------------------------------------

@@ -34,6 +34,8 @@ from typing import Any, Iterator
 
 import numpy as np
 
+from ray_tpu._private import atomic_io
+
 _MANIFEST = "manifest.json"
 _TREEDEF = "treedef.pkl"
 _COMMIT = "COMMIT.json"
@@ -76,21 +78,14 @@ class Checkpoint:
 # Atomic small-file writes
 # ---------------------------------------------------------------------------
 
-def _atomic_write_bytes(path: str, data: bytes) -> None:
-    """tmp + os.replace so a crash mid-write never leaves a torn file at
-    the final name (readers either see the old content or the new)."""
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
-
-
-def _atomic_write_json(path: str, obj: Any) -> None:
-    _atomic_write_bytes(path, json.dumps(obj).encode())
-
-
-def _atomic_write_pickle(path: str, obj: Any) -> None:
-    _atomic_write_bytes(path, pickle.dumps(obj))
+# tmp + os.replace so a crash mid-write never leaves a torn file at the
+# final name (readers either see the old content or the new). The
+# canonical implementation moved to ray_tpu._private.atomic_io so every
+# state-writing layer shares it; these aliases keep the historical names
+# that the rest of the train package (and backend_executor) import.
+_atomic_write_bytes = atomic_io.atomic_write_bytes
+_atomic_write_json = atomic_io.atomic_write_json
+_atomic_write_pickle = atomic_io.atomic_write_pickle
 
 
 def _file_crc32(path: str) -> int:

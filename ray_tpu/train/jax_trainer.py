@@ -113,7 +113,7 @@ def _session_events_dir_known() -> str | None:
         import ray_tpu
 
         return ray_tpu.runtime_info().get("session_dir")
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - no cluster context: no session dir
         return None
 
 
@@ -299,7 +299,7 @@ class DataParallelTrainer:
             import ray_tpu
 
             avail = ray_tpu.available_resources()
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - resource probe failed: skip this grow attempt
             return False
         need = self.scaling_config.worker_resources()
         return all(

@@ -15,12 +15,14 @@ the reference's checkpoint-dir copies.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 import traceback
 from typing import Any, Optional
 
 import ray_tpu
+from ray_tpu._private import atomic_io
 from ray_tpu.tune.experiment.trial import (
     ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial,
 )
@@ -29,6 +31,8 @@ from ray_tpu.tune.search.searcher import Searcher
 from ray_tpu.tune.trainable import Trainable
 
 EXPERIMENT_STATE_FILE = "experiment_state.json"
+
+logger = logging.getLogger(__name__)
 
 
 @ray_tpu.remote
@@ -122,7 +126,12 @@ class TuneController:
                 donor.checkpoint = ray_tpu.get(donor_actor.save.remote(), timeout=60)
                 donor.checkpoint_iter = donor.iteration
             except Exception:
-                pass
+                # Exploit proceeds from the donor's LAST saved checkpoint.
+                logger.warning(
+                    "transplant: saving donor %s failed; using its last "
+                    "checkpoint (iter %s)",
+                    donor.trial_id, donor.checkpoint_iter, exc_info=True,
+                )
         trial.config = dict(new_config)
         trial.checkpoint = donor.checkpoint
         trial.checkpoint_iter = donor.checkpoint_iter
@@ -193,11 +202,11 @@ class TuneController:
             return
         try:
             ray_tpu.get(actor.stop.remote(), timeout=5)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - stop timed out; kill follows
             pass
         try:
             ray_tpu.kill(actor)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - actor already dead
             pass
 
     def _drop_pending_future(self, trial: Trial) -> None:
@@ -275,7 +284,12 @@ class TuneController:
                     trial.checkpoint_iter = trial.iteration
                     trial.persist_checkpoint()
             except Exception:
-                pass
+                # A missed save costs resume granularity, not correctness —
+                # but a silently failing one costs the whole experiment.
+                logger.warning(
+                    "checkpointing trial %s failed", trial.trial_id,
+                    exc_info=True,
+                )
 
         if done:
             self._complete_trial(trial, result)
@@ -352,7 +366,9 @@ class TuneController:
             try:
                 handler(**kwargs)
             except Exception:
-                pass
+                # User callbacks must not kill the trial loop, but their
+                # bugs must not vanish either (reference logs these too).
+                logger.warning("callback %s raised", hook, exc_info=True)
 
     # -- experiment state (Tuner.restore) --
 
@@ -365,19 +381,16 @@ class TuneController:
             "trials": [t.to_json() for t in self.trials],
         }
         path = os.path.join(self.experiment_dir, EXPERIMENT_STATE_FILE)
-        tmp = path + ".tmp"
         try:
-            with open(tmp, "w") as f:
-                json.dump(state, f, default=str)
-            os.replace(tmp, path)
-        except TypeError:
+            atomic_io.atomic_write_json(path, state, default=str)
+        except TypeError:  # rtlint: disable=swallowed-exception - unserializable user state: restore restarts fresh, by design
             pass
 
     @staticmethod
     def _try(fn):
         try:
             return fn()
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - probe helper: callers treat None as unavailable
             return None
 
     def restore_experiment_state(self, resume_errored: bool = False) -> None:

@@ -12,6 +12,8 @@ import os
 import uuid
 from typing import Any, Optional
 
+from ray_tpu._private import atomic_io
+
 PENDING = "PENDING"
 RUNNING = "RUNNING"
 PAUSED = "PAUSED"
@@ -118,10 +120,12 @@ class Trial:
         if self.checkpoint is None:
             return
         try:
-            with open(os.path.join(self.local_dir, "checkpoint.json"), "w") as f:
-                json.dump({"data": self.checkpoint, "iter": self.checkpoint_iter}, f)
-        except TypeError:
-            pass  # non-json-serializable checkpoint: resume restarts fresh
+            atomic_io.atomic_write_json(
+                os.path.join(self.local_dir, "checkpoint.json"),
+                {"data": self.checkpoint, "iter": self.checkpoint_iter},
+            )
+        except TypeError:  # rtlint: disable=swallowed-exception - non-json-serializable checkpoint: resume restarts fresh, by design
+            pass
 
     def __repr__(self):
         return f"Trial({self.trial_id}, {self.status}, iter={self.iteration})"

@@ -168,7 +168,7 @@ class ChaosMonkey:
             try:
                 if client._sock is not None:
                     client._sock.close()
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - socket already closed
                 pass
         self.events.append(
             {"kill": kill, "status": reply.get("status"),

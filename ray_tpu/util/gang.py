@@ -136,7 +136,7 @@ class WorkerGang:
             # gangs at several sizes in quick succession).
             try:
                 remove_placement_group(self.pg)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - PG may be gone; the placement error re-raises below
                 pass
             raise
         member_cls = ray_tpu.remote(_GangMember)
@@ -217,16 +217,16 @@ class WorkerGang:
         try:
             ray_tpu.get([m.ping.remote() for m in self.members], timeout=30)
             return True
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - any failure counts as unhealthy
             return False
 
     def shutdown(self) -> None:
         for member in self.members if hasattr(self, "members") else []:
             try:
                 ray_tpu.kill(member)
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - member already dead
                 pass
         try:
             remove_placement_group(self.pg)
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - PG already removed
             pass

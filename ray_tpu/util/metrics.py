@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import logging
 import threading
 import time
 from typing import Mapping, Optional, Sequence
@@ -29,7 +30,11 @@ def _flush_loop() -> None:
         try:
             flush()
         except Exception:
-            pass
+            # Keep the daemon alive across controller blips; debug-level
+            # so a permanently broken uplink is still discoverable.
+            logging.getLogger(__name__).debug(
+                "metrics flush failed", exc_info=True
+            )
 
 
 def _ensure_flusher() -> None:
@@ -49,7 +54,9 @@ def _flush_at_exit() -> None:
     try:
         flush()
     except Exception:
-        pass
+        logging.getLogger(__name__).debug(
+            "final metrics flush failed", exc_info=True
+        )
 
 
 def flush() -> None:
@@ -62,7 +69,7 @@ def flush() -> None:
         return
     try:
         ctx = worker_mod.get_global_context()
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - no cluster context: nothing to flush to
         return
     entries = [
         {"key": key, "value": json.dumps(point).encode()}
@@ -294,7 +301,7 @@ def local_engine_points() -> list:
     for idx, (_loop_id, engine) in enumerate(engines):
         try:
             stats = engine.stats()
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - engine died mid-scrape; skip it
             continue
         for field, value in stats.items():
             points.append(
@@ -408,7 +415,7 @@ def collect_prometheus_text() -> str:
     """Render every recorded metric in Prometheus exposition format."""
     try:
         ctx = worker_mod.get_global_context()
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - no cluster context: empty exposition
         return ""
     keys = ctx.io.run(
         ctx.controller.call("kv_keys", {"namespace": "metrics", "prefix": ""})

@@ -419,15 +419,15 @@ def collect_diagnose_snapshot(session_dir: str | None = None) -> dict:
     }
     try:
         snapshot["latency"] = summarize_latency(session_dir)
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - summaries are independent; a failed one keeps its default
         pass
     try:
         snapshot["comm"] = summarize_comm(session_dir)
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - summaries are independent; a failed one keeps its default
         pass
     try:
         snapshot["resources"] = summarize_resources()
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - summaries are independent; a failed one keeps its default
         pass
     snapshot["workload"] = summarize_workload()
     snapshot["goodput"] = summarize_goodput()
@@ -446,6 +446,6 @@ def collect_diagnose_snapshot(session_dir: str | None = None) -> dict:
                 snapshot["rank_records"].setdefault(
                     experiment, []
                 ).extend(records)
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - workload timeline is optional in the snapshot
         pass
     return snapshot

@@ -182,9 +182,9 @@ def build_chrome_trace(
             try:
                 ctx = worker_mod.get_global_context()
                 points.extend(metrics.control_plane_points(ctx))
-            except Exception:
+            except Exception:  # rtlint: disable=swallowed-exception - control-plane counters are optional off-cluster
                 pass
             events.extend(_counter_events(points, now_us))
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - counter events are optional enrichment
             pass
     return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -42,6 +42,7 @@ import contextvars
 import glob
 import itertools
 import json
+import logging
 import os
 import threading
 import time
@@ -109,7 +110,7 @@ def configure(session_dir: str | None) -> None:
         # into the new session dir.
         try:
             flush()
-        except Exception:
+        except Exception:  # rtlint: disable=swallowed-exception - flush into a dead previous session is best-effort
             pass
         _dir = os.path.join(session_dir, "tracing")
 
@@ -210,7 +211,11 @@ def _flush_loop() -> None:
         try:
             flush()
         except Exception:
-            pass
+            # Keep the daemon alive; surface persistent write failures
+            # when span-level debugging is on.
+            logging.getLogger(__name__).debug(
+                "trace flush failed", exc_info=True
+            )
 
 
 def _ensure_flusher() -> None:

@@ -25,7 +25,7 @@ def force_cpu(devices: int = 8) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    except Exception:
+    except Exception:  # rtlint: disable=swallowed-exception - jax optional in the bench venv
         pass
 
 

@@ -131,8 +131,11 @@ def main() -> int:
         results.append(result)
         print(json.dumps(result), file=sys.stderr)
 
-    with open(os.path.join(REPO, "release_results.json"), "w") as fh:
-        json.dump(results, fh, indent=2)
+    from ray_tpu._private.atomic_io import atomic_write_json
+
+    atomic_write_json(
+        os.path.join(REPO, "release_results.json"), results, indent=2
+    )
     # Append-only history: one line per suite run (regression archaeology).
     with open(os.path.join(REPO, "release_history.jsonl"), "a") as fh:
         fh.write(json.dumps({
