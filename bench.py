@@ -8,14 +8,228 @@ vs_baseline is the GPU-parity ratio from BASELINE.json's north star
 by an A100's effective training FLOP/s on the same model (312 TFLOP/s bf16
 peak × 40% MFU = 125 TFLOP/s — the standard well-tuned-GPU operating
 point). vs_baseline >= 1.0 means one TPU chip matches/beats one A100.
+
+Matrix mode (ISSUE 10): ``--sharding dp|fsdp|tp|pp`` benchmarks ONE
+parallelism strategy on the same model family through the GSPMD trainer
+path (jax_utils.setup_sharded_training / one-jit train step), emitting
+the SAME JSON schema with ``detail.sharding`` + ``detail.factorization``
+so the driver's comparisons stay schema-stable across modes.
 """
 
 from __future__ import annotations
 
+import argparse
 import functools
 import json
+import os
 import sys
 import time
+
+
+def _emit(tokens_per_s: float, params: int, detail: dict) -> None:
+    """Shared JSON emitter — the two modes report identical schemas."""
+    achieved_flops = 6.0 * params * tokens_per_s     # fwd+bwd rule of thumb
+    a100_effective = 312e12 * 0.40                   # GPU-parity yardstick
+    import jax
+
+    device_kind = jax.devices()[0].device_kind
+    peaks = {
+        "TPU v4": 275e12, "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+        "TPU v5p": 459e12, "TPU v6 lite": 918e12,
+    }
+    peak = next((v for k, v in peaks.items() if device_kind.startswith(k)), None)
+    # Matrix mode spans len(jax.devices()) chips; peak scales with them.
+    n_dev = detail.get("devices", 1)
+    mfu = round(achieved_flops / (peak * n_dev), 4) if peak else None
+    print(
+        json.dumps(
+            {
+                "metric": "transformer_train_tokens_per_s_per_chip",
+                "value": round(tokens_per_s / n_dev, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(achieved_flops / a100_effective / n_dev, 4),
+                "detail": {
+                    "backend": jax.default_backend(),
+                    "device_kind": device_kind,
+                    "params": params,
+                    "achieved_tflops": round(achieved_flops / 1e12, 2),
+                    "mfu": mfu,
+                    **detail,
+                },
+            }
+        )
+    )
+
+
+def sharded_main(mode: str) -> None:
+    """--sharding matrix entry: train the bench transformer through the
+    GSPMD path under ONE strategy and report the same schema."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.transformer import (
+        TransformerConfig, init_params, loss_fn, num_params,
+        param_logical_dims, partition_stages, stage_forward, logits_loss,
+    )
+    from ray_tpu.parallel.mesh import MeshSpec
+    from ray_tpu.train import jax_utils
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "gpu")
+    n_dev = len(jax.devices())
+    if on_accel:
+        config = TransformerConfig(
+            vocab_size=8192, dim=4096, n_layers=4, n_heads=32, n_kv_heads=32,
+            hidden_dim=16384, max_seq=1024, dtype=jnp.bfloat16,
+        )
+        batch, steps = 4 * n_dev if mode in ("dp", "fsdp") else 16, 10
+    else:  # CPU matrix smoke: dims divisible by every axis size we use
+        config = TransformerConfig(
+            vocab_size=512, dim=128, n_layers=4, n_heads=8, n_kv_heads=8,
+            hidden_dim=256, max_seq=128, dtype=jnp.float32,
+        )
+        batch, steps = n_dev, 2
+
+    optimizer = optax.adamw(3e-4)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, config.max_seq + 1), 0,
+        config.vocab_size,
+    )
+
+    def batch_loss(params, tok):
+        return loss_fn(params, tok[:, :-1], tok[:, 1:], config)
+
+    if mode == "pp":
+        tokens_per_s, p, extra = _bench_pp(
+            config, optimizer, tokens, steps,
+            init_params, partition_stages, stage_forward, logits_loss,
+        )
+    else:
+        axes = {mode: n_dev}
+        mesh = MeshSpec(axes).build(jax.devices())
+        setup = jax_utils.setup_sharded_training(
+            lambda: init_params(config, jax.random.PRNGKey(0)),
+            optimizer,
+            mesh=mesh,
+            logical_dims=param_logical_dims(config),
+        )
+        step_fn = jax_utils.build_sharded_train_step(
+            batch_loss, optimizer, setup
+        )
+        tokens_sh = setup.shard_batch(tokens)
+        params, opt_state = setup.params, setup.opt_state
+        params, opt_state, loss = step_fn(params, opt_state, tokens_sh)
+        first_loss = float(loss)
+        start = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step_fn(params, opt_state, tokens_sh)
+        loss_value = float(loss)
+        elapsed = time.perf_counter() - start
+        if not (loss_value < first_loss):
+            print(
+                f"BENCH SANITY FAILED: loss did not decrease "
+                f"({first_loss} -> {loss_value})",
+                file=sys.stderr,
+            )
+            raise SystemExit(1)
+        tokens_per_s = batch * config.max_seq * steps / elapsed
+        p = num_params(params)
+        extra = {
+            "loss": loss_value,
+            "factorization": setup.factorization,
+        }
+    _emit(
+        tokens_per_s, p,
+        {"sharding": mode, "devices": n_dev, **extra},
+    )
+
+
+def _bench_pp(config, optimizer, tokens, steps, init_params,
+              partition_stages, stage_forward, logits_loss):
+    """Single-process 2-stage microbatched pipeline: same math the MPMD
+    stage runner executes, here in topological order (no wire), so the
+    matrix row measures the staged computation's throughput."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.parallel.pipeline import bubble_fraction
+
+    num_stages, microbatches = 2, 4
+    params = init_params(config, jax.random.PRNGKey(0))
+    stages = partition_stages(params, config, num_stages)
+    opt_states = [optimizer.init(s) for s in stages]
+
+    def s0_fwd(p, x):
+        return stage_forward(p, x, config, first=True, last=False)
+
+    def s1_loss(p, a, targets):
+        return logits_loss(
+            stage_forward(p, a, config, first=False, last=True), targets
+        )
+
+    fwd0 = jax.jit(s0_fwd)
+    grad1 = jax.jit(jax.value_and_grad(s1_loss, argnums=(0, 1)))
+
+    def bwd0(p, x, ct):
+        _, vjp_fn = jax.vjp(s0_fwd, p, x)
+        return vjp_fn(ct)[0]
+
+    bwd0 = jax.jit(bwd0)
+
+    def apply(p, o, g):
+        updates, new_o = optimizer.update(g, o, p)
+        return jax.tree.map(
+            lambda w, u: w + u.astype(w.dtype), p, updates
+        ), new_o
+
+    apply = jax.jit(apply)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    mb = inputs.shape[0] // microbatches
+
+    def one_step():
+        g_acc = [None, None]
+        losses = []
+        for m in range(microbatches):
+            x = inputs[m * mb:(m + 1) * mb]
+            y = targets[m * mb:(m + 1) * mb]
+            a = fwd0(stages[0], x)
+            loss, (g1, da) = grad1(stages[1], a, y)
+            g0 = bwd0(stages[0], x, da)
+            losses.append(loss)
+            for i, g in ((0, g0), (1, g1)):
+                g_acc[i] = g if g_acc[i] is None else jax.tree.map(
+                    jnp.add, g_acc[i], g
+                )
+        for i in range(num_stages):
+            g = jax.tree.map(lambda v: v / microbatches, g_acc[i])
+            stages[i], opt_states[i] = apply(stages[i], opt_states[i], g)
+        return float(jnp.mean(jnp.stack(losses)))
+
+    first_loss = one_step()  # warmup/compile
+    start = time.perf_counter()
+    for _ in range(steps):
+        loss_value = one_step()
+    elapsed = time.perf_counter() - start
+    if not (loss_value < first_loss):
+        print(
+            f"BENCH SANITY FAILED: loss did not decrease "
+            f"({first_loss} -> {loss_value})",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    p = sum(
+        int(jnp.size(l)) for s in stages for l in jax.tree.leaves(s)
+    )
+    tokens_per_s = inputs.shape[0] * inputs.shape[1] * steps / elapsed
+    return tokens_per_s, p, {
+        "loss": loss_value,
+        "factorization": {"dp": 1, "fsdp": 1, "tp": 1, "pp": num_stages},
+        "microbatches": microbatches,
+        "schedule_bubble_fraction": round(
+            bubble_fraction(num_stages, microbatches), 4
+        ),
+    }
 
 
 def main() -> None:
@@ -121,8 +335,26 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--sharding", choices=("dp", "fsdp", "tp", "pp"), default=None,
+        help="matrix mode: bench ONE parallelism strategy via the GSPMD "
+        "trainer path instead of the single-chip headline",
+    )
+    cli = parser.parse_args()
+    if cli.sharding and "xla_force_host_platform_device_count" not in (
+        os.environ.get("XLA_FLAGS", "")
+    ) and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # CPU twin: the matrix needs >1 device to shard over.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
     try:
-        main()
+        if cli.sharding:
+            sharded_main(cli.sharding)
+        else:
+            main()
     except Exception as exc:  # never crash the driver: report the failure
         print(
             json.dumps(

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# GSPMD sharded-training gate (ISSUE 10): NamedSharding mesh trainer +
+# MPMD pipeline stages.
+#
+# Two layers, same subsystem:
+#   1. tests/test_sharding.py — the functional floor (mesh-spec edge
+#      cases, FSDP auto-policy divisibility fallbacks, 1F1B schedule
+#      ordering/bubble, dp8 vs dp2xfsdp2xtp2 loss parity, the
+#      replicated path refusing over-budget states, and the elastic
+#      resize dp=4 -> dp=2xfsdp=2 bitwise loss-trajectory parity).
+#      These also run as part of plain tier-1 `pytest -m 'not slow'`.
+#   2. the sharded_training release entry under --smoke, which enforces
+#      fit-at-1B / replicated-refuses / pipeline-bubble <= 0.25 /
+#      MFU >= 0.72-on-chip and appends the run to release_history.jsonl.
+#
+# The same entry at full size: python release/run_all.py --only sharded_training
+# Usage: ci/run_sharded_bench.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== sharding (pytest, functional floor) =="
+python -m pytest tests/test_sharding.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+
+echo "== sharding (release floors, --smoke) =="
+python release/run_all.py --smoke --only sharded_training
+
+echo "sharded bench: PASS"

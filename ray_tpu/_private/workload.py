@@ -24,8 +24,16 @@ from typing import Any, Iterable
 
 # Per-rank phase fields of a StepStats record (seconds). ``wall_s`` is
 # the full report-to-report interval; ``compute_s`` is derived as the
-# remainder so the four phases always sum to wall.
-STEP_PHASES = ("data_wait_s", "compute_s", "collective_s", "checkpoint_s")
+# remainder so the phases always sum to wall. ``pp_bubble_s`` is time a
+# pipeline stage spent blocked on a neighbor's activations (ISSUE 10) —
+# zero on non-pipelined runs.
+STEP_PHASES = (
+    "data_wait_s",
+    "compute_s",
+    "collective_s",
+    "checkpoint_s",
+    "pp_bubble_s",
+)
 
 # Peak bf16 FLOP/s per chip kind — must match release/bench_mfu.py
 # (bench.py), which is the acceptance reference: in-framework MFU and

@@ -29,7 +29,9 @@ from ray_tpu.devtools.lint.core import (
 _SCOPE = ("train/", "models/", "parallel/", "ops/")
 
 _STEP_FN_RE = re.compile(r"(^|_)step($|_)|^step")
-_LOOP_FN_RE = re.compile(r"(^|_)(fit|loop|epoch)s?($|_)")
+# `schedule` covers the MPMD stage runner (ISSUE 10): a function driving
+# the per-microbatch 1F1B op stream is as hot as the step body itself.
+_LOOP_FN_RE = re.compile(r"(^|_)(fit|loop|epoch|schedule)s?($|_)")
 
 _SYNC_TAILS = {
     "block_until_ready": "forces a device sync",

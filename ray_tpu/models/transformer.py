@@ -83,6 +83,20 @@ class TransformerConfig:
         base.update(overrides)
         return TransformerConfig(**base)
 
+    @staticmethod
+    def llama_1b(**overrides) -> "TransformerConfig":
+        """~1.2B params (16 layers × 67M + 131M embed/head) — the smallest
+        config a replicated f32 train state (params+grads+Adam ≈ 19 GB)
+        cannot fit on one 16 GB chip, and the fit-at-1B release gate's
+        subject. Shapes keep every shardable dim divisible by 8 so any
+        (dp, fsdp, tp) factorization of a v4-8 slice tiles evenly."""
+        base = dict(
+            vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+            n_kv_heads=16, hidden_dim=8192, max_seq=2048, remat="dots",
+        )
+        base.update(overrides)
+        return TransformerConfig(**base)
+
 
 # Logical dim names per param leaf (layer-stacked leaves lead with "layer").
 def param_logical_dims(config: TransformerConfig) -> dict:
@@ -310,14 +324,13 @@ def forward(
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
-def loss_fn(
-    params: dict,
-    tokens: jax.Array,
+def logits_loss(
+    logits: jax.Array,
     targets: jax.Array,
-    config: TransformerConfig,
     mask: jax.Array | None = None,
 ) -> jax.Array:
-    logits = forward(params, tokens, config)
+    """Token cross-entropy from logits — shared by the fused loss_fn and
+    the pipeline's last stage (which receives logits over the wire)."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is not None:
@@ -325,8 +338,135 @@ def loss_fn(
     return jnp.mean(nll)
 
 
+def loss_fn(
+    params: dict,
+    tokens: jax.Array,
+    targets: jax.Array,
+    config: TransformerConfig,
+    mask: jax.Array | None = None,
+) -> jax.Array:
+    return logits_loss(forward(params, tokens, config), targets, mask)
+
+
 def num_params(params: dict) -> int:
     return sum(int(x.size) for x in jax.tree.leaves(params))
+
+
+def config_num_params(config: TransformerConfig) -> int:
+    """Parameter count from shapes alone — lets the memory-budget check
+    refuse a config before any array is materialized."""
+    d, hd = config.dim, config.head_dim
+    attn = d * hd * (config.n_heads * 2 + config.n_kv_heads * 2)
+    if config.moe:
+        e = config.moe.num_experts
+        mlp = d * e + 3 * e * d * config.hidden_dim
+    else:
+        mlp = 3 * d * config.hidden_dim
+    per_layer = attn + mlp + 2 * d
+    return (
+        config.n_layers * per_layer
+        + 2 * config.vocab_size * d  # embed + lm_head
+        + d  # final_norm
+    )
+
+
+# ---------------------------------------------------------------------------
+# MPMD pipeline stages (cross-slice form — train._internal.stage_runner)
+# ---------------------------------------------------------------------------
+def partition_stages(params: dict, config: TransformerConfig, num_stages: int) -> list[dict]:
+    """Split a full param tree into ``num_stages`` contiguous layer groups.
+
+    Stage 0 additionally owns the embedding table; the last stage owns the
+    final norm + lm_head. Stage trees are disjoint, so per-stage optimizer
+    updates compose to exactly the fused update.
+    """
+    if config.n_layers % num_stages != 0:
+        raise ValueError(
+            f"n_layers={config.n_layers} not divisible by {num_stages} stages"
+        )
+    per = config.n_layers // num_stages
+    stages = []
+    for s in range(num_stages):
+        layers = jax.tree.map(
+            lambda leaf: leaf[s * per : (s + 1) * per], params["layers"]
+        )
+        tree = {"layers": layers}
+        if s == 0:
+            tree["embed"] = params["embed"]
+        if s == num_stages - 1:
+            tree["final_norm"] = params["final_norm"]
+            tree["lm_head"] = params["lm_head"]
+        stages.append(tree)
+    return stages
+
+
+def merge_stages(stage_trees: list[dict]) -> dict:
+    """Inverse of :func:`partition_stages` — reassemble the fused tree
+    (checkpoint save goes through the fused layout so restore works at any
+    pipeline factorization, including pp=1)."""
+    layers = jax.tree.map(
+        lambda *leaves: jnp.concatenate(leaves, axis=0),
+        *[t["layers"] for t in stage_trees],
+    )
+    return {
+        "embed": stage_trees[0]["embed"],
+        "layers": layers,
+        "final_norm": stage_trees[-1]["final_norm"],
+        "lm_head": stage_trees[-1]["lm_head"],
+    }
+
+
+def stage_logical_dims(config: TransformerConfig, stage: int, num_stages: int) -> dict:
+    """param_logical_dims subset matching one stage's tree shape — so the
+    in-stage GSPMD (fsdp/tp inside a pipeline stage) reuses the same rules."""
+    full = param_logical_dims(config)
+    tree = {"layers": full["layers"]}
+    if stage == 0:
+        tree["embed"] = full["embed"]
+    if stage == num_stages - 1:
+        tree["final_norm"] = full["final_norm"]
+        tree["lm_head"] = full["lm_head"]
+    return tree
+
+
+def stage_forward(
+    stage_params: dict,
+    x: jax.Array,
+    config: TransformerConfig,
+    *,
+    first: bool,
+    last: bool,
+    positions: jax.Array | None = None,
+) -> jax.Array:
+    """Apply one pipeline stage's layer slice.
+
+    First stage: ``x`` is int tokens [batch, seq] → embeds then runs its
+    layers. Interior stages: ``x`` is activations [batch, seq, dim]
+    received over the collective p2p plane. Last stage: also applies
+    final_norm + lm_head, returning f32 logits.
+    """
+    attention_fn = _attention_impl(config)
+    cos, sin = rope_frequencies(config.head_dim, config.max_seq, config.rope_theta)
+    if first:
+        x = stage_params["embed"][x]
+
+    def layer_step(carry, layer):
+        h_in = carry
+        h_in = _attention_block(
+            h_in, layer, config, (cos, sin), positions, attention_fn
+        )
+        h = _rmsnorm_ckpt(h_in, layer["mlp_norm"])
+        if config.moe:
+            h_in = h_in + _moe_mlp(h, layer, config).astype(h_in.dtype)
+        else:
+            h_in = h_in + _dense_mlp(h, layer).astype(h_in.dtype)
+        return h_in, None
+
+    x, _ = jax.lax.scan(layer_step, x, stage_params["layers"])
+    if last:
+        x = rmsnorm_reference(x, stage_params["final_norm"])
+        x = (x @ stage_params["lm_head"]).astype(jnp.float32)
+    return x
 
 
 # ---------------------------------------------------------------------------

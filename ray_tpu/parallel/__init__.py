@@ -1,0 +1,54 @@
+"""Parallelism primitives — the GSPMD vocabulary of ray_tpu.
+
+One canonical import path for everything the trainer's sharding layer is
+built from: mesh construction (:class:`MeshSpec`, :class:`SliceTopology`),
+logical-dim sharding rules (:class:`LogicalRules`, :class:`LogicalSpec`,
+:func:`auto_shard_specs`), the pipeline schedulers (:func:`pipeline_step`,
+:func:`schedule_1f1b`), and the sequence-parallel attention makers.
+"""
+
+from ray_tpu.parallel.mesh import (
+    AXES,
+    DEFAULT_RULES,
+    LogicalRules,
+    LogicalSpec,
+    MeshSpec,
+    auto_shard_specs,
+    fsdp_extend_spec,
+    shard_batch,
+    single_host_mesh,
+    transformer_tp_rules,
+)
+from ray_tpu.parallel.pipeline import (
+    bubble_fraction,
+    pipeline_apply,
+    pipeline_step,
+    schedule_1f1b,
+    validate_schedule,
+)
+from ray_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    make_ulysses_attention,
+)
+from ray_tpu.parallel.topology import SliceTopology
+
+__all__ = [
+    "AXES",
+    "DEFAULT_RULES",
+    "LogicalRules",
+    "LogicalSpec",
+    "MeshSpec",
+    "SliceTopology",
+    "auto_shard_specs",
+    "bubble_fraction",
+    "fsdp_extend_spec",
+    "make_ring_attention",
+    "make_ulysses_attention",
+    "pipeline_apply",
+    "pipeline_step",
+    "schedule_1f1b",
+    "shard_batch",
+    "single_host_mesh",
+    "transformer_tp_rules",
+    "validate_schedule",
+]

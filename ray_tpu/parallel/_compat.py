@@ -1,0 +1,27 @@
+"""jax version compatibility for the parallel package.
+
+``jax.shard_map`` (with the ``check_vma`` kwarg) is the modern spelling;
+older jax only ships ``jax.experimental.shard_map.shard_map`` (kwarg
+``check_rep``). One import site so every pipeline/attention module works
+on both without scattering try/excepts.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma kwarg
+    from jax import shard_map as shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+            **kw,
+        )
+
+
+__all__ = ["shard_map"]

@@ -14,12 +14,117 @@ the classic (M + P - 1)-tick GPipe fill/drain loop.
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.parallel._compat import shard_map
+
+
+# ---------------------------------------------------------------------------
+# Microbatch scheduling (MPMD stages — the cross-slice pipeline)
+# ---------------------------------------------------------------------------
+#
+# The in-program ppermute pipeline below is the single-slice form. Across
+# pod slices the stages are SEPARATE programs on separate gang workers
+# (MPMD — "Scaling Deep Learning Training with MPMD Pipeline Parallelism"),
+# and the schedule is host-side data each stage runner executes, with p2p
+# activation hand-offs providing the cross-stage ordering. The scheduler
+# here is pure math (no jax) so the driver, the stage runner, and the
+# release gate all share one bubble model.
+
+def schedule_1f1b(
+    num_stages: int, num_microbatches: int, stage: int
+) -> list[tuple[str, int]]:
+    """This stage's op stream under the 1F1B (PipeDream-flush) schedule.
+
+    Returns an ordered list of ``("F", m)`` / ``("B", m)`` ops. Warmup
+    runs ``num_stages - stage - 1`` forwards, the steady state strictly
+    alternates 1F1B, and the cooldown drains the remaining backwards —
+    so at most ``num_stages - stage`` activations are ever live on a
+    stage (the memory win over GPipe, at identical bubble).
+    """
+    if not (0 <= stage < num_stages):
+        raise ValueError(f"stage {stage} out of range [0, {num_stages})")
+    if num_microbatches < 1:
+        raise ValueError("num_microbatches must be >= 1")
+    warmup = min(num_microbatches, num_stages - stage - 1)
+    ops: list[tuple[str, int]] = [("F", m) for m in range(warmup)]
+    fwd, bwd = warmup, 0
+    while fwd < num_microbatches:
+        ops.append(("F", fwd))
+        fwd += 1
+        ops.append(("B", bwd))
+        bwd += 1
+    while bwd < num_microbatches:
+        ops.append(("B", bwd))
+        bwd += 1
+    return ops
+
+
+def validate_schedule(
+    schedules: Sequence[Sequence[tuple[str, int]]]
+) -> None:
+    """Check a per-stage op-stream set for pipeline correctness.
+
+    Simulates the stages tick-by-tick with blocking p2p dependencies
+    (F(m) at stage s needs F(m) done at s-1; B(m) at stage s needs B(m)
+    done at s+1) and raises if any stage's stream would deadlock, skip
+    a microbatch, run B(m) before its own F(m), or exceed the 1F1B
+    in-flight activation bound of ``num_stages - stage``.
+    """
+    num_stages = len(schedules)
+    done_f = [set() for _ in range(num_stages)]
+    done_b = [set() for _ in range(num_stages)]
+    cursors = [0] * num_stages
+    progressed = True
+    while progressed:
+        progressed = False
+        for s, ops in enumerate(schedules):
+            while cursors[s] < len(ops):
+                kind, m = ops[cursors[s]]
+                if kind == "F":
+                    if s > 0 and m not in done_f[s - 1]:
+                        break
+                    done_f[s].add(m)
+                elif kind == "B":
+                    if m not in done_f[s]:
+                        raise ValueError(
+                            f"stage {s}: B({m}) before its own F({m})"
+                        )
+                    if s < num_stages - 1 and m not in done_b[s + 1]:
+                        break
+                    done_b[s].add(m)
+                else:
+                    raise ValueError(f"stage {s}: unknown op {kind!r}")
+                live = len(done_f[s]) - len(done_b[s])
+                if live > num_stages - s:
+                    raise ValueError(
+                        f"stage {s}: {live} live activations exceeds the "
+                        f"1F1B bound {num_stages - s}"
+                    )
+                cursors[s] += 1
+                progressed = True
+    stuck = [s for s in range(num_stages) if cursors[s] < len(schedules[s])]
+    if stuck:
+        raise ValueError(f"schedule deadlocks at stages {stuck}")
+    for s in range(num_stages):
+        micro = {m for _, m in schedules[s]}
+        if done_f[s] != micro or done_b[s] != micro:
+            raise ValueError(f"stage {s}: incomplete F/B coverage")
+
+
+def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """The ideal pipeline-bubble fraction (P-1)/(M+P-1): the share of
+    each stage's wall clock spent idle during fill+drain when every
+    microbatch tick costs the same. 1F1B and GPipe share this number —
+    1F1B only improves the activation-memory bound. The flight recorder
+    compares *measured* p2p-wait fractions against it."""
+    if num_stages < 1 or num_microbatches < 1:
+        raise ValueError("num_stages and num_microbatches must be >= 1")
+    return (num_stages - 1) / (num_microbatches + num_stages - 1)
 
 
 def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name, num_micro):
@@ -108,3 +213,31 @@ def pipeline_apply(
         check_vma=False,
     )(stacked_params, x_micro)
     return out.reshape(batch, *out.shape[2:])
+
+
+def pipeline_step(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+    param_specs=None,
+) -> jax.Array:
+    """Public entry point: run one pipelined application of ``stage_fn``.
+
+    Single-slice (SPMD) form of the pipeline — stages share one compiled
+    program and hand activations over the ``pp`` mesh axis. The MPMD
+    cross-slice form lives in train._internal.stage_runner, driven by
+    :func:`schedule_1f1b` over the collective p2p plane.
+    """
+    return pipeline_apply(
+        stage_fn,
+        stacked_params,
+        x,
+        mesh=mesh,
+        num_microbatches=num_microbatches,
+        axis_name=axis_name,
+        param_specs=param_specs,
+    )

@@ -34,7 +34,18 @@ def _start_session_fn(
     dataset_shards_per_rank: list[dict],
     mesh_axes: dict,
     slice_topology=None,
+    pipeline: dict | None = None,
 ) -> bool:
+    if pipeline is not None:
+        # MPMD stage assignment: gang rank r is stage r // gang_per_stage
+        # (contiguous ranks form one stage's gang).
+        num_stages = int(pipeline["num_stages"])
+        per_stage = max(1, gang_ctx.world_size // num_stages)
+        pipeline = {
+            **pipeline,
+            "stage": gang_ctx.rank // per_stage,
+            "stage_rank": gang_ctx.rank % per_stage,
+        }
     ctx = TrainContext(
         world_size=gang_ctx.world_size,
         world_rank=gang_ctx.rank,
@@ -48,6 +59,7 @@ def _start_session_fn(
         mesh=mesh_axes,
         slice_topology=slice_topology,
         collective_group=gang_ctx.group_name,
+        pipeline=pipeline,
     )
     session = init_session(ctx, lambda: train_fn(dict(train_loop_config)))
     gang_ctx.state["session"] = session
@@ -137,6 +149,14 @@ class BackendExecutor:
             dataset_shards_per_rank=dataset_shards_per_rank,
             mesh_axes=dict(sc.mesh_axes),
             slice_topology=sc.slice_topology,
+            pipeline=(
+                {
+                    "num_stages": int(sc.pipeline_stages),
+                    "microbatches": int(sc.microbatches),
+                }
+                if int(getattr(sc, "pipeline_stages", 1)) > 1
+                else None
+            ),
         )
 
     def _form_gang(self) -> WorkerGang:

@@ -37,6 +37,12 @@ class TrainContext:
     # worker loops pass it to jax_utils.build_mesh(topology=...).
     slice_topology: Any = None
     collective_group: str = ""
+    # MPMD pipeline assignment (ISSUE 10), set when
+    # ScalingConfig.pipeline_stages > 1: {"stage": s, "num_stages": S,
+    # "microbatches": M}. The stage runner
+    # (train._internal.stage_runner.PipelineStageRunner) reads it; None
+    # means no pipeline — the plain GSPMD path.
+    pipeline: Any = None
 
     def get_world_size(self) -> int:
         return self.world_size

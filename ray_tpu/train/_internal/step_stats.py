@@ -127,7 +127,12 @@ class StepRecorder:
         phases = _drain_phases()
         collective = min(wall, phases.get("collective", 0.0))
         checkpoint = min(wall, phases.get("checkpoint", 0.0))
-        compute = max(0.0, wall - data_wait - collective - checkpoint)
+        # Pipeline-stage recv waits (stage_runner): schedule bubble, not
+        # compute — subtracted from the remainder like the other phases.
+        pp_bubble = min(wall, phases.get("pp_bubble", 0.0))
+        compute = max(
+            0.0, wall - data_wait - collective - checkpoint - pp_bubble
+        )
         if self._device_kind is None:
             self._device_kind, self._devices = _device_info()
         self.step += 1
@@ -141,6 +146,7 @@ class StepRecorder:
             "compute_s": compute,
             "collective_s": collective,
             "checkpoint_s": checkpoint,
+            "pp_bubble_s": pp_bubble,
         }
         tokens = metrics.get("tokens")
         if isinstance(tokens, (int, float)) and not isinstance(tokens, bool):

@@ -75,6 +75,16 @@ class ScalingConfig:
     # CollectiveConfig(quantize="int8") to block-quantize DCN gradient
     # sync with error feedback. None ⇒ exact wire.
     collective_config: Any = None
+    # MPMD pipeline parallelism across slices (ISSUE 10): with
+    # pipeline_stages > 1 the gang's workers become pipeline STAGE gangs
+    # (worker rank i runs stage i; num_workers must be a multiple of
+    # pipeline_stages), each batch is cut into `microbatches` and
+    # scheduled 1F1B, with activations handed stage→stage over the
+    # collective p2p plane (always exact wire). dp/fsdp/tp still apply
+    # INSIDE each stage via mesh_axes — pp composes with, not replaces,
+    # the GSPMD axes.
+    pipeline_stages: int = 1
+    microbatches: int = 1
 
     def worker_resources(self) -> dict[str, float]:
         resources = {"CPU": 1.0, **dict(self.resources_per_worker)}
@@ -93,12 +103,40 @@ class ScalingConfig:
             and self.min_workers < self.num_workers
         )
 
+    def factorization(self) -> dict[str, int]:
+        """The (dp, fsdp, tp, pp) this config asks for. In-worker axes
+        come from mesh_axes; pp from pipeline_stages; dp additionally
+        multiplies in the cross-worker data-parallel replicas (workers
+        not consumed as pipeline stages are data-parallel)."""
+        axes = dict(self.mesh_axes)
+        pp = max(1, int(self.pipeline_stages))
+        dp_workers = max(1, self.num_workers // pp)
+        return {
+            "dp": int(axes.get("dp", 1)) * dp_workers,
+            "fsdp": int(axes.get("fsdp", 1)),
+            "tp": int(axes.get("tp", 1)),
+            "pp": pp,
+        }
+
     def __post_init__(self) -> None:
         if self.min_workers is not None and not (
             1 <= self.min_workers <= self.num_workers
         ):
             raise ValueError(
                 "min_workers must satisfy 1 <= min_workers <= num_workers"
+            )
+        if self.pipeline_stages < 1 or self.microbatches < 1:
+            raise ValueError(
+                "pipeline_stages and microbatches must be >= 1"
+            )
+        if (
+            self.pipeline_stages > 1
+            and self.num_workers % self.pipeline_stages != 0
+        ):
+            raise ValueError(
+                f"num_workers={self.num_workers} must be a multiple of "
+                f"pipeline_stages={self.pipeline_stages} (each stage is "
+                f"a gang of num_workers/pipeline_stages workers)"
             )
 
 

@@ -394,6 +394,13 @@ class DataParallelTrainer:
             metrics.setdefault(
                 "collective_backend", executor.selected_backend
             )
+            # Stamp the (dp, fsdp, tp, pp) factorization this run chose
+            # (ISSUE 10). Worker loops that know better (e.g. a mesh
+            # built over all local devices) report their own value and
+            # win the setdefault.
+            metrics.setdefault(
+                "factorization", self.scaling_config.factorization()
+            )
             ckpt = executor.merge_sharded_checkpoints(
                 [r.get("checkpoint") for r in round_results]
             )

@@ -5,13 +5,115 @@ prepare_model / prepare_data_loader, TPU-first: instead of wrapping a model
 in DDP, we build the device mesh, place params with NamedSharding, and sync
 gradients — in-jit (psum over ICI, the "xla" path) or eagerly through the
 collective group (the "ring" CPU twin).
+
+GSPMD-first training (ISSUE 10): :func:`setup_sharded_training` +
+:func:`build_sharded_train_step` make ONE ScalingConfig express data, FSDP,
+and tensor parallelism with no user-code changes — the mesh comes from the
+config's named axes, per-leaf NamedShardings from parallel.mesh logical
+dims + the FSDP shard-largest-axis auto-policy, and the whole step (grads,
+optimizer update, new state) compiles as one jax.jit program with explicit
+in/out shardings and *sharded optimizer state*. The replicated
+:func:`shard_params` path survives as the degenerate pure-data-parallel
+case — and refuses models whose train state cannot fit a chip, which is
+exactly where the sharded path takes over.
 """
 
 from __future__ import annotations
 
-from typing import Any, Iterable, Iterator
+import dataclasses
+import logging
+import os
+from typing import Any, Callable, Iterable, Iterator
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class MemoryBudgetError(RuntimeError):
+    """The planned train state cannot fit the per-device memory budget.
+
+    Raised BEFORE any array is materialized (planning runs on
+    jax.eval_shape results), so a doomed config fails in milliseconds
+    instead of OOM-killing a TPU host mid-init."""
+
+
+def device_memory_budget() -> int | None:
+    """Per-device memory budget in bytes, or None when unknowable.
+
+    ``RAY_TPU_HBM_BYTES`` overrides (the CPU twin / tests / release gates
+    model a chip size this way); otherwise the jax runtime's per-device
+    ``bytes_limit`` is used when it reports one. None disables budget
+    enforcement — never guess a limit and refuse a runnable config."""
+    env = os.environ.get("RAY_TPU_HBM_BYTES")
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            logger.warning("ignoring unparsable RAY_TPU_HBM_BYTES=%r", env)
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        limit = (stats or {}).get("bytes_limit")
+        return int(limit) if limit else None
+    except Exception:  # rtlint: disable=swallowed-exception - no jax / no stats: budget unknown, don't enforce
+        return None
+
+
+def _leaf_nbytes(leaf: Any, sharding: Any = None) -> int:
+    """This device's resident bytes for one (possibly sharded) leaf."""
+    shape = tuple(getattr(leaf, "shape", ()) or np.shape(leaf))
+    dtype = np.dtype(getattr(leaf, "dtype", None) or np.asarray(leaf).dtype)
+    if sharding is not None and hasattr(sharding, "shard_shape") and shape:
+        shape = sharding.shard_shape(shape)
+    size = 1
+    for dim in shape:
+        size *= int(dim)
+    return size * dtype.itemsize
+
+
+def state_bytes_per_device(tree: Any, shardings: Any = None) -> int:
+    """Per-device bytes of a pytree of arrays / ShapeDtypeStructs under
+    ``shardings`` (None ⇒ fully replicated — every leaf whole)."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    shard_leaves = (
+        jax.tree.leaves(shardings) if shardings is not None else [None] * len(leaves)
+    )
+    return sum(_leaf_nbytes(l, s) for l, s in zip(leaves, shard_leaves))
+
+
+def ensure_train_state_fits(
+    params: Any,
+    shardings: Any = None,
+    *,
+    optimizer_slots: int = 2,
+    workspace_frac: float = 0.2,
+    budget: int | None = None,
+    what: str = "train state",
+) -> int:
+    """Refuse configs whose training residency exceeds the device budget.
+
+    Residency model: params + grads + ``optimizer_slots`` optimizer
+    moments, all with the params' shardings (grads and Adam moments
+    mirror param layout under GSPMD), plus ``workspace_frac`` headroom
+    for activations/XLA workspace. Returns the estimated per-device
+    bytes; raises :class:`MemoryBudgetError` when over budget."""
+    budget = device_memory_budget() if budget is None else budget
+    per_state = state_bytes_per_device(params, shardings)
+    estimate = int(per_state * (2 + optimizer_slots) * (1.0 + workspace_frac))
+    if budget is not None and estimate > budget:
+        raise MemoryBudgetError(
+            f"{what} needs ~{estimate / 1e9:.1f} GB/device "
+            f"(params+grads+{optimizer_slots} optimizer slots "
+            f"+{workspace_frac:.0%} workspace) but the per-device budget "
+            f"is {budget / 1e9:.1f} GB. Shard it: set fsdp/tp axes in "
+            f"ScalingConfig.mesh_axes (see docs/sharding.md) instead of "
+            f"the replicated data-parallel path."
+        )
+    return estimate
 
 
 def build_mesh(axes: dict[str, int] | None = None, topology=None):
@@ -33,17 +135,30 @@ def build_mesh(axes: dict[str, int] | None = None, topology=None):
     return MeshSpec(dict(axes)).build(devices)
 
 
-def shard_params(params: Any, mesh, logical_dims: Any = None):
+def shard_params(
+    params: Any, mesh, logical_dims: Any = None, *, enforce_budget: bool = True
+):
     """Place a param pytree onto the mesh. With logical_dims (see
     parallel.mesh.LogicalRules), params get TP/FSDP shardings; without,
-    they are replicated."""
+    they are replicated — the degenerate pure-data-parallel case.
+
+    The replicated path refuses models whose training residency (params
+    + grads + Adam moments) exceeds the per-device budget: replication
+    cannot fit them by construction, and the failure should be a clear
+    refusal pointing at the sharded path, not a mid-init host OOM."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
     from ray_tpu.parallel.mesh import LogicalRules
 
     if logical_dims is not None:
         shardings = LogicalRules().tree_shardings(logical_dims, mesh)
+        if enforce_budget:
+            ensure_train_state_fits(
+                params, shardings, what="sharded train state"
+            )
         return jax.device_put(params, shardings)
+    if enforce_budget:
+        ensure_train_state_fits(params, None, what="replicated train state")
     return jax.device_put(
         params, jax.tree.map(lambda _: NamedSharding(mesh, P()), params)
     )
@@ -152,3 +267,270 @@ def iter_global_batches(
     for i, batch in enumerate(it):
         if i % world_size == world_rank:
             yield batch
+
+
+# ---------------------------------------------------------------------------
+# GSPMD-first training (ISSUE 10)
+# ---------------------------------------------------------------------------
+def mesh_factorization(mesh) -> dict[str, int]:
+    """The (dp, fsdp, tp, pp) factorization a mesh expresses — stamped
+    into Result.metrics so every run records how it was parallelized."""
+    shape = dict(getattr(mesh, "shape", {}) or {})
+    return {
+        "dp": int(shape.get("dp", 1)),
+        "fsdp": int(shape.get("fsdp", 1)),
+        "tp": int(shape.get("tp", 1)),
+        "pp": int(shape.get("pp", 1)),
+    }
+
+
+@dataclasses.dataclass
+class ShardedTrainSetup:
+    """Everything :func:`build_sharded_train_step` needs, planned and
+    materialized by :func:`setup_sharded_training`."""
+
+    mesh: Any
+    params: Any
+    opt_state: Any
+    param_shardings: Any
+    opt_shardings: Any
+    factorization: dict[str, int]
+    state_bytes_per_device: int
+
+    def shard_batch(self, batch: Any) -> Any:
+        """device_put a host batch with its leading dim split over the
+        data axes (dp × fsdp) of this setup's mesh."""
+        from ray_tpu.parallel.mesh import shard_batch as _shard
+
+        return _shard(batch, self.mesh)
+
+
+def _session_mesh():
+    """Mesh from the active train session's config, or all local devices."""
+    from ray_tpu.train._internal import session as session_mod
+
+    if session_mod.in_session():
+        ctx = session_mod.get_session().ctx
+        return build_mesh(
+            dict(ctx.mesh or {}), topology=ctx.slice_topology
+        )
+    return build_mesh()
+
+
+def _optimizer_state_shardings(
+    optimizer: Any, param_shapes: Any, param_shardings: Any, mesh
+):
+    """Shardings for the optimizer state, matching the params'.
+
+    Primary path: compile ``optimizer.init`` with the params' shardings
+    and read XLA's propagated ``output_shardings`` — Adam moments come
+    out sharded exactly like their params, counters replicated. Fallback
+    (older jax without output_shardings, exotic optimizers): match
+    optimizer leaves to param leaves by (shape, dtype), replicating
+    anything unmatched."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def normalize(s):
+        # Leaves with no data dependence on the params (step counters)
+        # come back single-device from the propagation probe; pin every
+        # sharding that doesn't span the mesh to replicated-on-mesh.
+        if getattr(s, "num_devices", 0) == mesh.devices.size:
+            return s
+        return NamedSharding(mesh, P())
+
+    try:
+        compiled = (
+            jax.jit(optimizer.init, in_shardings=(param_shardings,))
+            .lower(param_shapes)
+            .compile()
+        )
+        return jax.tree.map(normalize, compiled.output_shardings)
+    except Exception:  # rtlint: disable=swallowed-exception - propagation probe failed: shape-match fallback below
+        logger.debug(
+            "optimizer sharding propagation failed; using shape match",
+            exc_info=True,
+        )
+    by_shape: dict[tuple, Any] = {}
+    for leaf, sh in zip(
+        jax.tree.leaves(param_shapes), jax.tree.leaves(param_shardings)
+    ):
+        key = (tuple(leaf.shape), np.dtype(leaf.dtype))
+        by_shape.setdefault(key, sh)
+    opt_shapes = jax.eval_shape(optimizer.init, param_shapes)
+
+    def pick(leaf):
+        key = (tuple(leaf.shape), np.dtype(leaf.dtype))
+        return by_shape.get(key, NamedSharding(mesh, P()))
+
+    return jax.tree.map(pick, opt_shapes)
+
+
+def setup_sharded_training(
+    init_fn: Callable[[], Any],
+    optimizer: Any,
+    *,
+    mesh=None,
+    logical_dims: Any = None,
+    rules: Any = None,
+    fsdp_axis: str = "fsdp",
+    enforce_budget: bool = True,
+) -> ShardedTrainSetup:
+    """Plan and materialize a sharded train state from ONE mesh.
+
+    ``init_fn`` is a zero-arg callable returning the param pytree (close
+    over config + PRNG key). The flow is plan-before-materialize:
+
+      1. ``jax.eval_shape(init_fn)`` — shapes only, no arrays;
+      2. per-leaf NamedShardings via parallel.mesh.auto_shard_specs
+         (logical-dim TP rules + the FSDP shard-largest-axis policy;
+         axes absent from the mesh degrade to replication, so a pure-dp
+         mesh reproduces the replicated path);
+      3. memory-budget check on the PLAN — a config that cannot fit is
+         refused before any init work happens;
+      4. ``jax.jit(init_fn, out_shardings=...)`` — every device
+         materializes only its own param shards (a 1B model never
+         exists unsharded anywhere);
+      5. optimizer state is initialized the same way, with shardings
+         propagated from the params.
+    """
+    import jax
+
+    from ray_tpu.parallel.mesh import auto_shard_specs
+
+    # Sharding-invariant RNG (the modern jax default): without this, the
+    # SAME init_fn produces DIFFERENT weights under different
+    # out_shardings — breaking the contract that one config change
+    # refactorizes a run without changing its math (and the elastic
+    # resize-parity guarantee with it).
+    jax.config.update("jax_threefry_partitionable", True)
+    if mesh is None:
+        mesh = _session_mesh()
+    param_shapes = jax.eval_shape(init_fn)
+    param_shardings = auto_shard_specs(
+        param_shapes,
+        mesh,
+        logical_dims=logical_dims,
+        rules=rules,
+        fsdp_axis=fsdp_axis,
+    )
+    estimate = ensure_train_state_fits(
+        param_shapes,
+        param_shardings,
+        what="sharded train state",
+        budget=None if enforce_budget else float("inf"),
+    )
+    params = jax.jit(init_fn, out_shardings=param_shardings)()
+    opt_shardings = _optimizer_state_shardings(
+        optimizer, param_shapes, param_shardings, mesh
+    )
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
+    return ShardedTrainSetup(
+        mesh=mesh,
+        params=params,
+        opt_state=opt_state,
+        param_shardings=param_shardings,
+        opt_shardings=opt_shardings,
+        factorization=mesh_factorization(mesh),
+        state_bytes_per_device=estimate,
+    )
+
+
+def build_sharded_train_step(
+    loss_fn: Callable[[Any, Any], Any],
+    optimizer: Any,
+    setup: ShardedTrainSetup,
+    *,
+    group_name: str | None = None,
+    donate: bool = True,
+) -> Callable[[Any, Any, Any], tuple[Any, Any, Any]]:
+    """Compile ``loss_fn(params, batch) -> scalar`` into one train step.
+
+    Returns ``step(params, opt_state, batch) -> (params, opt_state,
+    loss)``. On one jax runtime (real slices via jax.distributed, or the
+    in-worker mesh) the WHOLE step — grads, cross-device reductions,
+    optimizer update — is one jit program with explicit out_shardings
+    and donated state: GSPMD inserts every collective.
+
+    ``group_name`` handles the ring CPU twin's multi-process gangs: each
+    worker owns a private mesh, so cross-WORKER gradient averaging runs
+    eagerly through the collective group between a grad jit and an
+    apply jit (still sharded within the worker)."""
+    import jax
+
+    donate_args = (0, 1) if donate else ()
+    param_sh, opt_sh = setup.param_shardings, setup.opt_shardings
+
+    def apply_update(params, opt_state, grads):
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        new_params = jax.tree.map(
+            lambda p, u: (p + u.astype(p.dtype)), params, updates
+        )
+        return new_params, new_opt
+
+    cross_worker = False
+    if group_name:
+        from ray_tpu.util.collective import collective
+
+        cross_worker = collective.get_group(group_name).world_size > 1
+
+    if not cross_worker:
+        def fused(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            new_params, new_opt = apply_update(params, opt_state, grads)
+            return new_params, new_opt, loss
+
+        return jax.jit(
+            fused,
+            out_shardings=(param_sh, opt_sh, None),
+            donate_argnums=donate_args,
+        )
+
+    grad_fn = jax.jit(
+        jax.value_and_grad(loss_fn), out_shardings=(None, param_sh)
+    )
+    apply_fn = jax.jit(
+        apply_update,
+        out_shardings=(param_sh, opt_sh),
+        donate_argnums=donate_args,
+    )
+
+    def step(params, opt_state, batch):
+        loss, grads = grad_fn(params, batch)
+        grads = sync_gradients(grads, group_name)
+        grads = jax.device_put(grads, param_sh)
+        params, opt_state = apply_fn(params, opt_state, grads)
+        return params, opt_state, loss
+
+    return step
+
+
+def save_sharded_state(
+    params: Any, opt_state: Any, *, extra: dict | None = None
+):
+    """Persist (params, opt_state) as one committed checkpoint.
+
+    Rides the two-phase committed-checkpoint protocol (per-rank DONE
+    markers + CRC inventory), saving each leaf's GLOBAL index with its
+    shards — which is what lets :func:`restore_sharded_state` re-place
+    the state onto ANY (dp, fsdp, tp) factorization on restore."""
+    from ray_tpu.train.checkpoint import save_pytree_checkpoint
+
+    return save_pytree_checkpoint(
+        {"params": params, "opt_state": opt_state}, extra=extra
+    )
+
+
+def restore_sharded_state(
+    checkpoint: Any, setup: ShardedTrainSetup
+) -> tuple[Any, Any, dict]:
+    """Load a committed checkpoint onto ``setup``'s mesh — the saved
+    factorization need not match (elastic resize: dp=4 → dp=2×fsdp=2
+    restores exactly). Returns (params, opt_state, extra)."""
+    from ray_tpu.train.checkpoint import load_pytree_checkpoint
+
+    tree, extra = load_pytree_checkpoint(
+        checkpoint,
+        {"params": setup.param_shardings, "opt_state": setup.opt_shardings},
+    )
+    return tree["params"], tree["opt_state"], extra

@@ -22,6 +22,8 @@ import time
 import yaml
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # direct invocation: the script dir, not the
+    sys.path.insert(0, REPO)  # repo root, lands on sys.path
 
 
 def _check(value, expr) -> bool:
