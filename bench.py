@@ -14,6 +14,13 @@ parallelism strategy on the same model family through the GSPMD trainer
 path (jax_utils.setup_sharded_training / one-jit train step), emitting
 the SAME JSON schema with ``detail.sharding`` + ``detail.factorization``
 so the driver's comparisons stay schema-stable across modes.
+
+Overlap mode (ISSUE 11): ``--overlap on|off`` runs the paired
+gradient-sync microbench on a real 2-worker ring gang — ``off`` is the
+monolithic blocking allreduce, ``on`` the bucketed async sync fenced
+after backward-sized compute — emitting ``detail.comm_exposed_s`` /
+``detail.collective_s`` plus the interleaved-schedule bubble fraction
+in the same envelope.
 """
 
 from __future__ import annotations
@@ -147,35 +154,58 @@ def sharded_main(mode: str) -> None:
 
 def _bench_pp(config, optimizer, tokens, steps, init_params,
               partition_stages, stage_forward, logits_loss):
-    """Single-process 2-stage microbatched pipeline: same math the MPMD
-    stage runner executes, here in topological order (no wire), so the
-    matrix row measures the staged computation's throughput."""
+    """Single-process INTERLEAVED pipeline (S=2 ranks x v=2 chunks, M=8
+    microbatches): same per-chunk math the MPMD stage runner executes,
+    here in topological order (no wire), so the matrix row measures the
+    staged computation's throughput. The interleaved schedules the MPMD
+    runner would follow are validated inline; the bubble fraction the
+    row reports is the interleaved (S−1)/(v·M+S−1)."""
     import jax
     import jax.numpy as jnp
 
-    from ray_tpu.parallel.pipeline import bubble_fraction
+    from ray_tpu.parallel.pipeline import (
+        bubble_fraction, schedule_interleaved_1f1b, validate_schedule,
+    )
 
-    num_stages, microbatches = 2, 4
+    num_stages, microbatches, virtual = 2, 8, 2
+    num_chunks = num_stages * virtual
+    # The op streams the two MPMD stage ranks would run for this shape —
+    # deadlock/coverage-check them before spending compute on the row.
+    validate_schedule(
+        [
+            schedule_interleaved_1f1b(num_stages, microbatches, r, virtual)
+            for r in range(num_stages)
+        ],
+        num_virtual=virtual,
+    )
     params = init_params(config, jax.random.PRNGKey(0))
-    stages = partition_stages(params, config, num_stages)
-    opt_states = [optimizer.init(s) for s in stages]
+    chunks = partition_stages(params, config, num_chunks)
+    opt_states = [optimizer.init(c) for c in chunks]
 
-    def s0_fwd(p, x):
-        return stage_forward(p, x, config, first=True, last=False)
+    def _mid_fwd(i):
+        def f(p, x):
+            return stage_forward(p, x, config, first=(i == 0), last=False)
+        return f
 
-    def s1_loss(p, a, targets):
+    def _mid_bwd(i):
+        fwd = _mid_fwd(i)
+
+        def b(p, x, ct):
+            _, vjp_fn = jax.vjp(fwd, p, x)
+            gp, gx = vjp_fn(ct)
+            # chunk 0 eats int tokens: no usable input cotangent.
+            return gp if i == 0 else (gp, gx)
+        return b
+
+    fwds = [jax.jit(_mid_fwd(i)) for i in range(num_chunks - 1)]
+    bwds = [jax.jit(_mid_bwd(i)) for i in range(num_chunks - 1)]
+
+    def last_loss(p, a, targets):
         return logits_loss(
             stage_forward(p, a, config, first=False, last=True), targets
         )
 
-    fwd0 = jax.jit(s0_fwd)
-    grad1 = jax.jit(jax.value_and_grad(s1_loss, argnums=(0, 1)))
-
-    def bwd0(p, x, ct):
-        _, vjp_fn = jax.vjp(s0_fwd, p, x)
-        return vjp_fn(ct)[0]
-
-    bwd0 = jax.jit(bwd0)
+    grad_last = jax.jit(jax.value_and_grad(last_loss, argnums=(0, 1)))
 
     def apply(p, o, g):
         updates, new_o = optimizer.update(g, o, p)
@@ -188,22 +218,31 @@ def _bench_pp(config, optimizer, tokens, steps, init_params,
     mb = inputs.shape[0] // microbatches
 
     def one_step():
-        g_acc = [None, None]
+        g_acc = [None] * num_chunks
         losses = []
+
+        def acc(i, g):
+            g_acc[i] = g if g_acc[i] is None else jax.tree.map(
+                jnp.add, g_acc[i], g
+            )
+
         for m in range(microbatches):
             x = inputs[m * mb:(m + 1) * mb]
             y = targets[m * mb:(m + 1) * mb]
-            a = fwd0(stages[0], x)
-            loss, (g1, da) = grad1(stages[1], a, y)
-            g0 = bwd0(stages[0], x, da)
+            acts, a = [], x
+            for i in range(num_chunks - 1):
+                acts.append(a)
+                a = fwds[i](chunks[i], a)
+            loss, (g_last, da) = grad_last(chunks[-1], a, y)
+            acc(num_chunks - 1, g_last)
+            for i in reversed(range(1, num_chunks - 1)):
+                gp, da = bwds[i](chunks[i], acts[i], da)
+                acc(i, gp)
+            acc(0, bwds[0](chunks[0], acts[0], da))
             losses.append(loss)
-            for i, g in ((0, g0), (1, g1)):
-                g_acc[i] = g if g_acc[i] is None else jax.tree.map(
-                    jnp.add, g_acc[i], g
-                )
-        for i in range(num_stages):
+        for i in range(num_chunks):
             g = jax.tree.map(lambda v: v / microbatches, g_acc[i])
-            stages[i], opt_states[i] = apply(stages[i], opt_states[i], g)
+            chunks[i], opt_states[i] = apply(chunks[i], opt_states[i], g)
         return float(jnp.mean(jnp.stack(losses)))
 
     first_loss = one_step()  # warmup/compile
@@ -219,17 +258,209 @@ def _bench_pp(config, optimizer, tokens, steps, init_params,
         )
         raise SystemExit(1)
     p = sum(
-        int(jnp.size(l)) for s in stages for l in jax.tree.leaves(s)
+        int(jnp.size(l)) for s in chunks for l in jax.tree.leaves(s)
     )
     tokens_per_s = inputs.shape[0] * inputs.shape[1] * steps / elapsed
     return tokens_per_s, p, {
         "loss": loss_value,
         "factorization": {"dp": 1, "fsdp": 1, "tp": 1, "pp": num_stages},
         "microbatches": microbatches,
+        "virtual_stages": virtual,
         "schedule_bubble_fraction": round(
-            bubble_fraction(num_stages, microbatches), 4
+            bubble_fraction(num_stages, microbatches, virtual), 4
         ),
     }
+
+
+def _overlap_worker(ctx, steps: int, overlap: bool, bucket_bytes: int):
+    """Gang-member body for --overlap: paired gradient-sync microbench
+    plus a short deterministic SGD run whose loss trajectory must be
+    IDENTICAL across modes (2-rank ring sums are two-operand adds, so
+    bucketed and monolithic reductions are bitwise equal)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.train import jax_utils
+    from ray_tpu.util.collective import bucketing
+
+    coll = ctx.collective()
+    group_name = ctx.group_name
+
+    # Synthetic grad pytree: mixed shapes (matrix/vector/scalar leaves)
+    # so bucket boundaries never align with leaf boundaries. ~14MB.
+    rng = np.random.default_rng(100 + ctx.rank)
+    grads = {
+        "emb": rng.standard_normal((1024, 512)).astype(np.float32),
+        "layers": [
+            {
+                "w": rng.standard_normal((512, 512)).astype(np.float32),
+                "b": rng.standard_normal(512).astype(np.float32),
+            }
+            for _ in range(10)
+        ],
+        "head": rng.standard_normal((512, 1024)).astype(np.float32),
+        "scale": np.float32(0.5),
+    }
+    leaves = [np.asarray(l) for l in jax.tree.leaves(grads)]
+    nbytes = sum(4 * bucketing.leaf_size(l) for l in leaves)
+    n_buckets = len(bucketing.partition_buckets(leaves, bucket_bytes))
+
+    # Warm (jit traces, mailboxes), then calibrate: one blocking sync
+    # measures the comm time a backward pass would have to hide.
+    jax_utils.sync_gradients_sharded([grads], group_name, overlap=False)
+    coll.barrier()
+    t0 = time.perf_counter()
+    jax_utils.sync_gradients_sharded([grads], group_name, overlap=False)
+    comm_ref = time.perf_counter() - t0
+    coll.barrier()
+
+    spin = rng.standard_normal((384, 384)).astype(np.float32)
+    wall = exposed = collective = float("inf")
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        if overlap:
+            handle = jax_utils.begin_gradient_sync(
+                [grads], group_name, bucket_bytes=bucket_bytes
+            )
+            # Stand-in for the rest of backward: BLAS matmuls release
+            # the GIL (like real device compute), sized to the
+            # calibrated comm time so a working overlap fully hides it.
+            acc = spin
+            while time.perf_counter() - t0 < 1.5 * comm_ref:
+                acc = (acc @ spin) / 384.0  # rescale: keep finite
+            handle.result()
+            step_exposed = handle.stats["comm_exposed_s"]
+            step_collective = handle.stats["collective_s"]
+        else:
+            jax_utils.sync_gradients_sharded(
+                [grads], group_name, overlap=False
+            )
+            # Blocking path: every comm second is exposed to the step.
+            step_exposed = step_collective = time.perf_counter() - t0
+        wall = min(wall, time.perf_counter() - t0)
+        exposed = min(exposed, step_exposed)
+        collective = min(collective, step_collective)
+        coll.barrier()
+
+    # Parity run: 2-rank data-parallel SGD on a linear model whose
+    # params span two leaves; tiny bucket_bytes forces multi-bucket
+    # syncs on the overlap path.
+    prng = np.random.default_rng(7)
+    true_w = prng.standard_normal(24).astype(np.float32)
+    x = prng.standard_normal((96, 24)).astype(np.float32)
+    y = x @ true_w
+    xs = x[ctx.rank::ctx.world_size]
+    ys = y[ctx.rank::ctx.world_size]
+    w = {"a": np.zeros(16, np.float32), "b": np.zeros(8, np.float32)}
+    traj = []
+    for _ in range(12):
+        w_full = np.concatenate([w["a"], w["b"]])
+        err = xs @ w_full - ys
+        g_full = ((2.0 / len(xs)) * (xs.T @ err)).astype(np.float32)
+        g = {"a": g_full[:16], "b": g_full[16:]}
+        if overlap:
+            g = jax_utils.begin_gradient_sync(
+                [g], group_name, bucket_bytes=48
+            ).result()
+        else:
+            g = jax_utils.sync_gradients_sharded(
+                [g], group_name, overlap=False
+            )
+        w = {k: w[k] - 0.2 * np.asarray(g[k]) for k in w}
+        traj.append(
+            float(
+                np.mean((x @ np.concatenate([w["a"], w["b"]]) - y) ** 2)
+            )
+        )
+    return {
+        "wall_s": wall,
+        "comm_exposed_s": exposed,
+        "collective_s": collective,
+        "comm_ref_s": comm_ref,
+        "grad_bytes": int(nbytes),
+        "buckets": n_buckets,
+        "loss_trajectory": traj,
+    }
+
+
+def overlap_main(mode: str) -> None:
+    """--overlap on|off: the paired half of the BENCH_r06 comparison.
+
+    Forms a REAL 2-worker ring gang (the DCN-tier CPU twin) and times
+    one gradient sync per step: ``off`` is the monolithic blocking
+    allreduce (all comm exposed); ``on`` launches the bucketed async
+    sync and fences after backward-sized compute, so ``comm_exposed_s``
+    is only the fence-blocked tail. Emits the shared JSON envelope;
+    ``vs_baseline`` is the fraction of collective time HIDDEN from the
+    step (0 for the blocking path, →1 when overlap works)."""
+    import ray_tpu
+    from ray_tpu.parallel.pipeline import (
+        bubble_fraction, schedule_interleaved_1f1b, validate_schedule,
+    )
+    from ray_tpu.util.collective.bucketing import DEFAULT_BUCKET_BYTES
+    from ray_tpu.util.gang import WorkerGang
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    overlap = mode == "on"
+    bucket_bytes = 2 << 20  # ~7 buckets over the ~14MB synthetic tree
+    # The interleaved schedules this PR ships ride the same release
+    # gate: deadlock/coverage-validate the acceptance grid inline.
+    for s in (2, 4):
+        for m in (4, 8):
+            for v in (1, 2):
+                validate_schedule(
+                    [
+                        schedule_interleaved_1f1b(s, m, r, v)
+                        for r in range(s)
+                    ],
+                    num_virtual=v,
+                )
+    ray_tpu.init(num_cpus=8)
+    try:
+        gang = WorkerGang(2, backend="ring")
+        try:
+            per_rank = gang.run(
+                _overlap_worker, timeout=600,
+                steps=5, overlap=overlap, bucket_bytes=bucket_bytes,
+            )
+        finally:
+            gang.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+    # The sync is collective: the step waits on the slowest rank.
+    slow = max(per_rank, key=lambda r: r["comm_exposed_s"])
+    exposed, coll_s = slow["comm_exposed_s"], slow["collective_s"]
+    hidden = max(0.0, 1.0 - exposed / coll_s) if coll_s > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "gradient_sync_effective_bytes_per_s",
+                "value": round(slow["grad_bytes"] / slow["wall_s"], 1),
+                "unit": "bytes/s",
+                "vs_baseline": round(hidden, 4),
+                "detail": {
+                    "overlap": mode,
+                    "world_size": 2,
+                    "grad_bytes": slow["grad_bytes"],
+                    "bucket_bytes": bucket_bytes,
+                    "default_bucket_bytes": DEFAULT_BUCKET_BYTES,
+                    "buckets": slow["buckets"],
+                    "wall_s": round(slow["wall_s"], 6),
+                    "comm_exposed_s": round(exposed, 6),
+                    "collective_s": round(coll_s, 6),
+                    "comm_ref_s": round(slow["comm_ref_s"], 6),
+                    "loss_trajectory": per_rank[0]["loss_trajectory"],
+                    "interleaved_valid": 1,
+                    "schedule_bubble_fraction": round(
+                        bubble_fraction(2, 8, 2), 4
+                    ),
+                },
+            }
+        )
+    )
 
 
 def main() -> None:
@@ -341,6 +572,12 @@ if __name__ == "__main__":
         help="matrix mode: bench ONE parallelism strategy via the GSPMD "
         "trainer path instead of the single-chip headline",
     )
+    parser.add_argument(
+        "--overlap", choices=("on", "off"), default=None,
+        help="paired gradient-sync microbench on a real 2-worker ring "
+        "gang: off = monolithic blocking sync, on = bucketed async sync "
+        "overlapped with backward-sized compute",
+    )
     cli = parser.parse_args()
     if cli.sharding and "xla_force_host_platform_device_count" not in (
         os.environ.get("XLA_FLAGS", "")
@@ -351,7 +588,9 @@ if __name__ == "__main__":
             + " --xla_force_host_platform_device_count=8"
         ).strip()
     try:
-        if cli.sharding:
+        if cli.overlap:
+            overlap_main(cli.overlap)
+        elif cli.sharding:
             sharded_main(cli.sharding)
         else:
             main()

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Overlap-everything gate (ISSUE 11): bucketed async gradient sync,
+# quantized pipeline activations, interleaved 1F1B.
+#
+# Two layers, same subsystem:
+#   1. tests/test_overlap.py — the functional floor (bucket partition
+#      covers every leaf exactly once on odd pytrees, deterministic
+#      bucket signatures, scatter/gather roundtrips, interleaved
+#      schedule validity over the (S,M,v) acceptance grid + v=1
+#      equivalence to plain 1F1B, comm_exposed StepStats accounting,
+#      the 2-worker overlapped-sync parity run, and the quantized
+#      activation-wire pipeline's convergence parity vs the exact
+#      wire). These also run as part of plain tier-1
+#      `pytest -m 'not slow'`.
+#   2. the overlap_sync release entry under --smoke, which runs the
+#      PAIRED bench.py --overlap off/on microbench and enforces
+#      comm_exposed_ratio < 0.30 / trajectory parity <= 1e-6 /
+#      interleaved-grid validity, appending the run to
+#      release_history.jsonl.
+#
+# The same entry at full size: python release/run_all.py --only overlap_sync
+# Usage: ci/run_overlap_bench.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== overlap (pytest, functional floor) =="
+python -m pytest tests/test_overlap.py \
+    -q -m 'not slow' -p no:cacheprovider "$@"
+
+echo "== overlap (release floors, --smoke) =="
+python release/run_all.py --smoke --only overlap_sync
+
+echo "overlap bench: PASS"

@@ -26,13 +26,19 @@ from typing import Any, Iterable
 # the full report-to-report interval; ``compute_s`` is derived as the
 # remainder so the phases always sum to wall. ``pp_bubble_s`` is time a
 # pipeline stage spent blocked on a neighbor's activations (ISSUE 10) —
-# zero on non-pipelined runs.
+# zero on non-pipelined runs. ``comm_exposed_s`` (ISSUE 11) is the slice
+# of collective time the step actually BLOCKED on under overlapped
+# gradient sync; when the overlap path ran, the compute remainder
+# subtracts the exposed slice instead of ``collective_s`` (the total op
+# time, which keeps accumulating on background threads), so wall is
+# partitioned by what stole step time, not by where work happened.
 STEP_PHASES = (
     "data_wait_s",
     "compute_s",
     "collective_s",
     "checkpoint_s",
     "pp_bubble_s",
+    "comm_exposed_s",
 )
 
 # Peak bf16 FLOP/s per chip kind — must match release/bench_mfu.py

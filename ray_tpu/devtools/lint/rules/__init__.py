@@ -7,4 +7,5 @@ from ray_tpu.devtools.lint.rules import (  # noqa: F401
     non_atomic_write,
     rank_divergent_collective,
     swallowed_exception,
+    sync_inside_overlap_window,
 )

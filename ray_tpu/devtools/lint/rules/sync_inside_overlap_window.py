@@ -1,0 +1,106 @@
+"""sync-inside-overlap-window: blocking the host while buckets fly.
+
+``begin_gradient_sync`` opens an OVERLAP WINDOW: the bucketed gradient
+allreduce is in flight on background threads and the host thread is
+supposed to keep feeding the device (later microbatches, the next
+chunk's backward). A host synchronization inside that window —
+``block_until_ready()``, ``.item()``, ``float(loss)``,
+``np.asarray(device_array)``, ``jax.device_get`` — or a second
+BLOCKING collective (``sync_gradients*``, ``.allreduce(...)``,
+``.barrier()``) stalls exactly the compute the overlap exists to hide,
+silently turning the async path back into the monolithic one. The
+flight recorder then shows ``comm_exposed_s`` creeping back toward
+``collective_s`` with no code diff to blame.
+
+The window closes at the fence: ``handle.result()`` / ``.fence()``.
+Detection is lexical per function (source order), which matches how
+the window is actually used — launch, compute, fence, step.
+
+Scope: the training/model/parallel layers (same as host-sync-in-step).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Rule,
+    Severity,
+    call_name,
+    register_rule,
+)
+
+_SCOPE = ("train/", "models/", "parallel/", "ops/")
+
+_OPEN_TAILS = {"begin_gradient_sync"}
+_CLOSE_TAILS = {"result", "fence", "finish_gradient_sync"}
+
+_SYNC_TAILS = {
+    "block_until_ready": "forces a device sync",
+    "item": "device->host copy + sync",
+    "device_get": "device->host copy + sync",
+    "barrier": "blocks the host on every rank",
+    "allreduce": "a second blocking collective serializes the window",
+    "allreduce_sharded": "a second blocking collective serializes the window",
+    "sync_gradients": "the monolithic blocking sync defeats the overlap",
+    "sync_gradients_sharded": "the monolithic blocking sync defeats the overlap",
+}
+_SYNC_FULL = {
+    "np.asarray": "materializes the device array on host",
+    "numpy.asarray": "materializes the device array on host",
+    "jax.device_get": "device->host copy + sync",
+    "float": "scalar device->host sync",
+    "int": "scalar device->host sync",
+}
+
+
+@register_rule
+class SyncInsideOverlapWindow(Rule):
+    name = "sync-inside-overlap-window"
+    severity = Severity.WARNING
+    description = (
+        "host sync or blocking collective between begin_gradient_sync() "
+        "and the fence — stalls the compute the overlap should hide"
+    )
+
+    def check(self, ctx: FileContext):
+        if not ctx.in_path(*_SCOPE):
+            return
+        for qual, fn in ctx.functions().items():
+            from ray_tpu.devtools.lint.callgraph import _own_statements
+
+            calls = [
+                n for n in _own_statements(fn) if isinstance(n, ast.Call)
+            ]
+            calls.sort(
+                key=lambda n: (n.lineno, n.col_offset)
+            )
+            open_at: ast.Call | None = None
+            for node in calls:
+                name = call_name(node)
+                tail = name.rsplit(".", 1)[-1]
+                if tail in _OPEN_TAILS:
+                    open_at = node
+                    continue
+                if tail in _CLOSE_TAILS:
+                    open_at = None
+                    continue
+                if open_at is None:
+                    continue
+                why = _SYNC_FULL.get(name) or _SYNC_TAILS.get(tail)
+                if why is None:
+                    continue
+                # float()/int() only matter on non-literal args.
+                if name in ("float", "int") and (
+                    not node.args
+                    or isinstance(node.args[0], ast.Constant)
+                ):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"`{name}` in `{qual}` {why} while the bucketed "
+                    f"gradient sync launched on line {open_at.lineno} is "
+                    f"still in flight — move it past the "
+                    f"`handle.result()` fence (or fence first)",
+                )

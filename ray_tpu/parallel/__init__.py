@@ -24,6 +24,7 @@ from ray_tpu.parallel.pipeline import (
     pipeline_apply,
     pipeline_step,
     schedule_1f1b,
+    schedule_interleaved_1f1b,
     validate_schedule,
 )
 from ray_tpu.parallel.ring_attention import (
@@ -47,6 +48,7 @@ __all__ = [
     "pipeline_apply",
     "pipeline_step",
     "schedule_1f1b",
+    "schedule_interleaved_1f1b",
     "shard_batch",
     "single_host_mesh",
     "transformer_tp_rules",

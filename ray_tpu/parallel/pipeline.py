@@ -64,67 +64,170 @@ def schedule_1f1b(
     return ops
 
 
-def validate_schedule(
-    schedules: Sequence[Sequence[tuple[str, int]]]
-) -> None:
-    """Check a per-stage op-stream set for pipeline correctness.
+def schedule_interleaved_1f1b(
+    num_stages: int,
+    num_microbatches: int,
+    stage: int,
+    num_virtual: int = 1,
+) -> list[tuple[str, int, int]]:
+    """This RANK's op stream under interleaved 1F1B (Megatron-style
+    virtual pipeline stages).
 
-    Simulates the stages tick-by-tick with blocking p2p dependencies
-    (F(m) at stage s needs F(m) done at s-1; B(m) at stage s needs B(m)
-    done at s+1) and raises if any stage's stream would deadlock, skip
-    a microbatch, run B(m) before its own F(m), or exceed the 1F1B
-    in-flight activation bound of ``num_stages - stage``.
+    Each physical rank hosts ``num_virtual`` model CHUNKS; chunk ``c``
+    on rank ``r`` is virtual stage ``c * num_stages + r``, so the
+    virtual pipeline wraps around the physical ring ``num_virtual``
+    times. Microbatches flow through the ranks in groups of
+    ``num_stages``: a rank runs ``num_stages`` forwards of chunk 0, then
+    the SAME microbatch group through chunk 1, …, and backwards mirror
+    in reverse-chunk order. Fill/drain shrinks from one chunk-sized ramp
+    to one stage-sized ramp — bubble (S−1)/(M+S−1) → (S−1)/(v·M+S−1),
+    see :func:`bubble_fraction`.
+
+    Returns ``("F"|"B", microbatch, chunk)`` ops. ``num_virtual=1``
+    reduces exactly to :func:`schedule_1f1b` (with chunk 0 appended).
+    ``num_virtual > 1`` requires ``num_microbatches % num_stages == 0``
+    (the microbatch-group rotation needs full groups).
+    """
+    if not (0 <= stage < num_stages):
+        raise ValueError(f"stage {stage} out of range [0, {num_stages})")
+    if num_microbatches < 1 or num_virtual < 1:
+        raise ValueError("num_microbatches and num_virtual must be >= 1")
+    if num_virtual == 1:
+        return [(kind, m, 0) for kind, m in
+                schedule_1f1b(num_stages, num_microbatches, stage)]
+    if num_microbatches % num_stages != 0:
+        raise ValueError(
+            f"interleaved 1F1B needs num_microbatches divisible by "
+            f"num_stages, got M={num_microbatches} S={num_stages}"
+        )
+    total = num_microbatches * num_virtual
+    group = num_stages * num_virtual  # one full rotation of the chunks
+
+    def fwd(i: int) -> tuple[str, int, int]:
+        chunk = (i // num_stages) % num_virtual
+        micro = (i // group) * num_stages + i % num_stages
+        return ("F", micro, chunk)
+
+    def bwd(i: int) -> tuple[str, int, int]:
+        chunk = num_virtual - 1 - (i // num_stages) % num_virtual
+        micro = (i // group) * num_stages + i % num_stages
+        return ("B", micro, chunk)
+
+    # Megatron warmup: enough forwards that the LAST virtual stage has
+    # run its first microbatch before anyone turns around, plus the
+    # 2-per-rank stagger that keeps the steady state collision-free.
+    warmup = min(
+        total, (num_stages - stage - 1) * 2 + (num_virtual - 1) * num_stages
+    )
+    ops = [fwd(i) for i in range(warmup)]
+    for i in range(total - warmup):
+        ops.append(fwd(warmup + i))
+        ops.append(bwd(i))
+    for i in range(total - warmup, total):
+        ops.append(bwd(i))
+    return ops
+
+
+def _normalize_schedules(schedules):
+    """Accept both (kind, m) and (kind, m, chunk) op streams."""
+    out = []
+    for ops in schedules:
+        out.append([
+            (op[0], op[1], op[2] if len(op) > 2 else 0) for op in ops
+        ])
+    return out
+
+
+def validate_schedule(
+    schedules: Sequence[Sequence[tuple]],
+    num_virtual: int = 1,
+) -> None:
+    """Check a per-rank op-stream set for pipeline correctness.
+
+    Simulates the ranks tick-by-tick with blocking p2p dependencies and
+    raises if any rank's stream would deadlock, skip a microbatch, or
+    run B before its own F. Ops may be ``(kind, m)`` (plain 1F1B) or
+    ``(kind, m, chunk)`` (interleaved; pass ``num_virtual``). In virtual
+    stage terms (vs = chunk·S + rank): F(m) at vs needs F(m) done at
+    vs−1, B(m) at vs needs B(m) done at vs+1 — the wraparound hops
+    between chunks ride the same physical neighbor links.
+
+    The 1F1B live-activation bound (≤ num_stages − rank) is enforced
+    only for ``num_virtual == 1``: interleaving trades that bound for
+    the smaller bubble (live activations grow with v by design).
     """
     num_stages = len(schedules)
-    done_f = [set() for _ in range(num_stages)]
-    done_b = [set() for _ in range(num_stages)]
+    schedules = _normalize_schedules(schedules)
+    num_vs = num_stages * num_virtual
+    done_f: dict[int, set] = {vs: set() for vs in range(num_vs)}
+    done_b: dict[int, set] = {vs: set() for vs in range(num_vs)}
     cursors = [0] * num_stages
     progressed = True
     while progressed:
         progressed = False
         for s, ops in enumerate(schedules):
             while cursors[s] < len(ops):
-                kind, m = ops[cursors[s]]
-                if kind == "F":
-                    if s > 0 and m not in done_f[s - 1]:
-                        break
-                    done_f[s].add(m)
-                elif kind == "B":
-                    if m not in done_f[s]:
-                        raise ValueError(
-                            f"stage {s}: B({m}) before its own F({m})"
-                        )
-                    if s < num_stages - 1 and m not in done_b[s + 1]:
-                        break
-                    done_b[s].add(m)
-                else:
-                    raise ValueError(f"stage {s}: unknown op {kind!r}")
-                live = len(done_f[s]) - len(done_b[s])
-                if live > num_stages - s:
+                kind, m, chunk = ops[cursors[s]]
+                if not (0 <= chunk < num_virtual):
                     raise ValueError(
-                        f"stage {s}: {live} live activations exceeds the "
-                        f"1F1B bound {num_stages - s}"
+                        f"rank {s}: chunk {chunk} out of range "
+                        f"[0, {num_virtual})"
                     )
+                vs = chunk * num_stages + s
+                if kind == "F":
+                    if vs > 0 and m not in done_f[vs - 1]:
+                        break
+                    done_f[vs].add(m)
+                elif kind == "B":
+                    if m not in done_f[vs]:
+                        raise ValueError(
+                            f"rank {s}: B({m}) chunk {chunk} before its "
+                            f"own F({m})"
+                        )
+                    if vs < num_vs - 1 and m not in done_b[vs + 1]:
+                        break
+                    done_b[vs].add(m)
+                else:
+                    raise ValueError(f"rank {s}: unknown op {kind!r}")
+                if num_virtual == 1:
+                    live = len(done_f[vs]) - len(done_b[vs])
+                    if live > num_stages - s:
+                        raise ValueError(
+                            f"stage {s}: {live} live activations exceeds "
+                            f"the 1F1B bound {num_stages - s}"
+                        )
                 cursors[s] += 1
                 progressed = True
     stuck = [s for s in range(num_stages) if cursors[s] < len(schedules[s])]
     if stuck:
         raise ValueError(f"schedule deadlocks at stages {stuck}")
     for s in range(num_stages):
-        micro = {m for _, m in schedules[s]}
-        if done_f[s] != micro or done_b[s] != micro:
-            raise ValueError(f"stage {s}: incomplete F/B coverage")
+        for chunk in range(num_virtual):
+            vs = chunk * num_stages + s
+            micro = {m for kind, m, c in schedules[s] if c == chunk}
+            if done_f[vs] != micro or done_b[vs] != micro:
+                raise ValueError(
+                    f"rank {s} chunk {chunk}: incomplete F/B coverage"
+                )
 
 
-def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
-    """The ideal pipeline-bubble fraction (P-1)/(M+P-1): the share of
-    each stage's wall clock spent idle during fill+drain when every
-    microbatch tick costs the same. 1F1B and GPipe share this number —
-    1F1B only improves the activation-memory bound. The flight recorder
-    compares *measured* p2p-wait fractions against it."""
-    if num_stages < 1 or num_microbatches < 1:
-        raise ValueError("num_stages and num_microbatches must be >= 1")
-    return (num_stages - 1) / (num_microbatches + num_stages - 1)
+def bubble_fraction(
+    num_stages: int, num_microbatches: int, num_virtual: int = 1
+) -> float:
+    """The ideal pipeline-bubble fraction: the share of each stage's
+    wall clock spent idle during fill+drain when every microbatch tick
+    costs the same. Plain 1F1B and GPipe share (P−1)/(M+P−1) — 1F1B
+    only improves the activation-memory bound. Interleaving the model
+    into ``num_virtual`` chunks per rank divides the ramp's share of
+    useful work: (P−1)/(v·M+P−1). The flight recorder compares
+    *measured* p2p-wait fractions against it."""
+    if num_stages < 1 or num_microbatches < 1 or num_virtual < 1:
+        raise ValueError(
+            "num_stages, num_microbatches, num_virtual must be >= 1"
+        )
+    return (num_stages - 1) / (
+        num_virtual * num_microbatches + num_stages - 1
+    )
 
 
 def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name, num_micro):

@@ -153,6 +153,7 @@ class BackendExecutor:
                 {
                     "num_stages": int(sc.pipeline_stages),
                     "microbatches": int(sc.microbatches),
+                    "virtual": int(getattr(sc, "virtual_stages", 1)),
                 }
                 if int(getattr(sc, "pipeline_stages", 1)) > 1
                 else None

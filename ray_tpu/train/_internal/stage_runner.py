@@ -1,29 +1,46 @@
-"""MPMD pipeline-stage runner — 1F1B across slice gangs (ISSUE 10).
+"""MPMD pipeline-stage runner — (interleaved) 1F1B across slice gangs.
 
-Each pipeline stage is a SEPARATE program on its own gang worker (MPMD:
+Each pipeline rank is a SEPARATE program on its own gang worker (MPMD:
 "Scaling Deep Learning Training with MPMD Pipeline Parallelism"), holding
-one contiguous slice of the model's layers. The driver-visible contract is
-unchanged — workers run an ordinary train loop and ``report()`` per step —
-but inside the step this runner executes the per-stage op stream from
-``parallel.pipeline.schedule_1f1b``, handing activations (forward) and
-activation-cotangents (backward) to neighbor stages over the collective
-p2p plane. p2p is ALWAYS exact wire: ISSUE-7 quantization applies to
-allreduce only, never to the activations the next stage's math depends on.
+one or more contiguous chunks of the model's layers. The driver-visible
+contract is unchanged — workers run an ordinary train loop and
+``report()`` per step — but inside the step this runner executes the
+per-rank op stream from ``parallel.pipeline.schedule_interleaved_1f1b``,
+handing activations (forward) and activation-cotangents (backward) to
+neighbor ranks over the collective p2p plane.
+
+Interleaved 1F1B (ISSUE 11): with ``virtual > 1`` chunks per rank, chunk
+``c`` on rank ``r`` is virtual stage ``c * num_stages + r`` — the virtual
+pipeline wraps the physical ring ``virtual`` times, shrinking the
+fill/drain bubble from (S−1)/(M+S−1) to (S−1)/(v·M+S−1) at the cost of
+``virtual − 1`` extra activation hand-offs per microbatch. The p2p links
+are unchanged: every virtual edge vs→vs+1 is the same physical
+next-neighbor hop.
+
+Activation wire (ISSUE 11): with
+``CollectiveConfig(quantize_activations="int8"|"fp8")`` the PR-7
+block-scaled codec extends from gradient allreduce to the activation /
+cotangent hand-offs, with per-edge persistent error-feedback residuals
+(keyed by direction × microbatch × virtual stage, so step t's rounding
+error corrects step t+1's message on the SAME edge). The loss broadcast
+and any non-float payload always ride the exact wire, and the codec is
+host-memory only (ring/hier backends) — the xla p2p path stays exact.
 
 Inside a stage, dp/fsdp/tp still apply: the stage's params are sharded
 over the worker's local GSPMD mesh with the same logical-dim rules the
 non-pipelined path uses — pp composes with the other axes.
 
-Memory follows the 1F1B bound (≤ num_stages − stage in-flight
-microbatches) and backward recomputes the stage forward from the saved
-INPUT (full per-stage remat) instead of holding vjp residuals — the
-standard MPMD trade: activations-in-flight stay O(microbatch), at one
-extra forward of FLOPs per microbatch.
+Memory follows the 1F1B bound on stashed inputs (scaled by ``virtual``)
+and backward recomputes the chunk forward from the saved INPUT (full
+per-chunk remat) instead of holding vjp residuals — the standard MPMD
+trade: activations-in-flight stay O(microbatch), at one extra forward of
+FLOPs per microbatch.
 
 Stage-level StepStats: wall time spent blocked in ``recv`` is attributed
 to the ``pp_bubble`` phase, so the flight recorder's per-step breakdown
 separates schedule bubbles from real compute and the release gate can
-assert bubble ≤ its bound.
+assert bubble ≤ its bound — which is exactly how the interleaved
+schedule's smaller bubble shows up as a measured number.
 
 Checkpointing under pp > 1 is deliberately per-stage-local for now: the
 committed-checkpoint reshard protocol covers (dp, fsdp, tp); resharding
@@ -35,25 +52,32 @@ docs/sharding.md, "Pipeline stages and checkpoints").
 from __future__ import annotations
 
 import time
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 import numpy as np
 
 from ray_tpu.train._internal import step_stats
 
+# Wire marker for codec-compressed activation payloads (self-describing
+# so mixed exact/quantized edges share one recv path).
+_ACT_WIRE = "__act"
+
 
 class PipelineStageRunner:
-    """Runs ONE stage's half of the 1F1B schedule, step by step.
+    """Runs ONE rank's half of the (interleaved) 1F1B schedule.
 
     Parameters
     ----------
-    stage_fn : (stage_params, activations) -> activations
-        This stage's forward for interior/first stages (first stage
-        receives the microbatch's model inputs instead of activations).
-    last_stage_fn : (stage_params, activations, microbatch) -> scalar loss
-        Used when this worker IS the last stage; closes over targets.
-    params : pytree
-        This stage's (possibly GSPMD-sharded) parameters.
+    stage_fn : (chunk_params, activations) -> activations, or a sequence
+        of ``virtual`` such callables (one per local chunk; chunk ``c``
+        is virtual stage ``c * num_stages + rank``). The FIRST virtual
+        stage receives the microbatch's model inputs instead of
+        activations.
+    last_stage_fn : (chunk_params, activations, microbatch) -> scalar loss
+        Used for the LAST virtual stage (last rank's last chunk); closes
+        over targets.
+    params : pytree, or a sequence of ``virtual`` pytrees
+        This rank's chunk parameters (possibly GSPMD-sharded).
     optimizer : optax-like GradientTransformation.
     activation_like : (microbatch) -> jax.ShapeDtypeStruct
         Wire shape/dtype of one microbatch's activations — recv needs it
@@ -66,7 +90,7 @@ class PipelineStageRunner:
         self,
         *,
         ctx: Any,
-        stage_fn: Callable,
+        stage_fn: Callable | Sequence[Callable],
         last_stage_fn: Callable,
         params: Any,
         optimizer: Any,
@@ -77,8 +101,9 @@ class PipelineStageRunner:
     ):
         import jax
 
-        from ray_tpu.parallel.pipeline import schedule_1f1b
+        from ray_tpu.parallel.pipeline import schedule_interleaved_1f1b
         from ray_tpu.util.collective import collective
+        from ray_tpu.util.collective.quantization import ErrorFeedback
 
         pipe = ctx.pipeline
         if not pipe:
@@ -89,35 +114,56 @@ class PipelineStageRunner:
         self.stage = int(pipe["stage"])
         self.num_stages = int(pipe["num_stages"])
         self.microbatches = int(pipe["microbatches"])
+        self.virtual = int(pipe.get("virtual", 1))
         if ctx.world_size != self.num_stages:
             raise NotImplementedError(
                 "stage gangs wider than one worker are not wired yet: "
                 f"world_size={ctx.world_size} != "
                 f"pipeline_stages={self.num_stages}"
             )
-        self.first = self.stage == 0
-        self.last = self.stage == self.num_stages - 1
         self.group = collective.get_group(ctx.collective_group)
-        self.params = params
-        self.opt_state = optimizer.init(params)
         self.optimizer = optimizer
         self.activation_like = activation_like
         self.microbatch_fn = microbatch_fn
         self.recv_timeout_s = float(recv_timeout_s)
-        self.schedule = schedule_1f1b(
-            self.num_stages, self.microbatches, self.stage
+        self.schedule = schedule_interleaved_1f1b(
+            self.num_stages, self.microbatches, self.stage, self.virtual
         )
 
-        self._fwd = jax.jit(stage_fn)
+        # Per-chunk state. v == 1 callers keep passing a single tree /
+        # callable; v > 1 callers pass one per chunk.
+        stage_fns = (
+            list(stage_fn)
+            if isinstance(stage_fn, (list, tuple))
+            else [stage_fn] * self.virtual
+        )
+        chunk_params = (
+            list(params)
+            if isinstance(params, (list, tuple))
+            else [params]
+        )
+        if len(stage_fns) != self.virtual or len(chunk_params) != self.virtual:
+            raise ValueError(
+                f"need {self.virtual} stage_fns/param chunks "
+                f"(virtual={self.virtual}), got {len(stage_fns)} fns / "
+                f"{len(chunk_params)} param trees"
+            )
+        self._chunk_params = chunk_params
+        self._opt_states = [optimizer.init(p) for p in chunk_params]
 
-        def _bwd(p, a, ct):
-            # Recompute-forward backward: vjp INSIDE jit so residuals
-            # never outlive the call (the 1F1B memory bound holds on
-            # stashed inputs, not activation stacks).
-            _, vjp_fn = jax.vjp(stage_fn, p, a)
-            return vjp_fn(ct)
+        self._fwd = [jax.jit(fn) for fn in stage_fns]
 
-        self._bwd = jax.jit(_bwd)
+        def _make_bwd(fn):
+            def _bwd(p, a, ct):
+                # Recompute-forward backward: vjp INSIDE jit so residuals
+                # never outlive the call (the memory bound holds on
+                # stashed inputs, not activation stacks).
+                _, vjp_fn = jax.vjp(fn, p, a)
+                return vjp_fn(ct)
+
+            return jax.jit(_bwd)
+
+        self._bwd = [_make_bwd(fn) for fn in stage_fns]
         self._last_grad = jax.jit(
             jax.value_and_grad(last_stage_fn, argnums=(0, 1))
         )
@@ -133,6 +179,47 @@ class PipelineStageRunner:
         self._param_shardings = param_shardings
         self._step_counter = 0
 
+        # Activation-wire codec (ISSUE 11): host-memory backends only —
+        # the xla p2p plane moves device arrays and stays exact.
+        cfg = self.group.config
+        self._act_cfg = None
+        if (
+            getattr(cfg, "quantize_activations", None)
+            and getattr(self.group, "backend_name", "") in ("ring", "hier")
+        ):
+            self._act_cfg = cfg.activation_wire_config()
+        self._act_ef = ErrorFeedback()
+
+    # -- back-compat single-chunk views -----------------------------------
+    @property
+    def params(self) -> Any:
+        """The single-chunk param tree (v == 1 callers), or the chunk
+        list under interleaving."""
+        return (
+            self._chunk_params[0] if self.virtual == 1 else self._chunk_params
+        )
+
+    @params.setter
+    def params(self, value: Any) -> None:
+        if self.virtual == 1:
+            self._chunk_params[0] = value
+        else:
+            self._chunk_params = list(value)
+
+    @property
+    def opt_state(self) -> Any:
+        return (
+            self._opt_states[0] if self.virtual == 1 else self._opt_states
+        )
+
+    # -- virtual-stage helpers -------------------------------------------
+    def _virtual_stage(self, chunk: int) -> int:
+        return chunk * self.num_stages + self.stage
+
+    @property
+    def num_virtual_stages(self) -> int:
+        return self.num_stages * self.virtual
+
     # -- p2p plumbing -----------------------------------------------------
     def _recv(self, src: int, tag: str, like):
         """Blocking neighbor recv; blocked wall time IS the pipeline
@@ -142,69 +229,105 @@ class PipelineStageRunner:
             src, tag=tag, timeout=self.recv_timeout_s, like=like
         )
         step_stats.record_phase("pp_bubble", time.perf_counter() - t0)
+        if isinstance(out, tuple) and len(out) == 4 and out[0] == _ACT_WIRE:
+            from ray_tpu.util.collective.quantization import decode
+
+            _, shape, dtype_str, enc = out
+            return decode(enc).reshape(shape).astype(np.dtype(dtype_str))
         return out
 
-    def _send(self, array, dst: int, tag: str) -> None:
-        self.group.send(np.asarray(array), dst, tag=tag)  # rtlint: disable=host-sync-in-step - eager p2p hand-off IS the wire, not an accidental sync
+    def _send(self, array, dst: int, tag: str, site=None) -> None:
+        arr = np.asarray(array)  # rtlint: disable=host-sync-in-step - eager p2p hand-off IS the wire, not an accidental sync
+        if (
+            self._act_cfg is not None
+            and site is not None
+            and arr.dtype.kind == "f"
+        ):
+            # Block-scaled quantized activation hand-off: the per-edge
+            # EF residual telescopes this step's rounding error into the
+            # next step's message on the SAME (direction, m, vs) edge.
+            enc = self._act_ef.encode(site, arr.ravel(), self._act_cfg)
+            self.group.send(
+                (_ACT_WIRE, arr.shape, arr.dtype.str, enc), dst, tag=tag
+            )
+            return
+        self.group.send(arr, dst, tag=tag)
 
     # -- one optimizer step ----------------------------------------------
     def train_step(self, batch: Any) -> float:
-        """Run this stage's full 1F1B op stream for one global batch and
-        apply the stage-local optimizer update. Every stage returns the
-        SAME mean microbatch loss (broadcast from the last stage)."""
+        """Run this rank's full op stream for one global batch and apply
+        the chunk-local optimizer updates. Every rank returns the SAME
+        mean microbatch loss (broadcast from the last rank)."""
         import jax
 
-        grads_acc = None
+        grads_acc: list = [None] * self.virtual
         losses: list = []
-        stash: dict[int, Any] = {}  # microbatch -> stage input (for bwd)
+        stash: dict[tuple, Any] = {}  # (micro, chunk) -> input / grads
         step_tag = self._next_tag()
-        for op, m in self.schedule:
+        prev_rank = (self.stage - 1) % self.num_stages
+        next_rank = (self.stage + 1) % self.num_stages
+        last_vs = self.num_virtual_stages - 1
+        for op, m, c in self.schedule:
+            vs = self._virtual_stage(c)
             micro = self.microbatch_fn(batch, m, self.microbatches)
             if op == "F":
-                if self.first:
+                if vs == 0:
                     a_in = self._model_inputs(micro)
                 else:
                     a_in = self._recv(
-                        self.stage - 1,
-                        f"{step_tag}f{m}",
+                        prev_rank,
+                        f"{step_tag}f{m}v{vs}",
                         self.activation_like(micro),
                     )
-                stash[m] = a_in
-                if self.last:
-                    # Last stage has no downstream cotangent to wait on:
-                    # loss + grads come from one fused value_and_grad.
+                if vs == last_vs:
+                    # Last virtual stage has no downstream cotangent to
+                    # wait on: loss + grads in one fused value_and_grad.
                     loss, (dp, da) = self._last_grad(
-                        self.params, a_in, micro
+                        self._chunk_params[c], a_in, micro
                     )
                     losses.append(loss)
-                    stash[m] = (dp, da)
+                    stash[(m, c)] = (dp, da)
                 else:
-                    y = self._fwd(self.params, a_in)
-                    self._send(y, self.stage + 1, f"{step_tag}f{m}")
+                    stash[(m, c)] = a_in
+                    y = self._fwd[c](self._chunk_params[c], a_in)
+                    self._send(
+                        y,
+                        next_rank,
+                        f"{step_tag}f{m}v{vs + 1}",
+                        site=("f", m, vs),
+                    )
             else:  # "B"
-                if self.last:
-                    dp, da = stash.pop(m)
+                if vs == last_vs:
+                    dp, da = stash.pop((m, c))
                 else:
                     ct = self._recv(
-                        self.stage + 1,
-                        f"{step_tag}b{m}",
+                        next_rank,
+                        f"{step_tag}b{m}v{vs}",
                         self.activation_like(micro),
                     )
-                    dp, da = self._bwd(self.params, stash.pop(m), ct)
-                if not self.first:
-                    self._send(da, self.stage - 1, f"{step_tag}b{m}")
-                grads_acc = (
+                    dp, da = self._bwd[c](
+                        self._chunk_params[c], stash.pop((m, c)), ct
+                    )
+                if vs > 0:
+                    self._send(
+                        da,
+                        prev_rank,
+                        f"{step_tag}b{m}v{vs - 1}",
+                        site=("b", m, vs),
+                    )
+                grads_acc[c] = (
                     dp
-                    if grads_acc is None
-                    else jax.tree.map(jax.numpy.add, grads_acc, dp)
+                    if grads_acc[c] is None
+                    else jax.tree.map(jax.numpy.add, grads_acc[c], dp)
                 )
-        grads = jax.tree.map(
-            lambda g: g / self.microbatches, grads_acc
-        )
-        self.params, self.opt_state = self._apply(
-            self.params, self.opt_state, grads
-        )
-        if self.last:
+        for c in range(self.virtual):
+            grads = jax.tree.map(
+                lambda g: g / self.microbatches, grads_acc[c]
+            )
+            self._chunk_params[c], self._opt_states[c] = self._apply(
+                self._chunk_params[c], self._opt_states[c], grads
+            )
+        if self.stage == self.num_stages - 1:
             local = float(np.mean([np.asarray(l) for l in losses]))  # rtlint: disable=host-sync-in-step - loss leaves the device to ride the broadcast wire
         else:
             local = 0.0

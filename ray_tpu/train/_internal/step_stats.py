@@ -130,8 +130,15 @@ class StepRecorder:
         # Pipeline-stage recv waits (stage_runner): schedule bubble, not
         # compute — subtracted from the remainder like the other phases.
         pp_bubble = min(wall, phases.get("pp_bubble", 0.0))
+        # Overlapped gradient sync (ISSUE 11): collective keeps the TOTAL
+        # op time (the work still happened, on background threads), but
+        # only the fence-blocked slice stole wall clock from the step —
+        # so when the overlap path ran, the compute remainder subtracts
+        # the exposed time instead of the total.
+        comm_exposed = min(wall, phases.get("comm_exposed", 0.0))
+        comm_blocking = comm_exposed if "comm_exposed" in phases else collective
         compute = max(
-            0.0, wall - data_wait - collective - checkpoint - pp_bubble
+            0.0, wall - data_wait - comm_blocking - checkpoint - pp_bubble
         )
         if self._device_kind is None:
             self._device_kind, self._devices = _device_info()
@@ -147,6 +154,7 @@ class StepRecorder:
             "collective_s": collective,
             "checkpoint_s": checkpoint,
             "pp_bubble_s": pp_bubble,
+            "comm_exposed_s": comm_exposed,
         }
         tokens = metrics.get("tokens")
         if isinstance(tokens, (int, float)) and not isinstance(tokens, bool):

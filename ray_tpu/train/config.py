@@ -85,6 +85,12 @@ class ScalingConfig:
     # the GSPMD axes.
     pipeline_stages: int = 1
     microbatches: int = 1
+    # Interleaved 1F1B (ISSUE 11): each stage rank hosts this many model
+    # CHUNKS (virtual pipeline stages), shrinking the fill/drain bubble
+    # from (S-1)/(M+S-1) to (S-1)/(v*M+S-1). Requires microbatches
+    # divisible by pipeline_stages when > 1; the model must partition
+    # into pipeline_stages * virtual_stages chunks.
+    virtual_stages: int = 1
 
     def worker_resources(self) -> dict[str, float]:
         resources = {"CPU": 1.0, **dict(self.resources_per_worker)}
@@ -128,6 +134,18 @@ class ScalingConfig:
         if self.pipeline_stages < 1 or self.microbatches < 1:
             raise ValueError(
                 "pipeline_stages and microbatches must be >= 1"
+            )
+        if self.virtual_stages < 1:
+            raise ValueError("virtual_stages must be >= 1")
+        if (
+            self.virtual_stages > 1
+            and self.microbatches % self.pipeline_stages != 0
+        ):
+            raise ValueError(
+                f"interleaved 1F1B (virtual_stages={self.virtual_stages}) "
+                f"needs microbatches divisible by pipeline_stages, got "
+                f"microbatches={self.microbatches} "
+                f"pipeline_stages={self.pipeline_stages}"
             )
         if (
             self.pipeline_stages > 1

@@ -179,7 +179,9 @@ def _unflatten_tree(flat: np.ndarray, leaves, treedef) -> Any:
 
     out, offset = [], 0
     for leaf in leaves:
-        size = int(np.prod(np.shape(leaf))) or 1
+        # prod(()) is already 1 for scalars; a genuinely empty leaf
+        # (size 0) must stay 0 so the reshape below round-trips it.
+        size = int(np.prod(np.shape(leaf), dtype=np.int64))
         out.append(
             flat[offset : offset + size].reshape(np.shape(leaf)).astype(
                 np.asarray(leaf).dtype
@@ -206,8 +208,75 @@ def sync_gradients(grads: Any, group_name: str) -> Any:
     return _unflatten_tree(flat, leaves, treedef)
 
 
+class GradientSyncHandle:
+    """An in-flight overlapped gradient sync (see begin_gradient_sync)."""
+
+    def __init__(self, inner, per_device_leaves, treedef, denom):
+        self._inner = inner
+        self._leaves = per_device_leaves[0]
+        self._treedef = treedef
+        self._denom = denom
+        self.stats: dict[str, float] = {}
+
+    def result(self) -> Any:
+        """Fence: block until every bucket lands, record the exposed
+        comm time, and return the globally-AVERAGED grad pytree."""
+        import jax
+        from ray_tpu.util.collective import bucketing
+
+        segments = self._inner.fence()
+        self.stats = dict(self._inner.stats)
+        out: list = [None] * len(self._leaves)
+        for bucket, segment in zip(self._inner.buckets, segments):
+            for i, arr in bucketing.scatter_segment(
+                np.asarray(segment, np.float32) / self._denom,
+                self._leaves,
+                bucket,
+            ).items():
+                out[i] = arr
+        return jax.tree.unflatten(self._treedef, out)
+
+
+def begin_gradient_sync(
+    per_device_grads: list,
+    group_name: str,
+    *,
+    bucket_bytes: int | None = None,
+) -> GradientSyncHandle:
+    """Launch a bucketed ASYNC gradient sync and return immediately.
+
+    The overlap half of :func:`sync_gradients_sharded`: the grad pytree
+    is partitioned into ~``bucket_bytes`` buckets (reverse-topological —
+    last-layer grads, which backward produces first, fly first) and each
+    bucket's quantized hierarchical allreduce launches on a background
+    thread. The caller keeps working (later microbatches, metrics, host
+    logging) and fences ONLY at the optimizer step via
+    ``handle.result()`` — the fence-blocked wall time lands in the new
+    ``comm_exposed_s`` StepStats phase while the total ``collective_s``
+    stays, which is exactly how the flight recorder proves the overlap.
+    """
+    import jax
+    from ray_tpu.util.collective import collective, overlap
+
+    group = collective.get_group(group_name)
+    per_device_leaves = []
+    treedef = None
+    for grads in per_device_grads:
+        leaves, treedef = jax.tree.flatten(grads)
+        per_device_leaves.append([np.asarray(l) for l in leaves])
+    denom = group.world_size * len(per_device_leaves)
+    inner = overlap.launch_bucketed_allreduce(
+        group, per_device_leaves, bucket_bytes
+    )
+    return GradientSyncHandle(inner, per_device_leaves, treedef, denom)
+
+
 def sync_gradients_sharded(
-    per_device_grads: list, group_name: str
+    per_device_grads: list,
+    group_name: str,
+    *,
+    overlap: bool | None = None,
+    bucket_bytes: int | None = None,
 ) -> Any:
     """Two-tier gradient mean for hierarchical-backend gangs: one grad
     pytree PER LOCAL DEVICE in, the globally-averaged pytree out.
@@ -215,10 +284,25 @@ def sync_gradients_sharded(
     Tier 1 reduces the local shards in one jit (psum over ICI); tier 2
     rides the DCN ring with this group's CollectiveConfig (so int8/fp8
     wire compression applies only to the cross-host hop). Falls back to
-    host-mean + :func:`sync_gradients` on non-hierarchical groups."""
+    host-mean + :func:`sync_gradients` on non-hierarchical groups.
+
+    ``overlap=True`` (or ``CollectiveConfig(overlap=True)`` with
+    ``overlap=None`` here) takes the bucketed async path: the sync is
+    launched bucket-by-bucket and fenced before returning, so buckets
+    overlap EACH OTHER on the wire; callers that can put work between
+    launch and fence should use :func:`begin_gradient_sync` directly.
+    """
     from ray_tpu.util.collective import collective
+    from ray_tpu.util.collective import overlap as overlap_mod
 
     group = collective.get_group(group_name)
+    if overlap is None:
+        overlap = bool(getattr(group.config, "overlap", False))
+    if overlap and overlap_mod.supports_overlap(group):
+        handle = begin_gradient_sync(
+            per_device_grads, group_name, bucket_bytes=bucket_bytes
+        )
+        return handle.result()
     flats = []
     leaves = treedef = None
     for grads in per_device_grads:

@@ -1,0 +1,146 @@
+"""Size-targeted gradient buckets for overlapped allreduce (ISSUE 11).
+
+"The Big Send-off" (PAPERS.md) observes that a monolithic post-backward
+allreduce serializes communication behind the whole backward pass; the
+fix is to partition the grad pytree into ~`bucket_bytes` buckets and
+launch each bucket's reduction as soon as its grads exist. This module
+is the pure-math half of that path: deterministic bucket PARTITIONING
+plus flat-segment gather/scatter. The async launch/fence machinery
+lives in :mod:`ray_tpu.util.collective.overlap`.
+
+Design constraints the partition honors:
+
+* **Every leaf lands in exactly one bucket** — scalars, zero-size
+  leaves, and mixed dtypes included. The reduction wire is f32, so a
+  bucket's byte size is ``4 * sum(leaf sizes)`` regardless of the
+  leaves' storage dtypes.
+* **Reverse-topological order**: leaves are packed starting from the
+  END of the flattened pytree. Backward produces last-layer grads
+  first, and jax.tree flattening walks layers in forward order, so
+  bucket 0 holds the leaves whose grads materialize earliest — launch
+  order matches production order.
+* **Rank determinism**: the partition is a pure function of the leaf
+  shapes and ``bucket_bytes``. Every rank derives the identical bucket
+  list from its (structurally identical) grad tree, so per-bucket
+  collective tags pair up without any negotiation.
+* **EF-safe tags**: each bucket carries a ``signature`` hashed from its
+  member leaves' (index, shape, dtype). When a resize/repartition moves
+  a leaf between buckets, the signature changes, the collective tag
+  changes, and the quantized ring's per-(tag, step) error-feedback
+  residuals start fresh instead of being misapplied to different data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Sequence
+
+import numpy as np
+
+# ~25MB of f32 per bucket: large enough that ring-hop latency amortizes,
+# small enough that several buckets are in flight during one backward.
+DEFAULT_BUCKET_BYTES = 25 * 1024 * 1024
+
+
+def leaf_size(leaf: Any) -> int:
+    """Element count of a leaf; scalars count 1, zero-size arrays 0."""
+    return int(np.prod(np.shape(leaf), dtype=np.int64))
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One bucket of the partition: which leaves, in launch order."""
+
+    index: int
+    leaf_ids: tuple[int, ...]  # indices into the flattened leaf list
+    nbytes: int                # f32 wire bytes of the whole bucket
+    signature: str             # structure hash — part of the wire tag
+
+    @property
+    def tag(self) -> str:
+        """The per-bucket collective tag. Includes the structure
+        signature so a repartition never reuses a stale EF site."""
+        return f"__gb{self.index}:{self.signature}"
+
+
+def _signature(leaves: Sequence[Any], leaf_ids: Sequence[int]) -> str:
+    meta = tuple(
+        (i, tuple(np.shape(leaves[i])), np.asarray(leaves[i]).dtype.str)
+        for i in leaf_ids
+    )
+    return hashlib.blake2s(repr(meta).encode(), digest_size=4).hexdigest()
+
+
+def partition_buckets(
+    leaves: Sequence[Any], bucket_bytes: int = DEFAULT_BUCKET_BYTES
+) -> list[Bucket]:
+    """Greedy size-targeted partition of ``leaves`` into buckets.
+
+    Walks the leaf list in REVERSE (last leaves — produced first by
+    backward — land in bucket 0) and closes a bucket once it reaches
+    ``bucket_bytes`` of f32 payload. Every leaf appears in exactly one
+    bucket; a single leaf larger than ``bucket_bytes`` gets a bucket of
+    its own rather than being split (the ring chunks it internally).
+    """
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    buckets: list[Bucket] = []
+    current: list[int] = []
+    current_bytes = 0
+
+    def _flush() -> None:
+        nonlocal current, current_bytes
+        if not current:
+            return
+        buckets.append(
+            Bucket(
+                index=len(buckets),
+                leaf_ids=tuple(current),
+                nbytes=current_bytes,
+                signature=_signature(leaves, current),
+            )
+        )
+        current, current_bytes = [], 0
+
+    for i in range(len(leaves) - 1, -1, -1):
+        current.append(i)
+        current_bytes += leaf_size(leaves[i]) * 4  # f32 wire
+        if current_bytes >= bucket_bytes:
+            _flush()
+    _flush()
+    return buckets
+
+
+def gather_segment(leaves: Sequence[Any], bucket: Bucket) -> np.ndarray:
+    """Concatenate a bucket's leaves into one flat f32 wire segment."""
+    parts = [
+        np.asarray(leaves[i], np.float32).ravel() for i in bucket.leaf_ids
+    ]
+    if not parts:
+        return np.zeros(0, np.float32)
+    return np.concatenate(parts)
+
+
+def scatter_segment(
+    segment: np.ndarray, leaves: Sequence[Any], bucket: Bucket
+) -> dict[int, np.ndarray]:
+    """Split a reduced flat segment back into per-leaf arrays with the
+    original shapes/dtypes. Returns {leaf_id: array}."""
+    out: dict[int, np.ndarray] = {}
+    offset = 0
+    for i in bucket.leaf_ids:
+        shape = np.shape(leaves[i])
+        size = leaf_size(leaves[i])
+        out[i] = (
+            segment[offset : offset + size]
+            .reshape(shape)
+            .astype(np.asarray(leaves[i]).dtype)
+        )
+        offset += size
+    if offset != segment.size:
+        raise ValueError(
+            f"bucket {bucket.index}: segment has {segment.size} elements, "
+            f"leaves expect {offset}"
+        )
+    return out

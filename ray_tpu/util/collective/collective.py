@@ -698,16 +698,20 @@ class HierarchicalGroup(BaseGroup):
         )
         return np.asarray(jitted(stacked))
 
-    def allreduce_sharded(self, per_device_arrays: list, op: str = SUM) -> np.ndarray:
+    def allreduce_sharded(
+        self, per_device_arrays: list, op: str = SUM, tag: str = "__hier"
+    ) -> np.ndarray:
         """Reduce one shard per local device across ALL hosts' devices:
-        tier-1 in-jit psum over the local mesh, tier-2 ring across hosts."""
+        tier-1 in-jit psum over the local mesh, tier-2 ring across hosts.
+        ``tag`` isolates concurrent reductions (the overlap path runs one
+        per bucket in flight) and keys the DCN tier's EF residuals."""
         partial = self._local_reduce(per_device_arrays, op)
-        return self._ring.allreduce(partial, op=op, tag="__hier")
+        return self._ring.allreduce(partial, op=op, tag=tag)
 
     # Host-level (single array per rank) collectives delegate to the ring:
     # the hierarchy only matters when device shards are in play.
-    def allreduce(self, array, op: str = SUM):
-        return self._ring.allreduce(np.asarray(array), op=op)
+    def allreduce(self, array, op: str = SUM, tag: str = "__ar"):
+        return self._ring.allreduce(np.asarray(array), op=op, tag=tag)
 
     def allgather(self, array):
         return self._ring.allgather(np.asarray(array))

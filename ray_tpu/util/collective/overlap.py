@@ -1,0 +1,160 @@
+"""Async bucketed allreduce — overlap gradient sync with other work.
+
+The launch/fence half of the ISSUE-11 overlap path (the partition math
+lives in :mod:`ray_tpu.util.collective.bucketing`). Each bucket's
+allreduce runs on a per-group background thread pool: the ring protocol
+underneath is wait-dominated (every hop parks on the shared asyncio RPC
+lane via ``send_async`` futures and mailbox events), so concurrent
+buckets interleave their hops instead of queueing behind each other,
+and the caller's thread is free to keep producing grads between
+``launch`` and ``fence``.
+
+Instrumentation contract (the flight recorder proves the overlap):
+
+* each bucket op still runs through the group's ``_traced_method``
+  wrapper on ITS OWN thread, so the step's total ``collective`` phase
+  time is unchanged — the work didn't shrink, it moved off the
+  critical path;
+* the wall time the caller actually spends blocked in :func:`fence` is
+  recorded as the new ``comm_exposed`` phase. A perfectly hidden sync
+  shows ``comm_exposed_s`` ≈ 0 while ``collective_s`` stays put — and
+  the StepRecorder subtracts the EXPOSED time (not the total) from the
+  compute remainder when the phase is present.
+
+Thread safety: concurrent ring ops are isolated by tag — sequence
+numbers, mailbox events, and error-feedback residuals are all keyed by
+(peer, tag) or (tag, step), and the per-bucket tags are distinct by
+construction (``Bucket.tag``). Cross-rank bucket launch order is
+deterministic (same partition on every rank), and even when a fast rank
+races ahead, its sends land in the slow rank's tag-addressed mailbox
+without blocking the slow rank's current bucket.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Sequence
+
+import numpy as np
+
+from ray_tpu.train._internal import step_stats
+from ray_tpu.util.collective import bucketing
+
+# Buckets in flight at once. More than a few saturates the shared RPC
+# lane; fewer leaves the ring idle between hops.
+_POOL_WORKERS = 8
+_pool_lock = threading.Lock()
+
+
+def _pool(group: Any) -> ThreadPoolExecutor:
+    """The group's lazily-created overlap thread pool (one per group —
+    pool lifetime matches group lifetime, torn down with the process)."""
+    pool = getattr(group, "_overlap_pool", None)
+    if pool is None:
+        with _pool_lock:
+            pool = getattr(group, "_overlap_pool", None)
+            if pool is None:
+                pool = ThreadPoolExecutor(
+                    max_workers=_POOL_WORKERS,
+                    thread_name_prefix=f"overlap-{group.group_name}",
+                )
+                group._overlap_pool = pool
+    return pool
+
+
+def supports_overlap(group: Any) -> bool:
+    """Only the host-memory backends take tagged concurrent allreduces;
+    the xla backend syncs in-jit where GSPMD already overlaps."""
+    return getattr(group, "backend_name", "") in ("ring", "hier")
+
+
+class SyncHandle:
+    """In-flight bucketed sync: one future per bucket, fenced once."""
+
+    def __init__(self, buckets: Sequence[bucketing.Bucket]):
+        self.buckets = list(buckets)
+        self.futures: list[Future] = []
+        self.launched_at = time.perf_counter()
+        self.stats: dict[str, float] = {}
+
+    def fence(self) -> list[np.ndarray]:
+        """Block until every bucket's reduction lands. Returns reduced
+        segments in bucket order and records the blocked wall time as
+        the ``comm_exposed`` phase (floored at a tick so the recorder
+        can tell "overlap ran and hid everything" from "no overlap")."""
+        t0 = time.perf_counter()
+        results = [f.result() for f in self.futures]
+        exposed = time.perf_counter() - t0
+        self.stats = {
+            "comm_exposed_s": exposed,
+            "collective_s": sum(sec for _, sec in results),
+            "buckets": float(len(self.buckets)),
+        }
+        step_stats.record_phase("comm_exposed", max(exposed, 1e-9))
+        return [seg for seg, _ in results]
+
+
+def launch_bucketed_allreduce(
+    group: Any,
+    per_device_leaves: Sequence[Sequence[Any]],
+    bucket_bytes: int | None = None,
+) -> SyncHandle:
+    """Partition per-device grad leaves into buckets and launch each
+    bucket's allreduce asynchronously (bucket 0 — the last layers,
+    first grads out of backward — flies first).
+
+    ``per_device_leaves`` is a list of flattened leaf lists, one per
+    local device (a single-device caller passes ``[leaves]``). Returns
+    a :class:`SyncHandle`; the SUM-reduced (NOT averaged) segments come
+    out of ``handle.fence()`` in bucket order.
+    """
+    if not supports_overlap(group):
+        raise ValueError(
+            f"backend {getattr(group, 'backend_name', '?')!r} has no "
+            "tagged-allreduce overlap path (use the default sync)"
+        )
+    if bucket_bytes is None:
+        bucket_bytes = int(
+            getattr(group.config, "bucket_bytes", 0)
+            or bucketing.DEFAULT_BUCKET_BYTES
+        )
+    template = per_device_leaves[0]
+    buckets = bucketing.partition_buckets(template, bucket_bytes)
+    handle = SyncHandle(buckets)
+    pool = _pool(group)
+    for bucket in buckets:
+        segments = [
+            bucketing.gather_segment(leaves, bucket)
+            for leaves in per_device_leaves
+        ]
+        handle.futures.append(
+            pool.submit(_reduce_bucket, group, bucket, segments)
+        )
+    return handle
+
+
+def _reduce_bucket(
+    group: Any, bucket: bucketing.Bucket, segments: list[np.ndarray]
+) -> tuple[np.ndarray, float]:
+    """One bucket's SUM reduction across local devices + the gang.
+    Runs on a pool thread; returns (reduced segment, op seconds)."""
+    t0 = time.perf_counter()
+    if segments[0].size == 0:
+        return segments[0], 0.0
+    if len(segments) > 1 and hasattr(group, "allreduce_sharded"):
+        out = np.asarray(
+            group.allreduce_sharded(segments, tag=bucket.tag)
+        )
+    else:
+        local = (
+            segments[0]
+            if len(segments) == 1
+            else np.sum(np.stack(segments), axis=0)
+        )
+        if group.world_size > 1:
+            out = np.asarray(group.allreduce(local, tag=bucket.tag))
+        else:
+            out = local
+    return out, time.perf_counter() - t0

@@ -60,6 +60,18 @@ class CollectiveConfig:
                      telescopes across steps instead of accumulating
                      (leave on for training; off only for one-shot
                      reductions where drift cannot compound).
+    quantize_activations — None (exact activation wire) or "int8"/"fp8"
+                     to extend the block-scaled codec to the pipeline
+                     stage runner's p2p activation/cotangent hand-offs,
+                     with per-edge persistent EF residuals. The loss
+                     broadcast and non-float payloads always stay exact.
+    overlap        — default for the gradient-sync call sites: bucketed
+                     async allreduce launched during backward, fenced at
+                     the optimizer step (sync_gradients_sharded's
+                     ``overlap=`` argument overrides per call).
+    bucket_bytes   — target f32 payload per overlap bucket. Smaller
+                     buckets start flying earlier and pipeline deeper;
+                     larger buckets amortize per-op latency better.
 
     Only SUM reductions over float arrays take the quantized path;
     min/max/product and integer arrays silently use the exact wire.
@@ -68,14 +80,24 @@ class CollectiveConfig:
     quantize: str | None = None
     block_size: int = 256
     error_feedback: bool = True
+    quantize_activations: str | None = None
+    overlap: bool = False
+    bucket_bytes: int = 25 * 1024 * 1024
 
     def __post_init__(self) -> None:
         if self.quantize not in _KINDS:
             raise ValueError(
                 f"quantize must be one of {_KINDS}, got {self.quantize!r}"
             )
+        if self.quantize_activations not in _KINDS:
+            raise ValueError(
+                f"quantize_activations must be one of {_KINDS}, got "
+                f"{self.quantize_activations!r}"
+            )
         if self.block_size <= 0:
             raise ValueError("block_size must be positive")
+        if self.bucket_bytes <= 0:
+            raise ValueError("bucket_bytes must be positive")
 
     @property
     def enabled(self) -> bool:
@@ -86,6 +108,16 @@ class CollectiveConfig:
         if self.quantize == "fp8" and fp8_supported():
             return "f8"
         return "q8"
+
+    def activation_wire_config(self) -> "CollectiveConfig":
+        """The config the stage runner's activation codec encodes with:
+        same block size / EF policy, but ``quantize`` set to the
+        ACTIVATION kind (encode()/ErrorFeedback key off ``quantize``)."""
+        return CollectiveConfig(
+            quantize=self.quantize_activations,
+            block_size=self.block_size,
+            error_feedback=self.error_feedback,
+        )
 
 
 def _blocked(flat: np.ndarray, block_size: int) -> np.ndarray:

@@ -1,0 +1,102 @@
+"""Overlap-everything release gates (ISSUE 11).
+
+Runs the PAIRED ``bench.py --overlap off`` / ``--overlap on``
+gradient-sync microbench (a real 2-worker ring gang; off = monolithic
+blocking allreduce, on = bucketed async sync fenced after
+backward-sized compute) and derives the acceptance numbers:
+
+  * ``comm_exposed_ratio`` — the on-path's fence-blocked comm time over
+    the off-path's total collective time. The issue gate: the overlapped
+    path must expose < 30% of what the blocking path pays.
+  * ``parity_max_dev`` — max per-step deviation between the two modes'
+    12-step SGD loss trajectories at identical precision. Bucketed and
+    monolithic 2-rank ring sums are both single two-operand adds per
+    element, so the trajectories must agree to <= 1e-6 (they are in
+    fact bitwise equal).
+  * ``interleaved_valid`` — both bench invocations deadlock/coverage-
+    validate the interleaved 1F1B schedule grid
+    (S, M, v) in {2,4} x {4,8} x {1,2} before timing anything.
+  * ``overlap_hidden_frac`` — fraction of collective seconds hidden
+    from the step on the on-path (1 - exposed/collective), reported for
+    the history file.
+
+Prints ONE JSON line for release/run_all.py. RAY_TPU_RELEASE_SMOKE is
+honored implicitly (the microbench is already CI-sized).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+SMOKE = os.environ.get("RAY_TPU_RELEASE_SMOKE") == "1"
+
+
+def _overlap_row(mode: str) -> dict:
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--overlap", mode],
+        capture_output=True, text=True, timeout=900, cwd=REPO,
+    )
+    line = next(
+        (l for l in reversed(proc.stdout.splitlines()) if l.startswith("{")),
+        None,
+    )
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"bench.py --overlap {mode} failed: {proc.stderr[-1000:]}"
+        )
+    data = json.loads(line)
+    if "error" in (data.get("detail") or {}):
+        raise RuntimeError(f"overlap row {mode}: {data['detail']['error']}")
+    return data
+
+
+def main() -> None:
+    off = _overlap_row("off")
+    on = _overlap_row("on")
+    d_off, d_on = off["detail"], on["detail"]
+
+    exposed = float(d_on["comm_exposed_s"])
+    off_collective = float(d_off["collective_s"])
+    traj_off = d_off["loss_trajectory"]
+    traj_on = d_on["loss_trajectory"]
+    parity_max_dev = max(
+        abs(a - b) for a, b in zip(traj_off, traj_on)
+    )
+    on_collective = float(d_on["collective_s"])
+    hidden = (
+        max(0.0, 1.0 - exposed / on_collective) if on_collective > 0 else 0.0
+    )
+
+    result = {
+        "benchmark": "overlap_sync",
+        "smoke": int(SMOKE),
+        "world_size": d_on["world_size"],
+        "grad_bytes": d_on["grad_bytes"],
+        "buckets": d_on["buckets"],
+        "bucket_bytes": d_on["bucket_bytes"],
+        "off_collective_s": round(off_collective, 6),
+        "on_comm_exposed_s": round(exposed, 6),
+        "on_collective_s": round(on_collective, 6),
+        "comm_exposed_ratio": round(
+            exposed / off_collective if off_collective > 0 else 1.0, 6
+        ),
+        "overlap_hidden_frac": round(hidden, 4),
+        "parity_max_dev": parity_max_dev,
+        "parity_steps": len(traj_off),
+        "interleaved_valid": int(
+            d_off.get("interleaved_valid", 0)
+            and d_on.get("interleaved_valid", 0)
+        ),
+        "schedule_bubble_fraction": d_on["schedule_bubble_fraction"],
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -520,7 +520,54 @@ def test_all_six_rules_registered():
         "host-sync-in-step",
         "swallowed-exception",
         "lockset-order",
+        "sync-inside-overlap-window",
     } <= names
+
+
+# ---------------------------------------------------------------------------
+# sync-inside-overlap-window
+# ---------------------------------------------------------------------------
+
+OVERLAP_WINDOW_BAD = """
+    from ray_tpu.train.jax_utils import begin_gradient_sync
+
+    def train_loop(grads, group, w, batches):
+        handle = begin_gradient_sync([grads], group)
+        loss = float(compute_next(w, batches))   # stalls the window
+        avg = handle.result()
+        return avg, loss
+
+    def other_loop(grads, group, coll):
+        h = begin_gradient_sync([grads], group)
+        coll.barrier()                           # blocks every rank mid-flight
+        return h.result()
+"""
+
+OVERLAP_WINDOW_GOOD = """
+    from ray_tpu.train.jax_utils import begin_gradient_sync
+
+    def train_loop(grads, group, w, batches):
+        handle = begin_gradient_sync([grads], group)
+        partial = compute_next(w, batches)       # async-safe work
+        avg = handle.result()
+        loss = float(partial)                    # host sync AFTER the fence
+        return avg, loss
+"""
+
+
+def test_sync_inside_overlap_window_fires_on_bad(tmp_path):
+    result = lint_src(tmp_path, "train/loop.py", OVERLAP_WINDOW_BAD,
+                      "sync-inside-overlap-window")
+    messages = [f.message for f in result.findings]
+    assert len(result.findings) == 2, messages
+    assert any("float" in m and "train_loop" in m for m in messages)
+    assert any("barrier" in m and "other_loop" in m for m in messages)
+
+
+def test_sync_inside_overlap_window_silent_on_good(tmp_path):
+    result = lint_src(tmp_path, "train/loop.py", OVERLAP_WINDOW_GOOD,
+                      "sync-inside-overlap-window")
+    assert result.findings == []
 
 
 # ---------------------------------------------------------------------------
