@@ -51,6 +51,7 @@ def build(force: bool = False) -> str:
             "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
             "-o", _LIB_PATH, *sources,
         ]
+        # rtlint: disable=blocking-in-async - one-time lazy toolchain compile, memoized on source mtimes; cold-start only, never on the steady-state loop
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     return _LIB_PATH
 
@@ -283,6 +284,7 @@ def build_fastlane(force: bool = False) -> str:
             f"-I{sysconfig.get_paths()['include']}",
             "-o", _FASTLANE_PATH, _FASTLANE_SRC,
         ]
+        # rtlint: disable=blocking-in-async - one-time lazy toolchain compile, memoized on source mtimes; cold-start only, never on the steady-state loop
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     return _FASTLANE_PATH
 

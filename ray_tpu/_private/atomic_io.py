@@ -32,6 +32,7 @@ def atomic_write_bytes(path: str, data: bytes, *, fsync: bool = False) -> None:
     """
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
+        # rtlint: disable=blocking-in-async - sync-by-design durability primitive (write+fsync+rename); async callers write small metadata blobs where atomicity beats a thread hop
         with open(tmp, "wb") as f:
             f.write(data)
             if fsync:

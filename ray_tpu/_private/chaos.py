@@ -408,6 +408,7 @@ def _schedule_from_env() -> FaultSchedule | None:
     if raw:
         if raw.startswith("@"):
             try:
+                # rtlint: disable=blocking-in-async - chaos-test fault schedule, read once at first injection when the env var is set; never on a production loop
                 with open(raw[1:]) as fh:
                     raw = fh.read()
             except OSError:

@@ -145,6 +145,7 @@ def _rpc_debug(message: str) -> None:
     if not os.environ.get("RAY_TPU_debug_rpc"):
         return
     try:
+        # rtlint: disable=blocking-in-async - opt-in forensics behind RAY_TPU_debug_rpc; one appended line per event, only while actively debugging lost frames
         with open("/tmp/raytpu_rpc_debug.log", "a") as fh:
             fh.write(f"{os.getpid()} {time.time():.3f} {message}\n")
     except OSError:

@@ -1,0 +1,8 @@
+"""Whole-program static analyses layered above the rtlint callgraph.
+
+``commgraph`` extracts every communication site in the package and
+builds the per-group channel graph that the protocol-verification
+rules (unmatched-p2p, tag-collision, rank-asymmetric-channel,
+schedule-deadlock) and the future compiled-dataflow-graph layer
+(ROADMAP item 2) consume.
+"""

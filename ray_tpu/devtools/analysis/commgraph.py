@@ -1,0 +1,552 @@
+"""Static communication graph: every send/recv/collective/overlap-launch
+site in the package, with its tag expression, group, and rank-guard
+context.
+
+This is the front-end ROADMAP item 2's compiled dataflow graphs will
+invoke at graph-declaration time: before a gang pre-opens on-device p2p
+channels, the channel graph here proves the protocol is well-formed —
+every send has a skeleton-compatible recv, no two sites can emit the
+same tag on one group, and rank-guarded endpoints complement instead of
+coincide.
+
+Tag expressions are normalized to *skeletons*: literal fragments are
+kept verbatim and dynamic fragments (f-string holes, ``.format`` /
+``%`` placeholders, arbitrary expressions) become wildcards. Two
+skeletons *unify* when some concrete string matches both — e.g. the
+stage-runner's forward-activation send ``f"{step_tag}f{m}v{vs + 1}"``
+and its recv ``f"{step_tag}f{m}v{vs}"`` both normalize to
+``{}f{}v{}`` and unify, while ``{}f{}v{}`` vs ``{}b{}v{}`` do not
+(see :func:`skeletons_unify` for the exact semantics). Matching errs
+generous, so "unmatched" findings are high-confidence: no assignment
+of dynamic fragments could ever have produced a partner.
+
+Extraction is scoped by path (``util/collective/``, ``train/``,
+``parallel/``) plus a group-ish receiver heuristic elsewhere, so socket
+``.send()`` / RPC ``.recv()`` plumbing in ``_private/`` never enters
+the graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from ray_tpu.devtools.lint.core import call_name
+
+# Wildcard marker inside a skeleton (rendered "{}" for humans/JSON).
+WILD = "\x00"
+
+_P2P_SEND = {"send", "send_async"}
+_P2P_RECV = {"recv"}
+_COLLECTIVES = {
+    "allreduce", "allreduce_sharded", "allgather", "reducescatter",
+    "broadcast", "barrier",
+}
+_LAUNCHES = {"launch_bucketed_allreduce", "begin_gradient_sync"}
+_METHODS = _P2P_SEND | _P2P_RECV | _COLLECTIVES | _LAUNCHES
+
+# Signature-derived defaults when no ``tag=`` is passed at the site.
+_DEFAULT_TAG = {
+    "allreduce": "__ar",
+    "allreduce_sharded": "__hier",
+}
+
+# Positional index of the tag argument, per method.
+_TAG_POS = {
+    "send": 2, "send_async": 2, "recv": 1,
+    "allreduce": 2, "allreduce_sharded": 2,
+}
+
+# Receivers that look like a collective group handle. Matches the tail
+# component: ``self.group``, ``group``, ``coll``, ``collective``,
+# ``self._ring``, ``gang.comm`` — not ``conn`` / ``engine`` /
+# ``self._sock``.
+_GROUPISH = re.compile(r"(^|\.)_?(group|coll\w*|comm\w*|ring|gang)\d*$")
+
+# Paths where bare/self receivers also count (the backends themselves).
+_COMM_PATHS = ("util/collective/",)
+# Paths scanned for group-ish sites at all.
+_SCAN_PATHS = ("util/collective/", "train/", "parallel/", "release/",
+               "bench")
+
+_RANKISH = re.compile(r"rank|stage|process_index")
+
+
+@dataclass
+class CommSite:
+    path: str
+    line: int
+    col: int
+    func: str               # enclosing function qual ('' at module level)
+    kind: str               # send | recv | collective | launch
+    method: str             # the call tail, e.g. send_async
+    group: str              # receiver text ('' for bare helper calls)
+    tag: str                # skeleton (WILD marks dynamic fragments)
+    tag_src: str            # original tag expression source
+    peer: str               # dst/src expression source ('' when unknown)
+    guards: list = field(default_factory=list)  # [[var, op, value], ...]
+    act_wire: bool = False  # payload is the __act self-describing tuple
+    thunk: bool = False     # inside a lambda/partial handed elsewhere
+
+    def to_dict(self) -> dict:
+        d = self.__dict__.copy()
+        d["tag"] = render_skeleton(self.tag)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CommSite":
+        d = dict(d)
+        d["tag"] = parse_skeleton(d["tag"])
+        return cls(**d)
+
+
+def render_skeleton(skel: str) -> str:
+    return skel.replace(WILD, "{}")
+
+
+def parse_skeleton(text: str) -> str:
+    return text.replace("{}", WILD)
+
+
+def _collapse(parts: list[str]) -> str:
+    """Join fragments, merging consecutive wildcards into one."""
+    out: list[str] = []
+    for p in parts:
+        if p == WILD and out and out[-1].endswith(WILD):
+            continue
+        out.append(p)
+    return "".join(out)
+
+
+_FORMAT_HOLE = re.compile(r"\{[^{}]*\}")
+_PERCENT_HOLE = re.compile(r"%[sdrfxi]")
+
+
+def tag_skeleton(node: ast.AST | None, default: str = "") -> str:
+    """Normalize a tag expression AST to a skeleton string."""
+    if node is None:
+        return default
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, str) else WILD
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append(WILD)
+        return _collapse(parts)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "format"
+                and isinstance(fn.value, ast.Constant)
+                and isinstance(fn.value.value, str)):
+            fmt = fn.value.value.replace("{{", "\x01").replace("}}", "\x02")
+            skel = _FORMAT_HOLE.sub(WILD, fmt)
+            return _collapse(
+                [skel.replace("\x01", "{").replace("\x02", "}")]
+            )
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Add):
+            return _collapse(
+                [tag_skeleton(node.left, WILD),
+                 tag_skeleton(node.right, WILD)]
+            )
+        if isinstance(node.op, ast.Mod) and \
+                isinstance(node.left, ast.Constant) and \
+                isinstance(node.left.value, str):
+            return _collapse([_PERCENT_HOLE.sub(WILD, node.left.value)])
+    return WILD
+
+
+def _tokens(skel: str) -> list[str]:
+    """Alternating literal/wildcard token sequence of a skeleton."""
+    out: list[str] = []
+    for i, part in enumerate(skel.split(WILD)):
+        if i:
+            out.append(WILD)
+        if part:
+            out.append(part)
+    return out
+
+
+def _pattern_matches(pattern: str, literal: str) -> bool:
+    rx = ".*".join(re.escape(p) for p in pattern.split(WILD))
+    return re.fullmatch(rx, literal, re.S) is not None
+
+
+def skeletons_unify(a: str, b: str) -> bool:
+    """True when the two skeletons denote the same channel family.
+
+    Literal vs literal is string equality; pattern vs literal is real
+    wildcard matching (a hole absorbs any substring). Pattern vs
+    pattern requires the *same literal structure* — naive two-sided
+    wildcard absorption would call ``{}f{}v{}`` and ``{}b{}v{}``
+    compatible (the string ``"fbv"`` matches both) and erase exactly
+    the forward/backward distinction the stage-runner tags encode.
+    """
+    if fully_literal(a):
+        return a == b if fully_literal(b) else _pattern_matches(b, a)
+    if fully_literal(b):
+        return _pattern_matches(a, b)
+    return _tokens(a) == _tokens(b)
+
+
+def fully_literal(skel: str) -> bool:
+    return WILD not in skel
+
+
+# ---------------------------------------------------------------------------
+# Extraction
+# ---------------------------------------------------------------------------
+
+def _receiver(call: ast.Call) -> str | None:
+    """Dotted receiver text of a method call; None for bare calls."""
+    if isinstance(call.func, ast.Attribute):
+        try:
+            return ast.unparse(call.func.value)
+        except (ValueError, RecursionError):
+            return None
+    return None
+
+
+def _receiver_ok(recv_txt: str | None, relpath: str) -> bool:
+    if recv_txt is None:
+        return False
+    if _GROUPISH.search(recv_txt):
+        return True
+    in_backend = any(p in relpath for p in _COMM_PATHS)
+    return in_backend and (recv_txt == "self"
+                          or recv_txt.startswith("self."))
+
+
+def _arg(call: ast.Call, pos: int | None, *kws: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg in kws:
+            return kw.value
+    if pos is not None and pos < len(call.args):
+        return call.args[pos]
+    return None
+
+
+def _safe_unparse(node: ast.AST | None) -> str:
+    if node is None:
+        return ""
+    try:
+        return ast.unparse(node)
+    except (ValueError, RecursionError):
+        return "<expr>"
+
+
+def _guard_atoms(test: ast.AST, negated: bool) -> list[list[str]]:
+    comps = [test]
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And) \
+            and not negated:
+        comps = list(test.values)
+    atoms: list[list[str]] = []
+    for c in comps:
+        if not (isinstance(c, ast.Compare) and len(c.ops) == 1
+                and isinstance(c.ops[0], (ast.Eq, ast.NotEq))):
+            continue
+        var = _safe_unparse(c.left)
+        val = _safe_unparse(c.comparators[0])
+        if not (_RANKISH.search(var) or _RANKISH.search(val)):
+            continue
+        positive = isinstance(c.ops[0], ast.Eq) != negated
+        atoms.append([var, "==" if positive else "!=", val])
+    return atoms
+
+
+def _site_context(call: ast.Call, parents: dict,
+                  func_of: dict) -> tuple[str, list, bool]:
+    """(enclosing function qual, guard atoms, in-thunk) for a call."""
+    guards: list = []
+    thunk = False
+    prev: ast.AST = call
+    cur = parents.get(call)
+    while cur is not None:
+        if isinstance(cur, ast.Lambda):
+            thunk = True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return func_of.get(cur, cur.name), guards, thunk
+        if isinstance(cur, ast.If) and prev is not cur.test:
+            negated = any(prev is s for s in cur.orelse)
+            guards.extend(_guard_atoms(cur.test, negated))
+        prev, cur = cur, parents.get(cur)
+    return "", guards, thunk
+
+
+def _payload_is_act_wire(node: ast.AST | None) -> bool:
+    if node is None:
+        return False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == "_ACT_WIRE":
+            return True
+        if isinstance(sub, ast.Constant) and sub.value == "__act":
+            return True
+    return False
+
+
+def _classify(method: str) -> str:
+    if method in _P2P_SEND:
+        return "send"
+    if method in _P2P_RECV:
+        return "recv"
+    if method in _LAUNCHES:
+        return "launch"
+    return "collective"
+
+
+def _make_site(relpath: str, call: ast.Call, method: str, group: str,
+               args_call: ast.Call, shift: int, parents: dict,
+               func_of: dict, thunk_forced: bool) -> CommSite:
+    """Build a site record. ``args_call`` carries the argument list
+    (differs from ``call`` for ``functools.partial(group.send, ...)``
+    thunks, where positions shift by one)."""
+    kind = _classify(method)
+    pos = _TAG_POS.get(method)
+    tag_node = _arg(args_call,
+                    pos + shift if pos is not None else None, "tag")
+    skel = tag_skeleton(tag_node, default=_DEFAULT_TAG.get(method, ""))
+    if kind == "send":
+        peer = _arg(args_call, 1 + shift, "dst_rank", "dst")
+        payload = _arg(args_call, 0 + shift, "array", "payload")
+    elif kind == "recv":
+        peer = _arg(args_call, 0 + shift, "src_rank", "src")
+        payload = None
+    else:
+        peer, payload = None, None
+    func, guards, thunk = _site_context(call, parents, func_of)
+    return CommSite(
+        path=relpath, line=call.lineno, col=call.col_offset + 1,
+        func=func, kind=kind, method=method, group=group,
+        tag=skel, tag_src=_safe_unparse(tag_node),
+        peer=_safe_unparse(peer), guards=guards,
+        act_wire=_payload_is_act_wire(payload),
+        thunk=thunk or thunk_forced,
+    )
+
+
+def extract_sites(tree: ast.Module, relpath: str) -> list[dict]:
+    """All communication sites in a parsed file, as JSON-serializable
+    dicts (the ``comm`` section of the cached per-file summary)."""
+    if not any(p in relpath for p in _SCAN_PATHS):
+        return []
+    parents: dict = {}
+    func_of: dict = {}
+
+    def index(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_of[child] = f"{prefix}{child.name}"
+                index(child, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.ClassDef):
+                index(child, f"{prefix}{child.name}.")
+            else:
+                index(child, prefix)
+
+    index(tree, "")
+
+    sites: list[CommSite] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        tail = name.rsplit(".", 1)[-1] if name else ""
+        if tail in _METHODS and isinstance(node.func, ast.Attribute):
+            recv_txt = _receiver(node)
+            if _receiver_ok(recv_txt, relpath):
+                sites.append(_make_site(
+                    relpath, node, tail, recv_txt or "", node, 0,
+                    parents, func_of, thunk_forced=False,
+                ))
+            continue
+        # functools.partial(group.send, arr, dst, tag=...) — the send
+        # is referenced, not called; positional args shift by one.
+        if tail == "partial" and node.args and \
+                isinstance(node.args[0], ast.Attribute):
+            target = node.args[0]
+            if target.attr in _METHODS:
+                recv_txt = _safe_unparse(target.value)
+                if _receiver_ok(recv_txt, relpath):
+                    sites.append(_make_site(
+                        relpath, node, target.attr, recv_txt,
+                        node, 1, parents, func_of, thunk_forced=True,
+                    ))
+    sites += _wrapper_sites(tree, relpath, sites, parents, func_of)
+    return [s.to_dict() for s in sites]
+
+
+def _wrapper_sites(tree: ast.Module, relpath: str, direct: list[CommSite],
+                   parents: dict, func_of: dict) -> list[CommSite]:
+    """One level of wrapper-forwarded tag propagation.
+
+    The stage-runner idiom routes every activation wire through thin
+    helpers — ``self._send(arr, dst, f"{step_tag}f{m}v{vs + 1}")`` calls
+    a ``_send(self, array, dst, tag, ...)`` that does
+    ``group.send(..., tag=tag)``. The direct site only sees the opaque
+    ``{}`` skeleton; the structured tag lives at the *wrapper call
+    site*. When a direct site's tag expression is exactly a parameter
+    of its enclosing function, each same-class (or module-local) call
+    to that function with an explicit tag argument yields a derived
+    site carrying the caller's tag skeleton and guard context.
+    """
+    node_of = {qual: fn for fn, qual in func_of.items()}
+    wrappers: dict[str, list[tuple[CommSite, str]]] = {}
+    for site in direct:
+        fn = node_of.get(site.func)
+        if fn is None or not site.tag_src.isidentifier():
+            continue
+        params = [a.arg for a in fn.args.args + fn.args.kwonlyargs]
+        if site.tag_src in params:
+            wrappers.setdefault(site.func, []).append(
+                (site, site.tag_src)
+            )
+    if not wrappers:
+        return []
+
+    derived: list[CommSite] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if not name:
+            continue
+        head, _, tail = name.partition(".")
+        caller, guards, thunk = _site_context(node, parents, func_of)
+        owner = caller.rpartition(".")[0]
+        if head in ("self", "cls") and tail and owner:
+            qual = f"{owner}.{tail}"
+        elif "." not in name:
+            qual = name
+        else:
+            continue
+        for inner, tag_param in wrappers.get(qual, ()):
+            fn = node_of[qual]
+            params = [a.arg for a in fn.args.args]
+            offset = 1 if params and params[0] in ("self", "cls") else 0
+            try:
+                pos = params.index(tag_param) - offset
+            except ValueError:
+                pos = None
+            tag_node = _arg(node, pos, tag_param)
+            if tag_node is None:
+                continue  # the direct site already covers the default
+            derived.append(CommSite(
+                path=relpath, line=node.lineno, col=node.col_offset + 1,
+                func=caller, kind=inner.kind, method=inner.method,
+                group=inner.group, tag=tag_skeleton(tag_node, WILD),
+                tag_src=_safe_unparse(tag_node), peer="",
+                guards=guards, act_wire=inner.act_wire, thunk=thunk,
+            ))
+    return derived
+
+
+# ---------------------------------------------------------------------------
+# Channel graph
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Channel:
+    send: CommSite
+    recvs: list[CommSite] = field(default_factory=list)
+
+
+class CommGraph:
+    """Per-group channel view over a flat site list."""
+
+    def __init__(self, sites: list[CommSite]):
+        self.sites = sites
+        self.sends = [s for s in sites if s.kind == "send"]
+        self.recvs = [s for s in sites if s.kind == "recv"]
+
+    @classmethod
+    def from_summaries(cls, site_dicts: list[dict]) -> "CommGraph":
+        return cls([CommSite.from_dict(d) for d in site_dicts])
+
+    def channels(self) -> list[Channel]:
+        """Each send paired with every skeleton-compatible recv.
+
+        Matching is generous across group keys: receiver *text* differs
+        legitimately between endpoints (``self.group`` on the sender,
+        ``coll`` on the receiver can be the same runtime group), so
+        only the tag skeleton gates the pairing — which keeps the
+        unmatched findings high-confidence.
+        """
+        out = []
+        for s in self.sends:
+            out.append(Channel(
+                send=s,
+                recvs=[r for r in self.recvs
+                       if skeletons_unify(s.tag, r.tag)],
+            ))
+        return out
+
+    def unmatched_recvs(self) -> list[CommSite]:
+        return [r for r in self.recvs
+                if not any(skeletons_unify(s.tag, r.tag)
+                           for s in self.sends)]
+
+    # -- export ---------------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "sites": [s.to_dict() for s in self.sites],
+            "channels": [
+                {
+                    "send": f"{c.send.path}:{c.send.line}",
+                    "tag": render_skeleton(c.send.tag),
+                    "recvs": [f"{r.path}:{r.line}" for r in c.recvs],
+                }
+                for c in self.channels()
+            ],
+        }
+
+    def to_dot(self) -> str:
+        """Graphviz digraph: send sites -> tag-family nodes -> recv
+        sites, one subgraph cluster per file."""
+        def nid(s: CommSite) -> str:
+            return f"s{abs(hash((s.path, s.line, s.col))) % 10**10}"
+
+        lines = [
+            "digraph commgraph {",
+            "  rankdir=LR;",
+            '  node [fontname="monospace" fontsize=10];',
+        ]
+        by_path: dict[str, list[CommSite]] = {}
+        for s in self.sites:
+            by_path.setdefault(s.path, []).append(s)
+        for i, (path, sites) in enumerate(sorted(by_path.items())):
+            lines.append(f"  subgraph cluster_{i} {{")
+            lines.append(f'    label="{path}";')
+            for s in sites:
+                shape = {"send": "box", "recv": "ellipse",
+                         "launch": "hexagon"}.get(s.kind, "diamond")
+                label = (f"{s.method} L{s.line}\\n"
+                         f"tag={render_skeleton(s.tag)}")
+                lines.append(
+                    f'    {nid(s)} [shape={shape} label="{label}"];'
+                )
+            lines.append("  }")
+        tags: dict[str, str] = {}
+        for c in self.channels():
+            key = render_skeleton(c.send.tag)
+            if key not in tags:
+                tags[key] = f"t{len(tags)}"
+                lines.append(
+                    f'  {tags[key]} [shape=plaintext label="[{key}]"];'
+                )
+            lines.append(f"  {nid(c.send)} -> {tags[key]};")
+            for r in c.recvs:
+                lines.append(f"  {tags[key]} -> {nid(r)};")
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+def graph_from_project(project) -> CommGraph:
+    """Build the channel graph from a ProjectGraph carrying per-file
+    ``comm_sites`` summaries (attached by the lint runner)."""
+    sites = getattr(project, "comm_sites", None) or []
+    return CommGraph.from_summaries(sites)

@@ -1,0 +1,84 @@
+"""Fingerprint-keyed incremental cache for per-file analysis summaries.
+
+The whole-program passes (ProjectGraph import resolution, commgraph
+communication-site extraction) cost one extra AST walk per file on top
+of the parse the rules already need. This cache stores each file's
+extracted summary keyed by a sha1 of its CONTENT, so an unchanged file
+costs one hash instead of one walk — the full-repo lint in CI stays
+within its wall-time budget as the analysis suite grows (the ISSUE-12
+acceptance bound: ≤ 2x the pre-commgraph run).
+
+The cache is a plain JSON file at ``<repo>/.rtlint-cache.json`` (git-
+ignored); a missing, torn, or version-skewed cache simply means a cold
+run. Entries for files that left the scan set are dropped on save, so
+the file tracks the checkout instead of growing without bound.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+# Bump when the summary schema changes — a stale schema must miss, not
+# feed the graph malformed entries.
+CACHE_VERSION = 2
+
+DEFAULT_CACHE = ".rtlint-cache.json"
+
+
+def fingerprint_source(source: str) -> str:
+    return hashlib.sha1(source.encode("utf-8", "replace")).hexdigest()
+
+
+class SummaryCache:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self.entries: dict[str, dict] = {}  # relpath -> {fp, summary}
+        self.hits = 0
+        self.misses = 0
+        self._touched: set[str] = set()
+
+    @classmethod
+    def load(cls, path: str | None) -> "SummaryCache":
+        cache = cls(path=path)
+        if not path or not os.path.exists(path):
+            return cache
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+            if data.get("version") == CACHE_VERSION:
+                cache.entries = data.get("files", {})
+        except (OSError, ValueError):
+            pass  # torn/corrupt cache == cold run
+        return cache
+
+    def get(self, relpath: str, fingerprint: str) -> dict | None:
+        self._touched.add(relpath)
+        entry = self.entries.get(relpath)
+        if entry and entry.get("fp") == fingerprint:
+            self.hits += 1
+            return entry["summary"]
+        self.misses += 1
+        return None
+
+    def put(self, relpath: str, fingerprint: str, summary: dict) -> None:
+        self._touched.add(relpath)
+        self.entries[relpath] = {"fp": fingerprint, "summary": summary}
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        files = {
+            rel: entry
+            for rel, entry in self.entries.items()
+            if rel in self._touched
+        }
+        try:
+            from ray_tpu._private.atomic_io import atomic_write_json
+
+            atomic_write_json(
+                self.path, {"version": CACHE_VERSION, "files": files}
+            )
+        except OSError:
+            pass  # read-only checkout: lint still works, just cold

@@ -101,6 +101,16 @@ class FileContext:
     tree: ast.Module
     lines: list[str] = field(default_factory=list)
     suppressions: Suppressions = None  # type: ignore[assignment]
+    # Whole-program layer, attached by the runner before rules execute:
+    # ``module`` is the dotted module name for files inside the ray_tpu
+    # package ('' otherwise), ``fingerprint`` keys the incremental
+    # summary cache, ``project`` is the shared callgraph.ProjectGraph
+    # (carries the commgraph site list as ``project.comm_sites``). Rules
+    # must tolerate ``project is None`` — unit tests parse files
+    # directly without a runner.
+    module: str = ""
+    fingerprint: str = ""
+    project: object = None
     # lazily-built shared analyses (see callgraph.py)
     _functions: dict = None         # type: ignore[assignment]
     _parents: dict = None           # type: ignore[assignment]

@@ -5,7 +5,11 @@ from ray_tpu.devtools.lint.rules import (  # noqa: F401
     host_sync_in_step,
     lockset_order,
     non_atomic_write,
+    rank_asymmetric_channel,
     rank_divergent_collective,
+    schedule_deadlock,
     swallowed_exception,
     sync_inside_overlap_window,
+    tag_collision,
+    unmatched_p2p,
 )

@@ -5,12 +5,15 @@ on that event loop — heartbeats miss, leases expire, and the failure
 detector declares healthy nodes dead. The same goes for synchronous
 subprocess spawns and unbounded file reads inside async handlers.
 
-Scope: framework async code (``_private/``, ``serve/_private/``,
-``dashboard/``, ``data/_internal/``). Hard-blocking primitives
-(``time.sleep``, ``subprocess.*``, blocking socket dials, ``requests``)
-are flagged even when reached *transitively* through module-local sync
-helpers; plain ``open()`` is only flagged lexically inside an
-``async def`` (helpers that touch files have legitimate sync callers).
+Scope: code reachable from an ``async def`` whose file lives in the
+framework async lane (``_private/``, ``serve/_private/``,
+``dashboard/``, ``data/_internal/``). Reachability rides the
+whole-program callgraph, so a sync helper in ``util/`` called from a
+dashboard coroutine is flagged at the helper's site. ``open()`` rides
+the same transitive graph as the hard-blocking primitives (the ISSUE-9
+lexical-only gap): a function reference handed to
+``asyncio.to_thread(...)`` is an argument, not a call edge, so the
+blessed thread-offload idiom stays silent.
 """
 
 from __future__ import annotations
@@ -20,10 +23,10 @@ import ast
 from ray_tpu.devtools.lint import callgraph
 from ray_tpu.devtools.lint.core import (
     FileContext,
+    Finding,
     Rule,
     Severity,
     call_name,
-    iter_calls,
     register_rule,
 )
 
@@ -43,10 +46,6 @@ _BLOCKING = {
     "requests.post": "blocking HTTP on the loop; move to a thread or aiohttp",
     "requests.request":
         "blocking HTTP on the loop; move to a thread or aiohttp",
-}
-
-# Only flagged lexically inside `async def` (not via the call graph).
-_LEXICAL_ONLY = {
     "open": "sync file I/O on the event loop; use `asyncio.to_thread(...)`",
 }
 
@@ -62,6 +61,36 @@ class BlockingInAsync(Rule):
         "async def in framework rpc/controller/agent/serve/dashboard code"
     )
 
+    def check_project(self, ctxs: list[FileContext]):
+        project = ctxs[0].project if ctxs else None
+        if project is None:
+            for ctx in ctxs:
+                yield from self.check(ctx)
+            return
+        reach = project.async_reachable()
+        for fid, info in project.functions():
+            root = fid if info["async"] else reach.get(fid)
+            if root is None:
+                continue
+            if not any(s in project.path(root) for s in _SCOPE):
+                continue
+            for name, line, col in info["calls"]:
+                hint = _BLOCKING.get(name)
+                if hint is None:
+                    continue
+                where = (
+                    f"`async def {fid[1]}`" if root == fid
+                    else (f"`{fid[1]}`, reachable from `async def "
+                          f"{project.render(root)}`")
+                )
+                yield Finding(
+                    rule=self.name, path=project.path(fid),
+                    line=line, col=col + 1,
+                    severity=self.severity,
+                    message=f"`{name}` inside {where}: {hint}",
+                )
+
+    # Module-local fallback for contexts parsed without a runner.
     def check(self, ctx: FileContext):
         if not ctx.in_path(*_SCOPE):
             return
@@ -77,8 +106,6 @@ class BlockingInAsync(Rule):
                     continue
                 name = call_name(node)
                 hint = _BLOCKING.get(name)
-                if hint is None and direct_async:
-                    hint = _LEXICAL_ONLY.get(name)
                 if hint is None:
                     continue
                 where = (

@@ -7,9 +7,14 @@ a lock. Pass 2 (here) flags cycles in that order graph: if one code
 path takes A→B and another B→A, two threads can each hold one and wait
 forever on the other.
 
-Module-local on purpose: ray_tpu keeps each subsystem's locks in one
-module, and cross-process "locks" are leases/tokens with their own
-protocols (checked at runtime by the chaos suite, not here).
+ISSUE 12 made pass 2 whole-program: every call made while a lock is
+held (``ModuleLocks.calls_under_lock``) resolves through the
+ProjectGraph, so ``gang.py`` holding its registry lock while calling
+into ``collective.py`` — which takes the group-table lock — produces a
+cross-module edge, and the AB/BA diff runs over one global graph with
+module-namespaced lock ids. Propagation stays one call level deep
+(same trade-off as the class-local pass); cross-process "locks" are
+leases/tokens with their own runtime protocols, still out of scope.
 """
 
 from __future__ import annotations
@@ -33,14 +38,54 @@ class LocksetOrder(Rule):
         "orders — a textbook AB/BA deadlock"
     )
 
-    def check(self, ctx: FileContext):
-        result = callgraph.analyze_locks(ctx.tree, ctx.path)
-        if not result.edges:
-            return
-        # first-seen edge per ordered pair (for the report site).
+    def check_project(self, ctxs: list[FileContext]):
+        project = ctxs[0].project if ctxs else None
+        analyses: dict[str, tuple[FileContext, callgraph.ModuleLocks]] = {}
+        for ctx in ctxs:
+            res = callgraph.analyze_locks(ctx.tree, ctx.path)
+            if res.locks:
+                analyses[ctx.path] = (ctx, res)
+
+        def ns(path: str, lock: str) -> str:
+            return f"{path}:{lock}"
+
         by_pair: dict[tuple[str, str], callgraph.LockOrderEdge] = {}
-        for e in result.edges:
-            by_pair.setdefault((e.first, e.second), e)
+        for path, (_ctx, res) in analyses.items():
+            for e in res.edges:
+                key = (ns(path, e.first), ns(path, e.second))
+                by_pair.setdefault(key, e)
+
+        if project is not None:
+            mod_of: dict[str, tuple] = {
+                ctx.module: (path, res)
+                for path, (ctx, res) in analyses.items()
+                if ctx.module
+            }
+            for path, (ctx, res) in analyses.items():
+                if not ctx.module:
+                    continue
+                for cul in res.calls_under_lock:
+                    owner = callgraph.owner_class_of(cul.qual)
+                    fid = project.resolve_call(
+                        ctx.module, owner, cul.callee)
+                    if fid is None or fid[0] == ctx.module:
+                        continue  # local pairs handled by pass 1
+                    target = mod_of.get(fid[0])
+                    if target is None:
+                        continue
+                    tpath, tres = target
+                    for site in tres.acquired.get(fid[1], ()):
+                        a = ns(path, cul.lock)
+                        b = ns(tpath, site.lock)
+                        if a == b:
+                            continue
+                        by_pair.setdefault((a, b), callgraph.LockOrderEdge(
+                            a, b, path, cul.line,
+                            via=(f"{cul.qual}: holds {cul.lock}, calls "
+                                 f"{project.render(fid)} which takes "
+                                 f"{site.lock}"),
+                        ))
+
         reported: set[frozenset] = set()
         for (a, b), edge in sorted(by_pair.items()):
             rev = by_pair.get((b, a))
@@ -52,14 +97,19 @@ class LocksetOrder(Rule):
             reported.add(pair)
             yield Finding(
                 rule=self.name,
-                path=ctx.path,
+                path=edge.path,
                 line=edge.line,
                 col=1,
                 severity=self.severity,
                 message=(
                     f"inconsistent lock order: `{a}` -> `{b}` here "
-                    f"({edge.via}) but `{b}` -> `{a}` at line "
-                    f"{rev.line} ({rev.via}) — pick one global order "
-                    f"or merge the critical sections"
+                    f"({edge.via}) but `{b}` -> `{a}` at "
+                    f"{rev.path}:{rev.line} ({rev.via}) — pick one "
+                    f"global order or merge the critical sections"
                 ),
             )
+
+    # Back-compat for direct per-file use (no runner): same analysis,
+    # one file.
+    def check(self, ctx: FileContext):
+        yield from self.check_project([ctx])

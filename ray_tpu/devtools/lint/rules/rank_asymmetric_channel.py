@@ -1,0 +1,91 @@
+"""rank-asymmetric-channel: a matched send/recv pair whose rank guards
+coincide instead of complementing.
+
+A p2p wire needs the *sender* guard and the *receiver* guard to select
+different ranks — ``if rank == src: send(...) else: recv(...)`` is the
+correct broadcast shape (the else negates the guard, so the endpoints
+complement). When BOTH endpoints of one tag family sit under the SAME
+positive equality guard, the selected rank sends to itself and every
+other rank runs neither side: the send buffers forever and the
+intended receivers block on nothing. The same analysis flags the
+self-send directly when the destination expression equals the guarded
+rank value.
+
+Guards are extracted syntactically (``rank == <expr>`` comparisons on
+rank-ish names, with else-branch negation) — no value analysis — so
+the rule only fires when both sides carry an *identical* positive
+atom, keeping it high-precision.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Finding,
+    Rule,
+    Severity,
+    register_rule,
+)
+
+
+def _positive_atoms(site) -> set[tuple[str, str]]:
+    return {(var, val) for var, op, val in site.guards if op == "=="}
+
+
+@register_rule
+class RankAsymmetricChannel(Rule):
+    name = "rank-asymmetric-channel"
+    severity = Severity.ERROR
+    description = ("send and recv of one tag family guarded onto the "
+                   "SAME rank — the wire has no second endpoint")
+
+    def check_project(self, ctxs: list[FileContext]):
+        project = ctxs[0].project if ctxs else None
+        if project is None:
+            return
+        from ray_tpu.devtools.analysis.commgraph import (
+            graph_from_project,
+            render_skeleton,
+        )
+
+        graph = graph_from_project(project)
+        seen: set[tuple] = set()
+        for channel in graph.channels():
+            s = channel.send
+            s_atoms = _positive_atoms(s)
+            if not s_atoms:
+                continue
+            # Self-send: destination expression equals the value the
+            # guard just pinned this rank to.
+            for var, val in s_atoms:
+                if s.peer and s.peer == val and \
+                        (s.path, s.line, "self") not in seen:
+                    seen.add((s.path, s.line, "self"))
+                    yield Finding(
+                        rule=self.name, path=s.path, line=s.line,
+                        col=s.col, severity=self.severity,
+                        message=(
+                            f"send to {s.peer!r} under guard "
+                            f"'{var} == {val}' targets the sending "
+                            f"rank itself"
+                        ),
+                    )
+            for r in channel.recvs:
+                if (s.path, s.line, r.path, r.line) in seen:
+                    continue
+                common = s_atoms & _positive_atoms(r)
+                if not common:
+                    continue
+                seen.add((s.path, s.line, r.path, r.line))
+                var, val = sorted(common)[0]
+                yield Finding(
+                    rule=self.name, path=s.path, line=s.line,
+                    col=s.col, severity=self.severity,
+                    message=(
+                        f"send (tag '{render_skeleton(s.tag)}') and "
+                        f"its recv at {r.path}:{r.line} are both "
+                        f"guarded by '{var} == {val}' — only that "
+                        f"rank runs either side, so the channel has "
+                        f"no second endpoint"
+                    ),
+                )

@@ -12,9 +12,15 @@ silently turning the async path back into the monolithic one. The
 flight recorder then shows ``comm_exposed_s`` creeping back toward
 ``collective_s`` with no code diff to blame.
 
-The window closes at the fence: ``handle.result()`` / ``.fence()``.
-Detection is lexical per function (source order), which matches how
-the window is actually used — launch, compute, fence, step.
+The window closes at the fence of the HANDLE — and the handle is
+tracked through aliases: ``h = begin_gradient_sync(...); g = h;
+g.result()`` closes the window, while ``other_future.result()`` does
+NOT (the ISSUE-12 fix: previously any ``.result()`` text closed it).
+Helpers that *return* the handle (found via the whole-program
+``returning_closure``) open a window at their call sites too; a helper
+that returns the handle to its own caller hands off the window with
+it. An alias that escapes (passed to another call) drops out of
+tracking, falling back to the permissive any-fence-closes behavior.
 
 Scope: the training/model/parallel layers (same as host-sync-in-step).
 """
@@ -22,7 +28,12 @@ Scope: the training/model/parallel layers (same as host-sync-in-step).
 from __future__ import annotations
 
 import ast
+from dataclasses import dataclass
 
+from ray_tpu.devtools.lint.callgraph import (
+    _own_statements,
+    owner_class_of,
+)
 from ray_tpu.devtools.lint.core import (
     FileContext,
     Rule,
@@ -34,7 +45,8 @@ from ray_tpu.devtools.lint.core import (
 _SCOPE = ("train/", "models/", "parallel/", "ops/")
 
 _OPEN_TAILS = {"begin_gradient_sync"}
-_CLOSE_TAILS = {"result", "fence", "finish_gradient_sync"}
+_CLOSE_TAILS = {"result", "fence"}
+_CLOSE_BARE = {"finish_gradient_sync"}
 
 _SYNC_TAILS = {
     "block_until_ready": "forces a device sync",
@@ -55,52 +67,157 @@ _SYNC_FULL = {
 }
 
 
+@dataclass
+class _Event:
+    line: int
+    col: int
+    kind: str           # open | copy | close | escape | ret | sync
+    node: ast.AST
+    obj: str = ""       # alias text the event concerns
+    dst: str = ""       # copy target
+    why: str = ""       # sync explanation
+    name: str = ""      # call name for the message
+
+    def key(self):
+        return (self.line, self.col)
+
+
+def _safe_unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except (ValueError, RecursionError):
+        return "<expr>"
+
+
 @register_rule
 class SyncInsideOverlapWindow(Rule):
     name = "sync-inside-overlap-window"
     severity = Severity.WARNING
     description = (
         "host sync or blocking collective between begin_gradient_sync() "
-        "and the fence — stalls the compute the overlap should hide"
+        "and the handle's fence — stalls the compute the overlap "
+        "should hide"
     )
+
+    def _openers(self, ctx: FileContext):
+        """Helper fids that transitively return the sync handle."""
+        project = ctx.project
+        if project is None:
+            return None, frozenset()
+        helpers = getattr(project, "_handle_helpers", None)
+        if helpers is None:
+            helpers = project.returning_closure(_OPEN_TAILS)
+            project._handle_helpers = helpers
+        return project, helpers
+
+    def _is_opener(self, name: str, ctx, project, helpers,
+                   owner: str | None) -> bool:
+        if name.rsplit(".", 1)[-1] in _OPEN_TAILS:
+            return True
+        if project is None:
+            return False
+        return project.resolve_call(ctx.module, owner, name) in helpers
 
     def check(self, ctx: FileContext):
         if not ctx.in_path(*_SCOPE):
             return
+        project, helpers = self._openers(ctx)
+        parents = ctx.parent_map()
         for qual, fn in ctx.functions().items():
-            from ray_tpu.devtools.lint.callgraph import _own_statements
-
-            calls = [
-                n for n in _own_statements(fn) if isinstance(n, ast.Call)
-            ]
-            calls.sort(
-                key=lambda n: (n.lineno, n.col_offset)
-            )
-            open_at: ast.Call | None = None
-            for node in calls:
+            owner = owner_class_of(qual)
+            events: list[_Event] = []
+            for node in _own_statements(fn):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.value, (ast.Name, ast.Attribute)):
+                    events.append(_Event(
+                        node.lineno, node.col_offset, "copy", node,
+                        obj=_safe_unparse(node.value),
+                        dst=_safe_unparse(node.targets[0]),
+                    ))
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
                 name = call_name(node)
+                if not name:
+                    continue
                 tail = name.rsplit(".", 1)[-1]
-                if tail in _OPEN_TAILS:
-                    open_at = node
+                if self._is_opener(name, ctx, project, helpers, owner):
+                    # `return begin_...(...)` forwards the handle —
+                    # the window belongs to the caller.
+                    parent = parents.get(node)
+                    if isinstance(parent, ast.Return):
+                        continue
+                    target = ""
+                    if isinstance(parent, ast.Assign) and \
+                            len(parent.targets) == 1:
+                        target = _safe_unparse(parent.targets[0])
+                    events.append(_Event(
+                        node.lineno, node.col_offset, "open", node,
+                        dst=target,
+                    ))
                     continue
-                if tail in _CLOSE_TAILS:
-                    open_at = None
+                if tail in _CLOSE_BARE:
+                    events.append(_Event(
+                        node.lineno, node.col_offset, "close", node,
+                    ))
                     continue
-                if open_at is None:
+                if tail in _CLOSE_TAILS and "." in name:
+                    events.append(_Event(
+                        node.lineno, node.col_offset, "close", node,
+                        obj=name.rsplit(".", 1)[0],
+                    ))
                     continue
                 why = _SYNC_FULL.get(name) or _SYNC_TAILS.get(tail)
-                if why is None:
+                if why is not None:
+                    if name in ("float", "int") and (
+                        not node.args
+                        or isinstance(node.args[0], ast.Constant)
+                    ):
+                        continue
+                    events.append(_Event(
+                        node.lineno, node.col_offset, "sync", node,
+                        why=why, name=name,
+                    ))
+                # Aliases handed to arbitrary calls escape tracking.
+                for arg in list(node.args) + \
+                        [k.value for k in node.keywords]:
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        events.append(_Event(
+                            node.lineno, node.col_offset, "escape",
+                            node, obj=_safe_unparse(arg),
+                        ))
+
+            window_open = False
+            open_line = 0
+            aliases: set[str] = set()
+            loose = False   # open, but no trackable alias
+            for ev in sorted(events, key=_Event.key):
+                if ev.kind == "open":
+                    window_open, open_line = True, ev.line
+                    aliases = {ev.dst} if ev.dst else set()
+                    loose = not aliases
+                elif not window_open:
                     continue
-                # float()/int() only matter on non-literal args.
-                if name in ("float", "int") and (
-                    not node.args
-                    or isinstance(node.args[0], ast.Constant)
-                ):
-                    continue
-                yield self.finding(
-                    ctx, node,
-                    f"`{name}` in `{qual}` {why} while the bucketed "
-                    f"gradient sync launched on line {open_at.lineno} is "
-                    f"still in flight — move it past the "
-                    f"`handle.result()` fence (or fence first)",
-                )
+                elif ev.kind == "copy":
+                    if ev.obj in aliases:
+                        aliases.add(ev.dst)
+                    else:
+                        aliases.discard(ev.dst)
+                elif ev.kind == "close":
+                    if not ev.obj or ev.obj in aliases or loose:
+                        window_open = False
+                elif ev.kind == "escape":
+                    if ev.obj in aliases:
+                        aliases.discard(ev.obj)
+                        if not aliases:
+                            loose = True
+                elif ev.kind == "sync":
+                    yield self.finding(
+                        ctx, ev.node,
+                        f"`{ev.name}` in `{qual}` {ev.why} while the "
+                        f"bucketed gradient sync launched on line "
+                        f"{open_line} is still in flight — move it "
+                        f"past the handle's `result()`/`fence()` (or "
+                        f"fence first)",
+                    )

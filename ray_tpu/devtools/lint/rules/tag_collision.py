@@ -1,0 +1,109 @@
+"""tag-collision: two distinct sites that can emit the SAME tag on one
+group — the failure mode the blake2s bucket signatures in
+``bucketing.py`` exist to prevent (a colliding tag lets one in-flight
+transfer consume another's payload: wrong bytes, right shape, silent).
+
+Two tiers, both strict so the rule stays high-precision:
+
+* cross-function: two send/launch sites whose tags are FULLY LITERAL
+  and identical, on the same group key. Dynamic skeletons that merely
+  *could* coincide (two ``{}/ag`` sites fed by different ``tag``
+  parameters) are excluded — the exact and quantized ring paths share
+  those skeletons legitimately because they are mutually exclusive.
+* same-function: two distinct sites whose tag *source text* is
+  identical (the holes are the same expressions, so whenever both
+  sites execute, the emitted strings coincide) — the copy-paste case.
+
+Collectives are exempt: sequential reuse of the default ``__ar`` tag
+across call sites is the normal idiom; only concurrent p2p wires and
+overlap launches need unique tags.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Finding,
+    Rule,
+    Severity,
+    register_rule,
+)
+
+
+@register_rule
+class TagCollision(Rule):
+    name = "tag-collision"
+    severity = Severity.ERROR
+    description = ("two sites can emit the same tag on one group — "
+                   "one transfer can consume another's payload")
+
+    def check_project(self, ctxs: list[FileContext]):
+        project = ctxs[0].project if ctxs else None
+        if project is None:
+            return
+        from ray_tpu.devtools.analysis.commgraph import (
+            fully_literal,
+            graph_from_project,
+            render_skeleton,
+        )
+
+        graph = graph_from_project(project)
+        sites = [s for s in graph.sites if s.kind in ("send", "launch")]
+        # Wrapper-derived sites share (path, line) with siblings from
+        # the same call (exact + act-wire inner branches): one site per
+        # location.
+        uniq: dict[tuple, object] = {}
+        for s in sites:
+            uniq.setdefault((s.path, s.line, s.col, s.tag), s)
+        sites = list(uniq.values())
+
+        by_literal: dict[tuple, list] = {}
+        by_src: dict[tuple, list] = {}
+        for s in sites:
+            if fully_literal(s.tag):
+                by_literal.setdefault((s.group, s.tag), []).append(s)
+            elif s.tag_src and s.func and \
+                    not s.tag_src.isidentifier():
+                # A bare-identifier tag (forwarded parameter) appears
+                # legitimately at several sites of one helper — e.g.
+                # the exact and act-wire branches of the stage
+                # runner's _send. Only structured expressions
+                # (f-strings, concatenations) join this tier.
+                by_src.setdefault(
+                    (s.path, s.func, s.group, s.tag_src), []
+                ).append(s)
+
+        for (group, tag), group_sites in sorted(by_literal.items()):
+            if len(group_sites) < 2:
+                continue
+            group_sites.sort(key=lambda s: (s.path, s.line))
+            first = group_sites[0]
+            for dup in group_sites[1:]:
+                yield Finding(
+                    rule=self.name, path=dup.path, line=dup.line,
+                    col=dup.col, severity=self.severity,
+                    message=(
+                        f"tag '{tag}' on group '{group or 'default'}' "
+                        f"is also emitted at {first.path}:{first.line} "
+                        f"— concurrent transfers would collide"
+                    ),
+                )
+        for (path, func, _group, src), group_sites in sorted(
+                by_src.items()):
+            spots = sorted({(s.line, s.col) for s in group_sites})
+            if len(spots) < 2:
+                continue
+            first_line = spots[0][0]
+            for line, col in spots[1:]:
+                s = next(x for x in group_sites
+                         if (x.line, x.col) == (line, col))
+                yield Finding(
+                    rule=self.name, path=path, line=line, col=col,
+                    severity=self.severity,
+                    message=(
+                        f"tag expression {src!r} "
+                        f"('{render_skeleton(s.tag)}') duplicated at "
+                        f"{path}:{first_line} in {func} — both sites "
+                        f"emit identical tags when they execute"
+                    ),
+                )

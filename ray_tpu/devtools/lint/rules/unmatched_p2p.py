@@ -1,0 +1,66 @@
+"""unmatched-p2p: a p2p send whose tag skeleton no recv can match, or
+a recv no send can produce — per direction x tag family.
+
+A tag with no partner is a guaranteed hang on the host-memory backends
+(``recv`` blocks until its timeout, ``send_async`` buffers forever) and
+a protocol error the compiled-graph channel pre-open would reject. The
+match deliberately errs generous (see ``skeletons_unify``): recvs are
+searched across every group key because receiver *text* differs
+legitimately between endpoints of the same runtime group — so anything
+still unmatched is high-confidence dead wire.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Finding,
+    Rule,
+    Severity,
+    register_rule,
+)
+
+
+@register_rule
+class UnmatchedP2p(Rule):
+    name = "unmatched-p2p"
+    severity = Severity.ERROR
+    description = ("p2p send with no skeleton-compatible recv (or "
+                   "vice versa) — a guaranteed hang or dead wire")
+
+    def check_project(self, ctxs: list[FileContext]):
+        project = ctxs[0].project if ctxs else None
+        if project is None:
+            return
+        from ray_tpu.devtools.analysis.commgraph import (
+            graph_from_project,
+            render_skeleton,
+        )
+
+        graph = graph_from_project(project)
+        if not graph.sends and not graph.recvs:
+            return
+        for channel in graph.channels():
+            if channel.recvs:
+                continue
+            s = channel.send
+            yield Finding(
+                rule=self.name, path=s.path, line=s.line, col=s.col,
+                severity=self.severity,
+                message=(
+                    f"{s.method} with tag "
+                    f"'{render_skeleton(s.tag)}' has no matching recv "
+                    f"anywhere in the scanned program — the payload is "
+                    f"never consumed (in {s.func or '<module>'})"
+                ),
+            )
+        for r in graph.unmatched_recvs():
+            yield Finding(
+                rule=self.name, path=r.path, line=r.line, col=r.col,
+                severity=self.severity,
+                message=(
+                    f"recv with tag '{render_skeleton(r.tag)}' has no "
+                    f"send that could produce it — blocks until "
+                    f"timeout (in {r.func or '<module>'})"
+                ),
+            )

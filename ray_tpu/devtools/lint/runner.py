@@ -13,12 +13,18 @@ false-negative storm, which the `lint_clean` release entry gates.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
 from dataclasses import dataclass, field
 
 from ray_tpu.devtools.lint.baseline import DEFAULT_BASELINE, Baseline
+from ray_tpu.devtools.lint.cache import (
+    DEFAULT_CACHE,
+    SummaryCache,
+    fingerprint_source,
+)
 from ray_tpu.devtools.lint.core import (
     FileContext,
     Finding,
@@ -63,10 +69,41 @@ class RunResult:
     stale: list[dict] = field(default_factory=list)
     suppressed: int = 0
     stats: dict = field(default_factory=dict)
+    project: object = None          # callgraph.ProjectGraph of the run
 
     @property
     def exit_code(self) -> int:
         return 1 if (self.findings or self.stale) else 0
+
+
+def build_project(
+    ctxs: list[FileContext], root: str, cache: SummaryCache
+):
+    """Whole-program layer: per-file summaries (callgraph + comm sites)
+    through the fingerprint cache, assembled into one ProjectGraph that
+    every FileContext shares."""
+    from ray_tpu.devtools.analysis import commgraph
+    from ray_tpu.devtools.lint import callgraph
+
+    project = callgraph.ProjectGraph(root=root)
+    comm_sites: list[dict] = []
+    for ctx in ctxs:
+        ctx.fingerprint = fingerprint_source(ctx.source)
+        ctx.module = callgraph.module_name(ctx.path) or ""
+        summary = cache.get(ctx.path, ctx.fingerprint)
+        if summary is None:
+            summary = {
+                "callgraph": callgraph.summarize_module(
+                    ctx.tree, ctx.path),
+                "comm": commgraph.extract_sites(ctx.tree, ctx.path),
+            }
+            cache.put(ctx.path, ctx.fingerprint, summary)
+        project.add_summary(ctx.path, summary["callgraph"])
+        comm_sites.extend(summary["comm"])
+    project.comm_sites = comm_sites
+    for ctx in ctxs:
+        ctx.project = project
+    return project
 
 
 def run_paths(
@@ -76,6 +113,8 @@ def run_paths(
     select: set[str] | None = None,
     disable: set[str] | None = None,
     baseline: Baseline | None = None,
+    cache_path: str | None = None,
+    use_cache: bool = True,
 ) -> RunResult:
     root = root or repo_root()
     rule_classes = all_rules()
@@ -101,6 +140,12 @@ def run_paths(
                 severity=Severity.ERROR,
                 message=f"file does not parse: {exc.msg}",
             ))
+
+    if use_cache and cache_path is None:
+        cache_path = os.path.join(root, DEFAULT_CACHE)
+    cache = SummaryCache.load(cache_path if use_cache else None)
+    project = build_project(ctxs, root, cache)
+    cache.save()
 
     raw: list[Finding] = list(parse_errors)
     crashes = 0
@@ -138,10 +183,14 @@ def run_paths(
         "rule_names": sorted(active),
         "suppressed_inline": suppressed,
         "rule_crashes": crashes,
+        "cache_hits": cache.hits,
+        "cache_misses": cache.misses,
+        "comm_sites": len(getattr(project, "comm_sites", ())),
         "wall_s": round(time.perf_counter() - start, 3),
     }
     return RunResult(findings=new, baselined=matched, stale=stale,
-                     suppressed=suppressed, stats=stats)
+                     suppressed=suppressed, stats=stats,
+                     project=project)
 
 
 # ---------------------------------------------------------------------------
@@ -166,11 +215,24 @@ def add_lint_arguments(p: argparse.ArgumentParser) -> None:
                    help="accept all current findings into the baseline "
                         "(existing justifications are preserved; new "
                         "entries get a TODO you must fill in)")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="rewrite the baseline keeping only entries that "
+                        "still match (justifications preserved); stale "
+                        "entries stop failing the run")
     p.add_argument("--select", default=None,
                    help="comma-separated rule names to run exclusively")
     p.add_argument("--disable", default=None,
                    help="comma-separated rule names to skip")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--comm-graph", action="store_true",
+                   help="print the communication-protocol certification "
+                        "summary (channel graph + schedule grids)")
+    p.add_argument("--comm-graph-out", default=None, metavar="FILE",
+                   help="export the channel graph (.dot or .json by "
+                        "extension); implies --comm-graph")
+    p.add_argument("--no-cache", action="store_true",
+                   help="skip the incremental summary cache "
+                        "(.rtlint-cache.json)")
 
 
 def default_paths(root: str) -> list[str]:
@@ -180,6 +242,43 @@ def default_paths(root: str) -> list[str]:
         if os.path.exists(cand):
             paths.append(cand)
     return paths
+
+
+def _emit_comm_graph(result: RunResult, out: str | None) -> None:
+    """Print the protocol-certification summary and optionally export
+    the channel graph (DOT for graphviz, JSON otherwise)."""
+    from ray_tpu.devtools.analysis.commgraph import graph_from_project
+
+    graph = graph_from_project(result.project)
+    channels = graph.channels()
+    unmatched = [c for c in channels if not c.recvs]
+    orphans = graph.unmatched_recvs()
+    print(f"comm-graph: {len(graph.sites)} sites "
+          f"({len(graph.sends)} send / {len(graph.recvs)} recv), "
+          f"{len(channels)} channels, "
+          f"{len(unmatched)} unmatched send(s), "
+          f"{len(orphans)} orphan recv(s)")
+    grids = getattr(result.project, "certified_grids", None)
+    if grids is None:
+        print("comm-graph: schedule grids not checked "
+              "(schedule-deadlock rule disabled)")
+    else:
+        ok = [g for g in grids if g["ok"]]
+        bad = [g for g in grids if not g["ok"]]
+        desc = ", ".join(
+            f"S={g['stages']}xM={g['microbatches']}xv={g['virtual']}"
+            for g in ok
+        ) or "none declared"
+        print(f"comm-graph: {len(ok)} schedule grid(s) certified "
+              f"deadlock-free ({desc})"
+              + (f"; {len(bad)} FAILED" if bad else ""))
+    if out:
+        from ray_tpu._private.atomic_io import atomic_write_text
+
+        text = graph.to_dot() if out.endswith(".dot") else \
+            json.dumps(graph.to_json(), indent=2) + "\n"
+        atomic_write_text(out, text)
+        print(f"comm-graph: exported to {out}")
 
 
 def cmd_lint(args: argparse.Namespace) -> int:
@@ -209,7 +308,20 @@ def cmd_lint(args: argparse.Namespace) -> int:
         return 2
 
     result = run_paths(paths, root=root, select=select, disable=disable,
-                       baseline=baseline)
+                       baseline=baseline,
+                       use_cache=not getattr(args, "no_cache", False))
+
+    if args.prune_baseline:
+        kept = result.baselined
+        removed = len(result.stale)
+        baseline.save(baseline_path, kept)
+        print(f"rtlint: baseline pruned — {removed} stale entr"
+              f"{'y' if removed == 1 else 'ies'} removed, "
+              f"{len(kept)} kept at {baseline_path}")
+        result.stale = []
+
+    if args.comm_graph or args.comm_graph_out:
+        _emit_comm_graph(result, args.comm_graph_out)
 
     if args.write_baseline:
         accepted = result.findings + result.baselined

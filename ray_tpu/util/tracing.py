@@ -201,6 +201,7 @@ def flush() -> None:
         )
     lines = "".join(parts)
     with _io_lock:
+        # rtlint: disable=blocking-in-async - flush normally runs on the background _flush_loop thread; the async-reachable path is the bounded force-flush at span shutdown
         with open(path, "a") as fh:
             fh.write(lines)
 

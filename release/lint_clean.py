@@ -10,9 +10,14 @@ line for release/run_all.py:
                      fixed debt must leave the ledger)
   * rule_crashes   — rules that died on some file (criterion ==0: a
                      crashing analyzer is a false-negative storm)
-  * rules_active   — loaded rule count (criterion >=6: the framework
-                     rules from ISSUE 9 all registered)
+  * rules_active   — loaded rule count (criterion >=10: the ISSUE-9
+                     framework rules plus the ISSUE-12 protocol
+                     verifiers all registered)
   * files_scanned  — coverage sanity floor
+  * comm_sites     — communication sites the commgraph extracted
+                     (criterion >=40: the protocol rules actually saw
+                     the training/collective surface, not an empty
+                     graph trivially passing)
 """
 
 import json
@@ -45,6 +50,8 @@ def main() -> int:
         "rule_crashes": result.stats["rule_crashes"],
         "rules_active": result.stats["rules"],
         "files_scanned": result.stats["files"],
+        "comm_sites": result.stats["comm_sites"],
+        "cache_hits": result.stats["cache_hits"],
         "wall_s": result.stats["wall_s"],
     }))
     return 0
