@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Run the serve-plane chaos suite, slow scenarios included (ISSUE 13).
+#
+# Tier-1 CI runs `pytest -m 'not slow'`, which covers the windowed
+# fail-point decision core, latency-point arming, and the ChaosMonkey
+# replica kill mid-load; this script is the nightly companion that also
+# executes the long windowed schedules (mid-request replica kills with
+# zero lost requests, proxy kill + client failover + controller
+# restart, injected slow-replica latency) plus the serve_chaos release
+# benchmark in smoke mode (replica AND proxy kill under load, then an
+# oom_risk-triggered drain). Usage: ci/run_serve_chaos.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== serve chaos suite (tier-1 subset) =="
+python -m pytest tests/test_serve_chaos.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== serve chaos suite (slow scenarios) =="
+python -m pytest tests/test_serve_chaos.py -q -m 'slow' \
+    -p no:cacheprovider "$@"
+
+echo "== serve chaos release benchmark (smoke) =="
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" RAY_TPU_RELEASE_SMOKE=1 \
+    python release/benchmarks_serve_chaos.py
+
+echo "serve chaos suite: PASS"

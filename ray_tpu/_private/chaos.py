@@ -97,6 +97,7 @@ class FaultSchedule:
         partitions: list[dict] | None = None,
         slow: list[dict] | None = None,
         fail_points: dict[str, int] | None = None,
+        latency_points: dict[str, float] | None = None,
         kills: list[dict] | None = None,
         call_timeout_s: float = 2.0,
         max_call_attempts: int = 6,
@@ -121,8 +122,19 @@ class FaultSchedule:
         self.partitions = list(partitions or [])
         # [{"match": "node:abc*", "extra_ms": 50}]
         self.slow = list(slow or [])
-        # {"controller.snapshot_save": 2} -> first 2 hits raise ChaosFault
+        # {"controller.snapshot_save": 2} -> first 2 hits raise ChaosFault.
+        # A value may also be {"count": N, "start_s": X, "duration_s": Y}:
+        # armed only inside the epoch-relative window (count -1 = every hit
+        # in the window). Windows bound process-kill fail points — a
+        # replacement process gets a fresh per-process budget, so an
+        # unwindowed kill point would fell every successor too.
         self.fail_points = dict(fail_points or {})
+        # {"serve.replica.request": 500.0} -> callers of latency_delay()
+        # at that point sleep the given extra milliseconds (slow-replica /
+        # tail-latency injection, ISSUE 13). Always-on while armed, unlike
+        # fail_points there is no hit budget — slowness is a condition,
+        # not an event.
+        self.latency_points = dict(latency_points or {})
         # [{"at_s": 3, "target": "controller"|"agent:<idx>"|"worker:<idx>",
         #   "restart_after_s": 2.0}] — executed by ChaosMonkey, not here.
         self.kills = list(kills or [])
@@ -379,12 +391,34 @@ class ChaosInjector:
         budget = schedule.fail_points.get(point)
         if not budget:
             return
+        if isinstance(budget, dict):
+            now = self.elapsed()
+            start = float(budget.get("start_s", 0.0))
+            duration = float(budget.get("duration_s", float("inf")))
+            if not (start <= now < start + duration):
+                return
+            budget = int(budget.get("count", -1))
+            if not budget:
+                return
         hits = self._fail_point_hits.get(point, 0)
         if budget > 0 and hits >= budget:
             return
         self._fail_point_hits[point] = hits + 1
         self._record("failpoint", point, hits, "fail")
         raise ChaosFault(f"injected fault at {point} (hit {hits + 1})")
+
+    def latency_delay(self, point: str) -> float:
+        """Extra seconds to sleep at the named latency point (0.0 when
+        unarmed). Returns the delay instead of sleeping so async callers
+        can await it and sync callers can time.sleep it."""
+        schedule = self.schedule
+        if schedule is None:
+            return 0.0
+        extra_ms = schedule.latency_points.get(point, 0.0)
+        if extra_ms <= 0:
+            return 0.0
+        self._record("latency_point", point, 0, f"{extra_ms}ms")
+        return extra_ms / 1000.0
 
     def close(self) -> None:
         if self._log_fh is not None:
@@ -489,3 +523,9 @@ def failpoint(point: str) -> None:
     """Module-level convenience: subsystems call ``chaos.failpoint(name)``
     at interesting internal boundaries; a no-op unless armed."""
     get_injector().failpoint(point)
+
+
+def latency_delay(point: str) -> float:
+    """Module-level convenience for latency injection points: extra
+    seconds to sleep here (0.0 unless armed)."""
+    return get_injector().latency_delay(point)

@@ -66,9 +66,11 @@ _GROUPISH = re.compile(r"(^|\.)_?(group|coll\w*|comm\w*|ring|gang)\d*$")
 
 # Paths where bare/self receivers also count (the backends themselves).
 _COMM_PATHS = ("util/collective/",)
-# Paths scanned for group-ish sites at all.
+# Paths scanned for group-ish sites at all. serve/_private is included
+# (ISSUE 13): the serve control plane hosts no collectives today, so the
+# scan doubles as a tripwire against one sneaking onto the request path.
 _SCAN_PATHS = ("util/collective/", "train/", "parallel/", "release/",
-               "bench")
+               "bench", "serve/_private/")
 
 _RANKISH = re.compile(r"rank|stage|process_index")
 

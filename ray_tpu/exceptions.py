@@ -58,7 +58,8 @@ class ReplicaDiedError(RayTpuError):
     the request could not be completed on another replica. Raised by
     DeploymentResponse.result() instead of a bare timeout/actor error so
     callers can distinguish 'my request is lost' from 'my request is
-    slow' (the handle already retried once against a healthy replica)."""
+    slow' (the handle already spent its RetryPolicy budget against
+    healthy replicas)."""
 
     def __init__(self, deployment: str, replica: str, detail: str = ""):
         self.deployment = deployment
@@ -73,6 +74,51 @@ class ReplicaDiedError(RayTpuError):
 
     def __reduce__(self):
         return (ReplicaDiedError, (self.deployment, self.replica))
+
+
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """The request's propagated serve Deadline expired before completion.
+
+    Subclasses TimeoutError so callers that handled the old bare
+    GetTimeoutError-style timeouts keep working; distinct from it so SLO
+    accounting can tell 'the budget ran out' from 'an internal get timed
+    out'. Maps to HTTP 504 at the proxy."""
+
+    def __init__(self, detail: str = ""):
+        self.detail = detail
+        super().__init__(detail or "request deadline exceeded")
+
+    def __reduce__(self):
+        return (DeadlineExceededError, (self.detail,))
+
+
+class RequestShedError(RayTpuError):
+    """Admission control rejected the request before doing work (queue depth
+    projected past the route SLO). Maps to HTTP 503 + Retry-After at the
+    proxy; never retried by the handle — retrying amplifies overload."""
+
+    def __init__(self, detail: str = "", retry_after_s: float = 1.0):
+        self.detail = detail
+        self.retry_after_s = retry_after_s
+        super().__init__(detail or "request shed by admission control")
+
+    def __reduce__(self):
+        return (RequestShedError, (self.detail, self.retry_after_s))
+
+
+class ReplicaDrainingError(RayTpuError):
+    """The replica is draining (oom_risk / SIGTERM / scale-down) and not
+    accepting new work. The handle retries another replica without charging
+    the circuit breaker — draining is deliberate, not a fault."""
+
+    def __init__(self, replica: str = ""):
+        self.replica = replica
+        super().__init__(
+            f"replica {replica!r} is draining and not accepting requests"
+        )
+
+    def __reduce__(self):
+        return (ReplicaDrainingError, (self.replica,))
 
 
 class ObjectLostError(RayTpuError):

@@ -26,7 +26,12 @@ from ray_tpu.serve.handle import (
     ResponseStream,
 )
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
-from ray_tpu.serve._private.common import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve._private.common import (
+    AutoscalingConfig,
+    Deadline,
+    DeploymentConfig,
+    RetryPolicy,
+)
 
 __all__ = [
     "deployment",
@@ -48,4 +53,6 @@ __all__ = [
     "get_multiplexed_model_id",
     "AutoscalingConfig",
     "DeploymentConfig",
+    "RetryPolicy",
+    "Deadline",
 ]

@@ -16,13 +16,24 @@ from ray_tpu.serve._private.common import AutoscalingConfig
 
 
 def calculate_desired_num_replicas(
-    config: AutoscalingConfig, total_ongoing_requests: float, current_replicas: int
+    config: AutoscalingConfig,
+    total_ongoing_requests: float,
+    current_replicas: int,
+    queue_depth: float = 0.0,
+    p99_ms: float | None = None,
 ) -> int:
+    # Demand counts queued-but-unstarted work too (ISSUE 13): a deployment
+    # whose batching queues are backing up is under-provisioned even while
+    # `ongoing` sits at target. queue_weight tunes how aggressively queue
+    # depth converts to replicas.
+    demand = total_ongoing_requests + getattr(
+        config, "queue_weight", 1.0
+    ) * max(0.0, queue_depth)
     if current_replicas == 0:
         # Scale from zero on any traffic.
-        raw = 1 if total_ongoing_requests > 0 else 0
+        raw = 1 if demand > 0 else 0
     else:
-        per_replica = total_ongoing_requests / current_replicas
+        per_replica = demand / current_replicas
         error_ratio = per_replica / config.target_ongoing_requests
         factor = (
             config.upscale_smoothing_factor
@@ -31,6 +42,12 @@ def calculate_desired_num_replicas(
         )
         smoothed = 1 + factor * (error_ratio - 1)
         raw = math.ceil(current_replicas * smoothed - 1e-9)
+    # SLO input (ISSUE 8 histograms → ISSUE 13 autoscaler): a breached
+    # p99 target forces at least one more replica even when the ongoing
+    # count looks healthy — tail latency is load the gauge can't see.
+    slo = getattr(config, "slo_p99_ms", None)
+    if slo and p99_ms is not None and p99_ms > slo and current_replicas > 0:
+        raw = max(raw, current_replicas + 1)
     return max(config.min_replicas, min(config.max_replicas, raw))
 
 
@@ -47,10 +64,13 @@ class AutoscalingState:
         total_ongoing_requests: float,
         current_replicas: int,
         now: float | None = None,
+        queue_depth: float = 0.0,
+        p99_ms: float | None = None,
     ) -> int:
         now = time.monotonic() if now is None else now
         desired = calculate_desired_num_replicas(
-            self.config, total_ongoing_requests, current_replicas
+            self.config, total_ongoing_requests, current_replicas,
+            queue_depth=queue_depth, p99_ms=p99_ms,
         )
         if desired == current_replicas:
             self._proposal = None

@@ -6,6 +6,8 @@ from python/ray/serve/config.py.
 
 from __future__ import annotations
 
+import contextvars
+import math
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -13,6 +15,99 @@ from typing import Any, Optional
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 DEFAULT_APP_NAME = "default"
+
+# HTTP header / gRPC metadata key carrying the request's remaining budget in
+# seconds (a relative duration, NOT a wall-clock timestamp: monotonic clocks
+# don't agree across processes, so each hop re-anchors locally).
+DEADLINE_HEADER = "X-RayTPU-Deadline"
+DEADLINE_METADATA_KEY = "x-raytpu-deadline"
+
+
+@dataclass(frozen=True)
+class Deadline:
+    """A point on this process's monotonic clock by which the request must
+    finish. Created once at ingress and threaded through proxy -> handle ->
+    replica -> batching; every serve-path timeout derives from it.
+
+    ``at_monotonic`` is ``math.inf`` for unbounded requests, so arithmetic
+    (remaining/expired) works without None-checks.
+    """
+
+    at_monotonic: float = math.inf
+
+    @classmethod
+    def after(cls, budget_s: Optional[float]) -> "Deadline":
+        if budget_s is None:
+            return cls(math.inf)
+        return cls(time.monotonic() + max(0.0, float(budget_s)))
+
+    @classmethod
+    def never(cls) -> "Deadline":
+        return cls(math.inf)
+
+    def is_unbounded(self) -> bool:
+        return math.isinf(self.at_monotonic)
+
+    def remaining(self, cap: Optional[float] = None) -> float:
+        """Seconds left (>= 0). ``cap`` tightens the result — the idiom for
+        deriving a sub-operation timeout from the request deadline."""
+        left = self.at_monotonic - time.monotonic()
+        if cap is not None:
+            left = min(left, cap)
+        return max(0.0, left)
+
+    def expired(self) -> bool:
+        return self.at_monotonic - time.monotonic() <= 0.0
+
+    def budget(self) -> Optional[float]:
+        """Remaining budget for the wire (header/metadata/meta dict); None
+        when unbounded. The receiving hop re-anchors with ``after()``."""
+        if self.is_unbounded():
+            return None
+        return self.remaining()
+
+
+_current_deadline: contextvars.ContextVar[Optional[Deadline]] = (
+    contextvars.ContextVar("raytpu_serve_deadline", default=None)
+)
+
+
+def current_deadline() -> Optional[Deadline]:
+    return _current_deadline.get()
+
+
+def set_current_deadline(deadline: Optional[Deadline]):
+    """Sets the ambient request deadline; returns a contextvar token the
+    caller must hand to ``reset_current_deadline``."""
+    return _current_deadline.set(deadline)
+
+
+def reset_current_deadline(token) -> None:
+    _current_deadline.reset(token)
+
+
+@dataclass
+class RetryPolicy:
+    """Per-deployment retry budget (replaces the old retry-once handoff).
+
+    Attempts are spent only while the request deadline has budget left;
+    backoff between attempts is full-jitter via util/backoff.Backoff, capped
+    by the remaining deadline. ``hedge`` arms tail-latency hedging: a second
+    attempt launches once the first has been in flight for ``hedge_after_s``
+    (or the route's observed p95 when None) and the loser is cancelled.
+    """
+
+    max_attempts: int = 3
+    initial_backoff_s: float = 0.02
+    max_backoff_s: float = 1.0
+    retry_on_timeout: bool = False
+    hedge: bool = False
+    hedge_after_s: Optional[float] = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RetryPolicy":
+        known = {k: v for k, v in d.items() if k in cls.__dataclass_fields__}
+        return cls(**known)
 
 
 @dataclass
@@ -28,6 +123,12 @@ class AutoscalingConfig:
     upscale_smoothing_factor: float = 1.0
     downscale_smoothing_factor: float = 1.0
     metrics_interval_s: float = 1.0
+    # Closed-loop inputs (ISSUE 13): queued-but-unstarted requests count
+    # toward demand with this weight, and a route p99 above ``slo_p99_ms``
+    # forces at least one replica of upscale pressure even when ongoing
+    # counts look healthy (queues hide behind batching).
+    queue_weight: float = 1.0
+    slo_p99_ms: Optional[float] = None
 
 
 @dataclass
@@ -41,6 +142,31 @@ class DeploymentConfig:
     graceful_shutdown_timeout_s: float = 20.0
     ray_actor_options: dict = field(default_factory=dict)
     max_batch_queue: int = 1000
+    # Reliability knobs (ISSUE 13). ``request_timeout_s`` seeds the Deadline
+    # when the caller didn't propagate one; ``health_probe_timeout_s`` bounds
+    # the liveness probe the handle runs before surfacing a bare timeout
+    # (was a hardcoded 5s); ``max_queued_requests`` is the per-route
+    # admission allowance above steady-state capacity (-1 derives 1x
+    # capacity, 0 disables queueing entirely).
+    request_timeout_s: float = 60.0
+    health_probe_timeout_s: float = 5.0
+    max_queued_requests: int = -1
+    retry_policy: RetryPolicy = field(default_factory=RetryPolicy)
+
+    def policy_snapshot(self) -> dict:
+        """The config subset routers/proxies need, published with the
+        membership snapshot so every hop prices timeouts off deployment
+        config instead of hardcoded constants."""
+        from dataclasses import asdict
+
+        return {
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "request_timeout_s": self.request_timeout_s,
+            "health_probe_timeout_s": self.health_probe_timeout_s,
+            "max_queued_requests": self.max_queued_requests,
+            "graceful_shutdown_timeout_s": self.graceful_shutdown_timeout_s,
+            "retry_policy": asdict(self.retry_policy),
+        }
 
 
 @dataclass
@@ -66,6 +192,9 @@ class ReplicaInfo:
     state: str = "STARTING"  # STARTING/RUNNING/DRAINING/STOPPING/DEAD
     version: str = ""
     started_at: float = field(default_factory=time.time)
+    # Which node hosts the replica actor — lets the controller map
+    # oom_risk telemetry events (keyed by node_id) to draining candidates.
+    node_id: str = ""
 
 
 @dataclass
@@ -74,6 +203,12 @@ class RequestMetadata:
     method_name: str = "__call__"
     multiplexed_model_id: str = ""
     http: bool = False
+    # Remaining deadline budget at dispatch time (seconds, None=unbounded).
+    # Relative on the wire; the replica re-anchors on its own clock.
+    deadline_budget_s: Optional[float] = None
+    # Attempt ordinal (0 = first try) so replicas/tracing can tell retries
+    # and hedges apart from fresh requests.
+    attempt: int = 0
 
 
 def new_replica_id(deployment: str) -> str:

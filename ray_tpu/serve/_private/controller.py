@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import os
 import pickle
 import threading
 import time
@@ -32,8 +33,22 @@ from ray_tpu.serve._private.common import (
 from ray_tpu.serve._private.replica import Replica
 
 RECONCILE_PERIOD_S = 0.25
+# Proxy liveness + route-p99 + oom_risk scans ride a slower tick than the
+# reconcile loop: each is an RPC or a file read, not a dict diff.
+PROXY_CHECK_PERIOD_S = 1.0
 
 logger = logging.getLogger(__name__)
+
+
+def _inc_reliability(name: str, **tags) -> None:
+    """Best-effort reliability counter bump (metric export must never take
+    down the reconcile loop)."""
+    try:
+        from ray_tpu.util import metrics as metrics_mod
+
+        metrics_mod.inc_serve_reliability(name, **tags)
+    except Exception:  # rtlint: disable=swallowed-exception - metrics backend unavailable; reconcile continues
+        pass
 
 
 def _kv_call(method: str, payload: dict) -> Any:
@@ -72,6 +87,18 @@ class ServeController:
         # timestamp would let the first deployment in iteration order
         # starve every other deployment's health checks.
         self._last_health_check: dict = {}
+        # Ingress proxy registry (ISSUE 13): name → {"name", "protocol",
+        # "host", "port"}. The reconcile loop health-checks each one and
+        # restarts it under the same name/port on death; the set is
+        # published in the membership snapshot so clients can fail over.
+        self._proxies: dict[str, dict] = {}
+        self._last_proxy_check = 0.0
+        # Latest per-route p99 (ms) scraped from proxy SLO histograms,
+        # fed into the autoscaler beside queue depth.
+        self._route_p99: dict[str, float] = {}
+        # oom_risk event high-water mark (the jax_trainer consumer
+        # pattern): only events newer than this trigger drains.
+        self._oom_seen = 0
         self._restore_checkpoint()
         self._thread = threading.Thread(target=self._reconcile_loop, daemon=True)
         self._thread.start()
@@ -188,6 +215,87 @@ class ServeController:
             return dict(self._routes)
 
     # ------------------------------------------------------------------
+    # ingress proxy lifecycle (ISSUE 13)
+    # ------------------------------------------------------------------
+    def register_proxy(
+        self, name: str, protocol: str, host: str, port: int
+    ) -> str:
+        """serve.start() reports each proxy it launched; from then on the
+        controller owns its liveness (health-check + restart on death)."""
+        with self._lock:
+            self._proxies[name] = {
+                "name": name, "protocol": protocol,
+                "host": host, "port": int(port),
+            }
+            self._bump_version_locked()
+        return "ok"
+
+    def unregister_proxy(self, name: str) -> str:
+        with self._lock:
+            self._proxies.pop(name, None)
+            self._bump_version_locked()
+        return "ok"
+
+    def get_proxies(self) -> list:
+        with self._lock:
+            return [dict(p) for p in self._proxies.values()]
+
+    def _ensure_proxies(self) -> None:
+        """Health-check every registered proxy; restart the dead ones under
+        the same name/port so clients that pinned an address recover."""
+        with self._lock:
+            descriptors = [dict(p) for p in self._proxies.values()]
+        for desc in descriptors:
+            name = desc["name"]
+            try:
+                handle = ray_tpu.get_actor(name)
+                ray_tpu.get(handle.get_num_requests.remote(), timeout=5)
+                continue
+            except Exception:  # rtlint: disable=swallowed-exception - dead/unreachable proxy detected; restart path follows
+                pass
+            logger.warning("proxy %s is down; restarting", name)
+            try:
+                if desc["protocol"] == "grpc":
+                    from ray_tpu.serve._private.grpc_proxy import GRPCProxy
+
+                    proxy_cls: Any = GRPCProxy
+                else:
+                    from ray_tpu.serve._private.proxy import HTTPProxy
+
+                    proxy_cls = HTTPProxy
+                ray_tpu.remote(proxy_cls).options(
+                    name=name, lifetime="detached", max_concurrency=64
+                ).remote(desc["host"], desc["port"])
+                _inc_reliability("proxy_restarts", proxy=name)
+            except Exception:
+                # Name may still be registered while the old actor's death
+                # propagates; the next tick retries.
+                logger.warning("proxy %s restart failed", name, exc_info=True)
+
+    def _scrape_route_p99(self) -> None:
+        """Pull per-route p99 from each HTTP proxy's SLO histograms (ISSUE
+        8) for the autoscaler; routes served by several proxies report the
+        worst tail."""
+        with self._lock:
+            descriptors = [
+                dict(p) for p in self._proxies.values()
+                if p["protocol"] == "http"
+            ]
+        merged: dict[str, float] = {}
+        for desc in descriptors:
+            try:
+                handle = ray_tpu.get_actor(desc["name"])
+                stats = ray_tpu.get(handle.get_route_stats.remote(), timeout=5)
+            except Exception:  # rtlint: disable=swallowed-exception - proxy down; _ensure_proxies handles it
+                continue
+            for route, snap in stats.items():
+                p99 = snap.get("p99_ms")
+                if p99 is not None:
+                    merged[route] = max(merged.get(route, 0.0), p99)
+        if merged:
+            self._route_p99.update(merged)
+
+    # ------------------------------------------------------------------
     # long-poll push (reference: long_poll.py LongPollHost)
     # ------------------------------------------------------------------
     def _bump_version_locked(self) -> None:
@@ -217,8 +325,16 @@ class ServeController:
                 replicas[qname] = {
                     "actor_names": running,
                     "max_ongoing_requests": info.config.max_ongoing_requests,
+                    # Reliability policy (ISSUE 13): routers/proxies price
+                    # deadlines, retries, and admission from deployment
+                    # config instead of hardcoded constants.
+                    "policy": info.config.policy_snapshot(),
                 }
-            return {"routes": dict(self._routes), "replicas": replicas}
+            return {
+                "routes": dict(self._routes),
+                "replicas": replicas,
+                "proxies": [dict(p) for p in self._proxies.values()],
+            }
 
     def _publish_if_changed(self) -> None:
         """End of each reconcile pass: if membership changed (replica went
@@ -338,11 +454,19 @@ class ServeController:
     def _reconcile_once(self) -> None:
         with self._lock:
             targets = dict(self._deployments)
+        # Slow tick: proxy liveness, route-p99 scrape, oom_risk scan (each
+        # is an RPC or a file read — too heavy for every 0.25s pass).
+        now = time.monotonic()
+        if now - self._last_proxy_check >= PROXY_CHECK_PERIOD_S:
+            self._last_proxy_check = now
+            self._ensure_proxies()
+            self._scrape_route_p99()
+            self._drain_oom_flagged()
         # Drain replicas of deleted deployments.
         for qname in list(self._replicas):
             if qname not in targets:
                 for rep in self._replicas.get(qname, []):
-                    self._stop_replica(rep)
+                    self._stop_replica(rep, trigger="app_delete")
                 with self._lock:
                     self._replicas.pop(qname, None)
         for qname, info in targets.items():
@@ -352,7 +476,11 @@ class ServeController:
             # Rolling update: stop replicas of stale versions first.
             stale = [r for r in replicas if r.version != info.version]
             for rep in stale:
-                self._stop_replica(rep)
+                self._stop_replica(
+                    rep,
+                    timeout_s=info.config.graceful_shutdown_timeout_s,
+                    trigger="rolling_update",
+                )
                 replicas.remove(rep)
             alive = [r for r in replicas if r.state in ("STARTING", "RUNNING")]
             for _ in range(target - len(alive)):
@@ -361,8 +489,14 @@ class ServeController:
                     replicas.append(rep)
             excess = len(alive) - target
             if excess > 0:
+                # Scale-down prefers drains over kills: the replica leaves
+                # the routing set first, finishes in-flight work, then dies.
                 for rep in alive[-excess:]:
-                    self._stop_replica(rep)
+                    self._stop_replica(
+                        rep,
+                        timeout_s=info.config.graceful_shutdown_timeout_s,
+                        trigger="scale_down",
+                    )
                     replicas.remove(rep)
             self._health_check(qname, info, replicas)
         self._publish_if_changed()
@@ -388,6 +522,8 @@ class ServeController:
                 info.init_kwargs,
                 info.config.user_config,
                 info.version,
+                # Admission + drain knobs the replica enforces locally.
+                limits=info.config.policy_snapshot(),
             )
         except Exception:
             traceback.print_exc()
@@ -409,21 +545,49 @@ class ServeController:
     def _await_ready(self, rep: ReplicaInfo, actor) -> None:
         try:
             ray_tpu.get(actor.check_health.remote(), timeout=120)
+            try:
+                rep.node_id = ray_tpu.get(
+                    actor.get_node_id.remote(), timeout=10
+                )
+            except Exception:  # rtlint: disable=swallowed-exception - node id is only used for oom_risk targeting
+                pass
             rep.state = "RUNNING"
         except Exception:
             rep.state = "DEAD"
 
-    def _stop_replica(self, rep: ReplicaInfo) -> None:
-        rep.state = "STOPPING"
+    def _stop_replica(
+        self,
+        rep: ReplicaInfo,
+        timeout_s: float = 20.0,
+        trigger: str = "scale_down",
+    ) -> None:
+        """Drain-before-kill (ISSUE 13): flip the replica to DRAINING (the
+        membership publish pulls it from every router), let in-flight
+        requests finish up to the graceful timeout, then kill. The replica
+        checkpoints its multiplexed models inside drain()."""
+        rep.state = "DRAINING"
         actor = self._actor_handles.pop(rep.actor_name, None)
         if actor is None:
+            rep.state = "DEAD"
             return
+        _inc_reliability("drains", deployment=rep.deployment, trigger=trigger)
 
         def _drain():
             try:
-                ray_tpu.get(actor.prepare_to_drain.remote(), timeout=10)
-            except Exception:  # rtlint: disable=swallowed-exception - replica hung in drain; kill follows
+                ray_tpu.get(actor.drain.remote(), timeout=10)
+            except Exception:  # rtlint: disable=swallowed-exception - replica hung entering drain; the kill below still lands
                 pass
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                try:
+                    ongoing = ray_tpu.get(
+                        actor.get_num_ongoing.remote(), timeout=5
+                    )
+                except Exception:  # rtlint: disable=swallowed-exception - replica died mid-drain; nothing left to wait for
+                    break
+                if ongoing <= 0:
+                    break
+                time.sleep(0.25)
             try:
                 ray_tpu.kill(actor)
             except Exception:  # rtlint: disable=swallowed-exception - actor already dead
@@ -431,6 +595,57 @@ class ServeController:
             rep.state = "DEAD"
 
         threading.Thread(target=_drain, daemon=True).start()
+
+    def _drain_oom_flagged(self) -> None:
+        """Proactive drain on oom_risk telemetry (ISSUE 5 → ISSUE 13): the
+        node agent projects a worker past its memory limit and publishes an
+        oom_risk event; replicas on that node drain (checkpointing loaded
+        models) before the OOM killer takes them mid-request. The reconcile
+        pass starts replacements as soon as the drain drops them from the
+        alive set."""
+        session_dir = os.environ.get("RAYTPU_SESSION_DIR")
+        if not session_dir:
+            try:
+                session_dir = ray_tpu.runtime_info().get("session_dir")
+            except Exception:  # rtlint: disable=swallowed-exception - no cluster context: no events to read
+                return
+        if not session_dir:
+            return
+        try:
+            from ray_tpu._private.event_export import read_events
+
+            events = read_events(session_dir, "oom_risk")
+        except Exception:  # rtlint: disable=swallowed-exception - unreadable events dir; retry next tick
+            return
+        fresh = events[self._oom_seen:]
+        if not fresh:
+            return
+        self._oom_seen = len(events)
+        nodes = {
+            ev.get("data", {}).get("node_id") for ev in fresh
+        } - {None, ""}
+        if not nodes:
+            return
+        with self._lock:
+            deployments = dict(self._deployments)
+        for qname, info in deployments.items():
+            replicas = self._replicas.get(qname, [])
+            flagged = [
+                r for r in replicas
+                if r.state == "RUNNING" and r.node_id in nodes
+            ]
+            for rep in flagged:
+                logger.warning(
+                    "draining replica %s: oom_risk on node %s",
+                    rep.replica_id, rep.node_id,
+                )
+                # Stay in the replicas list as DRAINING: the alive count
+                # drops, so the same pass starts a replacement elsewhere.
+                self._stop_replica(
+                    rep,
+                    timeout_s=info.config.graceful_shutdown_timeout_s,
+                    trigger="oom_risk",
+                )
 
     def _health_check(self, qname, info, replicas: list[ReplicaInfo]) -> None:
         now = time.monotonic()
@@ -444,7 +659,7 @@ class ServeController:
                 rep.state = "DEAD"
                 continue
             try:
-                ray_tpu.get(
+                result = ray_tpu.get(
                     actor.check_health.remote(),
                     timeout=info.config.health_check_timeout_s,
                 )
@@ -455,6 +670,17 @@ class ServeController:
                     ray_tpu.kill(actor)
                 except Exception:  # rtlint: disable=swallowed-exception - kill of an already-dead replica
                     pass
+                continue
+            if result == "draining":
+                # The replica started draining on its own (SIGTERM from
+                # the platform): honor it — pull it from routing, let
+                # in-flight work finish, and let reconcile start a
+                # replacement. _stop_replica's drain() call is idempotent.
+                self._stop_replica(
+                    rep,
+                    timeout_s=info.config.graceful_shutdown_timeout_s,
+                    trigger="sigterm",
+                )
         self._replicas[qname] = [r for r in replicas if r.state != "DEAD"]
 
     def _autoscale(self, qname: str, info: DeploymentInfo) -> None:
@@ -465,20 +691,28 @@ class ServeController:
             r for r in self._replicas.get(qname, []) if r.state == "RUNNING"
         ]
         total_ongoing = 0.0
+        queue_depth = 0.0
         for rep in running:
             actor = self._actor_handles.get(rep.actor_name)
             if actor is None:
                 continue
             try:
-                total_ongoing += ray_tpu.get(
-                    actor.get_num_ongoing.remote(), timeout=5
-                )
+                load = ray_tpu.get(actor.get_load.remote(), timeout=5)
+                total_ongoing += load.get("ongoing", 0)
+                queue_depth += load.get("queue_depth", 0)
             except Exception:  # rtlint: disable=swallowed-exception - queue-depth probe failed; autoscale on what we have
                 pass
         current = self._autoscale_counts.get(
             qname, info.config.autoscaling_config.min_replicas
         )
-        decision = state.decide(total_ongoing, current)
+        # SLO input (ISSUE 13): the proxies' per-route p99 (scraped on the
+        # slow tick) turns tail-latency breaches into upscale pressure.
+        decision = state.decide(
+            total_ongoing,
+            current,
+            queue_depth=queue_depth,
+            p99_ms=self._route_p99.get(qname),
+        )
         if decision != current:
             self._autoscale_counts[qname] = decision
 

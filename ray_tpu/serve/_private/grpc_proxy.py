@@ -28,6 +28,13 @@ from typing import Any
 SERVICE = "raytpu.serve.Serve"
 
 
+from ray_tpu import exceptions  # noqa: E402
+from ray_tpu.serve._private.common import (  # noqa: E402
+    DEADLINE_METADATA_KEY,
+    Deadline,
+    reset_current_deadline,
+    set_current_deadline,
+)
 from ray_tpu.serve._private.routing import RoutingMixin  # noqa: E402
 
 
@@ -96,8 +103,8 @@ class GRPCProxy(RoutingMixin):
 
     # Routing/_match/_handle_for come from RoutingMixin.
 
-    def _resolve(self, raw_request: bytes) -> tuple[Any, Any]:
-        """→ (handle, data). Raises ValueError for bad requests."""
+    def _resolve(self, raw_request: bytes) -> tuple[Any, Any, str]:
+        """→ (handle, data, qualified). Raises ValueError for bad requests."""
         try:
             request = json.loads(raw_request or b"{}")
         except json.JSONDecodeError as exc:
@@ -112,7 +119,44 @@ class GRPCProxy(RoutingMixin):
         if match is None:
             raise LookupError(f"no Serve route for {route!r}")
         _, qualified = match
-        return self._handle_for(qualified), request.get("data")
+        return self._handle_for(qualified), request.get("data"), qualified
+
+    def _ingress_deadline(self, context, qualified: str) -> Deadline:
+        """gRPC carries TWO deadline signals: the protocol-level client
+        deadline (context.time_remaining()) and the explicit
+        x-raytpu-deadline metadata budget. The tighter one wins; absent
+        both, the deployment's request_timeout_s seeds it."""
+        budgets = []
+        try:
+            remaining = context.time_remaining()
+            if remaining is not None:
+                budgets.append(float(remaining))
+        except Exception:  # rtlint: disable=swallowed-exception - context without a client deadline: fall through to metadata/config
+            pass
+        try:
+            for key, value in context.invocation_metadata() or ():
+                if key.lower() == DEADLINE_METADATA_KEY:
+                    budgets.append(float(value))
+        except (TypeError, ValueError):  # rtlint: disable=swallowed-exception - malformed metadata budget: fall through to config default
+            pass
+        if not budgets:
+            from ray_tpu.serve._private.long_poll import get_subscriber
+
+            policy = get_subscriber().get_replicas(qualified).get(
+                "policy"
+            ) or {}
+            budgets.append(float(policy.get("request_timeout_s", 60.0)))
+        return Deadline.after(min(budgets))
+
+    @staticmethod
+    def _call_with_deadline(handle, data, deadline: Deadline):
+        """Worker-thread body: anchor the ambient deadline, dispatch, and
+        let result() derive every timeout from it."""
+        token = set_current_deadline(deadline)
+        try:
+            return handle.remote(data).result()
+        finally:
+            reset_current_deadline(token)
 
     @staticmethod
     def _encode(item: Any) -> bytes:
@@ -132,14 +176,21 @@ class GRPCProxy(RoutingMixin):
 
         self._num_requests += 1
         try:
-            handle, data = await asyncio.to_thread(self._resolve, request)
+            handle, data, qualified = await asyncio.to_thread(
+                self._resolve, request
+            )
+            deadline = self._ingress_deadline(context, qualified)
             result = await asyncio.to_thread(
-                lambda: handle.remote(data).result(timeout=120)
+                self._call_with_deadline, handle, data, deadline
             )
         except LookupError as exc:
             await context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
         except ValueError as exc:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        except exceptions.RequestShedError as exc:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
+        except (exceptions.DeadlineExceededError, TimeoutError) as exc:
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
         except Exception as exc:
             await context.abort(
                 grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}"
@@ -169,15 +220,24 @@ class GRPCProxy(RoutingMixin):
 
         self._num_requests += 1
         try:
-            handle, data = await asyncio.to_thread(self._resolve, request)
+            handle, data, qualified = await asyncio.to_thread(
+                self._resolve, request
+            )
+            deadline = self._ingress_deadline(context, qualified)
             result = await asyncio.to_thread(
-                lambda: handle.remote(data).result(timeout=120)
+                self._call_with_deadline, handle, data, deadline
             )
         except LookupError as exc:
             await context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
             return
         except ValueError as exc:
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+            return
+        except exceptions.RequestShedError as exc:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
+            return
+        except (exceptions.DeadlineExceededError, TimeoutError) as exc:
+            await context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(exc))
             return
         except Exception as exc:
             await context.abort(

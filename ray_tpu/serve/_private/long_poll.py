@@ -72,6 +72,13 @@ class UpdateSubscriber:
                 )
             )
 
+    def get_proxies(self) -> list:
+        """Known ingress proxies: [{"name", "protocol", "host", "port"}].
+        Clients use this to fail over between proxies (ISSUE 13)."""
+        self.wait_ready()
+        with self._lock:
+            return list((self._snapshot or {}).get("proxies", []))
+
     def force_refresh(self) -> None:
         """Synchronous snapshot fetch for callers that cannot wait for the
         next push (e.g. a router spinning on scale-from-zero)."""
@@ -100,6 +107,7 @@ class UpdateSubscriber:
                 self._snapshot = {
                     "routes": update.get("routes", {}),
                     "replicas": update.get("replicas", {}),
+                    "proxies": update.get("proxies", []),
                 }
         self._have_snapshot.set()
 

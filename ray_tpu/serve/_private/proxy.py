@@ -14,17 +14,49 @@ import asyncio
 import contextlib
 import json
 import logging
+import os
 import time
 import threading
 from typing import Any
 
 import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu._private import chaos
 from ray_tpu._private.workload import LatencyHistogram
-from ray_tpu.serve._private.common import CONTROLLER_NAME
+from ray_tpu.serve._private.common import (
+    CONTROLLER_NAME,
+    DEADLINE_HEADER,
+    Deadline,
+    reset_current_deadline,
+    set_current_deadline,
+)
 from ray_tpu.serve._private.routing import RoutingMixin
 from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
+
+
+def parse_deadline_header(value: str | None, default_s: float) -> Deadline:
+    """Ingress deadline: the X-RayTPU-Deadline header carries the client's
+    remaining budget in seconds; absent or malformed, the route's default
+    request timeout seeds it."""
+    if value:
+        try:
+            return Deadline.after(float(value))
+        except (TypeError, ValueError):
+            pass
+    return Deadline.after(default_s)
+
+
+def admission_limit(num_replicas: int, max_ongoing: int,
+                    max_queued: int) -> int:
+    """Per-route in-flight ceiling at ONE ingress: steady-state capacity
+    (replicas x max_ongoing) plus the configured queue allowance (-1
+    derives a 1x-capacity queue). Past it, the proxy sheds with a fast
+    503 + Retry-After instead of queueing to death."""
+    capacity = max(1, num_replicas) * max(1, max_ongoing)
+    allowance = capacity if max_queued < 0 else max_queued
+    return capacity + allowance
 
 
 class HTTPProxy(RoutingMixin):
@@ -42,6 +74,9 @@ class HTTPProxy(RoutingMixin):
         self._handles: dict[str, Any] = {}
         self._last_refresh = 0.0
         self._num_requests = 0
+        # Per-route in-flight counts for admission control (ISSUE 13).
+        self._inflight: dict[str, int] = {}
+        self._shed_count = 0
         # Per-route SLO accounting (ISSUE 8): bounded log-spaced
         # histograms + error counts, flushed as serve/<route> workload
         # series and recorded into the Prometheus pipeline per request.
@@ -83,6 +118,13 @@ class HTTPProxy(RoutingMixin):
         path = "/" + request.match_info.get("tail", "")
         if path == "/-/healthz":
             return web.Response(text="ok")
+        # Chaos hook (ISSUE 13): an armed "serve.proxy.kill" failpoint
+        # takes this proxy down mid-request — the controller's health
+        # check restarts it and clients fail over to a sibling proxy.
+        try:
+            chaos.failpoint("serve.proxy.kill")
+        except chaos.ChaosFault:
+            os._exit(1)
         if path == "/-/routes":
             await asyncio.to_thread(self._refresh_routes)
             return web.json_response(self._routes)
@@ -92,6 +134,23 @@ class HTTPProxy(RoutingMixin):
             return web.Response(status=404, text=f"no route for {path}")
         _, qualified = match
         app_name, dep_name = qualified.split("_", 1)
+        policy = self._route_policy(qualified)
+        # Ingress deadline (ISSUE 13): the client's remaining budget rides
+        # the X-RayTPU-Deadline header; everything downstream (handle
+        # retries, replica, batching) derives its timeout from it.
+        deadline = parse_deadline_header(
+            request.headers.get(DEADLINE_HEADER),
+            float(policy.get("request_timeout_s", 60.0)),
+        )
+        # Admission control: when the route's in-flight load projects past
+        # capacity + queue allowance, shed fast with 503 + Retry-After.
+        limit = admission_limit(
+            policy.get("num_replicas", 1),
+            policy.get("max_ongoing_requests", 100),
+            policy.get("max_queued_requests", -1),
+        )
+        if self._inflight.get(qualified, 0) >= limit:
+            return self._shed_response(qualified, "proxy")
         body: Any
         if request.method in ("POST", "PUT", "PATCH"):
             raw = await request.read()
@@ -118,39 +177,71 @@ class HTTPProxy(RoutingMixin):
             else contextlib.nullcontext()
         )
         req_t0 = time.perf_counter()
+        self._inflight[qualified] = self._inflight.get(qualified, 0) + 1
         try:
-            # to_thread copies the contextvars context, so the handle's
-            # dispatch sees this span as the current trace parent.
-            with trace_scope:
-                result = await asyncio.to_thread(
-                    self._call_deployment, app_name, dep_name, body
+            try:
+                # to_thread copies the contextvars context, so the
+                # handle's dispatch sees this span as the current trace
+                # parent and the deadline as the ambient budget.
+                with trace_scope:
+                    result = await asyncio.to_thread(
+                        self._call_deployment, app_name, dep_name, body,
+                        deadline,
+                    )
+            except exceptions.RequestShedError:
+                return self._shed_response(qualified, "replica")
+            except (exceptions.DeadlineExceededError, TimeoutError) as exc:
+                self._observe_route(
+                    qualified, time.perf_counter() - req_t0, error=True,
+                    status="504",
                 )
-        except Exception as exc:
+                return web.Response(
+                    status=504, text=f"deadline exceeded: {exc}"
+                )
+            except RuntimeError as exc:
+                if "no available replica" in str(exc):
+                    # Backpressure/scale-to-zero exhausted the deadline:
+                    # service unavailable, not an internal error.
+                    return self._shed_response(qualified, "proxy")
+                self._observe_route(
+                    qualified, time.perf_counter() - req_t0, error=True
+                )
+                return web.Response(
+                    status=500, text=f"{type(exc).__name__}: {exc}"
+                )
+            except Exception as exc:
+                self._observe_route(
+                    qualified, time.perf_counter() - req_t0, error=True
+                )
+                return web.Response(
+                    status=500, text=f"{type(exc).__name__}: {exc}"
+                )
+            # For streams this is time-to-first-dispatch, not full-body
+            # time: a token stream's lifetime measures the client's read
+            # speed, not the serving SLO.
             self._observe_route(
-                qualified, time.perf_counter() - req_t0, error=True
+                qualified, time.perf_counter() - req_t0, error=False
             )
-            return web.Response(status=500, text=f"{type(exc).__name__}: {exc}")
-        # For streams this is time-to-first-dispatch, not full-body time:
-        # a token stream's lifetime measures the client's read speed, not
-        # the serving SLO.
-        self._observe_route(qualified, time.perf_counter() - req_t0, error=False)
-        if time.monotonic() - self._last_stats_flush >= self.STATS_FLUSH_S:
-            self._last_stats_flush = time.monotonic()
-            asyncio.get_running_loop().create_task(
-                asyncio.to_thread(self._flush_route_stats)
-            )
-        from ray_tpu.serve.handle import ResponseStream
+            if time.monotonic() - self._last_stats_flush >= self.STATS_FLUSH_S:
+                self._last_stats_flush = time.monotonic()
+                asyncio.get_running_loop().create_task(
+                    asyncio.to_thread(self._flush_route_stats)
+                )
+            from ray_tpu.serve.handle import ResponseStream
 
-        if isinstance(result, ResponseStream):
-            return await self._stream_response(request, result)
-        if isinstance(result, bytes):
-            return web.Response(body=result)
-        if isinstance(result, str):
-            return web.Response(text=result)
-        try:
-            return web.json_response(result)
-        except TypeError:
-            return web.Response(text=str(result))
+            if isinstance(result, ResponseStream):
+                return await self._stream_response(request, result)
+            if isinstance(result, bytes):
+                return web.Response(body=result)
+            if isinstance(result, str):
+                return web.Response(text=result)
+            try:
+                return web.json_response(result)
+            except TypeError:
+                return web.Response(text=str(result))
+        finally:
+            count = self._inflight.get(qualified, 1)
+            self._inflight[qualified] = max(0, count - 1)
 
     async def _stream_response(self, request, stream):
         """Streaming deployment → SSE (Accept: text/event-stream) or
@@ -197,12 +288,55 @@ class HTTPProxy(RoutingMixin):
         await response.write_eof()
         return response
 
-    def _call_deployment(self, app_name: str, dep_name: str, body: Any) -> Any:
+    def _call_deployment(self, app_name: str, dep_name: str, body: Any,
+                         deadline: Deadline) -> Any:
         handle = self._handle_for(f"{app_name}_{dep_name}")
-        return handle.remote(body).result(timeout=120)
+        # Runs on a worker thread: the ambient deadline set here is what
+        # handle.remote() picks up (and result() is bounded by it — no
+        # more hardcoded 120s cap).
+        token = set_current_deadline(deadline)
+        try:
+            return handle.remote(body).result()
+        finally:
+            reset_current_deadline(token)
+
+    def _route_policy(self, qualified: str) -> dict:
+        """Deployment policy + live replica count from the long-poll
+        snapshot (push-updated; zero RPCs on the request path)."""
+        from ray_tpu.serve._private.long_poll import get_subscriber
+
+        info = get_subscriber().get_replicas(qualified)
+        policy = dict(info.get("policy") or {})
+        policy.setdefault(
+            "max_ongoing_requests", info.get("max_ongoing_requests", 100)
+        )
+        policy["num_replicas"] = len(info.get("actor_names", ()))
+        return policy
+
+    def _shed_response(self, qualified: str, where: str):
+        """Fast 503 + Retry-After: the graceful-degradation contract —
+        callers back off instead of piling onto a saturated route."""
+        from aiohttp import web
+
+        self._shed_count += 1
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+
+            metrics_mod.inc_serve_reliability(
+                "shed", route=qualified, where=where
+            )
+            metrics_mod.record_serve_request(qualified, 0.0, "503")
+        except Exception:  # rtlint: disable=swallowed-exception - metric export must never fail a shed response
+            pass
+        return web.Response(
+            status=503,
+            headers={"Retry-After": "1"},
+            text="overloaded: request shed by admission control",
+        )
 
     # -- SLO accounting (ISSUE 8) ---------------------------------------
-    def _observe_route(self, route: str, seconds: float, error: bool) -> None:
+    def _observe_route(self, route: str, seconds: float, error: bool,
+                       status: str | None = None) -> None:
         with self._stats_lock:
             hist = self._route_hist.get(route)
             if hist is None:
@@ -219,7 +353,7 @@ class HTTPProxy(RoutingMixin):
             from ray_tpu.util import metrics as metrics_mod
 
             metrics_mod.record_serve_request(
-                route, seconds, "500" if error else "200"
+                route, seconds, status or ("500" if error else "200")
             )
         except Exception:
             # The request already succeeded; only the metric is lost.
@@ -288,3 +422,6 @@ class HTTPProxy(RoutingMixin):
 
     def get_num_requests(self) -> int:
         return self._num_requests
+
+    def get_shed_count(self) -> int:
+        return self._shed_count

@@ -13,11 +13,23 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import inspect
+import logging
+import os
+import signal
 import time
 from typing import Any
 
+from ray_tpu import exceptions
+from ray_tpu._private import chaos
 from ray_tpu._private.workload import LatencyHistogram
+from ray_tpu.serve._private.common import (
+    Deadline,
+    reset_current_deadline,
+    set_current_deadline,
+)
 from ray_tpu.util import tracing
+
+logger = logging.getLogger(__name__)
 
 _request_context: contextvars.ContextVar = contextvars.ContextVar(
     "serve_request_context", default=None
@@ -40,6 +52,7 @@ class Replica:
         init_kwargs: dict,
         user_config: Any,
         version: str,
+        limits: dict | None = None,
     ):
         from ray_tpu.serve.handle import _resolve_handle_placeholders
 
@@ -48,6 +61,32 @@ class Replica:
         self.version = version
         self._ongoing = 0
         self._total = 0
+        self._shed = 0
+        # Deployment-config subset the replica enforces locally (admission
+        # + drain timing); the controller passes it at construction.
+        limits = limits or {}
+        self._max_ongoing = int(limits.get("max_ongoing_requests", 100))
+        max_queued = int(limits.get("max_queued_requests", -1))
+        # Admission ceiling: steady-state capacity plus the queue
+        # allowance (-1 derives a 1x-capacity queue). The router already
+        # enforces max_ongoing per client — this guard catches the
+        # multi-proxy overcommit case where N routers each grant
+        # max_ongoing slots in good faith.
+        self._admission_limit = self._max_ongoing + (
+            self._max_ongoing if max_queued < 0 else max_queued
+        )
+        self._graceful_shutdown_timeout_s = float(
+            limits.get("graceful_shutdown_timeout_s", 20.0)
+        )
+        self._draining = False
+        # SIGTERM means "the platform wants this process gone soon": stop
+        # accepting work and let in-flight requests finish instead of
+        # dying mid-request. Actor tasks may run off the main thread, so
+        # installation is best-effort.
+        try:
+            signal.signal(signal.SIGTERM, self._on_sigterm)
+        except (ValueError, OSError):  # rtlint: disable=swallowed-exception - non-main thread / unsupported platform: drain still reachable via the drain() RPC
+            pass
         # Bounded log-spaced histogram (ISSUE 8) instead of a raw latency
         # list: O(1) memory for any request volume, p50/p95/p99 over the
         # replica's WHOLE life rather than the last 200 samples.
@@ -91,10 +130,45 @@ class Replica:
                     "into a downstream call — iterate the stream in the "
                     "caller and pass materialized values"
                 )
+        # Re-anchor the propagated deadline on this process's clock (the
+        # wire carries a relative budget; monotonic clocks don't agree
+        # across processes).
+        budget = meta.get("deadline_budget_s")
+        deadline = (
+            Deadline.after(budget) if budget is not None else Deadline.never()
+        )
+        if deadline.expired():
+            # Arrived dead: doing the work wastes capacity on an answer
+            # nobody is waiting for.
+            raise exceptions.DeadlineExceededError(
+                "request deadline expired before the replica started it"
+            )
+        if self._draining:
+            raise exceptions.ReplicaDrainingError(self.replica_id)
+        if self._ongoing >= self._admission_limit:
+            # Replica-side admission control: local queue projects past
+            # what the deployment config allows — shed fast instead of
+            # queueing to death.
+            self._shed += 1
+            raise exceptions.RequestShedError(
+                f"replica {self.replica_id} over admission limit "
+                f"({self._ongoing} >= {self._admission_limit})"
+            )
+        # Chaos hooks (ISSUE 13): mid-request kill emulates a replica
+        # dying while holding the request; the latency point emulates a
+        # slow replica for hedging/SLO tests.
+        try:
+            chaos.failpoint("serve.replica.mid_request")
+        except chaos.ChaosFault:
+            os._exit(1)
+        extra = chaos.latency_delay("serve.replica.request")
+        if extra > 0:
+            await asyncio.sleep(extra)
         self._ongoing += 1
         self._total += 1
         start = time.perf_counter()
         token = _request_context.set(meta)
+        deadline_token = set_current_deadline(deadline)
         try:
             if self._is_function:
                 target = self._callable
@@ -122,6 +196,7 @@ class Replica:
                 self._warm_shapes.add(meta["shape_key"])
             return result
         finally:
+            reset_current_deadline(deadline_token)
             _request_context.reset(token)
             self._ongoing -= 1
             self._latency_hist.observe(time.perf_counter() - start)
@@ -231,7 +306,11 @@ class Replica:
             result = self._callable.check_health()
             if inspect.iscoroutine(result):
                 await result
-        return "ok"
+        # "draining" is a healthy state that must leave the routing set:
+        # the controller sees it (e.g. after a SIGTERM the controller
+        # didn't initiate) and starts a replacement + excludes this
+        # replica from membership.
+        return "draining" if self._draining else "ok"
 
     def get_metrics(self) -> dict:
         from ray_tpu._private.worker_proc import _peak_rss_bytes
@@ -243,6 +322,8 @@ class Replica:
             "replica_id": self.replica_id,
             "ongoing": self._ongoing,
             "total": self._total,
+            "shed": self._shed,
+            "draining": self._draining,
             "p50_ms": lat["p50_ms"],
             "p95_ms": lat["p95_ms"],
             "p99_ms": lat["p99_ms"],
@@ -282,6 +363,20 @@ class Replica:
     def get_num_ongoing(self) -> int:
         return self._ongoing
 
+    def get_node_id(self) -> str:
+        return os.environ.get("RAYTPU_NODE_ID", "")
+
+    def get_load(self) -> dict:
+        """Autoscaler input: in-flight requests plus queued-but-unstarted
+        batching depth (the part `ongoing` alone hides)."""
+        from ray_tpu.serve import batching
+
+        return {
+            "ongoing": self._ongoing,
+            "queue_depth": batching.queue_stats()["queue_depth"],
+            "draining": self._draining,
+        }
+
     def get_warm_shapes(self) -> list:
         """Shape keys whose XLA programs this replica has already
         compiled (explicit request shape_keys + batching buckets) — the
@@ -291,5 +386,31 @@ class Replica:
 
         return sorted(self._warm_shapes | batching.warm_shapes())
 
-    def prepare_to_drain(self) -> str:
+    def _on_sigterm(self, signum, frame) -> None:
+        logger.info(
+            "replica %s received SIGTERM: draining", self.replica_id
+        )
+        self._draining = True
+
+    async def drain(self, checkpoint: bool = True) -> dict:
+        """Enter the drain lifecycle: stop accepting new requests (the
+        membership update pulls this replica from routers; stragglers get
+        ReplicaDrainingError), checkpoint multiplexed models, and report
+        in-flight work so the controller knows when the kill is clean."""
+        first = not self._draining
+        self._draining = True
+        checkpointed = 0
+        if checkpoint and first:
+            from ray_tpu.serve.multiplex import checkpoint_loaded_models
+
+            checkpointed = await checkpoint_loaded_models()
+        return {
+            "draining": True,
+            "ongoing": self._ongoing,
+            "streams": len(self._streams),
+            "checkpointed_models": checkpointed,
+        }
+
+    async def prepare_to_drain(self) -> str:
+        await self.drain()
         return "ok"

@@ -18,6 +18,7 @@ from ray_tpu.serve._private.common import (
     DEFAULT_APP_NAME,
     AutoscalingConfig,
     DeploymentConfig,
+    RetryPolicy,
 )
 from ray_tpu.serve.handle import DeploymentHandle, _HandlePlaceholder
 
@@ -25,6 +26,8 @@ _proxy_handle = None
 _proxy_port: Optional[int] = None
 _grpc_handle = None
 _grpc_port: Optional[int] = None
+# Extra HTTP proxies from start(num_proxies=N): [(port, handle)].
+_extra_proxies: list = []
 
 
 class Application:
@@ -96,6 +99,8 @@ class Deployment:
         for key, value in overrides.items():
             if key == "autoscaling_config" and isinstance(value, dict):
                 value = AutoscalingConfig(**value)
+            if key == "retry_policy" and isinstance(value, dict):
+                value = RetryPolicy.from_dict(value)
             if not hasattr(config, key):
                 raise TypeError(f"unknown deployment option {key!r}")
             setattr(config, key, value)
@@ -117,6 +122,11 @@ def deployment(
     health_check_period_s: float = 10.0,
     health_check_timeout_s: float = 30.0,
     route_prefix: Optional[str] = None,
+    request_timeout_s: float = 60.0,
+    health_probe_timeout_s: float = 5.0,
+    max_queued_requests: int = -1,
+    retry_policy: RetryPolicy | dict | None = None,
+    graceful_shutdown_timeout_s: float = 20.0,
 ):
     """@serve.deployment — same shapes as the reference decorator."""
 
@@ -125,29 +135,28 @@ def deployment(
             asc = AutoscalingConfig(**autoscaling_config)
         else:
             asc = autoscaling_config
+        if isinstance(retry_policy, dict):
+            policy = RetryPolicy.from_dict(retry_policy)
+        else:
+            policy = retry_policy or RetryPolicy()
         n_replicas = num_replicas
         if n_replicas == "auto":
             n_replicas = None
-            nonlocal_asc = asc or AutoscalingConfig()
-            config = DeploymentConfig(
-                num_replicas=1,
-                max_ongoing_requests=max_ongoing_requests,
-                user_config=user_config,
-                autoscaling_config=nonlocal_asc,
-                ray_actor_options=ray_actor_options or {},
-                health_check_period_s=health_check_period_s,
-                health_check_timeout_s=health_check_timeout_s,
-            )
-        else:
-            config = DeploymentConfig(
-                num_replicas=n_replicas or 1,
-                max_ongoing_requests=max_ongoing_requests,
-                user_config=user_config,
-                autoscaling_config=asc,
-                ray_actor_options=ray_actor_options or {},
-                health_check_period_s=health_check_period_s,
-                health_check_timeout_s=health_check_timeout_s,
-            )
+            asc = asc or AutoscalingConfig()
+        config = DeploymentConfig(
+            num_replicas=n_replicas or 1,
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config,
+            autoscaling_config=asc,
+            ray_actor_options=ray_actor_options or {},
+            health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
+            request_timeout_s=request_timeout_s,
+            health_probe_timeout_s=health_probe_timeout_s,
+            max_queued_requests=max_queued_requests,
+            retry_policy=policy,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+        )
         return Deployment(
             target,
             name or getattr(target, "__name__", "deployment"),
@@ -215,16 +224,33 @@ def _kill_quietly(handle) -> None:
             pass
 
 
+def _register_proxy(controller, name: str, protocol: str, host: str,
+                    port: int) -> None:
+    """Hand the proxy to the controller's lifecycle manager (health-check +
+    restart-on-death + membership publication for client failover)."""
+    try:
+        ray_tpu.get(
+            controller.register_proxy.remote(name, protocol, host, port),
+            timeout=30,
+        )
+    except Exception:  # rtlint: disable=swallowed-exception - older controller without the registry; proxy still serves, just unmanaged
+        pass
+
+
 def start(
     http_host: str = "127.0.0.1",
     http_port: Optional[int] = 8000,
     grpc_port: Optional[int] = None,
+    num_proxies: int = 1,
 ):
     """Start controller + ingress (reference: serve.start). ``http_port``
     None leaves any existing HTTP proxy untouched; ``grpc_port`` starts a
     gRPC ingress beside the HTTP one (reference: the proxy's dual
     HTTP+gRPC servers). Changing a port replaces (kills) the previous
-    proxy on the old port."""
+    proxy on the old port. ``num_proxies`` > 1 starts that many HTTP
+    proxies on consecutive ports (ISSUE 13 multi-proxy ingress): each is
+    registered with the controller, which health-checks and restarts them;
+    clients fail over between the published addresses."""
     global _proxy_handle, _proxy_port, _grpc_handle, _grpc_port
     controller = _get_or_create_controller()
     if http_port is not None and (
@@ -239,6 +265,23 @@ def start(
             http_host, http_port,
         )
         _proxy_port = http_port
+        _register_proxy(
+            controller, f"SERVE_PROXY::{http_port}", "http",
+            http_host, http_port,
+        )
+    if http_port is not None and num_proxies > 1:
+        from ray_tpu.serve._private.proxy import HTTPProxy
+
+        have = {port for port, _ in _extra_proxies}
+        for extra_port in range(http_port + 1, http_port + num_proxies):
+            if extra_port in have:
+                continue
+            name = f"SERVE_PROXY::{extra_port}"
+            handle = _get_or_create_proxy(
+                HTTPProxy, name, "ready", http_host, extra_port
+            )
+            _extra_proxies.append((extra_port, handle))
+            _register_proxy(controller, name, "http", http_host, extra_port)
     if grpc_port is not None and (
         _grpc_handle is None or _grpc_port != grpc_port
     ):
@@ -251,6 +294,10 @@ def start(
             http_host, grpc_port,
         )
         _grpc_port = grpc_port
+        _register_proxy(
+            controller, f"SERVE_GRPC_PROXY::{grpc_port}", "grpc",
+            http_host, grpc_port,
+        )
     return controller
 
 
@@ -356,6 +403,9 @@ def shutdown() -> None:
         pass
     _kill_quietly(_proxy_handle)
     _kill_quietly(_grpc_handle)
+    for _, handle in _extra_proxies:
+        _kill_quietly(handle)
+    _extra_proxies.clear()
     _proxy_handle = None
     _proxy_port = None
     _grpc_handle = None

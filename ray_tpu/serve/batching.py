@@ -18,6 +18,9 @@ import threading
 import weakref
 from typing import Any, Callable, Optional, Sequence
 
+from ray_tpu import exceptions
+from ray_tpu.serve._private.common import Deadline, current_deadline
+
 # Shape keys this PROCESS has compiled for (one replica per process):
 # bucket flushes land here; the replica wrapper unions them into its
 # warm-shape report for compile-cache-aware routing (SURVEY §3.4).
@@ -91,7 +94,10 @@ class _BatchQueue:
                 "largest bucket must be >= max_batch_size "
                 f"({self.bucket_sizes[-1]} < {max_batch_size})"
             )
-        self.queue: list[tuple[Any, asyncio.Future]] = []
+        # (item, future, deadline) — the request's propagated Deadline
+        # rides along so a flush can expire entries that waited past
+        # their budget instead of feeding dead work to the model.
+        self.queue: list[tuple[Any, asyncio.Future, Optional[Deadline]]] = []
         self._flusher: asyncio.Task | None = None
         self._lock = asyncio.Lock()
         # Flight-recorder counters (ISSUE 8): read by queue_stats().
@@ -117,7 +123,7 @@ class _BatchQueue:
     async def submit(self, item: Any) -> Any:
         future: asyncio.Future = asyncio.get_running_loop().create_future()
         async with self._lock:
-            self.queue.append((item, future))
+            self.queue.append((item, future, current_deadline()))
             if len(self.queue) >= self.max_batch_size:
                 self._take_and_flush()
             elif self._flusher is None or self._flusher.done():
@@ -137,9 +143,25 @@ class _BatchQueue:
             if self.queue:
                 self._take_and_flush()
 
-    async def _run_batch(self, batch: list[tuple[Any, asyncio.Future]]) -> None:
-        items = [item for item, _ in batch]
-        futures = [future for _, future in batch]
+    async def _run_batch(self, batch: list) -> None:
+        # Expire entries whose deadline lapsed while queued: feeding them
+        # to the model wastes a padded-batch slot on an answer nobody is
+        # waiting for (the caller already saw DeadlineExceededError).
+        fresh = []
+        for item, future, deadline in batch:
+            if deadline is not None and deadline.expired():
+                if not future.done():
+                    future.set_exception(
+                        exceptions.DeadlineExceededError(
+                            "request expired while queued for batching"
+                        )
+                    )
+            else:
+                fresh.append((item, future))
+        if not fresh:
+            return
+        items = [item for item, _ in fresh]
+        futures = [future for _, future in fresh]
         padded, real = self._pad(items)
         self.batches += 1
         self.items_real += real

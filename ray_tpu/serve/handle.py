@@ -8,10 +8,19 @@ the handle keeps a router that tracks the deployment's live replicas
 queue length (locally-tracked ongoing counts + max_ongoing_requests
 backpressure), and returns futures (DeploymentResponse) that compose
 between deployments.
+
+Reliability layer (ISSUE 13): every call carries a Deadline created at
+ingress; retries are budgeted by the deployment's RetryPolicy (full-jitter
+backoff, bounded by the remaining deadline) instead of the old retry-once
+handoff; optional hedging launches a second attempt at the route's
+observed p95 and cancels the loser; a per-replica circuit breaker stops
+routing to a flapping replica before it times out a queue of requests.
 """
 
 from __future__ import annotations
 
+import collections
+import logging
 import random
 import threading
 import time
@@ -19,8 +28,19 @@ from typing import Any, Optional
 
 import ray_tpu
 from ray_tpu import exceptions
-from ray_tpu.serve._private.common import CONTROLLER_NAME, RequestMetadata
+from ray_tpu.serve._private.common import (
+    Deadline,
+    RequestMetadata,
+    RetryPolicy,
+    current_deadline,
+)
 from ray_tpu.util import tracing
+from ray_tpu.util.metrics import (
+    inc_serve_reliability,
+    set_serve_breaker_state,
+)
+
+logger = logging.getLogger(__name__)
 
 # get()-level failures that mean "the replica process is gone", as opposed
 # to the request being slow or user code raising.
@@ -30,105 +50,416 @@ _REPLICA_DEATH_ERRORS = (
     exceptions.WorkerCrashedError,
 )
 
+# Replica-raised control-flow errors cross the actor wire wrapped in
+# TaskError (type is not preserved, only the remote traceback). Each is
+# raised with its class name in the message, so the traceback tail is an
+# unambiguous marker.
+_REMOTE_ERROR_KINDS = (
+    "ReplicaDrainingError",
+    "RequestShedError",
+    "DeadlineExceededError",
+)
+
+
+def _remote_error_kind(exc: Exception) -> Optional[str]:
+    if isinstance(exc, exceptions.TaskError):
+        tb = exc.remote_traceback or ""
+        for kind in _REMOTE_ERROR_KINDS:
+            if kind in tb:
+                return kind
+    return None
+
+
+class CircuitBreaker:
+    """Per-replica breaker: consecutive failures open it; after a cooldown
+    it half-opens (probe traffic allowed); one success closes it again.
+
+    States: 0=closed, 1=half-open, 2=open (the rt_serve_breaker_state
+    gauge uses the same encoding).
+    """
+
+    CLOSED, HALF_OPEN, OPEN = 0, 1, 2
+
+    def __init__(self, failure_threshold: int = 3, cooldown_s: float = 5.0):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._lock = threading.Lock()
+
+    def can_route(self) -> bool:
+        with self._lock:
+            if self.state == self.OPEN:
+                if time.monotonic() - self._opened_at >= self.cooldown_s:
+                    self.state = self.HALF_OPEN
+                    return True
+                return False
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self.state = self.CLOSED
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if (
+                self.state == self.HALF_OPEN
+                or self._failures >= self.failure_threshold
+            ):
+                self.state = self.OPEN
+                self._opened_at = time.monotonic()
+
+
+class _Attempt:
+    """One in-flight dispatch of a request onto a specific replica. Tracks
+    its router slot so every launched attempt releases exactly once."""
+
+    __slots__ = ("replica", "ref", "launched_at", "released", "discarded")
+
+    def __init__(self, replica: str, ref):
+        self.replica = replica
+        self.ref = ref
+        self.launched_at = time.monotonic()
+        self.released = False
+        self.discarded = False
+
 
 class DeploymentResponse:
     """Future for one deployment call; .result() blocks, passing the
-    response into another handle call chains through the object store."""
+    response into another handle call chains through the object store.
 
-    def __init__(self, ref, router: "Router", replica_name: str,
-                 deployment: str = "", retry=None):
-        self._ref = ref
+    Drives the retry/hedge state machine: replica deaths re-dispatch while
+    the RetryPolicy budget and the request Deadline both have room; a
+    timeout on a dead replica is a retriable death, a timeout on a live
+    replica is a DeadlineExceededError.
+    """
+
+    def __init__(self, handle: "DeploymentHandle", router: "Router",
+                 meta: RequestMetadata, args: tuple, kwargs: dict,
+                 deadline: Deadline, policy: RetryPolicy,
+                 first_attempt: _Attempt):
+        self._handle = handle
         self._router = router
-        self._replica_name = replica_name
-        self._deployment = deployment
-        # Zero-arg callable re-dispatching this request onto a healthy
-        # replica (set by DeploymentHandle.remote; the retried response
-        # carries retry=None so one request retries at most once).
-        self._retry = retry
+        self._meta = meta
+        self._args = args
+        self._kwargs = kwargs
+        self._deadline = deadline
+        self._policy = policy
+        self._attempts: list[_Attempt] = [first_attempt]
+        self._attempts_launched = 1
+        self._drain_retries = 0
+        self._hedged = False
+        self._deployment = handle.deployment_name
         self._done = False
 
-    def result(self, timeout: Optional[float] = 60.0) -> Any:
-        try:
-            value = ray_tpu.get(self._ref, timeout=timeout)
-        except _REPLICA_DEATH_ERRORS as exc:
-            return self._on_replica_death(exc, timeout)
-        except exceptions.GetTimeoutError as exc:
-            # A timeout on a DEAD replica is a lost request, not a slow
-            # one — probe liveness before surfacing a bare timeout.
-            if self._replica_alive():
-                self._mark_done()
-                raise
-            return self._on_replica_death(exc, timeout)
-        except Exception:
-            self._mark_done()
-            raise
-        if isinstance(value, dict) and "__serve_stream__" in value:
-            # Streaming deployment (generator handler): hand back an
-            # iterator that pulls batched chunks from the replica. The
-            # router's ongoing slot stays held until the stream ends —
-            # a live token stream IS an ongoing request.
-            return ResponseStream(self, value["__serve_stream__"])
-        self._mark_done()
-        return value
+    # Winning replica (stream pulls route here). Before a winner is known
+    # this is the primary attempt's replica.
+    @property
+    def _replica_name(self) -> str:
+        for att in self._attempts:
+            if not att.discarded:
+                return att.replica
+        return self._attempts[-1].replica if self._attempts else ""
 
-    def _replica_alive(self) -> bool:
+    # -- public API -----------------------------------------------------
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """Block for the call's value. ``timeout`` (when given) tightens
+        the propagated deadline; it can never extend it."""
+        deadline = self._deadline
+        if timeout is not None:
+            tightened = Deadline.after(timeout)
+            if tightened.at_monotonic < deadline.at_monotonic:
+                deadline = tightened
+        return self._await_result(deadline)
+
+    # -- state machine --------------------------------------------------
+    def _live_attempts(self) -> list[_Attempt]:
+        return [a for a in self._attempts if not a.discarded]
+
+    def _await_result(self, deadline: Deadline) -> Any:
+        from ray_tpu.util.backoff import Backoff
+
+        pol = self._policy
+        backoff = Backoff(
+            initial_backoff_s=pol.initial_backoff_s,
+            max_backoff_s=pol.max_backoff_s,
+        )
+        hedge_after = self._hedge_delay() if pol.hedge else None
+        while True:
+            live = self._live_attempts()
+            if not live:
+                live = [self._relaunch_or_raise(deadline, backoff, None)]
+            remaining = deadline.remaining()
+            if remaining <= 0.0:
+                return self._on_deadline_expired(deadline)
+            waits = [remaining]
+            hedge_due = False
+            if (
+                hedge_after is not None
+                and not self._hedged
+                and len(live) == 1
+                and self._attempts_launched < max(2, pol.max_attempts)
+            ):
+                until_hedge = (
+                    live[0].launched_at + hedge_after - time.monotonic()
+                )
+                if until_hedge <= 0.0:
+                    hedge_due = True
+                else:
+                    waits.append(until_hedge)
+            if hedge_due:
+                self._launch_hedge(deadline)
+                live = self._live_attempts()
+            ready, _ = ray_tpu.wait(
+                [a.ref for a in live],
+                num_returns=1,
+                timeout=max(0.01, min(waits)),
+            )
+            if not ready:
+                continue
+            att = next(a for a in live if a.ref == ready[0])
+            try:
+                value = ray_tpu.get(att.ref, timeout=deadline.remaining(cap=5.0) + 1.0)
+            except _REPLICA_DEATH_ERRORS as exc:
+                self._on_attempt_death(att, exc)
+                if not self._live_attempts():
+                    self._relaunch_or_raise(deadline, backoff, exc)
+                continue
+            except exceptions.GetTimeoutError:
+                # wait() said ready but the fetch stalled — treat like the
+                # deadline path on the next loop iteration.
+                continue
+            except Exception as exc:
+                kind = _remote_error_kind(exc)
+                if kind == "ReplicaDrainingError":
+                    self._on_attempt_draining(att, deadline, exc)
+                    continue
+                self._finish_all(winner=None)
+                if kind == "RequestShedError":
+                    raise exceptions.RequestShedError(
+                        f"replica of {self._deployment!r} shed the request"
+                    ) from exc
+                if kind == "DeadlineExceededError":
+                    inc_serve_reliability(
+                        "deadline_exceeded", deployment=self._deployment
+                    )
+                    raise exceptions.DeadlineExceededError(
+                        f"deadline expired inside {self._deployment!r}"
+                    ) from exc
+                raise
+            # Success on `att`.
+            self._router.breaker(att.replica).record_success()
+            self._finish_all(winner=att)
+            if isinstance(value, dict) and "__serve_stream__" in value:
+                # Streaming deployment (generator handler): hand back an
+                # iterator that pulls batched chunks from the replica. The
+                # router's ongoing slot stays held until the stream ends —
+                # a live token stream IS an ongoing request.
+                return ResponseStream(
+                    self, value["__serve_stream__"], att.replica, deadline
+                )
+            self._release(att)
+            self._router.note_latency(time.monotonic() - att.launched_at)
+            self._done = True
+            return value
+
+    def _hedge_delay(self) -> float:
+        if self._policy.hedge_after_s is not None:
+            return max(0.0, self._policy.hedge_after_s)
+        return self._router.observed_p95()
+
+    def _launch_hedge(self, deadline: Deadline) -> None:
+        self._hedged = True
+        primary = {a.replica for a in self._live_attempts()}
         try:
-            handle = self._router._replica_handle(self._replica_name)
-            ray_tpu.get(handle.check_health.remote(), timeout=5)
+            att = self._handle._launch_attempt(
+                self._router, self._meta, self._args, self._kwargs,
+                deadline, exclude=primary, attempt=self._attempts_launched,
+            )
+        except Exception:  # rtlint: disable=swallowed-exception - hedge is an optimization: no spare replica means no hedge, the primary attempt proceeds
+            inc_serve_reliability(
+                "hedges", deployment=self._deployment, outcome="skipped"
+            )
+            return
+        self._attempts.append(att)
+        self._attempts_launched += 1
+        inc_serve_reliability(
+            "hedges", deployment=self._deployment, outcome="launched"
+        )
+
+    def _relaunch_or_raise(self, deadline: Deadline, backoff,
+                           cause: Optional[Exception]) -> _Attempt:
+        """Dispatch a replacement attempt under the retry budget, or raise
+        the terminal error for this request."""
+        pol = self._policy
+        if (
+            self._attempts_launched >= max(1, pol.max_attempts)
+            or deadline.expired()
+        ):
+            last = self._attempts[-1] if self._attempts else None
+            raise exceptions.ReplicaDiedError(
+                self._deployment,
+                last.replica if last else "<none>",
+                f"retry budget exhausted after "
+                f"{self._attempts_launched} attempt(s)",
+            ) from cause
+        delay = backoff.next_delay(cap=deadline.remaining())
+        if delay > 0:
+            time.sleep(delay)
+        dead = {a.replica for a in self._attempts}
+        try:
+            att = self._handle._launch_attempt(
+                self._router, self._meta, self._args, self._kwargs,
+                deadline, exclude=dead, attempt=self._attempts_launched,
+            )
+        except Exception as exc:
+            last = self._attempts[-1] if self._attempts else None
+            raise exceptions.ReplicaDiedError(
+                self._deployment,
+                last.replica if last else "<none>",
+                f"retry dispatch failed: {exc}",
+            ) from (cause or exc)
+        self._attempts.append(att)
+        self._attempts_launched += 1
+        inc_serve_reliability(
+            "retries", deployment=self._deployment, reason="replica_death"
+        )
+        return att
+
+    def _on_attempt_death(self, att: _Attempt, exc: Exception) -> None:
+        self._discard(att)
+        self._router.breaker(att.replica).record_failure()
+        self._router.report_breaker(att.replica)
+        self._router.drop_replica(att.replica)
+
+    def _on_attempt_draining(self, att: _Attempt, deadline: Deadline,
+                             exc: Exception) -> None:
+        """Draining is deliberate (oom_risk / SIGTERM / scale-down): move
+        to another replica without charging the breaker or retry budget,
+        but bound the bounce count so a fully-draining fleet terminates."""
+        self._discard(att)
+        self._router.drop_replica(att.replica)
+        self._drain_retries += 1
+        if self._drain_retries > 8 or deadline.expired():
+            self._finish_all(winner=None)
+            raise exceptions.ReplicaDrainingError(att.replica) from exc
+        if self._live_attempts():
+            return
+        try:
+            fresh = self._handle._launch_attempt(
+                self._router, self._meta, self._args, self._kwargs,
+                deadline, exclude={a.replica for a in self._attempts},
+                attempt=self._attempts_launched,
+            )
+        except Exception:
+            self._finish_all(winner=None)
+            raise exceptions.ReplicaDrainingError(att.replica) from exc
+        self._attempts.append(fresh)
+        self._attempts_launched += 1
+        inc_serve_reliability(
+            "retries", deployment=self._deployment, reason="draining"
+        )
+
+    def _on_deadline_expired(self, deadline: Deadline) -> Any:
+        """Budget ran out with attempts still in flight. A timeout on a
+        DEAD replica is a lost request, not a slow one — probe liveness
+        (bounded by the configured probe timeout, not a hardcoded 5s)
+        before surfacing the deadline error."""
+        live = self._live_attempts()
+        primary = live[0] if live else None
+        self._finish_all(winner=None)
+        inc_serve_reliability(
+            "deadline_exceeded", deployment=self._deployment
+        )
+        if primary is not None and not self._replica_alive(primary.replica):
+            raise exceptions.ReplicaDiedError(
+                self._deployment, primary.replica,
+                "replica died and the deadline expired before a retry "
+                "could be dispatched",
+            )
+        raise exceptions.DeadlineExceededError(
+            f"deadline expired waiting on {self._deployment!r}"
+        )
+
+    def _replica_alive(self, replica_name: str) -> bool:
+        try:
+            handle = self._router._replica_handle(replica_name)
+            ray_tpu.get(
+                handle.check_health.remote(),
+                timeout=self._router.probe_timeout(),
+            )
             return True
         except Exception:  # rtlint: disable=swallowed-exception - health probe: any failure counts as dead
             return False
 
-    def _on_replica_death(self, exc: Exception, timeout) -> Any:
-        """The backing replica died mid-call: drop it from the router,
-        retry ONCE against a healthy replica, and if that is impossible
-        surface a typed ReplicaDiedError instead of the raw actor error
-        or a bare timeout."""
-        self._mark_done()
-        self._router.drop_replica(self._replica_name)
-        if self._retry is not None:
-            retry, self._retry = self._retry, None
+    # -- slot bookkeeping -----------------------------------------------
+    def _release(self, att: _Attempt) -> None:
+        if not att.released:
+            att.released = True
+            self._router.on_request_done(att.replica)
+
+    def _discard(self, att: _Attempt) -> None:
+        att.discarded = True
+        self._release(att)
+
+    def _finish_all(self, winner: Optional[_Attempt]) -> None:
+        """Settle every losing attempt: cancel best-effort, release its
+        router slot. The winner's slot stays held (streams keep it until
+        exhaustion; unary callers release right after)."""
+        for att in self._attempts:
+            if att is winner or att.discarded:
+                continue
+            att.discarded = True
             try:
-                fresh = retry()
-            except Exception as retry_exc:
-                raise exceptions.ReplicaDiedError(
-                    self._deployment, self._replica_name,
-                    f"retry dispatch failed: {retry_exc}",
-                ) from exc
-            return fresh.result(timeout=timeout)
-        raise exceptions.ReplicaDiedError(
-            self._deployment, self._replica_name, str(exc)
-        ) from exc
+                ray_tpu.cancel(att.ref)
+            except Exception:  # rtlint: disable=swallowed-exception - loser cancel is best-effort; the replica's stream reaper collects leftovers
+                pass
+            self._release(att)
+            if self._hedged:
+                inc_serve_reliability(
+                    "hedges", deployment=self._deployment, outcome="lost"
+                )
 
     def _mark_done(self):
+        """Release the winning attempt's slot (stream end / composition)."""
         if not self._done:
             self._done = True
-            self._router.on_request_done(self._replica_name)
+            for att in self._attempts:
+                self._release(att)
 
     def _to_object_ref(self):
         # Composed calls hand the ref downstream and never call
-        # .result(); release the router's ongoing slot now or the
+        # .result(); release the router's ongoing slots now or the
         # replica's count leaks permanently (router would declare
         # 'no available replica' after max_ongoing composed calls).
+        live = self._live_attempts()
+        ref = live[0].ref if live else self._attempts[-1].ref
         self._mark_done()
-        return self._ref
+        return ref
 
 
 class ResponseStream:
     """Iterator over a streaming deployment response (token streams).
 
     Pulls batched chunks via the replica's stream_next actor method;
-    releases the router's ongoing slot when the stream finishes.
+    releases the router's ongoing slot when the stream finishes. Every
+    pull timeout derives from the request's propagated Deadline.
     Role-equivalent of the reference's DeploymentResponseGenerator.
     """
 
-    def __init__(self, response: "DeploymentResponse", stream_id: str):
+    def __init__(self, response: "DeploymentResponse", stream_id: str,
+                 replica_name: str | None = None,
+                 deadline: Deadline | None = None):
         self._response = response
         self._stream_id = stream_id
+        self._replica_name = replica_name or response._replica_name
+        self._deadline = deadline or response._deadline
         self._buffer: list = []
         self._done = False
         self._error: str | None = None
-        self._timeout_s = 60.0
 
     def __iter__(self):
         return self
@@ -142,23 +473,25 @@ class ResponseStream:
 
     def _fill(self) -> None:
         """Pull chunks from the replica until the buffer is non-empty or
-        the stream ends."""
+        the stream ends. Each pull is bounded by the remaining request
+        deadline (no more hardcoded `timeout + 30` slack)."""
         router = self._response._router
-        replica = router._replica_handle(self._response._replica_name)
-        deadline = time.monotonic() + self._timeout_s
+        replica = router._replica_handle(self._replica_name)
         while not self._buffer and not self._done:
+            if self._deadline.expired():
+                self.cancel()
+                raise exceptions.DeadlineExceededError(
+                    "stream stalled past the request deadline"
+                )
             chunk = ray_tpu.get(
                 replica.stream_next.remote(self._stream_id),
-                timeout=self._timeout_s + 30,
+                timeout=max(0.05, self._deadline.remaining()),
             )
             self._buffer.extend(chunk.get("items", []))
             if chunk.get("done"):
                 self._done = True
                 self._error = chunk.get("error")
                 self._response._mark_done()
-            elif time.monotonic() > deadline and not self._buffer:
-                self.cancel()
-                raise TimeoutError("stream stalled")
 
     def __next__(self):
         if self._buffer:
@@ -188,12 +521,27 @@ class ResponseStream:
             self._done = True
             router = self._response._router
             try:
-                replica = router._replica_handle(self._response._replica_name)
+                replica = router._replica_handle(self._replica_name)
                 ray_tpu.get(
-                    replica.stream_cancel.remote(self._stream_id), timeout=30
+                    replica.stream_cancel.remote(self._stream_id),
+                    timeout=max(
+                        router.probe_timeout(),
+                        self._deadline.remaining(cap=10.0),
+                    ),
                 )
-            except Exception:  # rtlint: disable=swallowed-exception - replica died; the stream is already torn down
-                pass
+            except Exception as exc:
+                # Cleanup failure is survivable (the replica's stream
+                # reaper collects leftovers) but never silent: it leaks a
+                # server-side buffer until then (PR-9 swallowed-exception
+                # rule).
+                logger.debug(
+                    "stream_cancel for stream %s on %s failed: %s",
+                    self._stream_id, self._replica_name, exc,
+                )
+                inc_serve_reliability(
+                    "stream_cancel_failures",
+                    deployment=self._response._deployment,
+                )
             self._response._mark_done()
 
 
@@ -201,6 +549,8 @@ class Router:
     """Pow-2 replica choice with cached membership + local queue counts."""
 
     REFRESH_INTERVAL_S = 1.0
+    # Hedge delay fallback until enough latency samples exist.
+    DEFAULT_P95_S = 1.0
 
     def __init__(self, deployment: str, app_name: str):
         self.deployment = deployment
@@ -214,13 +564,60 @@ class Router:
         # stale snapshot and the death-retry path would re-pick it.
         self._banned: dict[str, float] = {}
         self._max_ongoing = 100
+        # Deployment policy subset published with the membership snapshot
+        # (timeouts, retry policy, admission allowance) — replaces the old
+        # scattered hardcoded constants.
+        self._policy: dict = {}
         self._last_refresh = 0.0
         self._lock = threading.Lock()
+        # Per-replica circuit breakers (ISSUE 13): consecutive dispatch/
+        # completion failures open the breaker and take the replica out of
+        # the candidate set until its cooldown half-opens it.
+        self._breakers: dict[str, CircuitBreaker] = {}
+        # Completed-request latency reservoir for the hedge trigger.
+        self._latencies: collections.deque = collections.deque(maxlen=128)
         # Compile-cache-aware stickiness (SURVEY §3.4): per-replica warm
         # shape keys, polled lazily once any caller routes by shape_key.
         self._warm: dict[str, set] = {}
         self._warm_ts = 0.0
 
+    # -- policy ---------------------------------------------------------
+    def policy(self) -> dict:
+        with self._lock:
+            return dict(self._policy)
+
+    def retry_policy(self) -> RetryPolicy:
+        return RetryPolicy.from_dict(self.policy().get("retry_policy", {}))
+
+    def request_timeout_s(self) -> float:
+        return float(self.policy().get("request_timeout_s", 60.0))
+
+    def probe_timeout(self) -> float:
+        return float(self.policy().get("health_probe_timeout_s", 5.0))
+
+    def breaker(self, actor_name: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(actor_name)
+            if br is None:
+                br = self._breakers[actor_name] = CircuitBreaker()
+            return br
+
+    def report_breaker(self, actor_name: str) -> None:
+        br = self.breaker(actor_name)
+        set_serve_breaker_state(self._qualified, actor_name, br.state)
+
+    def note_latency(self, seconds: float) -> None:
+        self._latencies.append(seconds)
+
+    def observed_p95(self) -> float:
+        """Route-local p95 of completed request latencies; seeds the hedge
+        trigger when RetryPolicy.hedge_after_s is unset."""
+        samples = sorted(self._latencies)
+        if len(samples) < 8:
+            return self.DEFAULT_P95_S
+        return samples[min(len(samples) - 1, int(0.95 * len(samples)))]
+
+    # -- membership -----------------------------------------------------
     def _refresh(self, force: bool = False) -> None:
         """Membership comes from the process-wide long-poll subscriber
         (push, no RPC); force=True short-circuits with a direct snapshot
@@ -244,6 +641,7 @@ class Router:
                 if name not in self._banned
             ]
             self._max_ongoing = info.get("max_ongoing_requests", 100)
+            self._policy = info.get("policy", self._policy)
             for name in self._replicas:
                 self._ongoing.setdefault(name, 0)
 
@@ -296,12 +694,25 @@ class Router:
                 else:
                     self._warm[name] = warm
 
-    def choose_replica(self, shape_key: str | None = None) -> str:
-        deadline = time.monotonic() + 30.0
+    def choose_replica(self, shape_key: str | None = None,
+                       deadline: Deadline | None = None,
+                       exclude: set | frozenset = frozenset()) -> str:
+        """Pick a replica and take an ongoing slot on it. The wait for
+        capacity/membership is bounded by the request's Deadline (the old
+        hardcoded 30s); ``exclude`` supports hedging and death retries."""
+        deadline = deadline or Deadline.after(self.request_timeout_s())
         while True:
             self._refresh()
             with self._lock:
-                candidates = list(self._replicas)
+                candidates = [
+                    c for c in self._replicas if c not in exclude
+                ]
+            # Breaker gate: flapping replicas drop out of the candidate
+            # set; when EVERY candidate's breaker is open, fall through
+            # with the full set (half-open probes beat a guaranteed error).
+            routable = [c for c in candidates if self.breaker(c).can_route()]
+            if routable:
+                candidates = routable
             if candidates and shape_key:
                 self._refresh_warm(candidates)
                 warm = [
@@ -326,12 +737,12 @@ class Router:
                     with self._lock:
                         self._ongoing[pick] = self._ongoing.get(pick, 0) + 1
                     return pick
-            if time.monotonic() > deadline:
+            if deadline.expired():
                 raise RuntimeError(
                     f"no available replica for {self._qualified} "
                     f"(backpressure or scale-to-zero)"
                 )
-            time.sleep(0.05)
+            time.sleep(min(0.05, max(0.005, deadline.remaining())))
             self._refresh(force=True)
 
     def on_request_done(self, actor_name: str) -> None:
@@ -382,6 +793,19 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         router = self._get_router()
+        # Pull membership/policy BEFORE seeding the deadline: a fresh
+        # router has no policy yet and would price the budget off the
+        # 60s default instead of the deployment's request_timeout_s.
+        router._refresh()
+        if not router.policy():
+            router._refresh(force=True)
+        # The ambient deadline (set by the proxy from the ingress header,
+        # or by an enclosing replica call) wins; otherwise this call is
+        # the ingress and seeds one from deployment config.
+        deadline = current_deadline() or Deadline.after(
+            router.request_timeout_s()
+        )
+        policy = router.retry_policy()
         meta = RequestMetadata(
             method_name=self._method_name, multiplexed_model_id=self._model_id
         )
@@ -391,21 +815,45 @@ class DeploymentHandle:
             a._to_object_ref() if isinstance(a, DeploymentResponse) else a
             for a in args
         )
+        from ray_tpu.util.backoff import Backoff
+
+        backoff = Backoff(
+            initial_backoff_s=policy.initial_backoff_s,
+            max_backoff_s=policy.max_backoff_s,
+        )
+        attempts = 0
         last_exc: Exception | None = None
-        for _ in range(3):
+        # Dispatch the FIRST attempt under the same budget as re-dispatch:
+        # a replica dying between refresh and call costs one attempt.
+        while True:
             try:
-                return self._dispatch_once(router, meta, args, kwargs,
-                                           allow_retry=True)
-            except Exception as exc:  # replica died between refresh and call
+                first = self._launch_attempt(
+                    router, meta, args, kwargs, deadline, attempt=attempts,
+                )
+                break
+            except Exception as exc:
+                attempts += 1
                 last_exc = exc
-        raise RuntimeError(
-            f"could not dispatch to {self.deployment_name}: {last_exc}"
+                if attempts >= max(1, policy.max_attempts) or deadline.expired():
+                    raise RuntimeError(
+                        f"could not dispatch to {self.deployment_name}: "
+                        f"{last_exc}"
+                    ) from last_exc
+                time.sleep(backoff.next_delay(cap=deadline.remaining()))
+        return DeploymentResponse(
+            self, router, meta, args, kwargs, deadline, policy, first,
         )
 
-    def _dispatch_once(self, router, meta, args, kwargs,
-                       allow_retry: bool) -> DeploymentResponse:
+    def _launch_attempt(self, router: Router, meta: RequestMetadata,
+                        args: tuple, kwargs: dict, deadline: Deadline,
+                        exclude: set | frozenset = frozenset(),
+                        attempt: int = 0) -> _Attempt:
+        """One dispatch onto a chosen replica; takes (and on failure
+        releases) the replica's ongoing slot."""
         replica_name = router.choose_replica(
-            shape_key=self._shape_key or None
+            shape_key=self._shape_key or None,
+            deadline=deadline,
+            exclude=exclude,
         )
         try:
             replica = router._replica_handle(replica_name)
@@ -420,6 +868,10 @@ class DeploymentHandle:
                     "method_name": meta.method_name,
                     "multiplexed_model_id": meta.multiplexed_model_id,
                     "shape_key": self._shape_key,
+                    # The remaining budget travels as a relative duration;
+                    # the replica re-anchors it on its own clock.
+                    "deadline_budget_s": deadline.budget(),
+                    "attempt": attempt,
                     # Serve-level trace propagation: the proxy's (or any
                     # caller's) current span becomes the replica span's
                     # parent across the actor-call boundary.
@@ -432,18 +884,7 @@ class DeploymentHandle:
             router.on_request_done(replica_name)
             router.drop_replica(replica_name)
             raise
-        # The response can re-dispatch itself ONCE onto another replica if
-        # this one dies mid-call (retry=None on the retried response).
-        retry = (
-            (lambda: self._dispatch_once(router, meta, args, kwargs,
-                                         allow_retry=False))
-            if allow_retry
-            else None
-        )
-        return DeploymentResponse(
-            ref, router, replica_name,
-            deployment=self.deployment_name, retry=retry,
-        )
+        return _Attempt(replica_name, ref)
 
     def __reduce__(self):
         return (_rebuild_handle, (self.deployment_name, self.app_name,

@@ -25,6 +25,40 @@ def get_multiplexed_model_id() -> str:
     return meta.get("multiplexed_model_id", "")
 
 
+# Every @multiplexed decorator's cache map, so a draining replica can
+# checkpoint its loaded models before the process exits (ISSUE 13).
+_ALL_CACHES: list = []
+
+
+async def checkpoint_loaded_models() -> int:
+    """Call ``checkpoint``/``__serve_checkpoint__`` on every model loaded
+    through @multiplexed in this process. Returns how many models were
+    checkpointed; per-model failures are logged and skipped (a drain must
+    not wedge on one broken model)."""
+    import logging
+
+    count = 0
+    for caches in _ALL_CACHES:
+        for cache in caches.values():
+            for model_id, model in list(cache.items()):
+                hook = getattr(model, "checkpoint", None) or getattr(
+                    model, "__serve_checkpoint__", None
+                )
+                if hook is None:
+                    continue
+                try:
+                    result = hook()
+                    if inspect.iscoroutine(result):
+                        await result
+                    count += 1
+                except Exception as exc:
+                    logging.getLogger(__name__).warning(
+                        "checkpoint of multiplexed model %r failed: %s",
+                        model_id, exc,
+                    )
+    return count
+
+
 def multiplexed(
     _fn: Callable | None = None, *, max_num_models_per_replica: int = 3
 ):
@@ -33,6 +67,7 @@ def multiplexed(
     def decorator(load_fn: Callable):
         caches: dict[int, "collections.OrderedDict"] = {}
         locks: dict[int, asyncio.Lock] = {}
+        _ALL_CACHES.append(caches)
 
         @functools.wraps(load_fn)
         async def wrapper(*args):

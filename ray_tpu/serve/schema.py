@@ -37,12 +37,22 @@ class DeploymentSchema:
     user_config: Any = None
     autoscaling_config: Optional[dict] = None
     ray_actor_options: Optional[dict] = None
+    # Reliability knobs (ISSUE 13): request deadline seed, health-probe
+    # timeout, admission queue allowance, retry/hedge policy, drain budget.
+    request_timeout_s: Optional[float] = None
+    health_probe_timeout_s: Optional[float] = None
+    max_queued_requests: Optional[int] = None
+    retry_policy: Optional[dict] = None
+    graceful_shutdown_timeout_s: Optional[float] = None
 
     def overrides(self) -> dict:
         out: dict = {}
         for field in (
             "num_replicas", "max_ongoing_requests", "user_config",
             "autoscaling_config", "ray_actor_options",
+            "request_timeout_s", "health_probe_timeout_s",
+            "max_queued_requests", "retry_policy",
+            "graceful_shutdown_timeout_s",
         ):
             value = getattr(self, field)
             if value is not None:
@@ -76,6 +86,9 @@ class ServeApplicationSchema:
 class HTTPOptionsSchema:
     host: str = "127.0.0.1"
     port: int = 8000
+    # Multi-proxy ingress (ISSUE 13): N proxies on consecutive ports,
+    # health-checked and restarted by the controller.
+    num_proxies: int = 1
 
 
 @dataclasses.dataclass
@@ -167,7 +180,9 @@ def deploy_from_config(schema: ServeDeploySchema) -> dict:
     from ray_tpu.serve import api
 
     api.start(
-        http_host=schema.http_options.host, http_port=schema.http_options.port
+        http_host=schema.http_options.host,
+        http_port=schema.http_options.port,
+        num_proxies=schema.http_options.num_proxies,
     )
     deployed = {}
     for app_schema in schema.applications:

@@ -36,10 +36,15 @@ class Backoff:
     def __post_init__(self) -> None:
         self._ceiling = self.initial_backoff_s
 
-    def next_delay(self) -> float:
+    def next_delay(self, cap: float | None = None) -> float:
+        """Sample the next delay. ``cap`` bounds the sample from above —
+        serve retry loops pass the request's remaining deadline so a
+        backoff never sleeps past the budget it is trying to spend."""
         delay = random.uniform(0, self._ceiling)
         self._ceiling = min(self._ceiling * 2, self.max_backoff_s)
         self.attempts += 1
+        if cap is not None:
+            delay = min(delay, max(0.0, cap))
         return delay
 
     def sleep(self) -> float:

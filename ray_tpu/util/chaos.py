@@ -75,10 +75,11 @@ class ChaosMonkey:
     Each kill entry::
 
         {"at_s": 3.0,                 # offset from monkey start
-         "target": "controller"       # or "agent:<idx>" or "worker"
+         "target": "controller"       # or "agent:<idx>", "worker", "actor"
          "index": 0,                  # worker kills: deterministic victim
          "agent": 0,                  # worker kills: which agent to ask
          "prefer": "actor",           # worker kills: prefer actor workers
+         "name": "SERVE_PROXY::8000", # actor kills: the named actor
          "restart_after_s": 2.0}      # controller only: restart delay
 
     Worker kills go through the agent's ``chaos_kill_worker`` RPC (the
@@ -148,6 +149,18 @@ class ChaosMonkey:
             _, _, raw_index = target.partition(":")
             self.cluster.kill_agent(int(raw_index or 0))
             self.events.append({"kill": kill, "status": "ok"})
+            return
+        if target == "actor":
+            # Named-actor kill (ISSUE 13): takes down serve proxies /
+            # replicas / any detached actor by registry name, exercising
+            # the controller's restart + client-failover paths.
+            import ray_tpu
+
+            name = kill["name"]
+            ray_tpu.kill(ray_tpu.get_actor(name))
+            self.events.append(
+                {"kill": kill, "status": "ok", "actor_name": name}
+            )
             return
         # Worker kill: ask the agent over a blocking wire-v1 client (this
         # thread has no asyncio loop).

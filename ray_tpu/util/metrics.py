@@ -250,6 +250,43 @@ def record_serve_request(route: str, seconds: float, status: str) -> None:
     _serve_requests.inc(1, tags={"route": route, "status": str(status)})
 
 
+_serve_reliability_counters: dict[str, Counter] = {}
+
+# Reliability event counters (ISSUE 13): every self-healing action on the
+# serve path is countable, so "did the breaker trip / did we shed" is a
+# dashboard query. Tag vocabulary is fixed per name below.
+_SERVE_RELIABILITY_TAGS = {
+    "retries": ("deployment", "reason"),
+    "hedges": ("deployment", "outcome"),
+    "shed": ("route", "where"),
+    "drains": ("deployment", "trigger"),
+    "stream_cancel_failures": ("deployment",),
+    "proxy_restarts": ("proxy",),
+    "deadline_exceeded": ("deployment",),
+}
+
+
+def inc_serve_reliability(name: str, n: int = 1, **tags: str) -> None:
+    """Increment rt_serve_<name>_total (retries, hedges, shed, drains,
+    stream_cancel_failures, proxy_restarts, deadline_exceeded)."""
+    counter = _serve_reliability_counters.get(name)
+    if counter is None:
+        counter = _serve_reliability_counters[name] = Counter(
+            f"rt_serve_{name}_total",
+            description=f"Serve reliability events: {name.replace('_', ' ')}",
+            tag_keys=_SERVE_RELIABILITY_TAGS.get(name, ()),
+        )
+    counter.inc(n, tags={k: str(v) for k, v in tags.items()})
+
+
+def set_serve_breaker_state(
+    deployment: str, replica_id: str, state: int
+) -> None:
+    """rt_serve_breaker_state{deployment,replica}: 0=closed, 1=half-open,
+    2=open. A per-replica circuit breaker state transition gauge."""
+    set_serve_replica_gauge("breaker_state", deployment, replica_id, state)
+
+
 def set_serve_replica_gauge(
     name: str, deployment: str, replica_id: str, value: float
 ) -> None:

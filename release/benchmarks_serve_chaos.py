@@ -1,0 +1,333 @@
+"""Serve-plane chaos benchmark (ISSUE 13 acceptance gate).
+
+Reference-equivalent: release/serve_tests/ chaos + long-running failure
+suites. Three phases against one serve app behind TWO ingress proxies:
+
+  1. baseline  — steady load, no faults; records the no-chaos p99.
+  2. chaos     — the ChaosMonkey SIGKILLs one REPLICA and one PROXY by
+                 actor name mid-load. Clients are real multi-ingress
+                 clients: they alternate proxy ports on connect errors
+                 and honor 503 Retry-After (sheds are counted, never
+                 lost). Any other 5xx counts as a LOST request.
+  3. drain     — a synthetic oom_risk event (the ISSUE-5 node-agent
+                 wire format) lands in the session's event log naming
+                 the replicas' node; the controller must drain them
+                 (finish in-flight, then replace) while light load
+                 keeps flowing without a single lost request.
+
+Gates (release_tests.yaml): lost == 0 through all phases, at least one
+replica kill and one proxy kill actually landed, chaos-phase p99 stays
+under 3x the baseline p99, and the oom drain replaces every flagged
+replica (drain_ok).
+
+Prints one JSON line:
+  {"lost": 0, "shed": ..., "p99_ratio": ..., "replica_kills": 1,
+   "proxy_kills": 1, "drain_ok": 1, ...}
+"""
+
+import json
+import sys
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from bench_env import force_cpu
+
+force_cpu()
+
+import concurrent.futures
+import os
+import threading
+import time
+
+PORTS = (8201, 8202)
+
+
+class LoadStats:
+    """Thread-safe tallies for one load phase."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: list[float] = []
+        self.shed = 0
+        self.lost = 0
+        self.lost_detail: list[str] = []
+
+    def p99_ms(self) -> float:
+        if not self.latencies:
+            return 0.0
+        xs = sorted(self.latencies)
+        return 1e3 * xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+
+
+def _one_request(client, payload, stats: LoadStats, deadline: float):
+    """One LOGICAL request: alternate ingress ports until a 2xx, as a
+    real multi-proxy client would. Connect errors fail over; 503s back
+    off per Retry-After (counted as shed, not lost); any other 5xx is a
+    lost request — the thing this benchmark exists to flag."""
+    import httpx
+
+    start = time.perf_counter()
+    while time.perf_counter() < deadline + 30:
+        for port in PORTS:
+            try:
+                resp = client.post(
+                    f"http://127.0.0.1:{port}/chaosbench",
+                    json=payload, timeout=15,
+                )
+            except httpx.HTTPError:
+                continue  # proxy down: fail over to the sibling
+            if resp.status_code == 200:
+                with stats.lock:
+                    stats.latencies.append(time.perf_counter() - start)
+                return resp.json()
+            if resp.status_code == 503:
+                with stats.lock:
+                    stats.shed += 1
+                time.sleep(float(resp.headers.get("Retry-After", 0.2)))
+                continue
+            with stats.lock:
+                stats.lost += 1
+                stats.lost_detail.append(
+                    f"HTTP {resp.status_code}: {resp.text[:120]}"
+                )
+            return None
+        time.sleep(0.1)
+    with stats.lock:
+        stats.lost += 1
+        stats.lost_detail.append("client gave up: no 2xx before deadline")
+    return None
+
+
+def _run_load(seconds: float, concurrency: int) -> LoadStats:
+    import httpx
+
+    stats = LoadStats()
+    deadline = time.perf_counter() + seconds
+
+    def worker(i: int):
+        with httpx.Client() as client:
+            n = 0
+            while time.perf_counter() < deadline:
+                _one_request(
+                    client, {"v": i * 100000 + n}, stats, deadline
+                )
+                n += 1
+
+    with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
+        futures = [pool.submit(worker, i) for i in range(concurrency)]
+        for future in futures:
+            future.result()
+    return stats
+
+
+def _inject_oom_risk(node_id: str) -> None:
+    """Write an oom_risk event in the node-agent wire format straight
+    into the session event log — the same file the agent's memory
+    projector appends to (reference: the elastic-trainer drain test)."""
+    import ray_tpu
+
+    session_dir = os.environ.get(
+        "RAYTPU_SESSION_DIR"
+    ) or ray_tpu.runtime_info().get("session_dir")
+    assert session_dir, "no session_dir: cannot inject oom_risk"
+    events_dir = os.path.join(session_dir, "events")
+    os.makedirs(events_dir, exist_ok=True)
+    record = {
+        "event_id": "serve-chaos-bench-oom-1",
+        "source_type": "oom_risk",
+        "timestamp": time.time(),
+        "severity": "WARNING",
+        "data": {"node_id": node_id},
+    }
+    with open(
+        os.path.join(events_dir, "events_oom_risk.jsonl"), "a"
+    ) as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def main(seconds: float = 8.0, concurrency: int = 8):
+    import bench_env
+    if bench_env.smoke():
+        seconds, concurrency = 4.0, 4
+
+    import httpx
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve._private.long_poll import get_subscriber
+    from ray_tpu.util.chaos import ChaosMonkey, FaultSchedule
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8)
+
+    serve.start(http_port=PORTS[0], num_proxies=len(PORTS))
+
+    @serve.deployment(
+        num_replicas=2,
+        health_check_period_s=1.0,
+        request_timeout_s=30.0,
+        retry_policy={"max_attempts": 8},
+        max_ongoing_requests=32,
+    )
+    class Worker:
+        def __call__(self, body):
+            body = body or {}
+            if body.get("op") == "node_id":
+                return {"node_id": os.environ.get("RAYTPU_NODE_ID", "")}
+            # A sliver of real work so latency isn't pure dispatch.
+            acc = 0
+            for i in range(2000):
+                acc += i * i
+            return {"v": body.get("v"), "acc": acc % 97}
+
+    serve.run(
+        Worker.bind(), name="chaosbench", route_prefix="/chaosbench",
+        http_port=PORTS[0],
+    )
+    assert httpx.post(
+        f"http://127.0.0.1:{PORTS[0]}/chaosbench", json={"v": -1},
+        timeout=60,
+    ).status_code == 200  # warm: deploy + route publish done
+
+    def running_replicas() -> int:
+        return (
+            serve.status()
+            .get("chaosbench", {})
+            .get("deployments", {})
+            .get("Worker", {})
+            .get("running_replicas", 0)
+        )
+
+    def wait_recovered(want: int, timeout_s: float = 90.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if running_replicas() >= want:
+                return True
+            time.sleep(0.5)
+        return False
+
+    # ---- phase 1: baseline --------------------------------------------
+    baseline = _run_load(seconds, concurrency)
+
+    # ---- phase 2: replica + proxy kills mid-load ----------------------
+    sub = get_subscriber()
+    sub.force_refresh()
+    replica_names = sorted(
+        sub.get_replicas("chaosbench_Worker")["actor_names"]
+    )
+    assert len(replica_names) == 2, replica_names
+    schedule = FaultSchedule(
+        seed=0,
+        kills=[
+            {"at_s": 1.0, "target": "actor", "name": replica_names[0]},
+            {
+                "at_s": 2.5, "target": "actor",
+                "name": f"SERVE_PROXY::{PORTS[1]}",
+            },
+        ],
+    )
+    monkey = ChaosMonkey(None, schedule).start()
+    chaos = _run_load(seconds, concurrency)
+    monkey.join(timeout=30)
+    replica_kills = sum(
+        1 for e in monkey.events
+        if e.get("status") == "ok"
+        and e.get("actor_name") in replica_names
+    )
+    proxy_kills = sum(
+        1 for e in monkey.events
+        if e.get("status") == "ok"
+        and str(e.get("actor_name", "")).startswith("SERVE_PROXY::")
+    )
+
+    # Controller must replace the corpse replica and restart the proxy.
+    recovered = wait_recovered(2)
+    proxy_back = False
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        try:
+            if httpx.get(
+                f"http://127.0.0.1:{PORTS[1]}/-/healthz", timeout=5
+            ).text == "ok":
+                proxy_back = True
+                break
+        except httpx.HTTPError:
+            time.sleep(0.5)
+
+    # ---- phase 3: oom_risk-triggered drain ----------------------------
+    with httpx.Client() as client:
+        node_id = _one_request(
+            client, {"op": "node_id"},
+            LoadStats(), time.perf_counter() + 60,
+        )["node_id"]
+    sub.force_refresh()
+    before = set(sub.get_replicas("chaosbench_Worker")["actor_names"])
+    _inject_oom_risk(node_id)
+
+    # Light load through the drain: every request must still succeed
+    # while the flagged replicas finish in-flight work and replacements
+    # spin up.
+    drain_stats = LoadStats()
+    stop_load = threading.Event()
+
+    def drain_loader():
+        with httpx.Client() as client:
+            n = 0
+            while not stop_load.is_set():
+                _one_request(
+                    client, {"v": n}, drain_stats,
+                    time.perf_counter() + 60,
+                )
+                n += 1
+                time.sleep(0.05)
+
+    loader = threading.Thread(target=drain_loader, daemon=True)
+    loader.start()
+    replaced = False
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        sub.force_refresh()
+        now_names = set(
+            sub.get_replicas("chaosbench_Worker")["actor_names"]
+        )
+        # Drain complete = every flagged replica left the routing set
+        # and the deployment is back at target size with fresh actors.
+        if now_names and not (now_names & before):
+            if running_replicas() >= 2:
+                replaced = True
+                break
+        time.sleep(0.5)
+    stop_load.set()
+    loader.join(timeout=30)
+    drain_ok = int(replaced and drain_stats.lost == 0)
+
+    lost = baseline.lost + chaos.lost + drain_stats.lost
+    shed = baseline.shed + chaos.shed + drain_stats.shed
+    base_p99 = baseline.p99_ms()
+    chaos_p99 = chaos.p99_ms()
+    detail = (
+        baseline.lost_detail + chaos.lost_detail + drain_stats.lost_detail
+    )
+    print(json.dumps(
+        {
+            "benchmark": "serve_chaos",
+            "requests": (
+                len(baseline.latencies) + len(chaos.latencies)
+                + len(drain_stats.latencies)
+            ),
+            "lost": lost,
+            "shed": shed,
+            "baseline_p99_ms": round(base_p99, 2),
+            "chaos_p99_ms": round(chaos_p99, 2),
+            "p99_ratio": round(chaos_p99 / base_p99, 3) if base_p99 else 0.0,
+            "replica_kills": replica_kills,
+            "proxy_kills": proxy_kills,
+            "replicas_recovered": int(recovered),
+            "proxy_restarted": int(proxy_back),
+            "drain_ok": drain_ok,
+            "lost_detail": detail[:5],
+        }
+    ))
+    serve.shutdown()
+
+
+if __name__ == "__main__":
+    main()

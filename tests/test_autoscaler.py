@@ -1,51 +1,106 @@
-"""Autoscaler e2e — real autoscaler loop, fake provider launching
-in-process nodes (reference: test_autoscaler_fake_multinode.py)."""
+"""Autoscaler tests: the serve autoscaling policy tested pure and
+table-driven (bounds clamping, scale-to-zero, cooldown/hysteresis, queue
+demand, SLO-histogram input), plus the cluster autoscaler e2e — real
+autoscaler loop, fake provider launching in-process nodes (reference:
+test_autoscaler_fake_multinode.py)."""
 
 import time
 
+import pytest
+
 import ray_tpu
+from ray_tpu.serve._private.autoscaling_policy import (
+    AutoscalingState,
+    calculate_desired_num_replicas,
+)
+from ray_tpu.serve._private.common import AutoscalingConfig
 
-def test_autoscaler_fake_provider():
-    """Reference: test_autoscaler_fake_multinode.py — real autoscaler loop,
-    fake nodes (in-process raylets) on one machine."""
-    from ray_tpu.autoscaler import (
-        AutoscalerConfig, FakeNodeProvider, NodeTypeConfig, StandardAutoscaler,
-    )
-    from ray_tpu.cluster_utils import Cluster
 
-    assert not ray_tpu.is_initialized()
-    cluster = Cluster(
-        initialize_head=True, head_node_args={"resources": {"CPU": 1}}
+# ---------- serve policy: pure, table-driven (ISSUE 13 satellite) ----------
+
+_BASE = dict(min_replicas=1, max_replicas=10, target_ongoing_requests=2.0)
+
+
+@pytest.mark.parametrize(
+    "label, cfg_kwargs, ongoing, current, queue, p99_ms, expected",
+    [
+        # bounds clamping
+        ("min clamp", _BASE, 0.0, 1, 0.0, None, 1),
+        ("max clamp", _BASE, 100.0, 2, 0.0, None, 10),
+        ("steady state", _BASE, 4.0, 2, 0.0, None, 2),
+        ("proportional up", _BASE, 8.0, 2, 0.0, None, 4),
+        ("scale down", _BASE, 2.0, 4, 0.0, None, 1),
+        # scale-to-zero (min_replicas=0)
+        ("idle to zero", dict(_BASE, min_replicas=0), 0.0, 3, 0.0, None, 0),
+        ("zero stays zero", dict(_BASE, min_replicas=0), 0.0, 0, 0.0, None, 0),
+        ("wake from zero", dict(_BASE, min_replicas=0), 1.0, 0, 0.0, None, 1),
+        ("queue wakes zero", dict(_BASE, min_replicas=0), 0.0, 0, 1.0, None, 1),
+        # queued-but-unstarted demand counts with queue_weight
+        ("queue adds demand", _BASE, 4.0, 2, 4.0, None, 4),
+        ("queue_weight scales",
+         dict(_BASE, queue_weight=0.5), 4.0, 2, 4.0, None, 3),
+        ("queue_weight off",
+         dict(_BASE, queue_weight=0.0), 4.0, 2, 100.0, None, 2),
+        # SLO-histogram input: p99 over budget forces >= +1 replica even
+        # when ongoing counts look healthy
+        ("slo breach upscales",
+         dict(_BASE, slo_p99_ms=100.0), 4.0, 2, 0.0, 250.0, 3),
+        ("slo healthy no-op",
+         dict(_BASE, slo_p99_ms=100.0), 4.0, 2, 0.0, 50.0, 2),
+        ("slo unset ignores p99", _BASE, 4.0, 2, 0.0, 9999.0, 2),
+        ("slo breach still max-clamped",
+         dict(_BASE, max_replicas=2, slo_p99_ms=100.0), 4.0, 2, 0.0, 500.0, 2),
+        # smoothing factors damp the step
+        ("downscale smoothing",
+         dict(_BASE, downscale_smoothing_factor=0.5), 2.0, 4, 0.0, None, 3),
+        ("upscale smoothing",
+         dict(_BASE, upscale_smoothing_factor=0.5), 8.0, 2, 0.0, None, 3),
+    ],
+    ids=lambda v: v if isinstance(v, str) else None,
+)
+def test_policy_table(label, cfg_kwargs, ongoing, current, queue, p99_ms,
+                      expected):
+    cfg = AutoscalingConfig(**cfg_kwargs)
+    got = calculate_desired_num_replicas(
+        cfg, ongoing, current, queue_depth=queue, p99_ms=p99_ms
     )
-    ray_tpu.init(address=cluster.address)
-    try:
-        provider = FakeNodeProvider(cluster)
-        autoscaler = StandardAutoscaler(
-            AutoscalerConfig(
-                node_types=[NodeTypeConfig("cpu2", {"CPU": 2}, max_workers=3)],
-                idle_timeout_s=3600,
-                update_interval_s=0.25,
-            ),
-            provider,
+    assert got == expected, f"{label}: expected {expected}, got {got}"
+
+
+def test_policy_cooldown_and_hysteresis():
+    """Upscale/downscale proposals only apply after their delay holds
+    continuously; a changed proposal resets the clock (hysteresis), and
+    direction-specific delays differ."""
+    cfg = AutoscalingConfig(
+        min_replicas=1, max_replicas=10, target_ongoing_requests=1.0,
+        upscale_delay_s=5.0, downscale_delay_s=30.0,
+    )
+    state = AutoscalingState(cfg)
+    # Sustained overload: applied only after upscale_delay_s.
+    assert state.decide(6.0, 2, now=0.0) == 2
+    assert state.decide(6.0, 2, now=4.9) == 2
+    assert state.decide(6.0, 2, now=5.1) == 6
+    # Load vanishes: the (longer) downscale delay gates the shrink.
+    assert state.decide(0.0, 6, now=6.0) == 6
+    assert state.decide(0.0, 6, now=20.0) == 6
+    # Flapping demand resets the pending proposal before it lands.
+    assert state.decide(6.0, 6, now=25.0) == 6  # back to steady: no change
+    assert state.decide(0.0, 6, now=26.0) == 6  # downscale clock restarts
+    assert state.decide(0.0, 6, now=55.0) == 6  # 29s < 30s: still held
+    assert state.decide(0.0, 6, now=56.5) == 1
+    # Queue + SLO inputs flow through decide() the same as ongoing load.
+    slo_state = AutoscalingState(
+        AutoscalingConfig(
+            min_replicas=1, max_replicas=10, target_ongoing_requests=1.0,
+            upscale_delay_s=5.0, downscale_delay_s=30.0, slo_p99_ms=100.0,
         )
-        autoscaler.start()
+    )
+    assert slo_state.decide(1.0, 1, now=0.0, p99_ms=400.0) == 1
+    assert slo_state.decide(1.0, 1, now=5.1, p99_ms=400.0) == 2
 
-        # Demand exceeding the head node's 1 CPU → autoscaler adds a node.
-        @ray_tpu.remote
-        def hold(seconds):
-            time.sleep(seconds)
-            return "done"
 
-        refs = [
-            hold.options(num_cpus=2).remote(3) for _ in range(2)
-        ]  # needs 4 CPUs; head has 1
-        out = ray_tpu.get(refs, timeout=120)
-        assert out == ["done", "done"]
-        assert len(provider.non_terminated_nodes()) >= 1
-        autoscaler.stop()
-    finally:
-        ray_tpu.shutdown()
-        cluster.shutdown()
+# ---------- cluster autoscaler e2e ----------
+
 def test_autoscaler_fake_provider():
     """Reference: test_autoscaler_fake_multinode.py — real autoscaler loop,
     fake nodes (in-process raylets) on one machine."""

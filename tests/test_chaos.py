@@ -9,7 +9,7 @@ Layers covered:
   * controller mutation idempotency (a duplicated create_actor /
     create_placement_group is provably applied ONCE — no ghosts),
   * the snapshot fail-point (_dirty retry path under kv:// store),
-  * serve replica death mid-call (typed error + retry-once),
+  * serve replica death mid-call (typed error + budgeted retries),
   * partition-then-heal node re-registration, and
   * the full seeded scenario from the issue (train + serve under drops,
     dup replies, a worker kill and a 10s asymmetric partition) — slow.
@@ -403,10 +403,12 @@ def test_snapshot_failpoint_dirty_retry(tmp_path, monkeypatch):
 # serve: replica death mid-call  (tier-1: retry path)
 # ---------------------------------------------------------------------------
 
-def test_serve_retries_once_onto_healthy_replica():
+def test_serve_retries_onto_healthy_replica():
     """Kill one of two replicas out from under the handle: every request
-    must still succeed — requests routed at the dead replica re-dispatch
-    once onto the healthy one instead of surfacing a raw actor error."""
+    must still succeed — dispatches that land on the dead replica retry
+    under the deployment's RetryPolicy budget (bounded by the request
+    Deadline) onto the healthy one instead of surfacing a raw actor
+    error."""
     from ray_tpu import serve
 
     assert not ray_tpu.is_initialized()
@@ -429,7 +431,7 @@ def test_serve_retries_once_onto_healthy_replica():
         victim = sorted(pids)[0]
         os.kill(victim, signal.SIGKILL)
         # Every request completes: dispatches that land on the corpse
-        # retry once against the survivor.
+        # re-dispatch against the survivor under the retry budget.
         answers = [handle.remote(i).result(timeout=60) for i in range(8)]
         assert [x for _, x in answers] == list(range(8))
         assert all(pid != victim for pid, _ in answers)
