@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Run the hang-doctor suite (ISSUE 14).
+#
+# Tier-1 CI runs `pytest -m 'not slow'`, which already covers the
+# flight-ring units, the adaptive-deadline watchdog, the evidence-merge
+# report builder, the span<->flight join, the recorder-bypass lint
+# rule, and both chaos e2e scenarios (one delayed rank is named; a
+# uniformly-slow cluster stays silent). This script is the nightly
+# companion that re-runs that subset and then executes the hang_doctor
+# release benchmark in smoke mode, enforcing the acceptance gates
+# (stall_detected==1, named_rank_correct==1, false_positives==0,
+# recorder_overhead<=0.02) via release/run_all.py.
+# Usage: ci/run_hang_doctor.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== hang doctor suite (unit + chaos e2e) =="
+python -m pytest tests/test_hang_doctor.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== span<->flight join + recorder-bypass lint regressions =="
+python -m pytest tests/test_observability.py -q -k 'join_flight' \
+    -p no:cacheprovider "$@"
+python -m pytest tests/test_lint.py -q -k 'comm_recorder' \
+    -p no:cacheprovider "$@"
+
+echo "== hang doctor release benchmark (smoke, gated) =="
+python release/run_all.py --smoke --only hang_doctor
+
+echo "hang doctor suite: PASS"

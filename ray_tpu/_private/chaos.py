@@ -133,7 +133,10 @@ class FaultSchedule:
         # at that point sleep the given extra milliseconds (slow-replica /
         # tail-latency injection, ISSUE 13). Always-on while armed, unlike
         # fail_points there is no hit budget — slowness is a condition,
-        # not an event.
+        # not an event. A value may also be the windowed dict form
+        # {"extra_ms": X, "start_s": S, "duration_s": D} (epoch-relative,
+        # like fail_points): the hang-doctor chaos gate uses it to wedge
+        # exactly one rank's allreduce for a bounded window.
         self.latency_points = dict(latency_points or {})
         # [{"at_s": 3, "target": "controller"|"agent:<idx>"|"worker:<idx>",
         #   "restart_after_s": 2.0}] — executed by ChaosMonkey, not here.
@@ -415,6 +418,13 @@ class ChaosInjector:
         if schedule is None:
             return 0.0
         extra_ms = schedule.latency_points.get(point, 0.0)
+        if isinstance(extra_ms, dict):
+            now = self.elapsed()
+            start = float(extra_ms.get("start_s", 0.0))
+            duration = float(extra_ms.get("duration_s", float("inf")))
+            if not (start <= now < start + duration):
+                return 0.0
+            extra_ms = float(extra_ms.get("extra_ms", 0.0))
         if extra_ms <= 0:
             return 0.0
         self._record("latency_point", point, 0, f"{extra_ms}ms")

@@ -217,6 +217,14 @@ class Controller:
         self._pub_flush_scheduled = False
         # Counters the scale suite and /metrics read via controller_stats.
         self.stats_counters = collections.Counter()
+        # Comm hang doctor (ISSUE 14): recent watchdog stall events and
+        # the merged cluster-wide hang reports built from the evidence
+        # harvests they trigger. Bounded: stalls are small dicts, reports
+        # carry stacks.
+        self._comm_stalls: collections.deque = collections.deque(maxlen=256)
+        self._hang_reports: collections.deque = collections.deque(maxlen=8)
+        self._hang_harvest_task: asyncio.Task | None = None
+        self._last_hang_harvest = 0.0
         # Idempotency-token reply cache for mutation RPCs: a client that
         # retried after a dropped/duplicated reply (or a controller
         # restart) gets the ORIGINAL reply back instead of re-applying
@@ -1724,6 +1732,124 @@ class Controller:
         self.stats_counters["oom_risk_events"] += 1
         await self.publish("oom_risk", payload)
         return {"status": "ok"}
+
+    # ------------------------------------------------------------------
+    # comm hang doctor (ISSUE 14)
+    # ------------------------------------------------------------------
+    async def rpc_report_comm_stall(self, conn, payload) -> dict:
+        """A rank's comm watchdog suspects a stall: record it, publish it
+        on the event channel, and kick off (debounced) the cluster-wide
+        evidence harvest that turns suspicion into a named hang report."""
+        self.stats_counters["comm_stall_events"] += 1
+        event = dict(payload or {})
+        event.setdefault("received_at", time.time())
+        self._comm_stalls.append(event)
+        await self.publish("comm_stall", event)
+        cooldown = float(
+            os.environ.get("RAY_TPU_HANG_HARVEST_COOLDOWN_S", "10")
+        )
+        now = time.monotonic()
+        if (
+            self._hang_harvest_task is None
+            or self._hang_harvest_task.done()
+        ) and now - self._last_hang_harvest >= cooldown:
+            self._last_hang_harvest = now
+            self._hang_harvest_task = spawn_task(
+                self._harvest_hang_evidence()
+            )
+        return {"status": "ok"}
+
+    async def _harvest_hang_evidence(self) -> dict:
+        """Fan the ``comm_evidence`` RPC across every alive node agent
+        and merge the pile into one hang report."""
+        from ray_tpu._private import hang_doctor
+
+        alive = [n for n in self.nodes.values() if n.alive]
+        evidence: dict[str, dict] = {}
+        for node in alive:
+            try:
+                client = await self._node_client(node)
+                evidence[node.node_id] = await client.call(
+                    "comm_evidence", {"last_n": 256}, timeout=30.0
+                )
+            except Exception as exc:  # rtlint: disable=swallowed-exception - a dead/partitioned node IS evidence; the report names what it did reach
+                evidence[node.node_id] = {
+                    "status": "error", "error": str(exc)
+                }
+        # build_report's first call walks the package for the static
+        # commgraph (file I/O + AST parse) — keep it off the event loop.
+        report = await asyncio.to_thread(
+            hang_doctor.build_report, list(self._comm_stalls), evidence
+        )
+        self._hang_reports.append(report)
+        self.stats_counters["hang_reports"] += 1
+        return report
+
+    async def rpc_hang_report(self, conn, payload) -> dict:
+        """Latest merged hang report (``fresh=True`` harvests now — the
+        `ray_tpu doctor --hang` path when no stall has auto-fired)."""
+        if (payload or {}).get("fresh") or not self._hang_reports:
+            report = await self._harvest_hang_evidence()
+        else:
+            report = self._hang_reports[-1]
+        if not (payload or {}).get("stacks", True):
+            report = dict(report, stacks={})
+        return {"status": "ok", "report": report}
+
+    async def rpc_cluster_stacks(self, conn, payload) -> dict:
+        """Native stack dump of every worker on every alive node (the
+        `ray_tpu stacks` CLI) — one agent hop per node, no py-spy."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        out: dict[str, dict] = {}
+        for node in alive:
+            try:
+                client = await self._node_client(node)
+                res = await client.call(
+                    "comm_evidence", {"last_n": 0, "stacks": True},
+                    timeout=30.0,
+                )
+                out[node.node_id] = res
+            except Exception as exc:  # rtlint: disable=swallowed-exception - unreachable node still listed, with the error in its slot
+                out[node.node_id] = {"status": "error", "error": str(exc)}
+        return {"status": "ok", "nodes": out}
+
+    async def rpc_comm_summary(self, conn, payload) -> dict:
+        """Live comm-plane stall view for `ray_tpu top` / the dashboard:
+        recent stall events, per-worker in-flight gauges (read straight
+        from the metrics KV mirror — snapshots, never drained), and the
+        hang-report count."""
+        inflight: dict[str, dict] = {}
+        for key, raw in self.kv.get("metrics", {}).items():
+            if not key.startswith(
+                ("rt_comm_inflight{", "rt_comm_inflight_oldest_age_s{")
+            ):
+                continue
+            try:
+                point = json.loads(raw)
+            except Exception:  # rtlint: disable=swallowed-exception - one corrupt KV point must not hide the rest
+                continue
+            worker = point.get("tags", {}).get("worker", "?")
+            slot = inflight.setdefault(
+                worker, {"inflight": 0.0, "oldest_age_s": 0.0, "ts": 0.0}
+            )
+            if point.get("name") == "rt_comm_inflight":
+                slot["inflight"] = point.get("value", 0.0)
+            else:
+                slot["oldest_age_s"] = point.get("value", 0.0)
+            slot["ts"] = max(slot["ts"], point.get("ts", 0.0))
+        stalls = list(self._comm_stalls)
+        last_stall = stalls[-1] if stalls else None
+        return {
+            "status": "ok",
+            "stall_total": self.stats_counters.get("comm_stall_events", 0),
+            "stalls": stalls[-32:],
+            "last_stall_age_s": (
+                max(0.0, time.time() - last_stall.get("received_at", 0.0))
+                if last_stall else None
+            ),
+            "inflight": inflight,
+            "hang_reports": len(self._hang_reports),
+        }
 
     async def rpc_controller_stats(self, conn, payload) -> dict:
         """Control-plane internals for the scale suite and /metrics: queue

@@ -34,6 +34,9 @@ _CHANNEL_FILES = {
     # Trend-aware OOM early warning (ISSUE 5): the memory monitor saw a
     # worker's RSS slope projecting past the kill limit.
     "oom_risk": "oom_risk",
+    # Comm watchdog suspected a stalled collective/p2p channel (ISSUE 14);
+    # the controller follows up with a cluster-wide evidence harvest.
+    "comm_stall": "comm_stall",
 }
 
 

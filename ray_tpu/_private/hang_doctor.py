@@ -1,0 +1,279 @@
+"""Cluster-wide hang report builder (ISSUE 14).
+
+The comm watchdog (:mod:`ray_tpu.util.collective.flight`) only knows its
+own process: "my recv on ``train:recv:s{}f{}v{}`` has aged past the
+channel deadline". Attribution needs the other side of every wire, so on
+a ``comm_stall`` event the controller harvests each node agent
+(``comm_evidence`` → per-worker ``comm_flight`` RPC: last-N ring
+records, in-flight summary, native stack dump) and hands the pile to
+:func:`build_report`, which merges it into one answer:
+
+    for each stalled channel, which ranks are *waiting* at the sequence
+    frontier, which ranks are *missing* from it (no in-flight record and
+    a completed-seq high-water mark behind the cluster's), and which
+    ranks the waiters' wire records actually point at.
+
+The missing set is the laggard signal: a rank wedged (or chaos-delayed)
+*before* its op reaches the recorder simply has no record at the
+frontier ``(group, tag, seq)`` while every peer's record ages there.
+
+Each runtime p2p channel is also reconciled against the PR-12 static
+commgraph: a ``send``/``recv`` channel whose tag skeleton unifies with
+no certified static site is flagged as *protocol drift* — traffic the
+static verifier never saw, i.e. code bypassing the blessed wire idiom or
+a schedule desync manufacturing tags outside the certified family.
+Collective and overlap kinds are exempt (their default tags are
+recorder-synthesized, not call-site literals).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+INFLIGHT_STATES = ("enqueued", "launched")
+
+# Runtime record kinds that map onto static commgraph site kinds.
+_P2P_KINDS = ("send", "recv")
+
+
+# ---------------------------------------------------------------------------
+# static-graph reconciliation (best-effort, cached)
+# ---------------------------------------------------------------------------
+
+_static_lock = threading.Lock()
+_static_cache: Optional[list[dict]] = None
+
+
+def static_comm_sites(root: Optional[str] = None) -> list[dict]:
+    """The repo's static comm sites (send/recv/collective tag skeletons),
+    extracted once per process by walking the installed ``ray_tpu``
+    package with the rtgraph extractor. Best-effort: returns ``[]`` on
+    any failure or when ``RAY_TPU_HANG_STATIC_RECONCILE=0`` — drift
+    checking then degrades to "unknown", never to a false positive."""
+    global _static_cache
+    if os.environ.get("RAY_TPU_HANG_STATIC_RECONCILE", "1") == "0":
+        return []
+    with _static_lock:
+        if _static_cache is not None:
+            return _static_cache
+        sites: list[dict] = []
+        try:
+            from ray_tpu.devtools.analysis import commgraph
+
+            if root is None:
+                import ray_tpu
+
+                root = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if not d.startswith((".", "__pycache__"))
+                ]
+                for name in sorted(filenames):
+                    if not name.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, name)
+                    try:
+                        with open(path, encoding="utf-8") as f:
+                            tree = ast.parse(f.read())
+                        for site in commgraph.extract_sites(tree, path):
+                            sites.append(site)
+                    except Exception:  # rtlint: disable=swallowed-exception - one unparseable file must not kill reconciliation
+                        continue
+        except Exception:  # rtlint: disable=swallowed-exception - devtools absent or unreadable tree: drift check degrades to unknown
+            sites = []
+        _static_cache = sites
+        return sites
+
+
+def _reset_static_cache() -> None:
+    """Tests only."""
+    global _static_cache
+    with _static_lock:
+        _static_cache = None
+
+
+def channel_in_static_graph(
+    kind: str, tag_skeleton: str, sites: Iterable[dict]
+) -> Optional[bool]:
+    """True/False when the static graph can answer, None when it can't
+    (no sites harvested, or a kind the static graph doesn't certify)."""
+    if kind not in _P2P_KINDS:
+        return None
+    sites = [s for s in sites if s.get("kind") in _P2P_KINDS]
+    if not sites:
+        return None
+    try:
+        from ray_tpu.devtools.analysis import commgraph
+
+        runtime = commgraph.parse_skeleton(tag_skeleton)
+        for s in sites:
+            static = commgraph.parse_skeleton(s.get("tag", ""))
+            if commgraph.skeletons_unify(static, runtime):
+                return True
+        return False
+    except Exception:  # rtlint: disable=swallowed-exception - reconciliation is advisory; report still names ranks
+        return None
+
+
+# ---------------------------------------------------------------------------
+# evidence merge
+# ---------------------------------------------------------------------------
+
+def _iter_worker_evidence(evidence: dict) -> Iterable[tuple[str, str, dict]]:
+    """Yield (node_id, worker_id, worker payload) over a harvest result
+    shaped {node_id: {"workers": {worker_id: payload}}}."""
+    for node_id, node_res in (evidence or {}).items():
+        if not isinstance(node_res, dict):
+            continue
+        for wid, wres in (node_res.get("workers") or {}).items():
+            if isinstance(wres, dict) and wres.get("status") == "ok":
+                yield node_id, wid, wres
+
+
+def _merge_channel(channel: str, records: list[dict]) -> dict:
+    """Fold every rank's records on one channel into the who-is-missing
+    verdict. ``records`` carry a ``_worker``/``_node`` annotation."""
+    world = max((int(r.get("world_size") or 1) for r in records), default=1)
+    inflight = [r for r in records if r.get("state") in INFLIGHT_STATES]
+    done_seq: dict[int, int] = {}
+    rank_worker: dict[int, str] = {}
+    for r in records:
+        rank = int(r.get("rank", 0))
+        rank_worker.setdefault(rank, r.get("_worker", "?"))
+        if r.get("state") == "completed":
+            seq = int(r.get("seq") or 0)
+            if seq > done_seq.get(rank, -1):
+                done_seq[rank] = seq
+    frontier = max(
+        (int(r.get("seq") or 0) for r in inflight),
+        default=max(done_seq.values(), default=0),
+    )
+    waiting = []
+    waited_on: set[int] = set()
+    for r in sorted(inflight, key=lambda r: -float(r.get("age_s") or 0.0)):
+        rank = int(r.get("rank", 0))
+        peer = int(r.get("peer", -1))
+        if peer >= 0:
+            waited_on.add(peer)
+        waiting.append({
+            "rank": rank,
+            "seq": int(r.get("seq") or 0),
+            "age_s": float(r.get("age_s") or 0.0),
+            "peer": peer,
+            "state": r.get("state"),
+            "site": r.get("site"),
+            "trace_id": r.get("trace_id"),
+            "worker": r.get("_worker"),
+            "node": r.get("_node"),
+        })
+    waiting_ranks = {w["rank"] for w in waiting}
+    missing = sorted(
+        rank for rank in range(world)
+        if rank not in waiting_ranks
+        and done_seq.get(rank, -1) < frontier
+    )
+    # A rank a waiter's wire record explicitly points at is a suspect
+    # even if its own evidence never arrived (dead process, lost node).
+    suspects = sorted(set(missing) | (waited_on - waiting_ranks))
+    sample = records[-1]
+    return {
+        "channel": channel,
+        "group": sample.get("group"),
+        "kind": sample.get("kind"),
+        "tag_skeleton": channel.rsplit(":", 1)[-1],
+        "world_size": world,
+        "frontier_seq": frontier,
+        "waiting_ranks": waiting,
+        "missing_ranks": missing,
+        "suspect_ranks": suspects,
+        "last_completed_seq_by_rank": {
+            str(k): v for k, v in sorted(done_seq.items())
+        },
+        "rank_worker": {str(k): v for k, v in sorted(rank_worker.items())},
+    }
+
+
+def build_report(
+    stalls: list[dict],
+    evidence: dict,
+    static_sites: Optional[list[dict]] = None,
+    include_stacks: bool = True,
+) -> dict:
+    """Merge watchdog stall events + the cluster evidence harvest into
+    one hang report. Pure on its inputs (deterministic, unit-testable);
+    ``static_sites=None`` means "harvest them yourself, best-effort"."""
+    if static_sites is None:
+        static_sites = static_comm_sites()
+
+    records: list[dict] = []
+    stacks: dict[str, Any] = {}
+    nodes: set[str] = set()
+    for node_id, wid, wres in _iter_worker_evidence(evidence):
+        nodes.add(node_id)
+        for r in wres.get("records") or []:
+            r = dict(r)
+            r["_worker"] = wid
+            r["_node"] = node_id
+            records.append(r)
+        if include_stacks and wres.get("stacks"):
+            stacks[wid] = {
+                "node": node_id,
+                "pid": wres.get("pid"),
+                "current_task": wres.get("current_task"),
+                "stacks": wres.get("stacks"),
+                "asyncio_tasks": wres.get("asyncio_tasks", {}),
+            }
+
+    # Channels to diagnose: every channel a watchdog flagged, plus any
+    # channel whose harvested records are themselves marked stalled.
+    flagged = {s.get("channel") for s in stalls if s.get("channel")}
+    flagged |= {
+        r.get("channel") for r in records
+        if r.get("stalled") and r.get("channel")
+    }
+    by_channel: dict[str, list[dict]] = {}
+    for r in records:
+        ch = r.get("channel")
+        if ch in flagged:
+            by_channel.setdefault(ch, []).append(r)
+
+    channels = []
+    for ch in sorted(flagged):
+        recs = by_channel.get(ch)
+        if not recs:
+            continue
+        merged = _merge_channel(ch, recs)
+        merged["in_static_graph"] = channel_in_static_graph(
+            merged["kind"], merged["tag_skeleton"], static_sites
+        )
+        merged["protocol_drift"] = merged["in_static_graph"] is False
+        channels.append(merged)
+    # Most suspects first: the channel pinning the most blame leads.
+    channels.sort(key=lambda c: -len(c["suspect_ranks"]))
+
+    lines = []
+    for c in channels:
+        who = ", ".join(f"rank {r}" for r in c["suspect_ranks"]) or "nobody"
+        lines.append(
+            f"{c['channel']} seq {c['frontier_seq']}: "
+            f"{len(c['waiting_ranks'])}/{c['world_size']} ranks waiting, "
+            f"suspect {who}"
+            + (" [PROTOCOL DRIFT: channel absent from static commgraph]"
+               if c["protocol_drift"] else "")
+        )
+    return {
+        "generated_at": time.time(),
+        "stall_events": list(stalls),
+        "channels": channels,
+        "nodes": sorted(nodes),
+        "workers_reporting": len(stacks) or len({
+            wid for _, wid, _ in _iter_worker_evidence(evidence)
+        }),
+        "stacks": stacks,
+        "summary": lines,
+    }

@@ -1479,6 +1479,28 @@ class NodeAgent:
             payload.get("worker_id", ""), "stack_trace", {}
         )
 
+    async def rpc_comm_evidence(self, conn, payload) -> dict:
+        """Hang-doctor fan-out: gather every local worker's comm flight
+        snapshot (+ stacks) in parallel, one agent hop per node."""
+        req = {
+            "last_n": int((payload or {}).get("last_n", 256)),
+            "stacks": bool((payload or {}).get("stacks", True)),
+        }
+        worker_ids = list(self.workers)
+        results = await asyncio.gather(
+            *(
+                self._forward_to_worker(wid, "comm_flight", req)
+                for wid in worker_ids
+            ),
+            return_exceptions=True,
+        )
+        workers = {}
+        for wid, res in zip(worker_ids, results):
+            if isinstance(res, BaseException):
+                res = {"status": "error", "error": str(res)}
+            workers[wid] = res
+        return {"status": "ok", "node_id": self.node_id, "workers": workers}
+
     async def rpc_node_info(self, conn, payload) -> dict:
         self._refresh_available_mirror()
         return {

@@ -119,7 +119,7 @@ class WorkerRuntime:
         for method in (
             "push_task", "push_actor_task", "create_actor", "exit",
             "cancel_task", "dag_register", "dag_push", "dag_pop",
-            "profiler", "stack_trace", "engine_debug",
+            "profiler", "stack_trace", "engine_debug", "comm_flight",
         ):
             ctx.core_server.route(method, getattr(self, f"rpc_{method}"))
         ctx.connect()
@@ -919,10 +919,9 @@ class WorkerRuntime:
     # ------------------------------------------------------------------
     # RPC handlers
     # ------------------------------------------------------------------
-    async def rpc_stack_trace(self, conn, payload) -> dict:
-        """Live stack dump of every thread in this worker (the reference's
-        dashboard 'Stack Trace' button shells out to py-spy on the worker
-        pid — reporter_agent.py; in-process frames need no subprocess)."""
+    def _collect_stacks(self) -> tuple[dict, dict]:
+        """(thread stacks, parked asyncio task stacks) — native frame
+        walk, no external deps."""
         frames = sys._current_frames()
         names = {t.ident: t.name for t in threading.enumerate()}
         stacks = {}
@@ -941,6 +940,13 @@ class WorkerRuntime:
                 ]
         except Exception:  # rtlint: disable=swallowed-exception - stack introspection is advisory debug info
             pass
+        return stacks, coros
+
+    async def rpc_stack_trace(self, conn, payload) -> dict:
+        """Live stack dump of every thread in this worker (the reference's
+        dashboard 'Stack Trace' button shells out to py-spy on the worker
+        pid — reporter_agent.py; in-process frames need no subprocess)."""
+        stacks, coros = self._collect_stacks()
         return {
             "status": "ok",
             "pid": os.getpid(),
@@ -949,6 +955,29 @@ class WorkerRuntime:
             "stacks": stacks,
             "asyncio_tasks": coros,
         }
+
+    async def rpc_comm_flight(self, conn, payload) -> dict:
+        """Hang-doctor evidence: this worker's last-N comm flight records,
+        in-flight summary, local stall events, and a native stack dump —
+        one round trip per rank during a cluster-wide harvest."""
+        from ray_tpu.util.collective import flight
+
+        last_n = int((payload or {}).get("last_n", 256))
+        with_stacks = bool((payload or {}).get("stacks", True))
+        out = {
+            "status": "ok",
+            "pid": os.getpid(),
+            "worker_id": self.ctx.worker_id,
+            "current_task": self._main_current_task,
+            "records": flight.snapshot(last_n),
+            "inflight": flight.inflight_summary(),
+            "stalls": flight.stall_events(),
+        }
+        if with_stacks:
+            stacks, coros = self._collect_stacks()
+            out["stacks"] = stacks
+            out["asyncio_tasks"] = coros
+        return out
 
     async def rpc_engine_debug(self, conn, payload) -> dict:
         """Native transport state of every conn this worker's engine owns

@@ -392,6 +392,30 @@ def diagnose(snapshot: dict) -> list[dict]:
     resources = snapshot.get("resources") or {}
     nodes = resources.get("nodes") or {}
 
+    # -- comm-plane stalls (ISSUE 14) ----------------------------------
+    # A suspected wedge outranks every throughput finding: nothing else
+    # in the snapshot matters while a collective is stuck.
+    commflight = snapshot.get("commflight") or {}
+    stall_total = int(commflight.get("stall_total") or 0)
+    if stall_total:
+        recent = commflight.get("stalls") or []
+        last = recent[-1] if recent else {}
+        chans = sorted({
+            e.get("channel") for e in recent[-8:] if e.get("channel")
+        })
+        findings.append(_finding(
+            "crit", 200 + 10 * stall_total, "comm_stall",
+            f"comm watchdog suspects {stall_total} stalled comm op(s) "
+            f"on {', '.join(chans) or 'unknown channels'} — run "
+            "`ray_tpu doctor --hang` for the rank-level hang report",
+            {
+                "stall_total": stall_total,
+                "channels": chans,
+                "last_stall": last,
+                "hang_reports": commflight.get("hang_reports", 0),
+            },
+        ))
+
     # -- training phase balance ----------------------------------------
     train = _latest_train_summaries(workload)
     for exp, s in train.items():

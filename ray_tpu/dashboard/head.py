@@ -148,11 +148,27 @@ function spark(points, key, w = 240, h = 36) {
 }
 async function resources() {
   const s = await fetch('/api/resources').then(r => r.json());
+  const cf = await fetch('/api/commflight').then(r => r.json()).catch(() => ({}));
   const ids = Object.keys(s.nodes ?? {});
+  const cfWorkers = cf.inflight ?? {};
+  const cfTotal = Object.values(cfWorkers)
+    .reduce((a, v) => a + (v.inflight ?? 0), 0);
   let html = '<h2>Resources</h2><div class="muted">' +
     `ingested ${esc(s.total_ingested ?? 0)} samples · ` +
     `dropped ${esc(s.total_dropped ?? 0)} · ` +
-    `oom_risk events ${esc(s.oom_risk_events ?? 0)}</div>`;
+    `oom_risk events ${esc(s.oom_risk_events ?? 0)} · ` +
+    `comm in-flight ${esc(cfTotal)} · ` +
+    `comm stalls ${esc(cf.stall_total ?? 0)}` +
+    (cf.last_stall_age_s != null
+      ? ` (last ${esc(cf.last_stall_age_s.toFixed?.(0) ?? '')}s ago)` : '') +
+    '</div>';
+  if (Object.keys(cfWorkers).length) {
+    html += '<h2>Comm flight</h2>' + table(
+      ['worker', 'in-flight', 'oldest op age'],
+      Object.entries(cfWorkers).map(([w, v]) =>
+        [esc(w.slice(-26)), esc(v.inflight ?? 0),
+         (v.inflight ? esc((v.oldest_age_s ?? 0).toFixed?.(1) ?? '') + 's' : '-')]));
+  }
   if (!ids.length) return html + '<div class="muted">no telemetry yet</div>';
   for (const id of ids) {
     const tl = await fetch('/api/timeseries?node_id=' +
@@ -304,6 +320,7 @@ class DashboardHead:
         app.router.add_get("/api/tracing", self._tracing)
         app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/stacks", self._stacks)
+        app.router.add_get("/api/commflight", self._commflight)
         app.router.add_post("/api/profile", self._profile)
         app.router.add_get("/api/serve", self._serve_state)
         app.router.add_get("/api/workers", self._workers)
@@ -456,6 +473,23 @@ class DashboardHead:
             await asyncio.to_thread(state_mod.summarize_resources),
             dumps=_dumps,
         )
+
+    async def _commflight(self, request):
+        """Comm-plane flight view (ISSUE 14): watchdog stall events,
+        per-worker in-flight gauges, and — with ?report=1 — the latest
+        merged hang report (?fresh=1 forces a harvest). The summary is a
+        snapshot of controller state (never drained), so a retried fetch
+        sees the same stalls: PR-5 snapshot-don't-drain."""
+        from aiohttp import web
+
+        out = await asyncio.to_thread(state_mod.summarize_commflight)
+        if request.query.get("report"):
+            out["report"] = await asyncio.to_thread(
+                state_mod.get_hang_report,
+                bool(request.query.get("fresh")),
+                bool(request.query.get("stacks")),
+            )
+        return web.json_response(out, dumps=_dumps)
 
     _TIERS = ("raw", "10s", "60s")
 

@@ -2,6 +2,7 @@
 
 from ray_tpu.devtools.lint.rules import (  # noqa: F401
     blocking_in_async,
+    comm_recorder_bypass,
     host_sync_in_step,
     lockset_order,
     non_atomic_write,

@@ -1,0 +1,111 @@
+"""comm-recorder-bypass: comm traffic invisible to the flight recorder.
+
+ISSUE 14 made every collective and ring p2p op append a record to the
+per-process flight ring (``ray_tpu.util.collective.flight``) — that is
+what lets the hang doctor name the rank missing from a wedged
+``(group, tag, seq)``. The recording happens in exactly one place:
+``util/collective/collective.py``, where the group methods are wrapped
+by ``_traced_method`` and the ring wire helpers record each mailbox
+send/recv. Code that tunnels *around* that layer produces comm traffic
+the watchdog can never see, so a hang there is silent again.
+
+Two bypass shapes are flagged outside the collective module itself:
+
+* a raw transport RPC whose method string starts with ``coll_send/``
+  (the ring wire protocol) — hand-rolled sends skip the wire record;
+* a subclass of the group family (``BaseGroup`` / ``RingGroup`` /
+  ``XlaGroup`` / ``HierarchicalGroup``) overriding ``send`` / ``recv``
+  / ``send_async`` — the ``_traced_method`` registration loop only
+  wraps classes defined in ``collective.py``, so such an override
+  silently sheds both the span and the flight record.
+
+Plain ``group.send(...)`` / ``group.recv(...)`` call sites are the
+blessed idiom (they ARE recorded) and are never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.lint.core import (
+    FileContext,
+    Rule,
+    Severity,
+    register_rule,
+)
+
+_WIRE_PREFIX = "coll_send"
+_GROUP_BASES = {"BaseGroup", "RingGroup", "XlaGroup", "HierarchicalGroup"}
+_WRAPPED_METHODS = {"send", "recv", "send_async"}
+_EXEMPT_SUFFIX = "util/collective/collective.py"
+
+
+def _string_head(node: ast.AST | None) -> str | None:
+    """The leading literal text of a str constant or f-string, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return None
+
+
+def _base_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+@register_rule
+class CommRecorderBypass(Rule):
+    name = "comm-recorder-bypass"
+    severity = Severity.WARNING
+    description = (
+        "comm traffic routed around the flight recorder (raw coll_send/ "
+        "RPC or a group-family send/recv override outside "
+        "collective.py) — the hang doctor cannot attribute stalls it "
+        "never records"
+    )
+
+    def check(self, ctx: FileContext):
+        if ctx.path.replace("\\", "/").endswith(_EXEMPT_SUFFIX):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    head = _string_head(arg)
+                    if head is not None and head.startswith(_WIRE_PREFIX):
+                        yield self.finding(
+                            ctx, node,
+                            f"raw `{head}…` transport RPC bypasses the "
+                            "comm flight recorder — go through the "
+                            "group's send/send_async so the hang doctor "
+                            "can see this wire",
+                        )
+                        break
+            elif isinstance(node, ast.ClassDef):
+                bases = {_base_name(b) for b in node.bases}
+                if not bases & _GROUP_BASES:
+                    continue
+                for item in node.body:
+                    if (
+                        isinstance(
+                            item,
+                            (ast.FunctionDef, ast.AsyncFunctionDef),
+                        )
+                        and item.name in _WRAPPED_METHODS
+                    ):
+                        yield self.finding(
+                            ctx, item,
+                            f"`{node.name}.{item.name}` overrides a "
+                            "group wire method outside collective.py: "
+                            "the _traced_method wrap (span + flight "
+                            "record) only covers classes defined there, "
+                            "so this override's traffic is invisible to "
+                            "the hang doctor",
+                        )

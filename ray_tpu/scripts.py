@@ -111,15 +111,28 @@ def _fmt_bytes(n) -> str:
     return f"{value:.1f}TiB"
 
 
-def _render_top(summary: dict) -> str:
+def _render_top(summary: dict, comm: dict | None = None) -> str:
     """One refresh frame of `ray_tpu top`: per-node utilization lines +
-    the heaviest workers by RSS, from the controller's telemetry store."""
+    the heaviest workers by RSS, from the controller's telemetry store,
+    plus the comm-plane flight line (in-flight ops / stalls) when the
+    comm summary is available."""
+    comm = comm or {}
+    inflight_total = sum(
+        int(v.get("inflight", 0)) for v in (comm.get("inflight") or {}).values()
+    )
+    last_age = comm.get("last_stall_age_s")
+    comm_bits = (
+        f"  comm_inflight={inflight_total}"
+        f"  comm_stalls={comm.get('stall_total', 0)}"
+        + (f"  last_stall={last_age:.0f}s ago" if last_age is not None else "")
+    )
     lines = [
         time.strftime("%H:%M:%S")
         + f"  nodes={len(summary.get('nodes') or {})}"
         + f"  samples={summary.get('total_ingested', 0)}"
         + f"  dropped={summary.get('total_dropped', 0)}"
-        + f"  oom_risk={summary.get('oom_risk_events', 0)}",
+        + f"  oom_risk={summary.get('oom_risk_events', 0)}"
+        + comm_bits,
         "",
         f"{'NODE':<14}{'CPU%':>6}{'MEM':>18}{'WORKERS':>9}"
         f"{'RSS(total)':>12}{'OBJSTORE':>10}{'HBM':>16}  TIERS",
@@ -156,11 +169,21 @@ def _render_top(summary: dict) -> str:
             workers.append((int(rss), worker_id, node_id))
     workers.sort(reverse=True)
     if workers:
-        lines += ["", f"{'WORKER':<28}{'NODE':<14}{'RSS':>12}"]
+        comm_by_worker = comm.get("inflight") or {}
+        lines += [
+            "",
+            f"{'WORKER':<28}{'NODE':<14}{'RSS':>12}"
+            f"{'COMM_INFL':>11}{'OLDEST':>9}",
+        ]
         for rss, worker_id, node_id in workers[:15]:
+            slot = comm_by_worker.get(worker_id) or {}
+            infl = int(slot.get("inflight", 0))
+            oldest = slot.get("oldest_age_s", 0.0) or 0.0
             lines.append(
                 f"{worker_id[-26:]:<28}{node_id[-12:]:<14}"
                 f"{_fmt_bytes(rss):>12}"
+                f"{infl:>11}"
+                f"{(f'{oldest:.1f}s' if infl else '-'):>9}"
             )
     return "\n".join(lines)
 
@@ -180,12 +203,15 @@ def cmd_top(args) -> None:
                 "resources": state.summarize_resources(),
                 "workload": state.summarize_workload(),
                 "goodput": state.summarize_goodput(),
+                "commflight": state.summarize_commflight(),
             },
             indent=2, default=str,
         ))
         return
     while True:
-        frame = _render_top(state.summarize_resources())
+        frame = _render_top(
+            state.summarize_resources(), state.summarize_commflight()
+        )
         if args.once:
             print(frame)
             return
@@ -216,6 +242,79 @@ def cmd_diagnose(args) -> None:
     print(f"ray_tpu diagnose — {len(findings)} finding(s)")
     for f in findings:
         print(f"  [{tags.get(f['severity'], '????'):<4}] {f['message']}")
+
+
+def cmd_doctor(args) -> None:
+    """`ray_tpu doctor --hang` — the cluster-wide hang report: which
+    ranks are missing from which (group, tag, seq), who the waiters'
+    wire records point at, protocol drift vs the static commgraph, and
+    (with --stacks) every wedged rank's native stack."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    report = state.get_hang_report(
+        fresh=args.fresh, stacks=args.stacks or args.json
+    )
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+        return
+    channels = report.get("channels") or []
+    stalls = report.get("stall_events") or []
+    print(
+        f"ray_tpu doctor — {len(stalls)} stall event(s), "
+        f"{len(channels)} stalled channel(s), "
+        f"{report.get('workers_reporting', 0)} worker(s) reporting"
+    )
+    for line in report.get("summary") or []:
+        print(f"  {line}")
+    for c in channels:
+        print(f"\n  channel {c['channel']} (frontier seq {c['frontier_seq']}, "
+              f"world {c['world_size']})")
+        for w in c.get("waiting_ranks", []):
+            peer = f" <- rank {w['peer']}" if w.get("peer", -1) >= 0 else ""
+            print(f"    waiting: rank {w['rank']} seq {w['seq']} "
+                  f"age {w['age_s']:.1f}s{peer}"
+                  + (f" [{w['site']}]" if w.get("site") else ""))
+        if c.get("missing_ranks"):
+            print(f"    MISSING: rank(s) "
+                  f"{', '.join(map(str, c['missing_ranks']))} — no record "
+                  "at the frontier")
+        if c.get("protocol_drift"):
+            print("    PROTOCOL DRIFT: channel absent from the certified "
+                  "static commgraph (rtgraph)")
+    if args.stacks:
+        for wid, blob in (report.get("stacks") or {}).items():
+            print(f"\n== {wid} (pid {blob.get('pid')}, "
+                  f"task {blob.get('current_task')}) ==")
+            for label, text in (blob.get("stacks") or {}).items():
+                print(f"-- {label} --\n{text}")
+    if not channels and not stalls:
+        print("  no comm stalls suspected — the comm plane looks healthy")
+
+
+def cmd_stacks(args) -> None:
+    """`ray_tpu stacks` — native Python stacks of every worker on every
+    alive node (the dashboard Stack Trace button, cluster-wide)."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    nodes = state.collect_cluster_stacks()
+    if args.json:
+        print(json.dumps(nodes, indent=2, default=str))
+        return
+    for node_id, res in sorted(nodes.items()):
+        if res.get("status") != "ok":
+            print(f"== node {node_id}: {res.get('error', 'unreachable')} ==")
+            continue
+        for wid, wres in sorted((res.get("workers") or {}).items()):
+            if wres.get("status") != "ok":
+                print(f"== {node_id} / {wid}: "
+                      f"{wres.get('error', 'unreachable')} ==")
+                continue
+            print(f"== {node_id} / {wid} (pid {wres.get('pid')}, "
+                  f"task {wres.get('current_task')}) ==")
+            for label, text in (wres.get("stacks") or {}).items():
+                print(f"-- {label} --\n{text}")
 
 
 def cmd_timeline(args) -> None:
@@ -332,6 +431,31 @@ def main(argv=None) -> None:
     p.add_argument("--json", action="store_true")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_diagnose)
+
+    p = sub.add_parser(
+        "doctor",
+        help="cluster-wide hang report: which ranks are missing from "
+             "which (group, tag, seq) comm channel",
+    )
+    p.add_argument("--hang", action="store_true",
+                   help="diagnose a suspected comm hang (the default and "
+                        "only mode today)")
+    p.add_argument("--fresh", action="store_true",
+                   help="force a cluster-wide evidence harvest now "
+                        "instead of returning the last report")
+    p.add_argument("--stacks", action="store_true",
+                   help="include every rank's native stack dump")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_doctor)
+
+    p = sub.add_parser(
+        "stacks",
+        help="native Python stacks of every worker on every alive node",
+    )
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_stacks)
 
     p = sub.add_parser("timeline")
     p.add_argument("--output", default="timeline.json")

@@ -57,6 +57,7 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 from ray_tpu.train._internal import step_stats
+from ray_tpu.util.collective import flight
 
 # Wire marker for codec-compressed activation payloads (self-describing
 # so mixed exact/quantized edges share one recv path).
@@ -225,9 +226,10 @@ class PipelineStageRunner:
         """Blocking neighbor recv; blocked wall time IS the pipeline
         bubble at this stage, so it lands in the pp_bubble phase."""
         t0 = time.perf_counter()
-        out = self.group.recv(
-            src, tag=tag, timeout=self.recv_timeout_s, like=like
-        )
+        with flight.site("pipeline"):
+            out = self.group.recv(
+                src, tag=tag, timeout=self.recv_timeout_s, like=like
+            )
         step_stats.record_phase("pp_bubble", time.perf_counter() - t0)
         if isinstance(out, tuple) and len(out) == 4 and out[0] == _ACT_WIRE:
             from ray_tpu.util.collective.quantization import decode
@@ -247,11 +249,13 @@ class PipelineStageRunner:
             # EF residual telescopes this step's rounding error into the
             # next step's message on the SAME (direction, m, vs) edge.
             enc = self._act_ef.encode(site, arr.ravel(), self._act_cfg)
-            self.group.send(
-                (_ACT_WIRE, arr.shape, arr.dtype.str, enc), dst, tag=tag
-            )
+            with flight.site("pipeline"):
+                self.group.send(
+                    (_ACT_WIRE, arr.shape, arr.dtype.str, enc), dst, tag=tag
+                )
             return
-        self.group.send(arr, dst, tag=tag)
+        with flight.site("pipeline"):
+            self.group.send(arr, dst, tag=tag)
 
     # -- one optimizer step ----------------------------------------------
     def train_step(self, batch: Any) -> float:

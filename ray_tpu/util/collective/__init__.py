@@ -5,6 +5,7 @@ Convenience re-exports so callers can write
 reaching into the submodules.
 """
 
+from ray_tpu.util.collective import flight  # noqa: F401
 from ray_tpu.util.collective.quantization import (  # noqa: F401
     CollectiveConfig,
     ErrorFeedback,

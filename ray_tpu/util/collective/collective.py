@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import inspect
 import os
 import pickle
 import threading
@@ -30,8 +31,10 @@ from typing import Any
 
 import numpy as np
 
+from ray_tpu._private import chaos
 from ray_tpu._private import worker as worker_mod
 from ray_tpu.util import tracing
+from ray_tpu.util.collective import flight
 from ray_tpu.util.collective.quantization import (
     CollectiveConfig,
     ErrorFeedback,
@@ -182,15 +185,29 @@ class RingGroup(BaseGroup):
         )
         self.wire_stats["bytes_sent"] += len(data)
         self.wire_stats["msgs_sent"] += 1
+        # Flight recorder (ISSUE 14): the wire-level record carries the
+        # REAL mailbox (tag, seq) a hang report names; enqueued here at
+        # issue time, launched when the frame goes out, completed when
+        # the peer acks.
+        rec = flight.p2p_started(
+            self.group_name, "send", tag, seq, self.rank, dst_rank,
+            self.world_size, nbytes=len(data),
+        )
 
         async def _send():
+            flight.launched(rec)
             client = await self.ctx._client_for(self._peer_addrs[dst_rank])
             await client.call(
                 f"coll_send/{self.group_name}",
                 {"src": self.rank, "tag": f"{tag}#{seq}", "data": data},
             )
 
-        return asyncio.run_coroutine_threadsafe(_send(), self.ctx.io.loop)
+        fut = asyncio.run_coroutine_threadsafe(_send(), self.ctx.io.loop)
+        if rec is not None:
+            fut.add_done_callback(
+                lambda f: flight.completed(rec, ok=f.exception() is None)
+            )
+        return fut
 
     def recv(self, src_rank: int, tag: str = "", timeout: float = 60.0,
              like=None) -> np.ndarray:
@@ -200,13 +217,26 @@ class RingGroup(BaseGroup):
         seq_key = (src_rank, tag)
         seq = self._recv_seq.get(seq_key, 0)
         key = (src_rank, f"{tag}#{seq}")
+        # Flight recorder (ISSUE 14): a recv blocked here is exactly what
+        # the hang watchdog watches — the record names (group, tag, seq)
+        # and the peer rank being waited on.
+        rec = flight.p2p_started(
+            self.group_name, "recv", tag, seq, self.rank, src_rank,
+            self.world_size,
+        )
+        flight.launched(rec)
 
         async def _recv():
             event = self._mailbox_events.setdefault(key, asyncio.Event())
             await asyncio.wait_for(event.wait(), timeout)
             return self._mailbox.pop(key)
 
-        data = self.ctx.io.run(_recv())
+        try:
+            data = self.ctx.io.run(_recv())
+        except BaseException:
+            flight.completed(rec, ok=False)
+            raise
+        flight.completed(rec)
         # Advance the stream only on success: a timed-out recv can be retried
         # for the SAME sequence number (otherwise every later message would be
         # delivered shifted by one).
@@ -773,7 +803,19 @@ def get_group(group_name: str = "default") -> BaseGroup:
 _op_tls = threading.local()
 
 
-def _instrumented(op: str, group: BaseGroup, array, call):
+# Default tags the group methods use when the caller passes none — the
+# flight-recorder channel id must match what actually rides the wire.
+_DEFAULT_TAGS = {
+    "allreduce": "__ar",
+    "allreduce_sharded": "__ar",
+    "allgather": "__ag",
+    "reducescatter": "__rs",
+    "broadcast": "__bc",
+    "barrier": "__barrier",
+}
+
+
+def _instrumented(op: str, group: BaseGroup, array, call, tag=None):
     """Run one collective op with full observability: the collective.*
     span carries op + backend + logical bytes + measured wire bytes, and
     the op feeds the rt_collective_* Prometheus series (bytes total +
@@ -788,12 +830,12 @@ def _instrumented(op: str, group: BaseGroup, array, call):
         return call()
     _op_tls.active = True
     try:
-        return _instrumented_outer(op, group, array, call)
+        return _instrumented_outer(op, group, array, call, tag=tag)
     finally:
         _op_tls.active = False
 
 
-def _instrumented_outer(op: str, group: BaseGroup, array, call):
+def _instrumented_outer(op: str, group: BaseGroup, array, call, tag=None):
     backend = getattr(group, "backend_name", type(group).__name__)
     if isinstance(array, (list, tuple)):  # allreduce_sharded: shard list
         nbytes = sum(getattr(a, "nbytes", 0) for a in array) or None
@@ -801,6 +843,18 @@ def _instrumented_outer(op: str, group: BaseGroup, array, call):
         nbytes = getattr(array, "nbytes", None)
     wire = getattr(group, "wire_stats", None)
     wire_before = wire["bytes_sent"] if wire else 0
+    # Chaos (ISSUE 14): a windowed per-rank latency point simulates a
+    # straggler that hasn't REACHED the collective yet — it sleeps before
+    # the flight record exists, so the laggard's evidence is an absent
+    # record, exactly what the hang report keys on.
+    stall_delay = chaos.latency_delay(f"collective.{op}.rank{group.rank}")
+    if stall_delay > 0:
+        time.sleep(stall_delay)
+    tag = tag if tag is not None else _DEFAULT_TAGS.get(op, "")
+    rec = flight.op_started(
+        group.group_name, op, tag, group.rank, group.world_size,
+        nbytes=nbytes or 0, backend=backend,
+    )
     start = time.perf_counter()
     if tracing.enabled():
         attrs = {
@@ -812,14 +866,33 @@ def _instrumented_outer(op: str, group: BaseGroup, array, call):
         }
         if nbytes is not None:
             attrs["bytes"] = int(nbytes)
+        if rec is not None:
+            # Joinable observability (ISSUE 14 satellite): the span
+            # carries the flight (seq, channel); the ring entry carries
+            # the trace id — hang reports and `ray_tpu timeline` meet
+            # on either key.
+            attrs["comm_seq"] = rec.seq
+            attrs["comm_channel"] = rec.channel
         with tracing.span(f"collective.{op}", **attrs) as span:
-            result = call()
+            if span is not None and rec is not None:
+                rec.trace_id = span.trace_id
+            ok = False
+            try:
+                result = _chaos_uniform_then(call)
+                ok = True
+            finally:
+                flight.completed(rec, ok=ok)
             if span is not None and wire is not None:
                 span.attributes["wire_bytes"] = (
                     wire["bytes_sent"] - wire_before
                 )
     else:
-        result = call()
+        ok = False
+        try:
+            result = _chaos_uniform_then(call)
+            ok = True
+        finally:
+            flight.completed(rec, ok=ok)
     elapsed = time.perf_counter() - start
     wire_delta = (wire["bytes_sent"] - wire_before) if wire else 0
     # Flight recorder (ISSUE 8): inside a train session this wall time is
@@ -838,6 +911,17 @@ def _instrumented_outer(op: str, group: BaseGroup, array, call):
         seconds=elapsed,
     )
     return result
+
+
+def _chaos_uniform_then(call):
+    """Uniform-slowness injection point (false-positive guard, ISSUE 14):
+    unlike the per-rank point above, this sleeps INSIDE the flight
+    record on every rank that arms it, so completed-op durations carry
+    the slowness and the adaptive p95 deadline must absorb it."""
+    delay = chaos.latency_delay("collective.op.uniform")
+    if delay > 0:
+        time.sleep(delay)
+    return call()
 
 
 def allreduce(array, group_name: str = "default", op: str = SUM):
@@ -893,11 +977,25 @@ def recv(
 
 
 def _traced_method(op: str, fn):
+    # Where the method's ``tag`` parameter sits positionally (past
+    # ``self``), resolved once at wrap time — op strings ("max") and
+    # tags are both str, so a scan-for-str heuristic would misfire.
+    try:
+        params = list(inspect.signature(fn).parameters)
+        tag_pos = params.index("tag") - 1
+    except ValueError:
+        tag_pos = None
+
     @functools.wraps(fn)
     def wrapper(self, *args, **kwargs):
         payload = args[0] if args else None
+        tag = kwargs.get("tag")
+        if tag is None and tag_pos is not None and len(args) > tag_pos:
+            candidate = args[tag_pos]
+            if isinstance(candidate, str):
+                tag = candidate
         return _instrumented(
-            op, self, payload, lambda: fn(self, *args, **kwargs)
+            op, self, payload, lambda: fn(self, *args, **kwargs), tag=tag
         )
     return wrapper
 

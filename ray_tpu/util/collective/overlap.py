@@ -41,6 +41,7 @@ import numpy as np
 
 from ray_tpu.train._internal import step_stats
 from ray_tpu.util.collective import bucketing
+from ray_tpu.util.collective import flight
 
 # Buckets in flight at once. More than a few saturates the shared RPC
 # lane; fewer leaves the ring idle between hops.
@@ -73,11 +74,12 @@ def supports_overlap(group: Any) -> bool:
 class SyncHandle:
     """In-flight bucketed sync: one future per bucket, fenced once."""
 
-    def __init__(self, buckets: Sequence[bucketing.Bucket]):
+    def __init__(self, buckets: Sequence[bucketing.Bucket], group: Any = None):
         self.buckets = list(buckets)
         self.futures: list[Future] = []
         self.launched_at = time.perf_counter()
         self.stats: dict[str, float] = {}
+        self._group = group
 
     def fence(self) -> list[np.ndarray]:
         """Block until every bucket's reduction lands. Returns reduced
@@ -85,7 +87,25 @@ class SyncHandle:
         the ``comm_exposed`` phase (floored at a tick so the recorder
         can tell "overlap ran and hid everything" from "no overlap")."""
         t0 = time.perf_counter()
-        results = [f.result() for f in self.futures]
+        g = self._group
+        rec = None
+        if g is not None:
+            # The fence itself is an in-flight comm op: if a bucket's
+            # allreduce wedges on a pool thread, this record is what
+            # ages past the watchdog deadline on the caller's behalf.
+            rec = flight.op_started(
+                g.group_name, "overlap.fence", f"b{len(self.buckets)}",
+                g.rank, g.world_size,
+                backend=getattr(g, "backend_name", ""),
+            )
+        try:
+            results = [f.result() for f in self.futures]
+        except BaseException:
+            if rec is not None:
+                flight.completed(rec, ok=False)
+            raise
+        if rec is not None:
+            flight.completed(rec)
         exposed = time.perf_counter() - t0
         self.stats = {
             "comm_exposed_s": exposed,
@@ -122,8 +142,14 @@ def launch_bucketed_allreduce(
         )
     template = per_device_leaves[0]
     buckets = bucketing.partition_buckets(template, bucket_bytes)
-    handle = SyncHandle(buckets)
+    handle = SyncHandle(buckets, group=group)
     pool = _pool(group)
+    flight.note(
+        group.group_name, "overlap.launch", f"b{len(buckets)}",
+        rank=group.rank, world_size=group.world_size,
+        nbytes=sum(b.nbytes for b in buckets),
+        backend=getattr(group, "backend_name", ""),
+    )
     for bucket in buckets:
         segments = [
             bucketing.gather_segment(leaves, bucket)

@@ -215,6 +215,51 @@ def record_collective_op(
 
 
 # ---------------------------------------------------------------------------
+# Comm flight recorder series (ISSUE 14): the per-process watchdog counts
+# suspected stalls and exports an in-flight gauge each tick. Gauges are
+# snapshots (overwritten, never drained), so a retried metrics flush stays
+# idempotent — the PR-5 snapshot-don't-drain rule.
+# ---------------------------------------------------------------------------
+
+_comm_stalls: Counter | None = None
+_comm_inflight: Gauge | None = None
+_comm_inflight_age: Gauge | None = None
+
+
+def record_comm_stall(group: str, channel: str) -> None:
+    """One watchdog-suspected comm stall: rt_comm_stalls_total{group,
+    channel} (channel = ``group:kind:tag-skeleton`` flight channel id)."""
+    global _comm_stalls
+    if _comm_stalls is None:
+        _comm_stalls = Counter(
+            "rt_comm_stalls_total",
+            description="Comm watchdog suspected-stall events",
+            tag_keys=("group", "channel"),
+        )
+    _comm_stalls.inc(1, tags={"group": group, "channel": channel})
+
+
+def set_comm_inflight(count: int, oldest_age_s: float, identity: str) -> None:
+    """Current in-flight comm ops on this process: rt_comm_inflight{worker}
+    plus the age of the oldest one (the watchdog's stall candidate)."""
+    global _comm_inflight, _comm_inflight_age
+    if _comm_inflight is None:
+        _comm_inflight = Gauge(
+            "rt_comm_inflight",
+            description="Comm ops currently in flight",
+            tag_keys=("worker",),
+        )
+        _comm_inflight_age = Gauge(
+            "rt_comm_inflight_oldest_age_s",
+            description="Age of the oldest in-flight comm op (seconds)",
+            tag_keys=("worker",),
+        )
+    tags = {"worker": identity}
+    _comm_inflight.set(float(count), tags=tags)
+    _comm_inflight_age.set(float(oldest_age_s), tags=tags)
+
+
+# ---------------------------------------------------------------------------
 # Serve SLO series (ISSUE 8): every proxied request feeds a per-route
 # latency histogram + status counter; replicas push occupancy gauges.
 # These are the Prometheus half of the flight recorder's serve view (the

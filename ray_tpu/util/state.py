@@ -216,6 +216,19 @@ def summarize_comm(session_dir: str | None = None) -> dict:
         spans = tracing.read_spans(session_dir)
     except Exception:
         return {}
+    # Watchdog-suspected stalls fold in as per-op columns (count + the
+    # channel names blamed), so the comm table answers "slow or WEDGED"
+    # in one view. Best-effort: no controller, no stall columns.
+    stall_count: dict[str, int] = {}
+    stall_channels: dict[str, set] = {}
+    try:
+        for ev in summarize_commflight().get("stalls", []):
+            op = ev.get("kind", "?")
+            stall_count[op] = stall_count.get(op, 0) + 1
+            if ev.get("channel"):
+                stall_channels.setdefault(op, set()).add(ev["channel"])
+    except Exception:  # rtlint: disable=swallowed-exception - stall columns are optional; spans alone still summarize
+        pass
     acc: dict[str, dict] = {}
     for span in spans:
         name = span.get("name", "")
@@ -238,6 +251,7 @@ def summarize_comm(session_dir: str | None = None) -> dict:
         durs = sorted(acc[key]["durs"])
         total_ms = sum(durs)
         nbytes = acc[key]["bytes"]
+        op = key.split("/", 1)[0]
         out[key] = {
             "count": len(durs),
             "total_ms": total_ms,
@@ -248,8 +262,46 @@ def summarize_comm(session_dir: str | None = None) -> dict:
             "bytes_per_s": (
                 nbytes / (total_ms / 1e3) if total_ms > 0 else 0.0
             ),
+            "stalls": stall_count.get(op, 0),
+            "stalled_channels": sorted(stall_channels.get(op, ())),
         }
     return out
+
+
+def summarize_commflight() -> dict:
+    """Live comm-plane flight-recorder view from the controller: recent
+    watchdog ``comm_stall`` events, per-worker in-flight gauges (count +
+    oldest-op age, overwritten each watchdog tick — snapshots, never
+    drained), and the number of merged hang reports available. Empty
+    structure — never an exception — on a fresh or absent cluster."""
+    try:
+        out = _call("comm_summary")
+    except Exception:
+        out = None
+    if not isinstance(out, dict):
+        out = {}
+    out.setdefault("stall_total", 0)
+    out.setdefault("stalls", [])
+    out.setdefault("last_stall_age_s", None)
+    out.setdefault("inflight", {})
+    out.setdefault("hang_reports", 0)
+    return out
+
+
+def get_hang_report(fresh: bool = False, stacks: bool = True) -> dict:
+    """The controller's latest merged hang report (see
+    ``ray_tpu._private.hang_doctor.build_report``); ``fresh=True`` forces
+    a cluster-wide evidence harvest right now (the `ray_tpu doctor
+    --hang` path when nothing has auto-fired yet)."""
+    out = _call("hang_report", {"fresh": bool(fresh), "stacks": bool(stacks)})
+    return out.get("report", {}) if isinstance(out, dict) else {}
+
+
+def collect_cluster_stacks() -> dict:
+    """Native Python stack dump of every worker on every alive node,
+    keyed node -> worker (the `ray_tpu stacks` CLI; no py-spy needed)."""
+    out = _call("cluster_stacks")
+    return out.get("nodes", {}) if isinstance(out, dict) else {}
 
 
 # ---------------------------------------------------------------------------
@@ -416,6 +468,7 @@ def collect_diagnose_snapshot(session_dir: str | None = None) -> dict:
         "goodput": {"runs": {}},
         "workload": {"series": {}},
         "rank_records": {},
+        "commflight": {},
     }
     try:
         snapshot["latency"] = summarize_latency(session_dir)
@@ -427,6 +480,10 @@ def collect_diagnose_snapshot(session_dir: str | None = None) -> dict:
         pass
     try:
         snapshot["resources"] = summarize_resources()
+    except Exception:  # rtlint: disable=swallowed-exception - summaries are independent; a failed one keeps its default
+        pass
+    try:
+        snapshot["commflight"] = summarize_commflight()
     except Exception:  # rtlint: disable=swallowed-exception - summaries are independent; a failed one keeps its default
         pass
     snapshot["workload"] = summarize_workload()

@@ -1,0 +1,282 @@
+"""Hang-doctor chaos gate + flight-recorder overhead (ISSUE 14).
+
+Three phases, one JSON verdict line:
+
+  1. stall      — a REAL 2-worker ring cluster under a windowed chaos
+                  fail-point that delays exactly ONE rank's allreduces.
+                  The comm watchdog on the waiting rank must fire, the
+                  controller must auto-harvest a cluster-wide hang
+                  report, and that report must name the delayed rank
+                  (and never blame the waiter) within a bounded
+                  detection latency.
+  2. uniform    — the same latency injected on EVERY rank via the
+                  in-op uniform point: the p95-adaptive per-channel
+                  deadline must absorb it with ZERO stall events
+                  (the false-positive guard).
+  3. overhead   — the recorder hot path (op_started/completed) timed
+                  in-process over many iterations; the gate metric is
+                  (records per op x per-record cost) / the measured
+                  per-op latency from phase 1's warmup — i.e. what the
+                  PR-7 collective microbench would actually pay for
+                  recording, computed deterministically instead of as
+                  a noisy wall-clock A/B. The A/B would hide a 2% cost
+                  inside run-to-run jitter; this form cannot.
+
+Gates (release_tests.yaml): stall_detected==1, named_rank_correct==1,
+false_positives==0, recorder_overhead<=0.02.
+
+Prints ONE JSON line, e.g.:
+  {"stall_detected": 1, "named_rank_correct": 1, "false_positives": 0,
+   "recorder_overhead": 0.0004, "detection_latency_s": 2.1, ...}
+
+RAY_TPU_RELEASE_SMOKE=1 shrinks the chaos windows so the suite fits CI.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from bench_env import force_cpu, smoke
+
+force_cpu()
+
+import os
+import statistics
+import time
+
+SMOKE = smoke()
+
+# Watchdog tuned for a bench-sized run: fast ticks, 1s floor, short
+# harvest debounce — same knobs the e2e tests pin.
+WATCHDOG_ENV = {
+    "RAY_TPU_COMM_WATCHDOG_TICK_S": "0.1",
+    "RAY_TPU_COMM_WATCHDOG_MIN_S": "1.0",
+    "RAY_TPU_COMM_WATCHDOG_K": "4.0",
+    "RAY_TPU_COMM_WATCHDOG_MIN_SAMPLES": "4",
+    "RAY_TPU_COMM_WATCHDOG_STARTUP_S": "3.0",
+    "RAY_TPU_COMM_WATCHDOG_COOLDOWN_S": "1.0",
+    "RAY_TPU_HANG_HARVEST_COOLDOWN_S": "1",
+}
+
+WARMUP_S = 4.0                       # chaos window opens this far in
+HORIZON_S = 8.0 if SMOKE else 12.0   # rank-0 stops issuing ops here
+STALL_MS = 4000
+UNIFORM_MS = 400.0
+UNIFORM_OPS = 10 if SMOKE else 30
+OVERHEAD_ITERS = 20_000 if SMOKE else 100_000
+
+
+def _set_env(extra):
+    env = dict(WATCHDOG_ENV)
+    env.update(extra)
+    for key, value in env.items():
+        os.environ[key] = value
+    return env
+
+
+def _clear_env(env):
+    for key in env:
+        os.environ.pop(key, None)
+
+
+def _looping_allreduces(ctx):
+    """Allreduce until rank 0's clock passes the schedule horizon; the
+    continue flag is broadcast from rank 0 so both ranks stay in
+    lockstep even while one is chaos-frozen. Returns per-op stats from
+    the local flight ring."""
+    import numpy as np
+
+    from ray_tpu._private import chaos as chaos_mod
+    from ray_tpu.util.collective import flight
+
+    sched = chaos_mod.get_injector().schedule
+    horizon = sched.epoch + float(os.environ["BENCH_HORIZON_S"])
+    group = ctx.collective()
+    ops = 0
+    cont = True
+    while cont:
+        group.allreduce(np.ones(1024, dtype=np.float32))
+        ops += 1
+        flag = (
+            np.array([1.0 if time.time() < horizon else 0.0])
+            if ctx.rank == 0 else np.zeros(1)
+        )
+        cont = bool(group.broadcast(flag, src_rank=0)[0] > 0.5)
+    records = flight.snapshot(last_n=4096)
+    durations = sorted(
+        r["duration_s"] for r in records
+        if r["kind"] == "allreduce" and r.get("duration_s") is not None
+    )
+    # Warmup median: delayed ops sit in the top tail, so the median of
+    # the first (pre-window) half is the honest no-chaos op latency.
+    warm = durations[: max(1, len(durations) // 2)]
+    return {
+        "rank": ctx.rank,
+        "ops": ops,
+        "stalls": flight.stall_count(),
+        "records_total": len(records),
+        "median_op_s": statistics.median(warm),
+    }
+
+
+def _uniform_allreduces(ctx):
+    import numpy as np
+
+    from ray_tpu.util.collective import flight
+
+    group = ctx.collective()
+    for _ in range(int(os.environ["BENCH_UNIFORM_OPS"])):
+        group.allreduce(np.ones(1024, dtype=np.float32))
+    return {"rank": ctx.rank, "stalls": flight.stall_count()}
+
+
+def _phase_stall() -> dict:
+    import ray_tpu
+    from ray_tpu._private import chaos as chaos_core
+    from ray_tpu.util import state
+    from ray_tpu.util.gang import WorkerGang
+
+    epoch = time.time()
+    env = _set_env({
+        "BENCH_HORIZON_S": str(HORIZON_S),
+        "RAY_TPU_chaos": json.dumps({
+            "seed": 14,
+            "epoch": epoch,
+            "latency_points": {
+                "collective.allreduce.rank1": {
+                    "extra_ms": STALL_MS,
+                    "start_s": WARMUP_S,
+                    "duration_s": HORIZON_S - WARMUP_S + float(STALL_MS) / 1e3,
+                },
+            },
+        }),
+    })
+    chaos_core.reset()
+    ray_tpu.init(num_cpus=8)
+    out = {}
+    try:
+        gang = WorkerGang(2, backend="ring")
+        try:
+            results = gang.run(_looping_allreduces, timeout=180)
+            deadline = time.time() + 30.0
+            summary = state.summarize_commflight()
+            while (
+                summary["stall_total"] < 1 or summary["hang_reports"] < 1
+            ) and time.time() < deadline:
+                time.sleep(0.5)
+                summary = state.summarize_commflight()
+            report = state.get_hang_report()
+            blamed, waiting = set(), set()
+            for chan in report.get("channels", []):
+                blamed.update(chan.get("suspect_ranks", []))
+                waiting.update(
+                    w["rank"] for w in chan.get("waiting_ranks", [])
+                )
+            detection = None
+            if summary["stalls"]:
+                first = min(
+                    ev.get("received_at", float("inf"))
+                    for ev in summary["stalls"]
+                )
+                detection = first - (epoch + WARMUP_S)
+            out = {
+                "ops": results[0]["ops"],
+                "stall_total": summary["stall_total"],
+                "stall_detected": int(summary["stall_total"] >= 1),
+                "named_rank_correct": int(
+                    blamed == {1} and 1 not in waiting and bool(report.get("channels"))
+                ),
+                "detection_latency_s": (
+                    round(detection, 3) if detection is not None else None
+                ),
+                "hang_report_summary": report.get("summary", []),
+                "median_op_s": results[0]["median_op_s"],
+                "records_per_op": (
+                    results[0]["records_total"] / max(1, results[0]["ops"])
+                ),
+            }
+        finally:
+            gang.shutdown()
+    finally:
+        ray_tpu.shutdown()
+        _clear_env(env)
+        os.environ.pop("RAY_TPU_chaos", None)
+        chaos_core.reset()
+    return out
+
+
+def _phase_uniform() -> dict:
+    import ray_tpu
+    from ray_tpu._private import chaos as chaos_core
+    from ray_tpu.util import state
+    from ray_tpu.util.gang import WorkerGang
+
+    env = _set_env({
+        "BENCH_UNIFORM_OPS": str(UNIFORM_OPS),
+        "RAY_TPU_chaos": json.dumps({
+            "seed": 15,
+            "latency_points": {"collective.op.uniform": UNIFORM_MS},
+        }),
+    })
+    chaos_core.reset()
+    ray_tpu.init(num_cpus=8)
+    try:
+        gang = WorkerGang(2, backend="ring")
+        try:
+            results = gang.run(_uniform_allreduces, timeout=180)
+            summary = state.summarize_commflight()
+            return {
+                "false_positives": (
+                    summary["stall_total"]
+                    + sum(r["stalls"] for r in results)
+                ),
+            }
+        finally:
+            gang.shutdown()
+    finally:
+        ray_tpu.shutdown()
+        _clear_env(env)
+        chaos_core.reset()
+
+
+def _phase_overhead(median_op_s: float, records_per_op: float) -> dict:
+    """Deterministic record-path cost: a dedicated recorder (watchdog
+    off) absorbs OVERHEAD_ITERS op_started/completed pairs; the gate is
+    that cost scaled by the REAL records-per-op and op latency measured
+    in phase 1."""
+    from ray_tpu.util.collective import flight
+
+    rec = flight.FlightRecorder(
+        capacity=4096, publish=lambda e: None, start_watchdog=False,
+    )
+    start = time.perf_counter()
+    for i in range(OVERHEAD_ITERS):
+        r = rec.start(
+            "bench", "allreduce", "__ar", rank=0, world_size=2,
+            nbytes=4096, backend="ring",
+        )
+        r.state = flight.LAUNCHED
+        rec.completed(r)
+    per_record_s = (time.perf_counter() - start) / OVERHEAD_ITERS
+    overhead = (per_record_s * records_per_op) / max(median_op_s, 1e-9)
+    return {
+        "per_record_us": round(per_record_s * 1e6, 3),
+        "recorder_overhead": round(overhead, 6),
+    }
+
+
+def main() -> int:
+    result = {"benchmark": "hang_doctor", "smoke": int(SMOKE)}
+    stall = _phase_stall()
+    result.update(stall)
+    result.update(_phase_uniform())
+    result.update(_phase_overhead(
+        stall.get("median_op_s") or 1e-3,
+        stall.get("records_per_op") or 1.0,
+    ))
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

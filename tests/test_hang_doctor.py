@@ -1,0 +1,537 @@
+"""Comm-plane flight recorder + hang doctor (ISSUE 14).
+
+Three layers, cheapest first:
+
+* deterministic units — the ring buffer, the adaptive per-channel
+  deadline, and ``check_once`` run against an injected clock (no
+  watchdog thread, no sleeps);
+* evidence-merge units — ``hang_doctor.build_report`` on synthetic
+  harvests must name exactly which ranks are missing from which
+  ``(group, tag, seq)`` frontier, and flag protocol drift only for a
+  p2p channel the static commgraph cannot unify;
+* chaos e2e — a windowed fail-point delays exactly ONE rank's
+  allreduce: the watchdog must fire, the controller's auto-harvested
+  hang report must name that rank, and detection latency is bounded.
+  The twin guard test injects the SAME latency uniformly on every
+  rank: the p95-adaptive deadline must then produce zero stalls.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos as chaos_core
+from ray_tpu._private import hang_doctor
+from ray_tpu._private.chaos import FaultSchedule
+from ray_tpu.util.collective import flight
+from ray_tpu.util.gang import WorkerGang
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_recorder(capacity=16, publish=None, **tuning):
+    clock = FakeClock()
+    rec = flight.FlightRecorder(
+        capacity=capacity,
+        clock=clock,
+        publish=publish if publish is not None else (lambda e: None),
+        start_watchdog=False,
+    )
+    for key, value in tuning.items():
+        setattr(rec, key, value)
+    return rec, clock
+
+
+# ---------------------------------------------------------------------------
+# ring buffer + record lifecycle
+# ---------------------------------------------------------------------------
+
+def test_channel_skeleton_folds_digit_runs():
+    assert flight.channel_skeleton("s3.f2v11") == "s{}.f{}v{}"
+    assert flight.channel_skeleton("__barrier7/r0") == "__barrier{}/r{}"
+    assert flight.channel_skeleton("") == ""
+    assert flight.channel_id("train", "recv", "act.s4") == "train:recv:act.s{}"
+
+
+def test_ring_wraparound_keeps_newest_records():
+    rec, _ = make_recorder(capacity=4)
+    for i in range(6):
+        rec.note("g", "allreduce", "__ar", rank=0, world_size=2)
+    snap = rec.snapshot()
+    assert len(snap) == 4
+    # Oldest two fell off the ring; survivors are newest-last by rid.
+    assert [r["rid"] for r in snap] == [2, 3, 4, 5]
+    assert all(r["state"] == "completed" for r in snap)
+    assert all("duration_s" in r for r in snap)
+
+
+def test_record_lifecycle_and_inflight_summary():
+    rec, clock = make_recorder()
+    r = rec.start("train", "recv", "act.s1", rank=0, world_size=2, peer=1)
+    assert r.state == flight.ENQUEUED
+    assert r.seq == 0
+    rec.launched(r)
+    assert r.state == flight.LAUNCHED
+    clock.advance(1.5)
+    summary = rec.inflight_summary()
+    assert summary["count"] == 1
+    assert summary["oldest_age_s"] == pytest.approx(1.5)
+    assert summary["channels"] == ["train:recv:act.s{}"]
+    # An in-flight snapshot entry reports its age, not a duration.
+    live = rec.snapshot()[-1]
+    assert live["age_s"] == pytest.approx(1.5)
+    rec.completed(r)
+    assert r.state == flight.COMPLETED
+    assert rec.inflight_summary()["count"] == 0
+    assert rec.snapshot()[-1]["duration_s"] == pytest.approx(1.5)
+
+    # Failed ops leave the in-flight map but never feed the p95 window.
+    bad = rec.start("train", "recv", "act.s2", rank=0, world_size=2, peer=1)
+    rec.completed(bad, ok=False)
+    assert bad.state == flight.FAILED
+    assert len(rec._chan_stats["train:recv:act.s{}"]) == 1
+
+
+def test_per_channel_seq_is_independent():
+    rec, _ = make_recorder()
+    a0 = rec.start("g1", "allreduce", "__ar")
+    a1 = rec.start("g1", "allreduce", "__ar")
+    b0 = rec.start("g2", "allreduce", "__ar")
+    assert (a0.seq, a1.seq, b0.seq) == (0, 1, 0)
+    # Tags in one skeleton family share a channel, hence a sequence.
+    s0 = rec.start("g1", "send", "mb3")
+    s1 = rec.start("g1", "send", "mb7")
+    assert s0.channel == s1.channel == "g1:send:mb{}"
+    assert (s0.seq, s1.seq) == (0, 1)
+    # p2p call sites pass the real mailbox seq instead.
+    explicit = rec.start("g1", "send", "mb9", seq=41)
+    assert explicit.seq == 41
+
+
+def test_site_label_and_trace_id_travel_with_the_record():
+    rec, _ = make_recorder()
+    with flight.site("pipeline"):
+        r = rec.start("train", "send", "act.s0", peer=1)
+    r.trace_id = "deadbeef"
+    out = r.to_dict()
+    assert out["site"] == "pipeline"
+    assert out["trace_id"] == "deadbeef"
+    # The label is scoped: records outside the block carry none.
+    assert rec.start("train", "send", "act.s0", peer=1).site is None
+
+
+# ---------------------------------------------------------------------------
+# adaptive deadline + watchdog scan
+# ---------------------------------------------------------------------------
+
+def test_deadline_startup_then_adapts_to_p95():
+    rec, clock = make_recorder(
+        min_deadline_s=1.0, k=2.0, min_samples=4, startup_deadline_s=10.0,
+    )
+    chan = "g:allreduce:__ar"
+    # Unarmed channel: generous startup grace (cold compile).
+    assert rec.deadline_s(chan) == 10.0
+    for _ in range(4):
+        r = rec.start("g", "allreduce", "__ar")
+        clock.advance(2.0)
+        rec.completed(r)
+    # Armed: k * p95 of observed 2.0s completions.
+    assert rec.deadline_s(chan) == pytest.approx(4.0)
+    # The floor wins when the channel is fast.
+    for _ in range(4):
+        r = rec.start("g", "allreduce", "__ar")
+        clock.advance(0.01)
+        rec.completed(r)
+    assert rec.deadline_s(chan) >= 1.0
+
+
+def test_check_once_fires_marks_stalled_and_cools_down():
+    events = []
+    rec, clock = make_recorder(
+        publish=events.append,
+        min_deadline_s=0.5, startup_deadline_s=1.0, cooldown_s=5.0,
+    )
+    r1 = rec.start("g", "recv", "act.s0", rank=0, world_size=2, peer=1)
+    clock.advance(0.5)
+    assert rec.check_once() == []          # under deadline: quiet
+    clock.advance(1.5)
+    fired = rec.check_once()
+    assert len(fired) == 1
+    ev = fired[0]
+    assert ev["channel"] == "g:recv:act.s{}"
+    assert ev["age_s"] == pytest.approx(2.0)
+    assert ev["deadline_s"] == pytest.approx(1.0)
+    assert r1.stalled is True
+    assert events == fired
+    assert rec.stall_count() == 1
+    # Same record never re-fires; a fresh breach on the same channel
+    # inside the cooldown is marked stalled but not published.
+    r2 = rec.start("g", "recv", "act.s0", rank=0, world_size=2, peer=1)
+    clock.advance(2.0)
+    assert rec.check_once() == []
+    assert r2.stalled is True
+    # After the cooldown the channel may fire again.
+    r3 = rec.start("g", "recv", "act.s0", rank=0, world_size=2, peer=1)
+    clock.advance(4.0)
+    assert len(rec.check_once()) == 1
+    assert rec.stall_count() == 2
+    assert r3.stalled is True
+
+
+# ---------------------------------------------------------------------------
+# evidence merge (hang_doctor on synthetic harvests)
+# ---------------------------------------------------------------------------
+
+def _rec(rank, state, seq, *, peer=-1, age=None, worker=None,
+         channel="train:recv:act.s{}", stalled=False):
+    group, kind, skel = channel.split(":")
+    out = {
+        "group": group, "kind": kind, "tag": skel, "channel": channel,
+        "seq": seq, "rank": rank, "world_size": 4, "peer": peer,
+        "state": state, "stalled": stalled,
+        "_worker": worker or f"w{rank}", "_node": "node-a",
+    }
+    if age is not None:
+        out["age_s"] = age
+    return out
+
+
+def test_merge_channel_names_missing_ranks_at_the_frontier():
+    records = [
+        # rank 0 waits at seq 7 on rank 3; rank 1 already completed 7.
+        _rec(0, "launched", 7, peer=3, age=12.5),
+        _rec(0, "completed", 6),
+        _rec(1, "completed", 7),
+        _rec(2, "completed", 6),   # behind the frontier, not waiting
+        # rank 3: no record at all (wedged before the recorder saw it)
+    ]
+    merged = hang_doctor._merge_channel("train:recv:act.s{}", records)
+    assert merged["world_size"] == 4
+    assert merged["frontier_seq"] == 7
+    assert [w["rank"] for w in merged["waiting_ranks"]] == [0]
+    assert merged["waiting_ranks"][0]["age_s"] == pytest.approx(12.5)
+    assert merged["missing_ranks"] == [2, 3]
+    # rank 3 is doubly damned: missing AND explicitly waited on.
+    assert merged["suspect_ranks"] == [2, 3]
+    assert merged["last_completed_seq_by_rank"] == {"0": 6, "1": 7, "2": 6}
+    assert merged["rank_worker"]["0"] == "w0"
+
+
+def test_merge_channel_suspects_peer_with_no_evidence():
+    # Only the waiter's evidence arrived (peer's node died): the wire
+    # record's peer pointer still names the suspect.
+    records = [_rec(0, "launched", 3, peer=2, age=30.0)]
+    merged = hang_doctor._merge_channel("train:recv:act.s{}", records)
+    assert 2 in merged["suspect_ranks"]
+    assert 0 not in merged["suspect_ranks"]
+
+
+def test_build_report_merges_harvest_and_flags_drift():
+    stalls = [{"channel": "train:recv:act.s{}", "group": "train",
+               "kind": "recv", "age_s": 12.5, "deadline_s": 2.0}]
+    evidence = {
+        "node-a": {
+            "status": "ok",
+            "workers": {
+                "w0": {
+                    "status": "ok",
+                    "pid": 111,
+                    "records": [
+                        _rec(0, "launched", 7, peer=1, age=12.5),
+                        # A second wedged channel the static graph has
+                        # never certified -> protocol drift.
+                        _rec(0, "launched", 2, peer=1, age=9.0,
+                             channel="train:send:rogue.q{}", stalled=True),
+                    ],
+                    "stacks": {"MainThread": "File ...recv..."},
+                },
+                "w1": {
+                    "status": "ok",
+                    "pid": 222,
+                    "records": [_rec(1, "completed", 6)],
+                    "stacks": {"MainThread": "File ...sleep..."},
+                },
+                "w2": {"status": "error", "error": "worker gone"},
+            },
+        },
+        "node-b": {"status": "error", "error": "agent unreachable"},
+    }
+    static_sites = [
+        {"kind": "recv", "tag": "act.s{}"},
+        {"kind": "send", "tag": "act.s{}"},
+    ]
+    report = hang_doctor.build_report(
+        stalls, evidence, static_sites=static_sites,
+    )
+    assert report["nodes"] == ["node-a"]
+    assert report["workers_reporting"] == 2
+    by_channel = {c["channel"]: c for c in report["channels"]}
+    certified = by_channel["train:recv:act.s{}"]
+    assert certified["in_static_graph"] is True
+    assert certified["protocol_drift"] is False
+    assert 1 in certified["suspect_ranks"]
+    rogue = by_channel["train:send:rogue.q{}"]
+    assert rogue["in_static_graph"] is False
+    assert rogue["protocol_drift"] is True
+    drift_lines = [l for l in report["summary"] if "PROTOCOL DRIFT" in l]
+    assert len(drift_lines) == 1 and "rogue" in drift_lines[0]
+    # Every summary line names at least one suspect rank.
+    assert all("suspect rank" in l for l in report["summary"])
+    assert report["stacks"]["w0"]["pid"] == 111
+    # stacks can be elided for the compact CLI path
+    lean = hang_doctor.build_report(
+        stalls, evidence, static_sites=static_sites, include_stacks=False,
+    )
+    assert lean["stacks"] == {}
+
+
+def test_channel_in_static_graph_degrades_to_unknown():
+    sites = [{"kind": "recv", "tag": "act.s{}"}]
+    assert hang_doctor.channel_in_static_graph("recv", "act.s{}", sites)
+    assert hang_doctor.channel_in_static_graph("send", "zzz{}", sites) is False
+    # Collective kinds carry recorder-synthesized tags: never drift.
+    assert hang_doctor.channel_in_static_graph("allreduce", "__ar", sites) is None
+    # No harvested sites at all: unknown, never a false positive.
+    assert hang_doctor.channel_in_static_graph("recv", "act.s{}", []) is None
+
+
+def test_static_comm_sites_env_kill_switch(monkeypatch):
+    hang_doctor._reset_static_cache()
+    monkeypatch.setenv("RAY_TPU_HANG_STATIC_RECONCILE", "0")
+    assert hang_doctor.static_comm_sites() == []
+    monkeypatch.delenv("RAY_TPU_HANG_STATIC_RECONCILE")
+    hang_doctor._reset_static_cache()
+    sites = hang_doctor.static_comm_sites()
+    try:
+        # The real package walk must certify the ring wire itself.
+        assert any(s.get("kind") in ("send", "recv") for s in sites)
+    finally:
+        hang_doctor._reset_static_cache()
+
+
+# ---------------------------------------------------------------------------
+# chaos schedule: windowed latency points
+# ---------------------------------------------------------------------------
+
+def test_chaos_windowed_latency_point():
+    try:
+        chaos_core.install(FaultSchedule(
+            0,
+            latency_points={
+                "p.win": {"extra_ms": 2000, "start_s": 4.0, "duration_s": 3.0},
+                "p.flat": 250.0,
+            },
+            epoch=time.time() - 5.0,      # elapsed ~5s: inside [4, 7)
+        ), export_env=False)
+        assert chaos_core.latency_delay("p.win") == pytest.approx(2.0)
+        assert chaos_core.latency_delay("p.flat") == pytest.approx(0.25)
+        assert chaos_core.latency_delay("p.unarmed") == 0.0
+
+        chaos_core.install(FaultSchedule(
+            0,
+            latency_points={"p.win": {"extra_ms": 2000, "start_s": 4.0,
+                                      "duration_s": 3.0}},
+            epoch=time.time() - 10.0,     # elapsed ~10s: window closed
+        ), export_env=False)
+        assert chaos_core.latency_delay("p.win") == 0.0
+
+        chaos_core.install(FaultSchedule(
+            0,
+            latency_points={"p.win": {"extra_ms": 2000, "start_s": 60.0}},
+            epoch=time.time(),            # window not yet open
+        ), export_env=False)
+        assert chaos_core.latency_delay("p.win") == 0.0
+        # The windowed form survives the env round-trip workers take.
+        rt = FaultSchedule.from_json(chaos_core.get_injector().schedule.to_json())
+        assert rt.latency_points["p.win"]["extra_ms"] == 2000
+    finally:
+        chaos_core.reset()
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: one laggard rank -> named; uniform slowness -> silence
+# ---------------------------------------------------------------------------
+
+_WATCHDOG_ENV = {
+    "RAY_TPU_COMM_WATCHDOG_TICK_S": "0.1",
+    "RAY_TPU_COMM_WATCHDOG_MIN_S": "1.0",
+    "RAY_TPU_COMM_WATCHDOG_K": "4.0",
+    "RAY_TPU_COMM_WATCHDOG_MIN_SAMPLES": "4",
+    "RAY_TPU_COMM_WATCHDOG_STARTUP_S": "3.0",
+    "RAY_TPU_COMM_WATCHDOG_COOLDOWN_S": "1.0",
+    "RAY_TPU_HANG_HARVEST_COOLDOWN_S": "1",
+}
+
+
+def _comm_cluster(extra_env):
+    assert not ray_tpu.is_initialized()
+    env = dict(_WATCHDOG_ENV)
+    env.update(extra_env)
+    for key, value in env.items():
+        os.environ[key] = value
+    # Workers inherit os.environ at spawn; the driver's cached (chaos-
+    # blind) injector must be dropped so everyone shares the schedule.
+    chaos_core.reset()
+    ray_tpu.init(num_cpus=8)
+    return env
+
+
+def _teardown_comm_cluster(env):
+    ray_tpu.shutdown()
+    for key in env:
+        os.environ.pop(key, None)
+    chaos_core.reset()
+
+
+@pytest.fixture()
+def stall_cluster():
+    epoch = time.time()
+    env = _comm_cluster({
+        "RAY_TPU_chaos": json.dumps({
+            "seed": 14,
+            "epoch": epoch,
+            "latency_points": {
+                # Exactly ONE rank's allreduces freeze for a 8s window
+                # opening 4s in — peers' records age at the frontier.
+                "collective.allreduce.rank1": {
+                    "extra_ms": 4000, "start_s": 4.0, "duration_s": 8.0,
+                },
+            },
+        }),
+    })
+    try:
+        yield epoch
+    finally:
+        _teardown_comm_cluster(env)
+
+
+@pytest.fixture()
+def uniform_latency_cluster():
+    env = _comm_cluster({
+        "RAY_TPU_chaos": json.dumps({
+            "seed": 15,
+            # Float form (backward compat): every rank, whole run.
+            "latency_points": {"collective.op.uniform": 400.0},
+        }),
+    })
+    try:
+        yield
+    finally:
+        _teardown_comm_cluster(env)
+
+
+def _looping_allreduces(ctx):
+    """Allreduce until rank 0's wall clock passes the schedule horizon.
+    The continue flag is broadcast from rank 0 so both ranks always
+    agree on the iteration count even while one of them is frozen."""
+    from ray_tpu._private import chaos as chaos_mod
+    from ray_tpu.util.collective import flight as flight_mod
+
+    sched = chaos_mod.get_injector().schedule
+    assert sched is not None, "worker inherited no chaos schedule"
+    horizon = sched.epoch + 8.0
+    group = ctx.collective()
+    ops = 0
+    cont = True
+    while cont:
+        group.allreduce(np.ones(4, dtype=np.float32))
+        ops += 1
+        flag = (
+            np.array([1.0 if time.time() < horizon else 0.0])
+            if ctx.rank == 0 else np.zeros(1)
+        )
+        cont = bool(group.broadcast(flag, src_rank=0)[0] > 0.5)
+    return {
+        "rank": ctx.rank,
+        "ops": ops,
+        "stalls": flight_mod.stall_count(),
+        "inflight": flight_mod.inflight_summary()["count"],
+    }
+
+
+def test_e2e_one_slow_rank_is_named_by_the_hang_report(stall_cluster):
+    from ray_tpu.util import state
+
+    epoch = stall_cluster
+    gang = WorkerGang(2, backend="ring")
+    try:
+        results = gang.run(_looping_allreduces, timeout=120)
+        # Both ranks ran in lockstep and drained their in-flight sets.
+        assert [r["ops"] for r in results] == [results[0]["ops"]] * 2
+        assert results[0]["ops"] >= 5
+
+        # The watchdog on the WAITING rank must have fired and reported.
+        deadline = time.time() + 30.0
+        summary = state.summarize_commflight()
+        while (
+            summary["stall_total"] < 1 or summary["hang_reports"] < 1
+        ) and time.time() < deadline:
+            time.sleep(0.5)
+            summary = state.summarize_commflight()
+        assert summary["stall_total"] >= 1, summary
+        assert summary["hang_reports"] >= 1, summary
+        assert summary["last_stall_age_s"] is not None
+
+        # Bounded detection latency: first controller-received stall vs
+        # the moment the chaos window opened.
+        window_open = epoch + 4.0
+        first = min(ev["received_at"] for ev in summary["stalls"])
+        latency = first - window_open
+        assert 0.0 <= latency < 20.0, f"detection latency {latency:.1f}s"
+
+        # The auto-harvested report (built WHILE the hang was live)
+        # names the chaos-frozen rank, never the waiting one.
+        report = state.get_hang_report()
+        assert report.get("channels"), report.get("summary")
+        blamed = set()
+        for chan in report["channels"]:
+            blamed.update(chan["suspect_ranks"])
+            assert isinstance(chan["frontier_seq"], int)
+            assert chan["world_size"] == 2
+        assert 1 in blamed, report["summary"]
+        assert all(w["rank"] != 1 for c in report["channels"]
+                   for w in c["waiting_ranks"])
+        assert any("suspect rank 1" in line for line in report["summary"])
+    finally:
+        gang.shutdown()
+
+
+def test_e2e_uniform_latency_yields_zero_false_positives(
+    uniform_latency_cluster,
+):
+    from ray_tpu.util import state
+
+    gang = WorkerGang(2, backend="ring")
+    try:
+        results = gang.run(_uniform_allreduces, timeout=120)
+        assert all(r["ops"] == 10 for r in results)
+        # Adaptive deadlines absorbed the uniform 400ms: no worker's
+        # watchdog fired, and the controller heard nothing.
+        assert all(r["stalls"] == 0 for r in results), results
+        summary = state.summarize_commflight()
+        assert summary["stall_total"] == 0, summary
+        assert summary["stalls"] == []
+    finally:
+        gang.shutdown()
+
+
+def _uniform_allreduces(ctx):
+    from ray_tpu.util.collective import flight as flight_mod
+
+    group = ctx.collective()
+    for _ in range(10):
+        group.allreduce(np.ones(8, dtype=np.float32))
+    return {"rank": ctx.rank, "ops": 10, "stalls": flight_mod.stall_count()}

@@ -622,6 +622,8 @@ def test_all_rules_registered():
         "tag-collision",
         "rank-asymmetric-channel",
         "schedule-deadlock",
+        # ISSUE-14 flight-recorder coverage guard
+        "comm-recorder-bypass",
     } <= names
 
 
@@ -1015,3 +1017,69 @@ def test_prune_baseline_round_trip(tmp_path):
     assert runner.main([
         "--no-cache", "--baseline", str(bl), str(fixture),
     ]) == 0
+
+
+# ---------------------------------------------------------------------------
+# comm-recorder-bypass (ISSUE 14): traffic the flight recorder can't see
+# ---------------------------------------------------------------------------
+
+RECORDER_BYPASS_BAD = """
+    class SideChannel:
+        async def push(self, client, group, rank, data):
+            await client.call(
+                f"coll_send/{group}",
+                {"src": rank, "tag": "oob#0", "data": data},
+            )
+"""
+
+RECORDER_BYPASS_OVERRIDE_BAD = """
+    from ray_tpu.util.collective.collective import RingGroup
+
+    class TurboGroup(RingGroup):
+        def send(self, array, dst_rank, tag="x"):
+            return self._fast_path(array, dst_rank, tag)
+"""
+
+RECORDER_BYPASS_GOOD = """
+    def exchange(group, arr, dst, src):
+        group.send(arr, dst, "grads/left")
+        return group.recv(src, tag="grads/left")
+"""
+
+
+def test_comm_recorder_bypass_raw_wire_rpc(tmp_path):
+    res = lint_src(
+        tmp_path, "train/side.py", RECORDER_BYPASS_BAD,
+        "comm-recorder-bypass",
+    )
+    assert rules_fired(res) == ["comm-recorder-bypass"]
+    assert "coll_send" in res.findings[0].message
+
+
+def test_comm_recorder_bypass_group_override(tmp_path):
+    res = lint_src(
+        tmp_path, "train/turbo.py", RECORDER_BYPASS_OVERRIDE_BAD,
+        "comm-recorder-bypass",
+    )
+    assert rules_fired(res) == ["comm-recorder-bypass"]
+    assert "TurboGroup.send" in res.findings[0].message
+
+
+def test_comm_recorder_bypass_blessed_idiom_clean(tmp_path):
+    # Plain group.send/recv IS the recorded path — never flagged.
+    res = lint_src(
+        tmp_path, "train/ok.py", RECORDER_BYPASS_GOOD,
+        "comm-recorder-bypass",
+    )
+    assert res.findings == []
+
+
+def test_comm_recorder_bypass_collective_module_exempt(tmp_path):
+    # The wire protocol's home gets to speak raw coll_send/.
+    res = lint_src(
+        tmp_path,
+        "ray_tpu/util/collective/collective.py",
+        RECORDER_BYPASS_BAD,
+        "comm-recorder-bypass",
+    )
+    assert res.findings == []

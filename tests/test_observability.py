@@ -447,3 +447,60 @@ def test_collective_spans_from_direct_group_calls(traced_cluster):
     assert entry["bytes"] == 2 * 4000  # 1000 f32 per rank
     assert entry["wire_bytes"] > 0  # DCN tier's serialized bytes attributed
     assert entry["total_ms"] >= 0
+
+
+def test_collective_spans_join_flight_records(traced_cluster):
+    """Regression (ISSUE 14 satellite): every collective.* span carries
+    the flight recorder's (comm_seq, comm_channel), and the ring entry
+    carries the span's trace_id — so a hang report and `ray_tpu
+    timeline` can be joined on either key."""
+    import numpy as np
+    from ray_tpu.util import tracing
+    from ray_tpu.util.gang import WorkerGang
+
+    g = WorkerGang(2, backend="ring")
+    try:
+        def fn(ctx):
+            import time as _time
+
+            from ray_tpu.util.collective import flight
+
+            coll = ctx.collective()
+            for _ in range(3):
+                coll.allreduce(np.ones(16, np.float32))
+            _time.sleep(0.4)  # outlive one flusher tick: spans hit disk
+            return [
+                {k: r[k] for k in ("kind", "seq", "channel", "trace_id")}
+                for r in flight.snapshot()
+                if r["kind"] == "allreduce"
+            ]
+
+        per_rank = g.run(fn, timeout=120)
+    finally:
+        g.shutdown()
+
+    records = [r for recs in per_rank for r in recs]
+    assert len(records) == 6  # 3 ops x 2 ranks, nested hops record nothing
+    assert all(r["trace_id"] for r in records), records
+    assert {r["seq"] for r in records} == {0, 1, 2}
+    (channel,) = {r["channel"] for r in records}
+    assert channel.endswith(":allreduce:__ar")
+
+    deadline = time.monotonic() + 30
+    spans = []
+    while time.monotonic() < deadline:
+        spans = [
+            s for s in tracing.read_spans(traced_cluster)
+            if s["name"] == "collective.allreduce"
+            and (s.get("attributes") or {}).get("comm_channel") == channel
+        ]
+        if len(spans) >= 6:
+            break
+        time.sleep(0.5)
+    assert len(spans) == 6, f"expected 6 stamped spans, got {len(spans)}"
+    # Join both ways: (trace_id, seq) pairs agree exactly.
+    span_keys = {
+        (s["trace_id"], s["attributes"]["comm_seq"]) for s in spans
+    }
+    rec_keys = {(r["trace_id"], r["seq"]) for r in records}
+    assert span_keys == rec_keys

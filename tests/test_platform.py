@@ -130,6 +130,16 @@ def test_dashboard_endpoints(ray_start_shared):
     # autoscaler status endpoint (monitor not running in this fixture)
     autoscaler = httpx.get(base + "/api/autoscaler", timeout=30).json()
     assert autoscaler == {"enabled": False}
+    # comm flight recorder view (ISSUE 14): quiet cluster -> zero stalls,
+    # and the empty shape is the full shape (snapshot, never drained).
+    commflight = httpx.get(base + "/api/commflight", timeout=30).json()
+    assert commflight["stall_total"] == 0
+    assert commflight["stalls"] == []
+    assert isinstance(commflight["inflight"], dict)
+    report = httpx.get(
+        base + "/api/commflight?report=1", timeout=60
+    ).json()
+    assert report["report"]["channels"] == []  # fresh harvest, no stalls
     assert "autoscaler" in index.text  # drill-down nav entry
     # grafana_dashboard_factory role: importable dashboard JSON with one
     # panel per live metric family
