@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Run the compiled-DAG (rtdag) suite (ISSUE 15).
+#
+# Tier-1 CI runs `pytest -m 'not slow'`, which already covers the graph
+# builder, the placement plan, fan-out/fan-in ordering, backpressure at
+# ring depth, device-vs-shm channel parity, teardown leak checks, the
+# zero-controller-RPC steady state, the commgraph DAG-wire fixtures,
+# and the chaos kill e2e (typed DAGActorDiedError + hang report naming
+# the dead rank). This script is the nightly companion that re-runs
+# that subset and then executes the compiled_dag_overhead release
+# benchmark in smoke mode, enforcing the acceptance gates
+# (hop_overhead_pct within bound, rpc_ratio>=10, dag_controller_rpcs==0)
+# via release/run_all.py.
+# Usage: ci/run_dag_bench.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== compiled DAG suite (unit + e2e) =="
+python -m pytest tests/test_dag.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== DAG chaos e2e (typed death + hang doctor) =="
+python -m pytest tests/test_dag_chaos.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== commgraph certifies DAG wires =="
+python -m ray_tpu lint --comm-graph
+
+echo "== compiled DAG release benchmark (smoke, gated) =="
+python release/run_all.py --smoke --only compiled_dag_overhead
+
+echo "compiled DAG suite: PASS"

@@ -578,6 +578,10 @@ class _ClientCallMixin:
         self.address = address
         self.name = name
         self.auto_reconnect = auto_reconnect
+        # Total call() invocations over this client's lifetime — the
+        # rtdag zero-RPC-per-step acceptance gate reads the delta across
+        # a window of steady-state executes.
+        self.calls_total = 0
         self.on_reconnect: Callable[[], Awaitable[None]] | None = None
         self._reconnect_lock: asyncio.Lock | None = None
         self._closed = False
@@ -620,6 +624,7 @@ class _ClientCallMixin:
         # frame is on the wire — callers that must order their writes
         # (actor sequence numbers) release the next writer from it while
         # still awaiting this reply concurrently.
+        self.calls_total += 1
         injector = chaos.get_injector()
         if injector.active:
             return await self._call_with_chaos(

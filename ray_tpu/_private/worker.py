@@ -205,6 +205,14 @@ def shutdown() -> None:
                 pass
             _autoscaler_monitor = None
         if _global_ctx is not None:
+            try:
+                # Compiled DAGs hold resident worker loops and ring slots
+                # — tear them down while the RPC plane is still up.
+                from ray_tpu.dag import dag as dag_mod
+
+                dag_mod.shutdown_all()
+            except Exception:  # rtlint: disable=swallowed-exception - shutdown must not be blocked by a wedged graph
+                pass
             _global_ctx.shutdown()
             _global_ctx = None
         if _local_cluster is not None:

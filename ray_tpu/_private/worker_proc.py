@@ -84,11 +84,9 @@ class WorkerRuntime:
         self._main_current_task: str | None = None
         self._cancel_target: str | None = None
         self._task_events_last_flush = 0.0
-        # compiled-graph state: dag_id → stage spec; (dag_id, seq) → buffers
-        self._dag_stages: dict[str, dict] = {}
-        self._dag_buffers: dict[str, dict] = {}
-        self._dag_results: dict[tuple, Any] = {}
-        self._dag_events: dict[tuple, asyncio.Event] = {}
+        # compiled-graph state: dag_id → resident rtdag runtime (stage
+        # loops + channels + per-dag device group), dag/executor.py
+        self._dag_runtimes: dict = {}
         # Fast execution lane (native exec queue, task_receiver.cc role):
         # push_task/push_actor_task frames bypass asyncio; the main thread
         # consumes them via rt_exec_next. Ineligible frames bounce back to
@@ -119,6 +117,7 @@ class WorkerRuntime:
         for method in (
             "push_task", "push_actor_task", "create_actor", "exit",
             "cancel_task", "dag_register", "dag_push", "dag_pop",
+            "dag_teardown",
             "profiler", "stack_trace", "engine_debug", "comm_flight",
         ):
             ctx.core_server.route(method, getattr(self, f"rpc_{method}"))
@@ -1302,215 +1301,79 @@ class WorkerRuntime:
             return {"status": "error", "error": payload}
 
     # ------------------------------------------------------------------
-    # compiled-graph (aDAG) channels [SURVEY §2.2 "Compiled graphs"]
+    # compiled-graph (rtdag) runtime [SURVEY §2.2 "Compiled graphs"]
     # ------------------------------------------------------------------
-    # The driver registers one stage spec per participating actor; pushes
-    # then flow actor→actor over direct worker RPC without driver
-    # round-trips (the reference's NCCL-channel role; here the channel is
-    # the worker's ordered RPC stream, device arrays ride ICI inside the
-    # stage's jitted fns).
+    # The driver registers this actor's stage bundle once at compile
+    # time; a resident StageLoop per stage (dag/executor.py) then moves
+    # every payload over pre-opened channels (shm ring / device p2p
+    # plane) — zero controller RPCs and zero per-hop notifies in steady
+    # state. Only the legacy socket fallback still rides dag_push/dag_pop.
 
     async def rpc_dag_register(self, conn, payload) -> dict:
-        stage = payload["stage"]
-        key = (payload["dag_id"], stage["node"])
-        self._dag_stages[key] = stage
-        self._dag_buffers.setdefault(key, {})
-        return {"status": "ok"}
+        from ray_tpu.dag.executor import DagRuntime
 
-    async def rpc_dag_teardown(self, conn, payload) -> dict:
-        """Release every resource a compiled DAG holds on this worker:
-        stage specs, buffered inputs, parked results, and any unread
-        shared-memory channel slots (reference: CompiledDAG.teardown and
-        channel closing in shared_memory_channel.py)."""
         dag_id = payload["dag_id"]
-        for key in [k for k in self._dag_stages if k[0] == dag_id]:
-            stage = self._dag_stages.pop(key)
-            self._dag_buffers.pop(key, None)
-            depth = stage.get("depth", 8)
-            # incoming channel slots are consumer-owned: delete leftovers
-            for base in stage.get("in_channels", ()):
-                for i in range(depth):
-                    try:
-                        self.ctx.store.delete(f"{base}-{i}")
-                    except Exception:  # rtlint: disable=swallowed-exception - consumer-owned slot may already be deleted
-                        pass
-        for key in [k for k in self._dag_results if k[0] == dag_id]:
-            self._dag_results.pop(key, None)
-        for key in [k for k in self._dag_events if k[0] == dag_id]:
-            self._dag_events.pop(key, None)
+        if dag_id in self._dag_runtimes:
+            return {"status": "ok"}  # idempotent re-register
+        loop = asyncio.get_running_loop()
+        ctx = self.ctx
+
+        def _build():
+            # Built OFF the io loop: the per-dag device-group rendezvous
+            # blocks on controller KV round trips that themselves need
+            # the loop free.
+            return DagRuntime(
+                ctx=ctx, dag_id=dag_id, payload=payload,
+                run_stage=self._dag_call, notify_loop=loop,
+            )
+
+        try:
+            runtime = await loop.run_in_executor(None, _build)
+        except Exception:
+            return {"status": "error", "error": traceback.format_exc()}
+        self._dag_runtimes[dag_id] = runtime
         return {"status": "ok"}
 
-    def _chan_read(self, base: str, seq: int, depth: int):
-        """Consumer side of a shm channel (dag/channel.py primitives)."""
-        from ray_tpu.dag import channel
-
-        return channel.read_consume(
-            self.ctx.store, channel.slot_name(base, seq, depth)
-        )
-
-    async def _chan_write(
-        self, base: str, seq: int, depth: int, parts, total: int
-    ) -> None:
-        """Producer side: stream parts into ring slot seq%depth once it
-        frees (the consumer's delete is the backpressure release)."""
-        from ray_tpu.dag import channel
-
-        name = channel.slot_name(base, seq, depth)
-        deadline = asyncio.get_running_loop().time() + 120.0
-        while not channel.try_write(self.ctx.store, name, parts, total):
-            if asyncio.get_running_loop().time() > deadline:
-                raise TimeoutError(
-                    f"channel slot {name} still unread after 120s"
-                )
-            await asyncio.sleep(0.002)
-
-    async def _dag_deliver(self, dag_id, node, seq, slot, value) -> dict:
-        """Feed one input slot of a stage; runs the stage when complete."""
-        key = (dag_id, node)
-        stage = self._dag_stages.get(key)
-        if stage is None:
-            return {"status": "error",
-                    "error": f"dag {dag_id} stage {node} not registered"}
-        slots = self._dag_buffers[key].setdefault(seq, {})
-        slots[slot] = value
-        if set(slots) != set(stage["slots"]):
-            return {"status": "ok"}
-        self._dag_buffers[key].pop(seq)
-        # Detach execution+forward: the push acks as soon as inputs are
-        # buffered, so upstream (and the driver) pipelines the next seq
-        # while this stage computes — the point of compiled-graph channels.
-        from ray_tpu._private.rpc import spawn_task
-
-        spawn_task(self._dag_run_stage(dag_id, seq, stage, slots))
-        return {"status": "ok"}
+    def _dag_call(self, method_name: str, args):
+        """Run one stage invocation on the actor's single-width executor
+        — stage loops pipeline across actors, never within one."""
+        method = getattr(self.actor_instance, method_name)
+        return self.executor.submit(method, *args).result()
 
     async def rpc_dag_push(self, conn, payload) -> dict:
-        dag_id = payload["dag_id"]
-        seq = payload["seq"]
-        stage_key = (dag_id, payload["node"])
-        stage = self._dag_stages.get(stage_key)
-        if stage is None:
+        """Socket-fallback edge delivery: feed one buffered input slot."""
+        runtime = self._dag_runtimes.get(payload["dag_id"])
+        if runtime is None:
             return {"status": "error",
-                    "error": f"dag {dag_id} stage {payload['node']} unknown"}
-        if payload.get("channel"):
-            # shm channel: only a tiny notify crossed the socket
-            loop = asyncio.get_running_loop()
-            value = await loop.run_in_executor(
-                None, self._chan_read, payload["channel"], seq,
-                stage.get("depth", 8),
-            )
-        else:
-            value = serialization.deserialize(payload["value"], zero_copy=False)
-        return await self._dag_deliver(
-            dag_id, payload["node"], seq, payload["slot"], value
-        )
-
-    async def _dag_run_stage(
-        self, dag_id: str, seq: int, stage: dict, slots: dict
-    ) -> None:
-        method = getattr(self.actor_instance, stage["method"])
-        args = [slots[name] for name in stage["slots"]]
-        loop = asyncio.get_running_loop()
-
-        def run():
-            return method(*args)
-
+                    "error": f"dag {payload['dag_id']} not registered"}
+        value = serialization.deserialize(payload["value"], zero_copy=False)
         try:
-            result = await loop.run_in_executor(self.executor, run)
-        except Exception:
-            result = exceptions.TaskError(stage["method"], traceback.format_exc())
-        failed = isinstance(result, exceptions.TaskError)
-        if stage.get("is_output"):
-            key = (dag_id, seq)
-            out_base = stage.get("out_channel")
-            if out_base and not failed:
-                parts, total, _ = serialization.serialize_parts(result)
-                try:
-                    await self._chan_write(
-                        out_base, seq, stage.get("depth", 8), parts, total
-                    )
-                    result = ("__dagchan__", out_base)
-                except Exception:  # rtlint: disable=swallowed-exception - fall back to the inline result path
-                    pass  # fall back to inline result
-            self._dag_results[key] = result
-            self._dag_events.setdefault(key, asyncio.Event()).set()
-            return
-        parts, total, _ = serialization.serialize_parts(result)
-        raw = None  # joined lazily: only inline/same-actor edges need it
-        depth = stage.get("depth", 8)
-        for target in stage.get("downstream", ()):
-            try:
-                use_chan = bool(target.get("channel")) and not failed
-                if not use_chan and raw is None:
-                    # same-actor edges never get channels (compile guard),
-                    # so this join also covers the branch below
-                    raw = serialization.join_parts(parts)
-                if target["actor_id"] == (self.actor_spec or {}).get(
-                    "actor_id"
-                ):
-                    # Same-actor edge (multi-stage actors): no channel, no
-                    # socket — deliver a private copy in-process.
-                    await self._dag_deliver(
-                        dag_id, target["node"], seq, target["slot"],
-                        serialization.deserialize(raw, zero_copy=False),
-                    )
-                    continue
-                if use_chan:
-                    await self._chan_write(
-                        target["channel"], seq, depth, parts, total
-                    )
-                client = await self.ctx._actor_client(target["actor_id"])
-                msg = {
-                    "dag_id": dag_id,
-                    "node": target["node"],
-                    "seq": seq,
-                    "slot": target["slot"],
-                }
-                if use_chan:
-                    # Channel edge: the DATA already sits in shm — the
-                    # notify is fire-and-forget (the unread REP is dropped
-                    # by the client's resolver). Errors surface as pop
-                    # timeouts, the same failure envelope as a died stage.
-                    msg["channel"] = target["channel"]
-                    engine = getattr(client, "_engine", None)
-                    conn_id = getattr(client, "_conn_id", None)
-                    if engine is not None and conn_id is not None:
-                        from ray_tpu._private.rpc import (
-                            REQ, _encode_payload,
-                        )
-
-                        msgid = engine.pylib.rt_next_msgid(
-                            engine.handle, conn_id
-                        )
-                        engine.send(
-                            conn_id, REQ, msgid, b"dag_push",
-                            _encode_payload(msg),
-                        )
-                        continue
-                else:
-                    msg["value"] = raw
-                await client.call("dag_push", msg)
-            except Exception:
-                traceback.print_exc()
+            runtime.feed(payload["node"], payload["slot"],
+                         payload["seq"], value)
+        except KeyError as exc:
+            return {"status": "error", "error": str(exc)}
+        return {"status": "ok"}
 
     async def rpc_dag_pop(self, conn, payload) -> dict:
-        key = (payload["dag_id"], payload["seq"])
-        event = self._dag_events.setdefault(key, asyncio.Event())
-        try:
-            await asyncio.wait_for(event.wait(), timeout=payload.get("timeout", 300))
-        except asyncio.TimeoutError:
-            return {"status": "timeout"}
-        result = self._dag_results.pop(key)
-        self._dag_events.pop(key, None)
-        if (
-            isinstance(result, tuple)
-            and len(result) == 2
-            and result[0] == "__dagchan__"
-        ):
-            # result already sits in the driver-co-located shm channel
-            return {"status": "ok", "channel": result[1]}
-        raw, _ = serialization.serialize(result)
-        return {"status": "ok", "value": raw}
+        """Socket-fallback output pop: await the parked result for seq."""
+        runtime = self._dag_runtimes.get(payload["dag_id"])
+        if runtime is None:
+            return {"status": "error",
+                    "error": f"dag {payload['dag_id']} not registered"}
+        return await runtime.pop(
+            payload["seq"], payload.get("timeout", 300)
+        )
+
+    async def rpc_dag_teardown(self, conn, payload) -> dict:
+        """Stop the resident loops, free consumer-owned ring slots, and
+        leave the per-dag device group. Idempotent."""
+        runtime = self._dag_runtimes.pop(payload["dag_id"], None)
+        if runtime is not None:
+            loop = asyncio.get_running_loop()
+            # stop() joins threads that may be blocked in channel ops —
+            # keep the io loop free while they wind down.
+            await loop.run_in_executor(None, runtime.stop)
+        return {"status": "ok"}
 
     async def rpc_cancel_task(self, conn, payload) -> dict:
         """Cancel a task on this worker (reference: CoreWorker::CancelTask →

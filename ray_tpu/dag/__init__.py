@@ -4,6 +4,14 @@ from ray_tpu.dag.dag import (
     DAGNode,
     DAGRef,
     InputNode,
+    MultiOutputNode,
 )
 
-__all__ = ["InputNode", "DAGNode", "ClassMethodNode", "CompiledDAG", "DAGRef"]
+__all__ = [
+    "InputNode",
+    "DAGNode",
+    "ClassMethodNode",
+    "CompiledDAG",
+    "DAGRef",
+    "MultiOutputNode",
+]

@@ -11,6 +11,7 @@ RPC moves per hop.
 
 from __future__ import annotations
 
+import struct
 import weakref
 
 from ray_tpu._private import serialization
@@ -65,6 +66,10 @@ def read_consume(store, name: str, timeout_ms: int = 60_000):
     view = store.get(name, timeout_ms=timeout_ms)
     if view is None:
         raise TimeoutError(f"channel slot {name} never arrived")
+    return _consume_view(store, name, view)
+
+
+def _consume_view(store, name: str, view):
     if view.nbytes >= ZERO_COPY_THRESHOLD:
         value = serialization.deserialize(view, zero_copy=True)
         try:
@@ -76,3 +81,47 @@ def read_consume(store, name: str, timeout_ms: int = 60_000):
         return serialization.deserialize(view, zero_copy=False)
     finally:
         _free_slot(store, name)
+
+
+# -- seq-framed slots (rtdag polling channels) ---------------------------
+# The resident executor loops (dag/executor.py) consume slots by POLLING
+# (non-blocking store.get) instead of a notify RPC, so each slot carries
+# its sequence number in an 8-byte header: a consumer that wakes up on a
+# slot can verify it holds the seq it expects rather than a stale or
+# wrapped-around write.
+
+SEQ_HEADER = struct.Struct("<Q")
+
+# Distinguishes "slot not written yet" from any legitimate payload value
+# (None included) on the non-blocking read path.
+NOT_READY = object()
+
+
+def try_write_seq(store, name: str, seq: int, parts, total: int) -> bool:
+    """One seq-framed write attempt; False while the ring slot is still
+    occupied by an unconsumed earlier seq."""
+    return try_write(
+        store, name, [SEQ_HEADER.pack(seq), *parts], total + SEQ_HEADER.size
+    )
+
+
+def read_seq_consume(store, name: str, seq: int):
+    """Non-blocking seq-framed read. Returns NOT_READY when the slot is
+    absent or still holds an older seq; otherwise consumes the slot and
+    returns its value (zero-copy above the threshold, like
+    read_consume)."""
+    view = store.get(name, timeout_ms=0)
+    if view is None:
+        return NOT_READY
+    if view.nbytes < SEQ_HEADER.size:
+        _free_slot(store, name)
+        raise RuntimeError(f"channel slot {name}: truncated seq header")
+    (got,) = SEQ_HEADER.unpack(view[: SEQ_HEADER.size])
+    if got != seq:
+        # Unreachable under strict in-order consumption — surface loudly
+        # rather than polling a wedged slot forever.
+        _free_slot(store, name)
+        raise RuntimeError(
+            f"channel slot {name}: seq desync (holds {got}, expected {seq})"
+        )
+    return _consume_view(store, name, view[SEQ_HEADER.size:])

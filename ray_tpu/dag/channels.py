@@ -1,0 +1,243 @@
+"""rtdag channel families — the typed edges of a compiled dataflow graph.
+
+A compiled DAG edge is one of four channel families, chosen by the
+compile-time placement plan (dag/placement.py):
+
+* ``ShmChannel``    — co-located host payloads ride the node's shm object
+                      store in a seq-framed bounded ring (dag/channel.py
+                      primitives). Steady state is pure write/poll: no
+                      RPC of any kind moves per hop.
+* ``DeviceChannel`` — the collective p2p plane (``util/collective`` ring
+                      wire send/recv), exact or block-scale quantized via
+                      the PR-7 codec. Payloads move worker→worker without
+                      touching the driver or the object store, and every
+                      op records into the comm flight ring (the group
+                      methods are ``_traced_method``-wrapped), so the
+                      hang doctor covers DAG wires for free.
+* ``LocalChannel``  — bounded in-process asyncio ring for same-process
+                      streams (the serve replica token stream rides it).
+* socket            — legacy per-push RPC fallback (no channel object;
+                      the driver/worker issue ``dag_push`` calls), kept
+                      for explicitly requested ``channel="socket"``
+                      edges.
+
+Device-edge tags follow the rtgraph skeleton convention
+(``dagch:e{src}:{dst}:{slot}`` with all-integer holes), so the static
+commgraph extractor certifies DAG wires like any other channel and the
+hang doctor's static reconciliation unifies runtime records with these
+call sites.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ray_tpu._private import serialization
+from ray_tpu.dag import channel as shm
+from ray_tpu.util.collective import flight
+
+# Wire marker for codec-compressed device payloads — same self-describing
+# envelope the pipeline activation wire uses, so mixed exact/quantized
+# edges share one decode path.
+_ACT_WIRE = "__act"
+
+
+class ChannelClosedError(RuntimeError):
+    """The channel's owning loop was stopped while an op was blocked."""
+
+
+class ShmChannel:
+    """One shm-ring edge: bounded ring of seq-framed slots, producer
+    busy-waits on slot reuse (the consumer's free IS the backpressure
+    release), consumer polls non-blockingly (timeout_ms=0 keeps the
+    store-client lock uncontended) with idle backoff."""
+
+    def __init__(self, store, base: str, depth: int, *, group: str = "dag",
+                 site: str = "dag"):
+        self._store = store
+        self.base = base
+        self.depth = depth
+        self._group = group
+        self._site = site
+
+    def push(self, seq: int, value, timeout: float = 120.0, stop=None) -> None:
+        parts, total, _ = serialization.serialize_parts(value)
+        self.push_parts(seq, parts, total, timeout=timeout, stop=stop)
+
+    def push_parts(self, seq: int, parts, total: int,
+                   timeout: float = 120.0, stop=None) -> None:
+        name = shm.slot_name(self.base, seq, self.depth)
+        deadline = time.monotonic() + timeout
+        while not shm.try_write_seq(self._store, name, seq, parts, total):
+            if stop is not None and stop():
+                raise ChannelClosedError(f"{self.base}: channel closed")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"channel slot {name} still unread after {timeout}s"
+                )
+            time.sleep(0.002)
+        with flight.site(self._site):
+            flight.note(self._group, "chan_push", tag=self.base, nbytes=total)
+
+    def pop(self, seq: int, timeout: float | None = None, stop=None):
+        name = shm.slot_name(self.base, seq, self.depth)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        started = time.monotonic()
+        delay = 0.002
+        while True:
+            value = shm.read_seq_consume(self._store, name, seq)
+            if value is not shm.NOT_READY:
+                with flight.site(self._site):
+                    flight.note(self._group, "chan_pop", tag=self.base)
+                return value
+            if stop is not None and stop():
+                raise ChannelClosedError(f"{self.base}: channel closed")
+            now = time.monotonic()
+            if deadline is not None and now > deadline:
+                raise TimeoutError(
+                    f"channel slot {name} not ready in {timeout}s"
+                )
+            time.sleep(delay)
+            if now - started > 1.0:
+                # Idle backoff: a cold edge must not hammer the store
+                # server with 500 polls/s forever; a hot edge never gets
+                # past the 2ms floor.
+                delay = min(delay * 2, 0.05)
+
+    def free_slots(self) -> None:
+        """Delete every ring slot (teardown; idempotent)."""
+        for i in range(self.depth):
+            shm._free_slot(self._store, f"{self.base}-{i}")
+
+
+class DeviceChannel:
+    """One edge on the collective p2p plane.
+
+    Two calling modes share the instance:
+
+    * edge mode (``push_edge``/``pop_edge``) — the rtdag executor's fixed
+      (src, dst, slot) identity; the wire tag is the certified skeleton
+      ``dagch:e{src}:{dst}:{slot}``.
+    * tagged mode (``push``/``pop`` with a keyword-only ``tag``) — the
+      pipeline stage runner's per-(step, microbatch, virtual-stage) tags;
+      the caller's f-string IS the certified site.
+
+    Ordering rides the ring wire's per-(peer, tag) mailbox sequence
+    numbers; bounded driver admission bounds mailbox growth. With a
+    ``wire_cfg`` (PR-7 codec), float ndarrays are block-scale quantized
+    with per-edge error feedback; everything else stays exact.
+    """
+
+    def __init__(self, group, peer: int, *, src: int = 0, dst: int = 0,
+                 slot: int = 0, site: str = "dag", wire_cfg=None, ef=None):
+        self._group = group
+        self._peer = peer
+        self._src = src
+        self._dst = dst
+        self._slot = slot
+        self._site = site
+        self._wire_cfg = wire_cfg
+        self._ef = ef
+
+    # -- tagged mode (pipeline wire) ------------------------------------
+    def push(self, value, *, tag: str, ef_site=None) -> None:
+        payload = self._encode(value, ef_site)
+        with flight.site(self._site):
+            self._group.send(payload, self._peer, tag=tag)
+
+    def pop(self, *, tag: str, timeout: float = 60.0, like=None):
+        with flight.site(self._site):
+            out = self._group.recv(
+                self._peer, tag=tag, timeout=timeout, like=like
+            )
+        return self._decode(out)
+
+    # -- edge mode (rtdag wire) -----------------------------------------
+    def push_edge(self, value) -> None:
+        payload = self._encode(value, (self._src, self._dst, self._slot))
+        with flight.site(self._site):
+            self._group.send(
+                payload, self._peer,
+                tag=f"dagch:e{self._src}:{self._dst}:{self._slot}",
+            )
+
+    def pop_edge(self, *, timeout: float = 60.0, like=None):
+        with flight.site(self._site):
+            out = self._group.recv(
+                self._peer,
+                tag=f"dagch:e{self._src}:{self._dst}:{self._slot}",
+                timeout=timeout, like=like,
+            )
+        return self._decode(out)
+
+    # -- codec ----------------------------------------------------------
+    def _encode(self, value, ef_site):
+        if (
+            self._wire_cfg is not None
+            and self._ef is not None
+            and ef_site is not None
+            and isinstance(value, np.ndarray)
+            and value.dtype.kind == "f"
+        ):
+            enc = self._ef.encode(ef_site, value.ravel(), self._wire_cfg)
+            return (_ACT_WIRE, value.shape, value.dtype.str, enc)
+        return value
+
+    def _decode(self, out):
+        if isinstance(out, tuple) and len(out) == 4 and out[0] == _ACT_WIRE:
+            from ray_tpu.util.collective.quantization import decode
+
+            _, shape, dtype_str, enc = out
+            return decode(enc).reshape(shape).astype(np.dtype(dtype_str))
+        return out
+
+
+class LocalChannel:
+    """Bounded in-process channel for asyncio producers/consumers — the
+    rtdag family backing same-process streams (serve replica token
+    streams). ``pop_batch`` implements the batched-drain semantics the
+    streaming RPC needs: one blocking wait, then drain without waiting."""
+
+    def __init__(self, maxsize: int = 256, *, group: str = "dag",
+                 label: str = ""):
+        import asyncio
+
+        self._q: "asyncio.Queue" = asyncio.Queue(maxsize=maxsize)
+        self._group = group
+        self._label = label
+        self._closed = False
+        # Lifecycle-only flight notes: per-item records would rotate
+        # genuinely stalled ops out of the bounded flight ring.
+        flight.note(self._group, "chan_open", tag=label)
+
+    async def put(self, item) -> None:
+        if self._closed:
+            raise ChannelClosedError(f"{self._label}: channel closed")
+        await self._q.put(item)
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    async def pop_batch(self, max_items: int, timeout_s: float) -> list:
+        """Block up to ``timeout_s`` for the first item, then drain up to
+        ``max_items`` without waiting. Returns [] on timeout."""
+        import asyncio
+
+        items: list = []
+        try:
+            items.append(await asyncio.wait_for(self._q.get(), timeout_s))
+        except asyncio.TimeoutError:
+            return items
+        while len(items) < max_items:
+            try:
+                items.append(self._q.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        return items
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            flight.note(self._group, "chan_close", tag=self._label)

@@ -1,42 +1,91 @@
-"""Compiled graphs (aDAG-equivalent) — static actor DAGs with channels.
+"""rtdag — compiled dataflow graphs on pre-opened channels.
 
 Role-equivalent of python/ray/dag/ :: InputNode / DAGNode /
-.experimental_compile (SURVEY §2.2): a static graph of actor method calls
-is compiled once; every `execute()` then flows actor→actor over direct
-worker RPC channels with ZERO driver round-trips between stages — the
-pipeline-parallel inference substrate. On TPU, stage payloads are host
-arrays; device arrays stay in each stage's HBM between its jitted calls
-(and intra-slice stages exchange via in-jit collectives, not channels).
+MultiOutputNode / .experimental_compile (SURVEY §2.2): a static graph of
+actor method calls is compiled ONCE — the compile-time placement plan
+(dag/placement.py) pins every actor, assigns device-plane ranks, and
+pre-opens every edge's channel — and every `execute()` then flows
+actor→actor over those channels with ZERO controller RPCs per step.
 
-Overlap comes for free: execute() is async (returns a DAGRef), so seq k+1
-enters stage 0 while seq k is in stage 1 — microbatch pipelining.
+Channel families (dag/channels.py), chosen per edge by the plan:
+shm ring (co-located host payloads, pure write/poll), device plane
+(collective p2p send/recv, exact or PR-7-quantized — the aDAG "NCCL
+channel" role), in-process local delivery (same-actor edges), and a
+legacy socket fallback. Workers run one resident executor loop per
+stage (dag/executor.py); bounded in-flight `execute()` pipelining gets
+its backpressure from the ring depth.
+
+Every channel op records into the comm flight ring under
+``flight.site("dag")`` and device tags follow the rtgraph skeleton
+convention, so the watchdog/hang-doctor/commgraph planes cover compiled
+graphs like any other wire.
 
     with InputNode() as inp:
         x = worker_a.preprocess.bind(inp)
         out = worker_b.infer.bind(x)
-    dag = out.experimental_compile()
-    ref = dag.execute(batch)          # non-blocking
+    dag = out.experimental_compile()      # or compile(channel="device")
+    ref = dag.execute(batch)              # non-blocking, zero RPCs
     result = ref.get(timeout=60)
+    dag.close()                           # drain + free + stop loops
 """
 
 from __future__ import annotations
 
+import asyncio
 import itertools
 import time
 import uuid
-from typing import Any, Optional
+import weakref
+from typing import Any
 
+from ray_tpu import exceptions
 from ray_tpu._private import serialization, worker as worker_mod
+from ray_tpu.dag import placement
+from ray_tpu.dag.channels import DeviceChannel, ShmChannel
 
 _node_counter = itertools.count()
+
+_CHANNEL_FAMILIES = (None, "auto", "shm", "device", "socket")
+
+# Live compiled graphs, closed from the driver shutdown path so resident
+# worker loops and ring slots never outlive the session.
+_LIVE_DAGS: "weakref.WeakValueDictionary[str, CompiledDAG]" = (
+    weakref.WeakValueDictionary()
+)
+
+
+def shutdown_all() -> None:
+    """Tear down every live compiled DAG (driver shutdown hook)."""
+    for dag in list(_LIVE_DAGS.values()):
+        try:
+            dag.teardown()
+        except Exception:  # rtlint: disable=swallowed-exception - shutdown must proceed past a dead graph
+            pass
 
 
 class DAGNode:
     def __init__(self):
         self.node_id = next(_node_counter)
+        self.channel_hint: str | None = None
 
-    def experimental_compile(self) -> "CompiledDAG":
-        return CompiledDAG(self)
+    def with_channel(self, family: str) -> "DAGNode":
+        """Per-node channel-family hint for the edges that feed this
+        node (and its output edge when it is a DAG output): "shm",
+        "device", "socket", or "auto" (clear the hint)."""
+        if family not in ("auto", "shm", "device", "socket"):
+            raise ValueError(
+                f"unknown channel family {family!r} "
+                "(use 'auto', 'shm', 'device', or 'socket')"
+            )
+        self.channel_hint = None if family == "auto" else family
+        return self
+
+    def experimental_compile(
+        self, channel: str | None = None, quantize_wire: str | None = None
+    ) -> "CompiledDAG":
+        return CompiledDAG(
+            self, channel=channel, quantize_wire=quantize_wire
+        )
 
     def _upstream(self) -> list["DAGNode"]:
         return []
@@ -53,6 +102,27 @@ class InputNode(DAGNode):
         return None
 
 
+def _interpret(node: "DAGNode", input_values: tuple, memo: dict) -> Any:
+    """Shared interpreted (uncompiled) executor — one actor call per
+    node, memoized so fan-out nodes run once."""
+    if node.node_id in memo:
+        return memo[node.node_id]
+    if isinstance(node, InputNode):
+        value = input_values[0] if len(input_values) == 1 else input_values
+    else:
+        import ray_tpu
+
+        args = [
+            _interpret(a, input_values, memo) if isinstance(a, DAGNode)
+            else a
+            for a in node.args
+        ]
+        method = getattr(node.actor, node.method_name)
+        value = ray_tpu.get(method.remote(*args), timeout=300)
+    memo[node.node_id] = value
+    return value
+
+
 class ClassMethodNode(DAGNode):
     def __init__(self, actor_handle, method_name: str, args: tuple):
         super().__init__()
@@ -65,25 +135,32 @@ class ClassMethodNode(DAGNode):
 
     def execute(self, *input_values) -> Any:
         """Interpreted (uncompiled) execution via normal actor calls."""
+        return _interpret(self, input_values, {})
 
-        def resolve(node, memo):
-            if node.node_id in memo:
-                return memo[node.node_id]
-            if isinstance(node, InputNode):
-                value = input_values[0] if len(input_values) == 1 else input_values
-            else:
-                import ray_tpu
 
-                args = [
-                    resolve(a, memo) if isinstance(a, DAGNode) else a
-                    for a in node.args
-                ]
-                method = getattr(node.actor, node.method_name)
-                value = ray_tpu.get(method.remote(*args), timeout=300)
-            memo[node.node_id] = value
-            return value
+class MultiOutputNode(DAGNode):
+    """Marks several graph nodes as the DAG's outputs: `execute().get()`
+    returns their values as a list, each member riding its own output
+    channel (the reference's MultiOutputNode role)."""
 
-        return resolve(self, {})
+    def __init__(self, nodes):
+        super().__init__()
+        self.nodes = list(nodes)
+        if not self.nodes:
+            raise ValueError("MultiOutputNode needs at least one node")
+        for n in self.nodes:
+            if not isinstance(n, ClassMethodNode):
+                raise ValueError(
+                    "MultiOutputNode members must be actor method nodes "
+                    f"(got {type(n).__name__})"
+                )
+
+    def _upstream(self) -> list[DAGNode]:
+        return list(self.nodes)
+
+    def execute(self, *input_values) -> list:
+        memo: dict = {}
+        return [_interpret(n, input_values, memo) for n in self.nodes]
 
 
 class _BoundMethod:
@@ -120,43 +197,92 @@ class DAGRef:
         return self._dag._pop(self._seq, timeout)
 
 
+class _OutReader:
+    """Driver-side in-order consumer of ONE output edge. Channel seqs
+    are strictly ordered, so an out-of-order get() buffers the earlier
+    seqs it drains on the way."""
+
+    def __init__(self, dag: "CompiledDAG", actor_id: str, out: dict,
+                 chan):
+        self._dag = dag
+        self._actor_id = actor_id
+        self._out = out
+        self._chan = chan
+        self._next = 0
+        self._ready: dict[int, Any] = {}
+
+    def read(self, seq: int, deadline: float) -> Any:
+        if self._out["family"] == "socket":
+            return self._socket_pop(seq, deadline)
+        while seq not in self._ready:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"dag output seq={seq} not ready")
+            if self._out["family"] == "shm":
+                value = self._chan.pop(self._next, timeout=remaining)
+            else:
+                value = self._chan.pop_edge(timeout=remaining)
+            self._ready[self._next] = value
+            self._next += 1
+        return self._ready.pop(seq)
+
+    def _socket_pop(self, seq: int, deadline: float) -> Any:
+        remaining = max(0.1, deadline - time.monotonic())
+        # Client deadline strictly AFTER the server-side pop wait, so the
+        # timeout reply always beats the transport deadline (an abandoned
+        # pop would consume the result into a dropped reply).
+        resp = self._dag._call_actor(
+            self._actor_id, "dag_pop",
+            {"dag_id": self._dag.dag_id, "seq": seq, "timeout": remaining},
+            timeout=remaining + 15,
+        )
+        if resp.get("status") == "timeout":
+            raise TimeoutError(f"dag output seq={seq} not ready")
+        if resp.get("status") != "ok":
+            raise RuntimeError(
+                f"dag_pop failed: {resp.get('error', resp)!r}"
+            )
+        return serialization.deserialize(resp["value"], zero_copy=False)
+
+
 class CompiledDAG:
-    """v2 compiled graph: multi-stage actors, pre-allocated shared-memory
-    channels (co-located edges move ONE tiny notify RPC per hop — the
-    payload rides the node's shm store in a bounded ring, reference
-    shared_memory_channel.py role), and real teardown()."""
+    """rtdag compiled graph: placement-planned stages, pre-opened
+    channels on every edge, resident worker loops, bounded in-flight
+    pipelining with ring-depth backpressure, and real close()."""
 
     CHANNEL_DEPTH = 8  # ring slots per edge = max pipelined seqs in flight
 
-    def __init__(self, output_node: DAGNode):
+    def __init__(self, output_node: DAGNode, *, channel: str | None = None,
+                 quantize_wire: str | None = None):
         if isinstance(output_node, InputNode):
             raise ValueError("cannot compile a bare InputNode")
+        if channel not in _CHANNEL_FAMILIES:
+            raise ValueError(
+                f"unknown channel family {channel!r} "
+                f"(use one of {_CHANNEL_FAMILIES[1:]})"
+            )
         self.dag_id = f"dag-{uuid.uuid4().hex[:8]}"
         self.output_node = output_node
+        self._channel_override = None if channel == "auto" else channel
+        self._quantize_wire = quantize_wire
+        self._out_nodes = (
+            list(output_node.nodes)
+            if isinstance(output_node, MultiOutputNode)
+            else [output_node]
+        )
+        self._multi_output = isinstance(output_node, MultiOutputNode)
         self._seq = itertools.count()
         self._ctx = worker_mod.get_global_context()
         self._stages: dict[int, dict] = {}  # node_id → stage spec
         self._input_targets: list[dict] = []
+        self._out_readers: list[_OutReader] = []
+        self._out_channel = None  # first output channel (back-compat)
+        self._all_shm_bases: list[str] = []
+        self._group = None
         self._torn_down = False
         self._inflight: set[int] = set()
         self._compile()
-
-    def _actor_node(self, actor_id: str) -> str | None:
-        """Which cluster node hosts this actor (channel co-location).
-        Waits for placement: compile typically runs right after actor
-        creation, before scheduling assigns a node."""
-        try:
-            info = self._ctx.io.run(
-                self._ctx.controller.call(
-                    "get_actor_info",
-                    {"actor_id": actor_id, "wait_ready": True},
-                    timeout=60,
-                ),
-                timeout=70,
-            )
-        except Exception:  # rtlint: disable=swallowed-exception - placement unknown: caller treats None as no co-location
-            return None
-        return info.get("node_id")
+        _LIVE_DAGS[self.dag_id] = self
 
     # -- graph lowering --------------------------------------------------
     def _compile(self) -> None:
@@ -170,12 +296,14 @@ class CompiledDAG:
                 walk(up)
 
         walk(self.output_node)
-        method_nodes = [
-            n for n in nodes.values() if isinstance(n, ClassMethodNode)
-        ]
-        # Build stage specs: slots for DAG-node args; constants stay the
+        method_nodes = sorted(
+            (n for n in nodes.values() if isinstance(n, ClassMethodNode)),
+            key=lambda n: n.node_id,
+        )
+        if not method_nodes:
+            raise ValueError("DAG has no actor method nodes")
+        # Stage skeletons: slots for DAG-node args; constants stay the
         # reference restriction (close over them in the actor).
-        actor_nodes: dict[str, str | None] = {}
         for node in method_nodes:
             slots = []
             for i, arg in enumerate(node.args):
@@ -187,76 +315,222 @@ class CompiledDAG:
                         "InputNode (got a constant; close over it in the "
                         "actor instead)"
                     )
-            actor_id = node.actor._actor_id
-            if actor_id not in actor_nodes:
-                actor_nodes[actor_id] = self._actor_node(actor_id)
             self._stages[node.node_id] = {
                 "node": node.node_id,
-                "actor_id": actor_id,
-                "cluster_node": actor_nodes[actor_id],
+                "actor_id": node.actor._actor_id,
                 "method": node.method_name,
                 "slots": slots,
+                "in_edges": [],
                 "downstream": [],
-                "in_channels": [],
-                "is_output": node.node_id == self.output_node.node_id,
+                "outs": [],
+                "is_output": False,
                 "depth": self.CHANNEL_DEPTH,
             }
-        driver_node = self._ctx.node_id
-        # Wire edges; co-located endpoints get a shm channel.
+        # Explicit compile-time placement (no swallowed probe): pins each
+        # actor's node, assigns device-plane ranks, raises on failure.
+        ordered_actors: list[str] = []
+        for node in method_nodes:
+            aid = node.actor._actor_id
+            if aid not in ordered_actors:
+                ordered_actors.append(aid)
+        self._actor_ids = ordered_actors
+        plan = placement.PlacementPlan.resolve(self._ctx, ordered_actors)
+        self._plan = plan
+        families: set[str] = set()
+
+        # -- wire edges --------------------------------------------------
         for node in method_nodes:
             stage = self._stages[node.node_id]
+            dst_aid = stage["actor_id"]
             for i, arg in enumerate(node.args):
                 slot = f"a{i}"
                 if isinstance(arg, InputNode):
-                    chan = None
-                    if stage["cluster_node"] == driver_node:
-                        chan = (
-                            f"dagch-{self.dag_id}-in-{node.node_id}-{slot}"
-                        )
-                        stage["in_channels"].append(chan)
-                    self._input_targets.append(
-                        {
-                            "actor_id": stage["actor_id"],
-                            "node": node.node_id,
-                            "slot": slot,
-                            "channel": chan,
-                        }
+                    fam = placement.edge_family(
+                        plan, None, dst_aid, node.channel_hint,
+                        self._channel_override,
                     )
-                elif isinstance(arg, ClassMethodNode):
-                    src = self._stages[arg.node_id]
-                    chan = None
-                    if (
-                        src["cluster_node"] is not None
-                        and src["cluster_node"] == stage["cluster_node"]
-                        and src["actor_id"] != stage["actor_id"]
-                    ):
-                        chan = (
+                    families.add(fam)
+                    edge = {
+                        "slot": slot, "family": fam, "src": arg.node_id,
+                        "dst": node.node_id, "slot_id": i,
+                    }
+                    target = {
+                        "actor_id": dst_aid, "node": node.node_id,
+                        "slot": slot, "family": fam, "channel": None,
+                        "src": arg.node_id, "dst": node.node_id,
+                        "slot_id": i, "chan": None,
+                    }
+                    if fam == "shm":
+                        base = f"dagch-{self.dag_id}-in-{node.node_id}-{slot}"
+                        edge["channel"] = base
+                        target["channel"] = base
+                        self._all_shm_bases.append(base)
+                    elif fam == "device":
+                        edge["peer_rank"] = 0
+                        target["channel"] = (
+                            f"dagch:e{arg.node_id}:{node.node_id}:{i}"
+                        )
+                    stage["in_edges"].append(edge)
+                    self._input_targets.append(target)
+                else:  # ClassMethodNode
+                    src_stage = self._stages[arg.node_id]
+                    src_aid = src_stage["actor_id"]
+                    fam = placement.edge_family(
+                        plan, src_aid, dst_aid, node.channel_hint,
+                        self._channel_override,
+                    )
+                    families.add(fam)
+                    common = {
+                        "src": arg.node_id, "dst": node.node_id,
+                        "slot_id": i,
+                    }
+                    in_edge = {"slot": slot, "family": fam, **common}
+                    down = {
+                        "actor_id": dst_aid, "node": node.node_id,
+                        "slot": slot, "family": fam, **common,
+                    }
+                    if fam == "shm":
+                        base = (
                             f"dagch-{self.dag_id}-e{arg.node_id}-"
                             f"{node.node_id}-{slot}"
                         )
-                        stage["in_channels"].append(chan)
-                    src["downstream"].append(
-                        {
-                            "actor_id": stage["actor_id"],
-                            "node": node.node_id,
-                            "slot": slot,
-                            "channel": chan,
-                        }
-                    )
-        out_stage = self._stages[self.output_node.node_id]
-        self._output_actor = out_stage["actor_id"]
-        self._out_channel = None
-        if out_stage["cluster_node"] == driver_node:
-            self._out_channel = f"dagch-{self.dag_id}-out"
-            out_stage["out_channel"] = self._out_channel
-        # Register every stage with its hosting worker (channels are part
-        # of the registration — pre-allocated at compile time).
-        for stage in self._stages.values():
-            self._call_actor(
-                stage["actor_id"],
-                "dag_register",
-                {"dag_id": self.dag_id, "stage": stage},
+                        in_edge["channel"] = base
+                        down["channel"] = base
+                        self._all_shm_bases.append(base)
+                    elif fam == "device":
+                        in_edge["peer_rank"] = plan.rank_of(src_aid)
+                        down["peer_rank"] = plan.rank_of(dst_aid)
+                    src_stage["downstream"].append(down)
+                    stage["in_edges"].append(in_edge)
+        # -- output edges ------------------------------------------------
+        out_specs: list[tuple[str, dict]] = []
+        for k, out_node in enumerate(self._out_nodes):
+            stage = self._stages[out_node.node_id]
+            stage["is_output"] = True
+            aid = stage["actor_id"]
+            fam = placement.edge_family(
+                plan, aid, None, out_node.channel_hint,
+                self._channel_override,
             )
+            families.add(fam)
+            out = {
+                "family": fam, "src": out_node.node_id,
+                "dst": next(_node_counter), "slot_id": 0,
+            }
+            if fam == "shm":
+                out["channel"] = f"dagch-{self.dag_id}-out-{k}"
+                self._all_shm_bases.append(out["channel"])
+            elif fam == "device":
+                out["peer_rank"] = 0
+            stage["outs"].append(out)
+            out_specs.append((aid, out))
+            if self._out_channel is None:
+                self._out_channel = out.get("channel") or (
+                    f"dagch:e{out['src']}:{out['dst']}:0"
+                    if fam == "device" else None
+                )
+        if (
+            self._multi_output
+            and sum(1 for _, o in out_specs if o["family"] == "socket") > 1
+        ):
+            raise ValueError(
+                "the socket fallback supports a single output edge; use "
+                "shm or device channels for MultiOutputNode graphs"
+            )
+        self._register(plan, need_group="device" in families)
+        # -- driver-side channel objects ---------------------------------
+        wire_cfg, ef = self._make_wire_codec()
+        store = self._ctx.store
+        for t in self._input_targets:
+            if t["family"] == "shm":
+                t["chan"] = ShmChannel(
+                    store, t["channel"], self.CHANNEL_DEPTH,
+                    group=self.dag_id,
+                )
+            elif t["family"] == "device":
+                t["chan"] = DeviceChannel(
+                    self._group, plan.rank_of(t["actor_id"]),
+                    src=t["src"], dst=t["dst"], slot=t["slot_id"],
+                    wire_cfg=wire_cfg, ef=ef,
+                )
+        for aid, out in out_specs:
+            chan = None
+            if out["family"] == "shm":
+                chan = ShmChannel(
+                    store, out["channel"], self.CHANNEL_DEPTH,
+                    group=self.dag_id,
+                )
+            elif out["family"] == "device":
+                chan = DeviceChannel(
+                    self._group, plan.rank_of(aid), src=out["src"],
+                    dst=out["dst"], slot=out["slot_id"],
+                )
+            self._out_readers.append(_OutReader(self, aid, out, chan))
+
+    def _make_wire_codec(self):
+        if not self._quantize_wire:
+            return None, None
+        from ray_tpu.util.collective.quantization import (
+            CollectiveConfig,
+            ErrorFeedback,
+        )
+
+        cfg = CollectiveConfig(quantize_activations=self._quantize_wire)
+        return cfg.activation_wire_config(), ErrorFeedback()
+
+    def _register(self, plan: placement.PlacementPlan,
+                  need_group: bool) -> None:
+        """Register stage bundles on every participating worker; when
+        device edges exist, rendezvous the per-DAG collective group (the
+        driver is rank 0). The register RPCs are issued CONCURRENTLY
+        with the driver's own group init — each worker's handler blocks
+        in the group rendezvous until all ranks (driver included) have
+        registered, so awaiting acks first would deadlock."""
+        by_actor: dict[str, list] = {}
+        for stage in self._stages.values():
+            by_actor.setdefault(stage["actor_id"], []).append(stage)
+        ctx = self._ctx
+
+        async def _register_all():
+            async def one(aid: str):
+                client = await ctx._actor_client(aid)
+                resp = await client.call("dag_register", {
+                    "dag_id": self.dag_id,
+                    "stages": by_actor[aid],
+                    "depth": self.CHANNEL_DEPTH,
+                    "wire_quant": self._quantize_wire,
+                    "group": (
+                        {
+                            "name": self.dag_id,
+                            "world_size": plan.world_size,
+                            "rank": plan.rank_of(aid),
+                        }
+                        if need_group else None
+                    ),
+                }, timeout=120)
+                if (resp or {}).get("status") != "ok":
+                    raise RuntimeError(
+                        f"dag_register failed on actor {aid}: {resp!r}"
+                    )
+
+            await asyncio.gather(*[one(aid) for aid in by_actor])
+
+        if not need_group:
+            ctx.io.run(_register_all(), timeout=180)
+            return
+        from ray_tpu.util.collective import collective
+
+        fut = asyncio.run_coroutine_threadsafe(_register_all(), ctx.io.loop)
+        try:
+            collective.init_collective_group(
+                plan.world_size, 0, backend="ring", group_name=self.dag_id
+            )
+            self._group = collective.get_group(self.dag_id)
+            fut.result(timeout=180)
+        except Exception:
+            fut.cancel()
+            self._destroy_group(sync=True)
+            raise
 
     # -- worker RPC helpers ----------------------------------------------
     def _call_actor(
@@ -264,8 +538,8 @@ class CompiledDAG:
         timeout: float = 300.0,
     ) -> dict:
         ctx = self._ctx
-        # Fast lane: channel notifies and pops ride the native call table
-        # straight from this thread (no io-loop round trip per hop).
+        # Fast lane: socket-family pushes and pops ride the native call
+        # table straight from this thread (no io-loop round trip per hop).
         conn = (
             ctx._direct_actor_conn(actor_id)
             if ctx._engine is not None
@@ -345,96 +619,93 @@ class CompiledDAG:
             )
         seq = next(self._seq)
         self._inflight.add(seq)
-        parts, total, _ = serialization.serialize_parts(value)
-        raw = None
-        written: set[str] = set()
+        parts = total = raw = None
         for target in self._input_targets:
-            chan = target["channel"]
-            msg = {
-                "dag_id": self.dag_id,
-                "node": target["node"],
-                "seq": seq,
-                "slot": target["slot"],
-            }
-            if chan is not None:
-                if chan not in written:
-                    self._chan_put(chan, seq, parts, total)
-                    written.add(chan)
-                msg["channel"] = chan
-            else:
+            fam = target["family"]
+            if fam == "shm":
+                if parts is None:
+                    parts, total, _ = serialization.serialize_parts(value)
+                target["chan"].push_parts(seq, parts, total)
+            elif fam == "device":
+                target["chan"].push_edge(value)
+            else:  # socket fallback: one RPC per push
                 if raw is None:
-                    raw = serialization.join_parts(parts)
-                msg["value"] = raw
-            self._call_actor(target["actor_id"], "dag_push", msg)
+                    raw = serialization.join_parts(
+                        serialization.serialize_parts(value)[0]
+                    )
+                self._call_actor(target["actor_id"], "dag_push", {
+                    "dag_id": self.dag_id, "node": target["node"],
+                    "seq": seq, "slot": target["slot"], "value": raw,
+                })
         return DAGRef(self, seq)
-
-    def _chan_put(self, base: str, seq: int, parts, total: int) -> None:
-        """Driver-side producer: streamed ring-slot write with
-        backpressure (slot freed when the consumer deletes it)."""
-        from ray_tpu.dag import channel
-
-        name = channel.slot_name(base, seq, self.CHANNEL_DEPTH)
-        deadline = time.monotonic() + 120.0
-        while not channel.try_write(self._ctx.store, name, parts, total):
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"channel slot {name} stuck for 120s")
-            time.sleep(0.002)
 
     def _pop(self, seq: int, timeout: float) -> Any:
         self._inflight.discard(seq)
-        # Client deadline strictly AFTER the server-side pop wait, so the
-        # timeout reply always beats the transport deadline (an abandoned
-        # pop would consume the result into a dropped reply).
-        resp = self._call_actor(
-            self._output_actor,
-            "dag_pop",
-            {"dag_id": self.dag_id, "seq": seq, "timeout": timeout},
-            timeout=timeout + 15,
-        )
-        if resp["status"] == "timeout":
-            raise TimeoutError(f"dag output seq={seq} not ready in {timeout}s")
-        if resp.get("channel"):
-            from ray_tpu.dag import channel
-
-            value = channel.read_consume(
-                self._ctx.store,
-                channel.slot_name(resp["channel"], seq, self.CHANNEL_DEPTH),
-            )
-        else:
-            value = serialization.deserialize(resp["value"], zero_copy=False)
-        from ray_tpu import exceptions
-
-        if isinstance(value, exceptions.TaskError):
-            raise value
-        return value
-
-    async def _teardown_async(self) -> None:
-        for actor_id in {s["actor_id"] for s in self._stages.values()}:
+        deadline = time.monotonic() + timeout
+        values = []
+        for reader in self._out_readers:
             try:
-                client = await self._ctx._actor_client(actor_id)
-                await client.call(
-                    "dag_teardown", {"dag_id": self.dag_id}, timeout=10
-                )
-            except Exception:  # rtlint: disable=swallowed-exception - actor may be dead; teardown is idempotent
-                pass
-        # Driver-owned output ring: freed here too, so the __del__ path
-        # (which can only fire-and-forget this coroutine) leaks nothing.
-        if self._out_channel:
-            for i in range(self.CHANNEL_DEPTH):
-                try:
-                    self._ctx.store.delete(f"{self._out_channel}-{i}")
-                except Exception:  # rtlint: disable=swallowed-exception - ring slot already freed
-                    pass
+                values.append(reader.read(seq, deadline))
+            except (TimeoutError, asyncio.TimeoutError):
+                self._raise_pop_timeout(seq, timeout)
+        errors = [v for v in values if isinstance(v, exceptions.TaskError)]
+        if errors:
+            raise errors[0]
+        return values if self._multi_output else values[0]
 
-    def teardown(self) -> None:
-        """Release stage registrations, buffered inputs, and channel slots
-        on every participating worker (and the driver's output ring)."""
+    def _raise_pop_timeout(self, seq: int, timeout: float) -> None:
+        """A pop timeout on a static graph means either a dead stage or a
+        genuinely slow one — probe actor liveness so the caller gets a
+        typed death error instead of a bare timeout."""
+        for aid in self._actor_ids:
+            try:
+                info = self._ctx.io.run(
+                    self._ctx.controller.call(
+                        "get_actor_info", {"actor_id": aid}, timeout=10
+                    ),
+                    timeout=15,
+                )
+            except Exception:  # rtlint: disable=swallowed-exception - controller unreachable: fall through to the plain timeout
+                continue
+            if (info or {}).get("state") == "DEAD":
+                raise exceptions.DAGActorDiedError(
+                    self.dag_id, aid, self._plan.rank_of(aid),
+                    detail=str((info or {}).get("death_cause") or ""),
+                )
+        raise TimeoutError(
+            f"dag output seq={seq} not ready in {timeout}s"
+        )
+
+    # -- teardown ---------------------------------------------------------
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain in-flight executions, stop the resident worker loops,
+        and free every channel ring slot. Idempotent."""
         if self._torn_down:
             return
         self._torn_down = True
+        _LIVE_DAGS.pop(self.dag_id, None)
+        # Drain admitted-but-unpopped seqs so no worker loop is wedged
+        # mid-push when the teardown RPC lands.
+        for seq in sorted(self._inflight):
+            deadline = time.monotonic() + min(5.0, timeout)
+            for reader in self._out_readers:
+                try:
+                    reader.read(seq, deadline)
+                except Exception:  # rtlint: disable=swallowed-exception - draining a dead or torn graph; slots are freed below regardless
+                    pass
+        self._inflight.clear()
         try:
-            import asyncio
+            self._ctx.io.run(self._teardown_async(), timeout=timeout)
+        except Exception:  # rtlint: disable=swallowed-exception - teardown race with shutdown; worker side is idempotent
+            pass
+        self._destroy_group(sync=True)
 
+    def teardown(self) -> None:
+        """Back-compat alias for close(); safe to call from the io loop
+        or a GC finalizer (falls back to fire-and-forget there)."""
+        if self._torn_down:
+            return
+        try:
             on_io_loop = asyncio.get_running_loop() is self._ctx.io.loop
         except RuntimeError:
             on_io_loop = False
@@ -442,12 +713,48 @@ class CompiledDAG:
             # Never block the io loop (a GC-triggered __del__ can run
             # on ANY thread, including the loop itself): fire and
             # forget — worker-side teardown is idempotent.
+            self._torn_down = True
+            _LIVE_DAGS.pop(self.dag_id, None)
             self._spawn_teardown()
+            self._destroy_group(sync=False)
         else:
+            self.close()
+
+    async def _teardown_async(self) -> None:
+        for actor_id in self._actor_ids:
             try:
-                self._ctx.io.run(self._teardown_async(), timeout=30)
-            except Exception:  # rtlint: disable=swallowed-exception - teardown race with shutdown; worker side is idempotent
+                client = await self._ctx._actor_client(actor_id)
+                await client.call(
+                    "dag_teardown", {"dag_id": self.dag_id}, timeout=10
+                )
+            except Exception:  # rtlint: disable=swallowed-exception - actor may be dead; teardown is idempotent
                 pass
+        # Driver-side backstop: every shm ring slot of this DAG (input,
+        # inter-stage, and output rings) — a dead worker must not leak
+        # its consumer-owned slots, and the driver-owned output ring is
+        # freed here so the __del__ fire-and-forget path leaks nothing.
+        for base in self._all_shm_bases:
+            for i in range(self.CHANNEL_DEPTH):
+                try:
+                    self._ctx.store.delete(f"{base}-{i}")
+                except Exception:  # rtlint: disable=swallowed-exception - ring slot already freed
+                    pass
+
+    def _destroy_group(self, sync: bool) -> None:
+        if self._group is None:
+            return
+        from ray_tpu.util.collective import collective
+
+        if sync:
+            try:
+                collective.destroy_collective_group(self.dag_id)
+            except Exception:  # rtlint: disable=swallowed-exception - rendezvous keys die with the controller; the registry entry is what must go
+                collective._groups.pop(self.dag_id, None)
+        else:
+            # destroy() round-trips the controller KV via the io loop we
+            # may be ON: drop the registry entry only.
+            collective._groups.pop(self.dag_id, None)
+        self._group = None
 
     def _spawn_teardown(self) -> None:
         """Fire-and-forget teardown that never leaks an unawaited
@@ -466,5 +773,6 @@ class CompiledDAG:
             if not self._torn_down:
                 self._torn_down = True
                 self._spawn_teardown()
+                self._destroy_group(sync=False)
         except Exception:  # rtlint: disable=swallowed-exception - __del__ during interpreter teardown
             pass

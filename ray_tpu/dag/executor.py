@@ -1,0 +1,363 @@
+"""Resident per-stage executor loops — the worker half of rtdag.
+
+After compile, every (dag_id, stage) on a worker gets a daemon
+``StageLoop`` thread that processes sequence numbers strictly in order:
+pop every input edge for seq k, run the bound actor method on the
+actor's single-width executor (preserving actor single-threadedness
+while stages on DIFFERENT actors pipeline), push every output edge.
+Steady state is pure channel-push/channel-pop — no controller RPC, no
+per-hop notify.
+
+Blocking discipline (hang-doctor compatibility): worker-side device pops
+use SHORT retry slices (timed-out slices complete ok=False, never feed
+the watchdog's p95 window, and age out below the deadline floor), so an
+idle resident loop can never trip a false stall — while its in-flight
+short-slice records ARE harvested as waiting-rank evidence when the
+driver's full-timeout pop flags the shared DAG channel.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import threading
+import traceback
+
+from ray_tpu import exceptions
+from ray_tpu._private import serialization
+from ray_tpu.dag.channels import (
+    ChannelClosedError,
+    DeviceChannel,
+    ShmChannel,
+)
+
+# Worker-side device-pop retry slice: long enough to stay cheap, short
+# enough that stop() is honored promptly and a timed-out slice stays
+# under the watchdog's deadline floor.
+_POP_SLICE_S = 0.5
+
+_TIMEOUTS = (TimeoutError, asyncio.TimeoutError)
+
+
+class SeqBuffer:
+    """Thread-safe in-order mailbox feeding one input slot (local and
+    socket edges; shm/device edges pop their channel directly)."""
+
+    def __init__(self):
+        self._items: dict[int, object] = {}
+        self._cv = threading.Condition()
+
+    def put(self, seq: int, value) -> None:
+        with self._cv:
+            self._items[seq] = value
+            self._cv.notify_all()
+
+    def pop(self, seq: int, stop) -> object:
+        with self._cv:
+            while seq not in self._items:
+                if stop():
+                    raise ChannelClosedError("stage loop stopped")
+                self._cv.wait(timeout=0.1)
+            return self._items.pop(seq)
+
+    def wake(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
+
+
+class StageLoop(threading.Thread):
+    """One stage's resident loop: in-order pop → compute → push."""
+
+    def __init__(self, *, dag_id: str, stage: dict, store, group,
+                 run_stage, deliver_local, send_socket, park_output,
+                 wire_cfg=None, ef=None):
+        super().__init__(
+            daemon=True, name=f"rtdag-{dag_id}-n{stage['node']}"
+        )
+        self.dag_id = dag_id
+        self.stage = stage
+        self._stop = threading.Event()
+        self._run_stage = run_stage
+        self._deliver_local = deliver_local
+        self._send_socket = send_socket
+        self._park_output = park_output
+        depth = stage.get("depth", 8)
+        # Input poppers, in declared slot order (== method arg order).
+        self._in_pops: list[tuple[str, object]] = []
+        self._buffers: dict[str, SeqBuffer] = {}
+        for edge in stage.get("in_edges", ()):
+            fam = edge["family"]
+            if fam == "shm":
+                chan = ShmChannel(
+                    store, edge["channel"], depth, group=dag_id
+                )
+            elif fam == "device":
+                chan = DeviceChannel(
+                    group, edge["peer_rank"], src=edge["src"],
+                    dst=edge["dst"], slot=edge["slot_id"],
+                    wire_cfg=wire_cfg, ef=ef,
+                )
+            else:  # local / socket: fed via feed()
+                chan = self._buffers.setdefault(edge["slot"], SeqBuffer())
+            self._in_pops.append((edge["slot"], fam, chan))
+        # Output channels, keyed by (node, slot) for downstream edges.
+        self._down_chans: dict[tuple, object] = {}
+        for edge in stage.get("downstream", ()):
+            fam = edge["family"]
+            key = (edge["node"], edge["slot"])
+            if fam == "shm":
+                self._down_chans[key] = ShmChannel(
+                    store, edge["channel"], depth, group=dag_id
+                )
+            elif fam == "device":
+                self._down_chans[key] = DeviceChannel(
+                    group, edge["peer_rank"], src=edge["src"],
+                    dst=edge["dst"], slot=edge["slot_id"],
+                    wire_cfg=wire_cfg, ef=ef,
+                )
+        # Output edges to the driver (a stage may back several
+        # MultiOutputNode members).
+        self._out_chans: list[tuple[dict, object]] = []
+        for out in stage.get("outs", ()):
+            if out["family"] == "shm":
+                chan = ShmChannel(store, out["channel"], depth, group=dag_id)
+            elif out["family"] == "device":
+                chan = DeviceChannel(
+                    group, out["peer_rank"], src=out["src"],
+                    dst=out["dst"], slot=out["slot_id"],
+                    wire_cfg=wire_cfg, ef=ef,
+                )
+            else:  # socket: parked locally, pulled via dag_pop
+                chan = None
+            self._out_chans.append((out, chan))
+
+    # -- control ---------------------------------------------------------
+    def feed(self, slot: str, seq: int, value) -> None:
+        buf = self._buffers.get(slot)
+        if buf is None:
+            raise KeyError(
+                f"{self.name}: slot {slot!r} is not a buffered edge"
+            )
+        buf.put(seq, value)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for buf in self._buffers.values():
+            buf.wake()
+
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+    def free_slots(self) -> None:
+        """Consumer-owned shm ring slots (this stage's input edges)."""
+        for _, fam, chan in self._in_pops:
+            if fam == "shm":
+                chan.free_slots()
+
+    # -- per-edge ops ----------------------------------------------------
+    def _pop_input(self, fam: str, chan, seq: int):
+        if fam == "shm":
+            return chan.pop(seq, timeout=None, stop=self.stopped)
+        if fam == "device":
+            while True:
+                if self.stopped():
+                    raise ChannelClosedError("stage loop stopped")
+                try:
+                    return chan.pop_edge(timeout=_POP_SLICE_S)
+                except _TIMEOUTS:
+                    continue
+        return chan.pop(seq, stop=self.stopped)  # SeqBuffer
+
+    def _push_downstream(self, edge, seq: int, result, cache: dict) -> None:
+        fam = edge["family"]
+        if fam == "local":
+            # Same-actor edge: deliver a private copy in-process (the
+            # serialize round trip IS the copy barrier).
+            if "raw" not in cache:
+                parts, total, _ = serialization.serialize_parts(result)
+                cache["raw"] = serialization.join_parts(parts)
+            self._deliver_local(
+                edge["node"], edge["slot"], seq, cache["raw"]
+            )
+        elif fam == "shm":
+            if "parts" not in cache:
+                cache["parts"], cache["total"], _ = (
+                    serialization.serialize_parts(result)
+                )
+            chan = self._down_chans[(edge["node"], edge["slot"])]
+            chan.push_parts(
+                seq, cache["parts"], cache["total"], stop=self.stopped
+            )
+        elif fam == "device":
+            self._down_chans[(edge["node"], edge["slot"])].push_edge(result)
+        else:  # socket
+            if "raw" not in cache:
+                parts, total, _ = serialization.serialize_parts(result)
+                cache["raw"] = serialization.join_parts(parts)
+            self._send_socket(edge, seq, cache["raw"])
+
+    # -- main loop -------------------------------------------------------
+    def run(self) -> None:
+        stage = self.stage
+        try:
+            for seq in itertools.count():
+                if self.stopped():
+                    return
+                args = []
+                err = None
+                for slot, fam, chan in self._in_pops:
+                    value = self._pop_input(fam, chan, seq)
+                    if err is None and isinstance(
+                        value, exceptions.TaskError
+                    ):
+                        err = value
+                    args.append(value)
+                if err is not None:
+                    result = err  # skip compute, forward the failure
+                else:
+                    try:
+                        result = self._run_stage(stage["method"], args)
+                    except Exception:
+                        result = exceptions.TaskError(
+                            stage["method"], traceback.format_exc()
+                        )
+                cache: dict = {}
+                for edge in stage.get("downstream", ()):
+                    self._push_downstream(edge, seq, result, cache)
+                for out, chan in self._out_chans:
+                    if chan is None:
+                        self._park_output(seq, result)
+                    elif out["family"] == "shm":
+                        chan.push(seq, result, stop=self.stopped)
+                    else:
+                        chan.push_edge(result)
+        except ChannelClosedError:
+            return
+        except Exception:
+            if not self.stopped():
+                traceback.print_exc()
+
+
+class DagRuntime:
+    """All rtdag state one worker holds for one dag_id: the per-dag
+    device-plane group membership (if any device edges exist), the
+    resident StageLoops, and the parked results of a socket-family
+    output edge. Built OFF the io loop (group rendezvous blocks on
+    controller KV via ctx.io.run)."""
+
+    def __init__(self, *, ctx, dag_id: str, payload: dict, run_stage,
+                 notify_loop):
+        self._ctx = ctx
+        self.dag_id = dag_id
+        self._stages = payload["stages"]
+        self._notify_loop = notify_loop
+        self._results: dict[int, object] = {}
+        self._events: dict[int, asyncio.Event] = {}
+        self._group_name = None
+        group = None
+        gspec = payload.get("group")
+        if gspec:
+            from ray_tpu.util.collective import collective
+
+            collective.init_collective_group(
+                gspec["world_size"], gspec["rank"], backend="ring",
+                group_name=gspec["name"],
+            )
+            group = collective.get_group(gspec["name"])
+            self._group_name = gspec["name"]
+        wire_cfg = None
+        ef = None
+        if payload.get("wire_quant"):
+            from ray_tpu.util.collective.quantization import (
+                CollectiveConfig,
+                ErrorFeedback,
+            )
+
+            wire_cfg = CollectiveConfig(
+                quantize_activations=payload["wire_quant"]
+            ).activation_wire_config()
+            ef = ErrorFeedback()
+        self._loops = [
+            StageLoop(
+                dag_id=dag_id, stage=stage, store=ctx.store, group=group,
+                run_stage=run_stage, deliver_local=self._deliver_local,
+                send_socket=self._send_socket,
+                park_output=self._park_output, wire_cfg=wire_cfg, ef=ef,
+            )
+            for stage in self._stages
+        ]
+        for loop in self._loops:
+            loop.start()
+
+    # -- inbound ---------------------------------------------------------
+    def feed(self, node: int, slot: str, seq: int, value) -> None:
+        for loop in self._loops:
+            if loop.stage["node"] == node:
+                loop.feed(slot, seq, value)
+                return
+        raise KeyError(f"dag {self.dag_id}: stage {node} not on this worker")
+
+    # -- StageLoop callbacks ---------------------------------------------
+    def _deliver_local(self, node: int, slot: str, seq: int, raw) -> None:
+        self.feed(
+            node, slot, seq, serialization.deserialize(raw, zero_copy=False)
+        )
+
+    def _send_socket(self, edge: dict, seq: int, raw) -> None:
+        async def _push():
+            client = await self._ctx._actor_client(edge["actor_id"])
+            await client.call("dag_push", {
+                "dag_id": self.dag_id, "node": edge["node"],
+                "slot": edge["slot"], "seq": seq, "value": raw,
+            })
+
+        def _log_err(f):
+            try:
+                exc = f.exception()
+            except Exception:  # rtlint: disable=swallowed-exception - cancelled future during teardown
+                return
+            if exc is not None:
+                traceback.print_exception(type(exc), exc, exc.__traceback__)
+
+        fut = asyncio.run_coroutine_threadsafe(_push(), self._ctx.io.loop)
+        fut.add_done_callback(_log_err)
+
+    def _park_output(self, seq: int, result) -> None:
+        self._results[seq] = result
+
+        def _set():
+            self._events.setdefault(seq, asyncio.Event()).set()
+
+        self._notify_loop.call_soon_threadsafe(_set)
+
+    # -- outbound (socket out-edge legacy pop) ---------------------------
+    async def pop(self, seq: int, timeout: float) -> dict:
+        event = self._events.setdefault(seq, asyncio.Event())
+        try:
+            await asyncio.wait_for(event.wait(), timeout)
+        except asyncio.TimeoutError:
+            return {"status": "timeout"}
+        result = self._results.pop(seq)
+        self._events.pop(seq, None)
+        raw, _ = serialization.serialize(result)
+        return {"status": "ok", "value": raw}
+
+    # -- teardown --------------------------------------------------------
+    def stop(self) -> None:
+        """Stop every loop, free consumer-owned ring slots, and leave the
+        per-dag collective group. Blocking — run off the io loop."""
+        for loop in self._loops:
+            loop.stop()
+        for loop in self._loops:
+            loop.join(timeout=5)
+        for loop in self._loops:
+            loop.free_slots()
+        self._results.clear()
+        if self._group_name is not None:
+            from ray_tpu.util.collective import collective
+
+            try:
+                collective.destroy_collective_group(self._group_name)
+            except Exception:  # rtlint: disable=swallowed-exception - teardown races worker shutdown; the group registry entry is gone either way
+                pass
+            self._group_name = None

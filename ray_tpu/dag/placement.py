@@ -1,0 +1,102 @@
+"""Compile-time placement plan for rtdag graphs.
+
+Compiling a DAG pins every participating actor to the cluster node that
+hosts it BEFORE any channel is opened: channel-family selection (shm vs
+device vs socket) is a pure function of this plan, every actor gets a
+stable device-plane rank (driver = 0, actors = 1..N in graph order), and
+placement failures surface as compile errors instead of silently
+degrading an edge to a slower family.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+class PlacementError(RuntimeError):
+    """An actor's placement could not be resolved at compile time."""
+
+
+class PlacementPlan:
+    """Resolved placement for one compiled DAG: driver node plus, per
+    actor, its hosting cluster node and device-plane rank."""
+
+    def __init__(self, driver_node: str, actors: dict[str, dict]):
+        self.driver_node = driver_node
+        self.actors = actors  # actor_id → {"node_id": str, "rank": int}
+
+    @classmethod
+    def resolve(cls, ctx, actor_ids, timeout: float = 60.0) -> "PlacementPlan":
+        """Query the controller for every actor's placement concurrently,
+        waiting for scheduling (compile typically runs right after actor
+        creation). Raises PlacementError on any unresolved actor — an
+        unplaceable DAG must fail at compile, not at first execute."""
+
+        async def _gather():
+            return await asyncio.gather(*[
+                ctx.controller.call(
+                    "get_actor_info",
+                    {"actor_id": aid, "wait_ready": True},
+                    timeout=timeout,
+                )
+                for aid in actor_ids
+            ])
+
+        try:
+            infos = ctx.io.run(_gather(), timeout=timeout + 10)
+        except Exception as exc:
+            raise PlacementError(
+                f"placement query failed for actors {list(actor_ids)}: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
+        actors: dict[str, dict] = {}
+        for rank, (aid, info) in enumerate(zip(actor_ids, infos), start=1):
+            node = (info or {}).get("node_id")
+            state = (info or {}).get("state")
+            if not node or state == "DEAD":
+                raise PlacementError(
+                    f"actor {aid} has no live placement "
+                    f"(state={state!r}, node={node!r})"
+                )
+            actors[aid] = {"node_id": node, "rank": rank}
+        return cls(ctx.node_id, actors)
+
+    # -- queries ---------------------------------------------------------
+    def node_of(self, actor_id: str | None) -> str:
+        """Hosting node; None means the driver."""
+        if actor_id is None:
+            return self.driver_node
+        return self.actors[actor_id]["node_id"]
+
+    def rank_of(self, actor_id: str | None) -> int:
+        """Device-plane rank; the driver is rank 0."""
+        if actor_id is None:
+            return 0
+        return self.actors[actor_id]["rank"]
+
+    def colocated(self, a: str | None, b: str | None) -> bool:
+        return self.node_of(a) == self.node_of(b)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.actors) + 1  # + driver
+
+
+def edge_family(plan: PlacementPlan, src: str | None, dst: str | None,
+                hint: str | None, override: str | None) -> str:
+    """Channel family for one edge (src/dst are actor ids; None = the
+    driver endpoint). Precedence: same-actor > compile-wide override >
+    per-node hint > auto (co-located → shm, else device)."""
+    if src is not None and src == dst:
+        return "local"
+    choice = override or hint
+    if choice is None:
+        return "shm" if plan.colocated(src, dst) else "device"
+    if choice == "shm" and not plan.colocated(src, dst):
+        raise ValueError(
+            f"edge {src or 'driver'} → {dst or 'driver'} requested an shm "
+            "channel but the endpoints are on different nodes"
+        )
+    if choice not in ("shm", "device", "socket"):
+        raise ValueError(f"unknown channel family {choice!r}")
+    return choice

@@ -37,8 +37,13 @@ from ray_tpu.devtools.lint.core import call_name
 # Wildcard marker inside a skeleton (rendered "{}" for humans/JSON).
 WILD = "\x00"
 
-_P2P_SEND = {"send", "send_async"}
-_P2P_RECV = {"recv"}
+_P2P_SEND = {"send", "send_async", "push"}
+_P2P_RECV = {"recv", "pop"}
+# Channel-object verbs (rtdag DeviceChannel). ``push``/``pop`` are far
+# too common as plain container methods to admit on receiver shape
+# alone, so a site only counts when it passes an explicit ``tag=``
+# keyword — the certified-tag idiom.
+_TAG_KW_ONLY = {"push", "pop"}
 _COLLECTIVES = {
     "allreduce", "allreduce_sharded", "allgather", "reducescatter",
     "broadcast", "barrier",
@@ -70,7 +75,7 @@ _COMM_PATHS = ("util/collective/",)
 # (ISSUE 13): the serve control plane hosts no collectives today, so the
 # scan doubles as a tripwire against one sneaking onto the request path.
 _SCAN_PATHS = ("util/collective/", "train/", "parallel/", "release/",
-               "bench", "serve/_private/")
+               "bench", "serve/_private/", "dag/")
 
 _RANKISH = re.compile(r"rank|stage|process_index")
 
@@ -311,7 +316,13 @@ def _make_site(relpath: str, call: ast.Call, method: str, group: str,
     tag_node = _arg(args_call,
                     pos + shift if pos is not None else None, "tag")
     skel = tag_skeleton(tag_node, default=_DEFAULT_TAG.get(method, ""))
-    if kind == "send":
+    if method in _TAG_KW_ONLY:
+        # Channel verbs: the peer is baked into the channel object at
+        # compile time, not visible at the call site.
+        peer = None
+        payload = _arg(args_call, 0 + shift, "value") \
+            if kind == "send" else None
+    elif kind == "send":
         peer = _arg(args_call, 1 + shift, "dst_rank", "dst")
         payload = _arg(args_call, 0 + shift, "array", "payload")
     elif kind == "recv":
@@ -358,6 +369,10 @@ def extract_sites(tree: ast.Module, relpath: str) -> list[dict]:
         name = call_name(node)
         tail = name.rsplit(".", 1)[-1] if name else ""
         if tail in _METHODS and isinstance(node.func, ast.Attribute):
+            if tail in _TAG_KW_ONLY and not any(
+                kw.arg == "tag" for kw in node.keywords
+            ):
+                continue  # container .push()/.pop(), not a channel verb
             recv_txt = _receiver(node)
             if _receiver_ok(recv_txt, relpath):
                 sites.append(_make_site(
@@ -370,7 +385,10 @@ def extract_sites(tree: ast.Module, relpath: str) -> list[dict]:
         if tail == "partial" and node.args and \
                 isinstance(node.args[0], ast.Attribute):
             target = node.args[0]
-            if target.attr in _METHODS:
+            if target.attr in _METHODS and not (
+                target.attr in _TAG_KW_ONLY
+                and not any(kw.arg == "tag" for kw in node.keywords)
+            ):
                 recv_txt = _safe_unparse(target.value)
                 if _receiver_ok(recv_txt, relpath):
                     sites.append(_make_site(

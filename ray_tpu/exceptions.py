@@ -53,6 +53,32 @@ class ActorUnavailableError(RayTpuError):
     """The actor is temporarily unreachable (e.g. restarting)."""
 
 
+class DAGActorDiedError(ActorDiedError):
+    """An actor participating in a compiled DAG died while an execution
+    was in flight. Raised from DAGRef.get() instead of a bare timeout so
+    callers can distinguish 'the graph is dead' from 'the graph is
+    slow'; names the dead actor and its device-plane rank so the report
+    lines up with the hang doctor's suspect ranks."""
+
+    def __init__(self, dag_id: str, actor_id: str, rank: int,
+                 detail: str = ""):
+        self.dag_id = dag_id
+        self.actor_id = actor_id
+        self.rank = rank
+        message = (
+            f"compiled DAG {dag_id}: actor {actor_id} (dag rank {rank}) "
+            "died with executions in flight"
+        )
+        if detail:
+            message += f": {detail}"
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (
+            DAGActorDiedError, (self.dag_id, self.actor_id, self.rank)
+        )
+
+
 class ReplicaDiedError(RayTpuError):
     """The serve replica backing an in-flight request died mid-call and
     the request could not be completed on another replica. Raised by

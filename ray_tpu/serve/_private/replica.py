@@ -205,12 +205,17 @@ class Replica:
     STREAM_IDLE_TTL_S = 120.0
 
     def _open_stream(self, gen) -> str:
+        from ray_tpu.dag.channels import LocalChannel
+
         stream_id = f"stream-{self.replica_id}-{self._stream_counter}"
         self._stream_counter += 1
-        queue: asyncio.Queue = asyncio.Queue(maxsize=256)
-        task = asyncio.get_running_loop().create_task(self._pump(gen, queue))
+        # The token stream rides an rtdag LocalChannel — the same-process
+        # channel family of the compiled-dataflow plane (ISSUE 15); its
+        # bounded ring is the decode-loop backpressure.
+        chan = LocalChannel(maxsize=256, group="serve", label=stream_id)
+        task = asyncio.get_running_loop().create_task(self._pump(gen, chan))
         self._streams[stream_id] = {
-            "queue": queue, "task": task, "last_access": time.monotonic(),
+            "chan": chan, "task": task, "last_access": time.monotonic(),
         }
         self._reap_idle_streams()
         return stream_id
@@ -219,62 +224,66 @@ class Replica:
         entry = self._streams.pop(stream_id, None)
         if entry is not None:
             entry["task"].cancel()
+            entry["chan"].close()
             self._ongoing -= 1
 
     def _reap_idle_streams(self) -> None:
         """Abandoned streams (client crashed / never iterated) must not pin
-        the generator + queue + ongoing slot forever."""
+        the generator + channel + ongoing slot forever."""
         now = time.monotonic()
         for sid, entry in list(self._streams.items()):
             if now - entry["last_access"] > self.STREAM_IDLE_TTL_S:
                 self._finish_stream(sid)
 
-    async def _pump(self, gen, queue: asyncio.Queue) -> None:
-        """Drains the user generator into the stream queue. Sentinel dicts
-        terminate: {'done': True} or {'error': repr}."""
+    async def _pump(self, gen, chan) -> None:
+        """Drains the user generator into the stream channel. Sentinel
+        dicts terminate: {'done': True} or {'error': repr}."""
+        from ray_tpu.dag.channels import ChannelClosedError
+
         try:
             if inspect.isasyncgen(gen):
                 async for item in gen:
-                    await queue.put({"item": item})
+                    await chan.put({"item": item})
             else:
                 for item in gen:
-                    await queue.put({"item": item})
+                    await chan.put({"item": item})
                     await asyncio.sleep(0)  # let consumers interleave
-            await queue.put({"done": True})
+            await chan.put({"done": True})
+        except ChannelClosedError:
+            return  # stream finished/cancelled under us: nothing to park
         except Exception as exc:
-            await queue.put({"error": f"{type(exc).__name__}: {exc}"})
+            try:
+                await chan.put({"error": f"{type(exc).__name__}: {exc}"})
+            except ChannelClosedError:
+                return
+        finally:
+            # The generator body may hold device buffers; drop our ref
+            # promptly rather than waiting for task GC.
+            del gen
 
     async def stream_next(
         self, stream_id: str, max_items: int = 64, timeout_s: float = 30.0
     ) -> dict:
         """Pop at least one event (blocking up to timeout_s), then drain up
         to max_items without waiting — batching amortizes the per-chunk
-        RPC."""
+        RPC (LocalChannel.pop_batch IS those semantics)."""
         entry = self._streams.get(stream_id)
         if entry is None:
             return {"items": [], "done": True, "error": "unknown stream"}
         entry["last_access"] = time.monotonic()
-        queue = entry["queue"]
+        events = await entry["chan"].pop_batch(max_items, timeout_s)
+        if not events:
+            entry["last_access"] = time.monotonic()
+            return {"items": [], "done": False}
         items: list = []
         done = False
         error = None
-        try:
-            event = await asyncio.wait_for(queue.get(), timeout_s)
-        except asyncio.TimeoutError:
-            entry["last_access"] = time.monotonic()
-            return {"items": [], "done": False}
-        while True:
+        for event in events:
             if "item" in event:
                 items.append(event["item"])
             else:
                 done = True
                 error = event.get("error")
-                break
-            if len(items) >= max_items:
-                break
-            try:
-                event = queue.get_nowait()
-            except asyncio.QueueEmpty:
                 break
         if done:
             self._finish_stream(stream_id)

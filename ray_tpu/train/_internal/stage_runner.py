@@ -56,12 +56,8 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from ray_tpu.dag.channels import DeviceChannel
 from ray_tpu.train._internal import step_stats
-from ray_tpu.util.collective import flight
-
-# Wire marker for codec-compressed activation payloads (self-describing
-# so mixed exact/quantized edges share one recv path).
-_ACT_WIRE = "__act"
 
 
 class PipelineStageRunner:
@@ -190,6 +186,17 @@ class PipelineStageRunner:
         ):
             self._act_cfg = cfg.activation_wire_config()
         self._act_ef = ErrorFeedback()
+        # Neighbor rings as rtdag device channels (ISSUE 15): the 1F1B
+        # activation wire is the same channel family a compiled DAG edge
+        # uses — tagged mode, with the codec/EF state owned per edge.
+        self._prev_ring = DeviceChannel(
+            self.group, (self.stage - 1) % self.num_stages,
+            site="pipeline", wire_cfg=self._act_cfg, ef=self._act_ef,
+        )
+        self._next_ring = DeviceChannel(
+            self.group, (self.stage + 1) % self.num_stages,
+            site="pipeline", wire_cfg=self._act_cfg, ef=self._act_ef,
+        )
 
     # -- back-compat single-chunk views -----------------------------------
     @property
@@ -222,40 +229,22 @@ class PipelineStageRunner:
         return self.num_stages * self.virtual
 
     # -- p2p plumbing -----------------------------------------------------
-    def _recv(self, src: int, tag: str, like):
-        """Blocking neighbor recv; blocked wall time IS the pipeline
-        bubble at this stage, so it lands in the pp_bubble phase."""
+    def _recv(self, ring: DeviceChannel, tag: str, like):
+        """Blocking neighbor pop; blocked wall time IS the pipeline
+        bubble at this stage, so it lands in the pp_bubble phase. The
+        channel decodes codec-compressed payloads before returning."""
         t0 = time.perf_counter()
-        with flight.site("pipeline"):
-            out = self.group.recv(
-                src, tag=tag, timeout=self.recv_timeout_s, like=like
-            )
+        out = ring.pop(tag=tag, timeout=self.recv_timeout_s, like=like)
         step_stats.record_phase("pp_bubble", time.perf_counter() - t0)
-        if isinstance(out, tuple) and len(out) == 4 and out[0] == _ACT_WIRE:
-            from ray_tpu.util.collective.quantization import decode
-
-            _, shape, dtype_str, enc = out
-            return decode(enc).reshape(shape).astype(np.dtype(dtype_str))
         return out
 
-    def _send(self, array, dst: int, tag: str, site=None) -> None:
+    def _send(self, array, ring: DeviceChannel, tag: str, site=None) -> None:
         arr = np.asarray(array)  # rtlint: disable=host-sync-in-step - eager p2p hand-off IS the wire, not an accidental sync
-        if (
-            self._act_cfg is not None
-            and site is not None
-            and arr.dtype.kind == "f"
-        ):
-            # Block-scaled quantized activation hand-off: the per-edge
-            # EF residual telescopes this step's rounding error into the
-            # next step's message on the SAME (direction, m, vs) edge.
-            enc = self._act_ef.encode(site, arr.ravel(), self._act_cfg)
-            with flight.site("pipeline"):
-                self.group.send(
-                    (_ACT_WIRE, arr.shape, arr.dtype.str, enc), dst, tag=tag
-                )
-            return
-        with flight.site("pipeline"):
-            self.group.send(arr, dst, tag=tag)
+        # With a wire codec configured, the channel block-scale-quantizes
+        # float payloads; the per-edge EF residual (keyed by ``site`` =
+        # direction × microbatch × virtual stage) telescopes this step's
+        # rounding error into the next step's message on the SAME edge.
+        ring.push(arr, tag=tag, ef_site=site)
 
     # -- one optimizer step ----------------------------------------------
     def train_step(self, batch: Any) -> float:
@@ -268,8 +257,6 @@ class PipelineStageRunner:
         losses: list = []
         stash: dict[tuple, Any] = {}  # (micro, chunk) -> input / grads
         step_tag = self._next_tag()
-        prev_rank = (self.stage - 1) % self.num_stages
-        next_rank = (self.stage + 1) % self.num_stages
         last_vs = self.num_virtual_stages - 1
         for op, m, c in self.schedule:
             vs = self._virtual_stage(c)
@@ -279,7 +266,7 @@ class PipelineStageRunner:
                     a_in = self._model_inputs(micro)
                 else:
                     a_in = self._recv(
-                        prev_rank,
+                        self._prev_ring,
                         f"{step_tag}f{m}v{vs}",
                         self.activation_like(micro),
                     )
@@ -296,7 +283,7 @@ class PipelineStageRunner:
                     y = self._fwd[c](self._chunk_params[c], a_in)
                     self._send(
                         y,
-                        next_rank,
+                        self._next_ring,
                         f"{step_tag}f{m}v{vs + 1}",
                         site=("f", m, vs),
                     )
@@ -305,7 +292,7 @@ class PipelineStageRunner:
                     dp, da = stash.pop((m, c))
                 else:
                     ct = self._recv(
-                        next_rank,
+                        self._next_ring,
                         f"{step_tag}b{m}v{vs}",
                         self.activation_like(micro),
                     )
@@ -315,7 +302,7 @@ class PipelineStageRunner:
                 if vs > 0:
                     self._send(
                         da,
-                        prev_rank,
+                        self._prev_ring,
                         f"{step_tag}b{m}v{vs - 1}",
                         site=("b", m, vs),
                     )

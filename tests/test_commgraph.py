@@ -368,3 +368,56 @@ def test_repo_protocol_certified():
               for g in grids if g["ok"]}
     assert (2, 8, 2) in shapes
     assert all(g["ok"] for g in grids), grids
+
+
+# ---------------------------------------------------------------------------
+# rtdag channel verbs (ISSUE 15): push/pop sites gated on tag= keyword
+# ---------------------------------------------------------------------------
+
+def test_extract_channel_push_pop_with_tag_kwarg():
+    """DeviceChannel verbs enter the graph as send/recv when (and only
+    when) the call passes an explicit ``tag=`` keyword."""
+    sites = sites_of("""
+        def hop(ring, arr, step):
+            ring.push(arr, tag=f"dagch:e{step}:1:0")
+            return ring.pop(tag=f"dagch:e{step}:1:0", timeout=5.0)
+    """, "dag/mod.py")
+    kinds = {(s.kind, s.method) for s in sites}
+    assert ("send", "push") in kinds
+    assert ("recv", "pop") in kinds
+    push = next(s for s in sites if s.method == "push")
+    pop = next(s for s in sites if s.method == "pop")
+    assert render_skeleton(push.tag) == "dagch:e{}:1:0"
+    assert skeletons_unify(push.tag, pop.tag)
+    # The peer is baked into the channel object, invisible at the site.
+    assert push.peer == ""
+
+
+def test_extract_bare_pop_push_are_not_channel_verbs():
+    """Container .pop()/.push() without a tag keyword never enter the
+    graph — dict.pop/list.pop in scanned paths must not alias channels."""
+    sites = sites_of("""
+        def cleanup(self, name, ring, arr):
+            self._groups.pop(name, None)
+            ring.pop(0)
+            ring.push(arr, "positional-not-a-tag")
+    """, "dag/mod.py")
+    assert sites == []
+
+
+def test_dag_push_with_no_unifying_pop_is_a_dead_channel():
+    """A DAG wire whose pop side was renamed/dropped shows up as a dead
+    channel (send with zero recvs) — the drift the verifier exists for."""
+    push_only = sites_of("""
+        def wire(ring, arr, e):
+            ring.push(arr, tag=f"dagch:e{e}:2:0")
+    """, "dag/a.py")
+    popped = sites_of("""
+        def other(ring):
+            return ring.pop(tag=f"stream:e{0}:2:0", timeout=1.0)
+    """, "dag/b.py")
+    graph = CommGraph(push_only + popped)
+    dead = [c for c in graph.channels() if not c.recvs]
+    assert len(dead) == 1 and dead[0].send.method == "push"
+    orphans = graph.unmatched_recvs()
+    assert len(orphans) == 1 and orphans[0].method == "pop"
