@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Run the self-healing rtdag suite (ISSUE 16).
+#
+# Tier-1 CI runs `pytest -m 'not slow'`, which already covers the
+# supervised kill-mid-stream exactly-once e2e, snapshot/restore resume,
+# unsupervised failure-path cleanup + edge-evidence errors, shm epoch
+# fencing, and the slow-wire no-false-restart chaos test. This script
+# is the nightly companion that re-runs that subset plus the PR-15
+# chaos e2e (typed death + hang doctor), re-certifies the epoch-fenced
+# DAG wires in the static comm graph, and executes the
+# dag_chaos_recovery release benchmark in smoke mode, enforcing the
+# acceptance gates (lost_outputs==0, dup_outputs==0, recoveries==1,
+# bounded recovery_latency_s, dag_controller_rpcs==0, bounded
+# supervise_overhead_pct) via release/run_all.py.
+# Usage: ci/run_dag_recovery.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== DAG recovery suite (supervisor + epoch fencing + replay) =="
+python -m pytest tests/test_dag_recovery.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== DAG chaos e2e (typed death + hang doctor) =="
+python -m pytest tests/test_dag_chaos.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== commgraph certifies epoch-fenced DAG wires =="
+python -m ray_tpu lint --comm-graph
+
+echo "== DAG chaos-recovery release benchmark (smoke, gated) =="
+python release/run_all.py --smoke --only dag_chaos_recovery
+
+echo "DAG recovery suite: PASS"

@@ -1371,6 +1371,42 @@ class Controller:
             self._mark_dirty("actors", actor.actor_id)
             await self.publish("actor_state", actor.snapshot())
 
+    async def rpc_restart_actor(self, conn, payload) -> dict:
+        """Resurrect a DEAD actor through the normal lease path — the
+        rtdag supervisor's recovery primitive. The replacement may land
+        on any node with capacity (the supervisor re-derives channel
+        families from the new placement). Idempotent twice over: the
+        mutation token absorbs re-sends, and by state — an actor already
+        PENDING/RESTARTING/ALIVE is where the caller wants it."""
+        cached = self._mutation_cached(payload)
+        if cached is not None:
+            return cached
+        actor = self.actors.get(payload["actor_id"])
+        if actor is None:
+            return self._mutation_record(payload, {"status": "missing"})
+        if actor.state != "DEAD":
+            return self._mutation_record(
+                payload, {"status": "ok", "state": actor.state}
+            )
+        actor.state = "RESTARTING"
+        actor.death_cause = None
+        actor.address = None
+        actor.worker_id = None
+        actor.ready_event.clear()
+        if actor.name:
+            # Death evicted the name; the resurrected actor reclaims it
+            # unless someone else took it in the meantime.
+            self.named_actors.setdefault(
+                (actor.spec.get("namespace", "default"), actor.name),
+                actor.actor_id,
+            )
+        self._mark_dirty("actors", actor.actor_id)
+        await self.publish("actor_state", actor.snapshot())
+        spawn_task(self._schedule_actor(actor))
+        return self._mutation_record(
+            payload, {"status": "ok", "state": "RESTARTING"}
+        )
+
     async def rpc_list_actors(self, conn, payload) -> list:
         return [a.snapshot() for a in self.actors.values()]
 

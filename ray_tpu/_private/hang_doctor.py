@@ -277,3 +277,16 @@ def build_report(
         "stacks": stacks,
         "summary": lines,
     }
+
+
+def blamed_ranks(report: dict) -> set:
+    """Every rank a merged hang report points a finger at — the union of
+    suspect and missing ranks across all diagnosed channels. The rtdag
+    supervisor records this next to its own victim ranks so post-mortems
+    can check the two diagnosis planes (controller liveness vs comm
+    evidence) named the same culprit."""
+    blamed: set = set()
+    for ch in (report or {}).get("channels") or []:
+        blamed.update(ch.get("suspect_ranks") or [])
+        blamed.update(ch.get("missing_ranks") or [])
+    return blamed

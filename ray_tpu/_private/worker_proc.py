@@ -117,7 +117,7 @@ class WorkerRuntime:
         for method in (
             "push_task", "push_actor_task", "create_actor", "exit",
             "cancel_task", "dag_register", "dag_push", "dag_pop",
-            "dag_teardown",
+            "dag_teardown", "dag_snapshot", "dag_restore",
             "profiler", "stack_trace", "engine_debug", "comm_flight",
         ):
             ctx.core_server.route(method, getattr(self, f"rpc_{method}"))
@@ -1313,8 +1313,18 @@ class WorkerRuntime:
         from ray_tpu.dag.executor import DagRuntime
 
         dag_id = payload["dag_id"]
-        if dag_id in self._dag_runtimes:
-            return {"status": "ok"}  # idempotent re-register
+        epoch = int(payload.get("epoch", 0))
+        existing = self._dag_runtimes.get(dag_id)
+        if existing is not None:
+            if int(getattr(existing, "epoch", 0)) >= epoch:
+                return {"status": "ok"}  # idempotent re-register
+            # Recovery re-register at a newer epoch: a SURVIVOR actor
+            # rebuilds its loops against the re-opened channels. The old
+            # runtime is stopped off-loop first (its threads may be
+            # blocked in channel ops against dead peers).
+            self._dag_runtimes.pop(dag_id, None)
+            stop_loop = asyncio.get_running_loop()
+            await stop_loop.run_in_executor(None, existing.stop)
         loop = asyncio.get_running_loop()
         ctx = self.ctx
 
@@ -1346,6 +1356,11 @@ class WorkerRuntime:
         if runtime is None:
             return {"status": "error",
                     "error": f"dag {payload['dag_id']} not registered"}
+        push_epoch = int(payload.get("epoch", 0))
+        if push_epoch != int(getattr(runtime, "epoch", 0)):
+            # Epoch fencing for the socket family: a pre-crash push (or
+            # a stale driver) must not feed a re-opened graph.
+            return {"status": "stale_epoch", "epoch": runtime.epoch}
         value = serialization.deserialize(payload["value"], zero_copy=False)
         try:
             runtime.feed(payload["node"], payload["slot"],
@@ -1373,6 +1388,45 @@ class WorkerRuntime:
             # stop() joins threads that may be blocked in channel ops —
             # keep the io loop free while they wind down.
             await loop.run_in_executor(None, runtime.stop)
+        return {"status": "ok"}
+
+    async def rpc_dag_snapshot(self, conn, payload) -> dict:
+        """Stateful-actor checkpoint hook: call ``__dag_snapshot__`` on
+        the actor instance (if it defines one) and return the serialized
+        blob. The driver stores blobs opaquely; ``no_hook`` lets
+        stateless stages participate in all-or-nothing snapshots for
+        free."""
+        hook = getattr(self.actor_instance, "__dag_snapshot__", None)
+        if hook is None:
+            return {"status": "no_hook"}
+        loop = asyncio.get_running_loop()
+        try:
+            # The hook runs on the actor's single-width executor (state
+            # access must serialize with stage invocations), awaited
+            # off-loop so a slow snapshot can't wedge the io loop.
+            fut = self.executor.submit(hook)
+            state_obj = await loop.run_in_executor(None, fut.result)
+            blob, _ = serialization.serialize(state_obj)
+        except Exception:
+            return {"status": "error", "error": traceback.format_exc()}
+        return {"status": "ok", "blob": blob}
+
+    async def rpc_dag_restore(self, conn, payload) -> dict:
+        """Inverse of dag_snapshot: hand the committed blob back to
+        ``__dag_restore__`` — survivors roll back and replacements catch
+        up to the same consistent cut before replay starts."""
+        hook = getattr(self.actor_instance, "__dag_restore__", None)
+        if hook is None:
+            return {"status": "no_hook"}
+        loop = asyncio.get_running_loop()
+        try:
+            state_obj = serialization.deserialize(
+                payload["blob"], zero_copy=False
+            )
+            fut = self.executor.submit(hook, state_obj)
+            await loop.run_in_executor(None, fut.result)
+        except Exception:
+            return {"status": "error", "error": traceback.format_exc()}
         return {"status": "ok"}
 
     async def rpc_cancel_task(self, conn, payload) -> dict:

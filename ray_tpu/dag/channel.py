@@ -86,29 +86,62 @@ def _consume_view(store, name: str, view):
 # -- seq-framed slots (rtdag polling channels) ---------------------------
 # The resident executor loops (dag/executor.py) consume slots by POLLING
 # (non-blocking store.get) instead of a notify RPC, so each slot carries
-# its sequence number in an 8-byte header: a consumer that wakes up on a
-# slot can verify it holds the seq it expects rather than a stale or
-# wrapped-around write.
+# a (channel epoch, sequence number) header: a consumer that wakes up on
+# a slot can verify it holds the seq it expects rather than a stale or
+# wrapped-around write, and a frame written before a crash-recovery
+# epoch bump is DISCARDED (freeing the slot for the replaying producer)
+# instead of desequencing the re-opened ring.
 
-SEQ_HEADER = struct.Struct("<Q")
+SEQ_HEADER = struct.Struct("<QQ")  # (epoch, seq)
 
 # Distinguishes "slot not written yet" from any legitimate payload value
 # (None included) on the non-blocking read path.
 NOT_READY = object()
 
+# Loud evidence that epoch fencing fired: every discarded pre-crash
+# frame bumps this counter (scraped by tests and the recovery
+# benchmark) and emits a ``stale_frame`` note into the comm flight ring.
+_stale_frames = 0
 
-def try_write_seq(store, name: str, seq: int, parts, total: int) -> bool:
+
+def stale_frame_count() -> int:
+    return _stale_frames
+
+
+def _note_stale_frame(name: str, got_epoch: int, epoch: int,
+                      seq: int) -> None:
+    global _stale_frames
+    _stale_frames += 1
+    try:
+        from ray_tpu.util.collective import flight
+
+        with flight.site("dag"):
+            # Evidence rides the tag (frame epoch vs channel epoch) and
+            # the seq field — flight records have a fixed shape.
+            flight.note(
+                "dag", "stale_frame",
+                tag=f"{name}:e{got_epoch}<{epoch}", seq=seq,
+            )
+    except Exception:  # rtlint: disable=swallowed-exception - fencing must work without a flight ring (unit tests)
+        pass
+
+
+def try_write_seq(store, name: str, seq: int, parts, total: int,
+                  epoch: int = 0) -> bool:
     """One seq-framed write attempt; False while the ring slot is still
     occupied by an unconsumed earlier seq."""
     return try_write(
-        store, name, [SEQ_HEADER.pack(seq), *parts], total + SEQ_HEADER.size
+        store, name, [SEQ_HEADER.pack(epoch, seq), *parts],
+        total + SEQ_HEADER.size,
     )
 
 
-def read_seq_consume(store, name: str, seq: int):
-    """Non-blocking seq-framed read. Returns NOT_READY when the slot is
-    absent or still holds an older seq; otherwise consumes the slot and
-    returns its value (zero-copy above the threshold, like
+def read_seq_consume(store, name: str, seq: int, epoch: int = 0):
+    """Non-blocking epoch+seq-framed read. Returns NOT_READY when the
+    slot is absent, still holds an older seq, or holds a stale-epoch
+    frame (which is consumed and discarded loudly — the slot frees so
+    the post-recovery producer can claim it); otherwise consumes the
+    slot and returns its value (zero-copy above the threshold, like
     read_consume)."""
     view = store.get(name, timeout_ms=0)
     if view is None:
@@ -116,7 +149,20 @@ def read_seq_consume(store, name: str, seq: int):
     if view.nbytes < SEQ_HEADER.size:
         _free_slot(store, name)
         raise RuntimeError(f"channel slot {name}: truncated seq header")
-    (got,) = SEQ_HEADER.unpack(view[: SEQ_HEADER.size])
+    got_epoch, got = SEQ_HEADER.unpack(view[: SEQ_HEADER.size])
+    if got_epoch != epoch:
+        if got_epoch < epoch:
+            # Pre-crash frame surviving into a re-opened channel: fence
+            # it out — free the slot (unblocking the replaying producer)
+            # and count the discard instead of raising a seq desync.
+            _free_slot(store, name)
+            _note_stale_frame(name, got_epoch, epoch, seq)
+            return NOT_READY
+        _free_slot(store, name)
+        raise RuntimeError(
+            f"channel slot {name}: frame epoch {got_epoch} is ahead of "
+            f"this consumer's epoch {epoch} (reader missed a recovery)"
+        )
     if got != seq:
         # Unreachable under strict in-order consumption — surface loudly
         # rather than polling a wedged slot forever.

@@ -22,10 +22,11 @@ compile-time placement plan (dag/placement.py):
                       edges.
 
 Device-edge tags follow the rtgraph skeleton convention
-(``dagch:e{src}:{dst}:{slot}`` with all-integer holes), so the static
-commgraph extractor certifies DAG wires like any other channel and the
-hang doctor's static reconciliation unifies runtime records with these
-call sites.
+(``dagch:p{epoch}:e{src}:{dst}:{slot}`` with all-integer holes — the
+channel epoch fences pre-crash frames out of re-opened edges), so the
+static commgraph extractor certifies DAG wires like any other channel
+and the hang doctor's static reconciliation unifies runtime records
+with these call sites.
 """
 
 from __future__ import annotations
@@ -34,7 +35,7 @@ import time
 
 import numpy as np
 
-from ray_tpu._private import serialization
+from ray_tpu._private import chaos, serialization
 from ray_tpu.dag import channel as shm
 from ray_tpu.util.collective import flight
 
@@ -55,12 +56,13 @@ class ShmChannel:
     store-client lock uncontended) with idle backoff."""
 
     def __init__(self, store, base: str, depth: int, *, group: str = "dag",
-                 site: str = "dag"):
+                 site: str = "dag", epoch: int = 0):
         self._store = store
         self.base = base
         self.depth = depth
         self._group = group
         self._site = site
+        self.epoch = epoch
 
     def push(self, seq: int, value, timeout: float = 120.0, stop=None) -> None:
         parts, total, _ = serialization.serialize_parts(value)
@@ -70,7 +72,9 @@ class ShmChannel:
                    timeout: float = 120.0, stop=None) -> None:
         name = shm.slot_name(self.base, seq, self.depth)
         deadline = time.monotonic() + timeout
-        while not shm.try_write_seq(self._store, name, seq, parts, total):
+        while not shm.try_write_seq(
+            self._store, name, seq, parts, total, epoch=self.epoch
+        ):
             if stop is not None and stop():
                 raise ChannelClosedError(f"{self.base}: channel closed")
             if time.monotonic() > deadline:
@@ -87,7 +91,9 @@ class ShmChannel:
         started = time.monotonic()
         delay = 0.002
         while True:
-            value = shm.read_seq_consume(self._store, name, seq)
+            value = shm.read_seq_consume(
+                self._store, name, seq, epoch=self.epoch
+            )
             if value is not shm.NOT_READY:
                 with flight.site(self._site):
                     flight.note(self._group, "chan_pop", tag=self.base)
@@ -119,7 +125,7 @@ class DeviceChannel:
 
     * edge mode (``push_edge``/``pop_edge``) — the rtdag executor's fixed
       (src, dst, slot) identity; the wire tag is the certified skeleton
-      ``dagch:e{src}:{dst}:{slot}``.
+      ``dagch:p{epoch}:e{src}:{dst}:{slot}``.
     * tagged mode (``push``/``pop`` with a keyword-only ``tag``) — the
       pipeline stage runner's per-(step, microbatch, virtual-stage) tags;
       the caller's f-string IS the certified site.
@@ -131,7 +137,8 @@ class DeviceChannel:
     """
 
     def __init__(self, group, peer: int, *, src: int = 0, dst: int = 0,
-                 slot: int = 0, site: str = "dag", wire_cfg=None, ef=None):
+                 slot: int = 0, site: str = "dag", wire_cfg=None, ef=None,
+                 epoch: int = 0):
         self._group = group
         self._peer = peer
         self._src = src
@@ -140,6 +147,7 @@ class DeviceChannel:
         self._site = site
         self._wire_cfg = wire_cfg
         self._ef = ef
+        self.epoch = epoch
 
     # -- tagged mode (pipeline wire) ------------------------------------
     def push(self, value, *, tag: str, ef_site=None) -> None:
@@ -155,19 +163,30 @@ class DeviceChannel:
         return self._decode(out)
 
     # -- edge mode (rtdag wire) -----------------------------------------
+    # The channel epoch rides the tag itself (``p{epoch}``): a frame sent
+    # before a crash-recovery epoch bump lands in a mailbox no
+    # post-recovery pop ever reads, so stale device frames are fenced by
+    # construction. All holes are integers, so the commgraph extractor
+    # still folds every DAG wire to one certified skeleton.
     def push_edge(self, value) -> None:
         payload = self._encode(value, (self._src, self._dst, self._slot))
         with flight.site(self._site):
             self._group.send(
                 payload, self._peer,
-                tag=f"dagch:e{self._src}:{self._dst}:{self._slot}",
+                tag=f"dagch:p{self.epoch}:e{self._src}:{self._dst}:{self._slot}",
             )
 
     def pop_edge(self, *, timeout: float = 60.0, like=None):
+        # Chaos latency point: a windowed schedule makes the whole device
+        # wire slow-but-alive, which is exactly what the supervisor's
+        # false-positive tests need to distinguish from death.
+        extra = chaos.latency_delay("dag.device.pop")
+        if extra > 0:
+            time.sleep(extra)
         with flight.site(self._site):
             out = self._group.recv(
                 self._peer,
-                tag=f"dagch:e{self._src}:{self._dst}:{self._slot}",
+                tag=f"dagch:p{self.epoch}:e{self._src}:{self._dst}:{self._slot}",
                 timeout=timeout, like=like,
             )
         return self._decode(out)

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import threading
 import time
 import uuid
 import weakref
@@ -81,10 +82,12 @@ class DAGNode:
         return self
 
     def experimental_compile(
-        self, channel: str | None = None, quantize_wire: str | None = None
+        self, channel: str | None = None, quantize_wire: str | None = None,
+        supervise: bool = False, max_recoveries: int = 3,
     ) -> "CompiledDAG":
         return CompiledDAG(
-            self, channel=channel, quantize_wire=quantize_wire
+            self, channel=channel, quantize_wire=quantize_wire,
+            supervise=supervise, max_recoveries=max_recoveries,
         )
 
     def _upstream(self) -> list["DAGNode"]:
@@ -197,10 +200,23 @@ class DAGRef:
         return self._dag._pop(self._seq, timeout)
 
 
+# Supervised driver pops run in short slices so the supervisor can probe
+# actor liveness while blocked (unsupervised pops stay full-timeout — the
+# blocked record is what feeds the comm watchdog's stall detection).
+_DRIVER_POP_SLICE_S = 0.5
+
+
 class _OutReader:
     """Driver-side in-order consumer of ONE output edge. Channel seqs
     are strictly ordered, so an out-of-order get() buffers the earlier
-    seqs it drains on the way."""
+    seqs it drains on the way.
+
+    Recovery support: ``_next`` is the CHANNEL cursor (next seq to pop
+    off the wire); ``_discard_below`` is the replay-dedup frontier. After
+    a crash recovery the supervisor refits this reader onto the
+    re-opened epoch and rewinds the channel cursor to the replay base —
+    replayed frames below the old cursor are popped and dropped, so the
+    caller never sees a duplicate."""
 
     def __init__(self, dag: "CompiledDAG", actor_id: str, out: dict,
                  chan):
@@ -209,22 +225,59 @@ class _OutReader:
         self._out = out
         self._chan = chan
         self._next = 0
+        self._discard_below = 0
         self._ready: dict[int, Any] = {}
+
+    def refit(self, out: dict, chan, start_seq: int) -> None:
+        """Point this reader at the post-recovery channel (new epoch,
+        possibly a new family if the replacement actor moved nodes) and
+        rewind the channel cursor to the replay base; everything already
+        drained stays deduplicated via ``_discard_below``."""
+        self._out = out
+        self._chan = chan
+        self._discard_below = max(self._discard_below, self._next)
+        self._next = start_seq
 
     def read(self, seq: int, deadline: float) -> Any:
         if self._out["family"] == "socket":
             return self._socket_pop(seq, deadline)
         while seq not in self._ready:
+            self.drain_one(deadline)
+        return self._ready.pop(seq)
+
+    def drain_one(self, deadline: float) -> None:
+        """Pop the next channel seq into the ready buffer (or discard it
+        as a replay duplicate). Supervised DAGs pop in short slices,
+        probing liveness between slices; unsupervised DAGs block the
+        full remaining timeout (the watchdog-visible stall)."""
+        sliced = self._dag._supervise
+        while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                raise TimeoutError(f"dag output seq={seq} not ready")
-            if self._out["family"] == "shm":
-                value = self._chan.pop(self._next, timeout=remaining)
-            else:
-                value = self._chan.pop_edge(timeout=remaining)
+                raise TimeoutError(
+                    f"dag output seq={self._next} not ready"
+                )
+            slice_s = (
+                min(remaining, _DRIVER_POP_SLICE_S) if sliced else remaining
+            )
+            try:
+                if self._out["family"] == "shm":
+                    value = self._chan.pop(self._next, timeout=slice_s)
+                else:
+                    value = self._chan.pop_edge(timeout=slice_s)
+                break
+            except (TimeoutError, asyncio.TimeoutError):
+                if not sliced or slice_s >= remaining:
+                    raise
+                # Slow slice, time still left: probe (raises a typed
+                # death error if an actor is gone; a slow-but-alive
+                # graph just keeps waiting — no false-positive restart).
+                self._dag._maybe_probe(self._out, self._next)
+        if self._next >= self._discard_below:
             self._ready[self._next] = value
-            self._next += 1
-        return self._ready.pop(seq)
+        else:
+            self._dag.replay_discards += 1
+        self._next += 1
 
     def _socket_pop(self, seq: int, deadline: float) -> Any:
         remaining = max(0.1, deadline - time.monotonic())
@@ -252,8 +305,14 @@ class CompiledDAG:
 
     CHANNEL_DEPTH = 8  # ring slots per edge = max pipelined seqs in flight
 
+    # Supervised liveness probing: how long a blocked driver pop waits
+    # between probes when nothing flagged a stall (the flight watchdog's
+    # stall listener short-circuits this).
+    PROBE_INTERVAL_S = 2.0
+
     def __init__(self, output_node: DAGNode, *, channel: str | None = None,
-                 quantize_wire: str | None = None):
+                 quantize_wire: str | None = None, supervise: bool = False,
+                 max_recoveries: int = 3):
         if isinstance(output_node, InputNode):
             raise ValueError("cannot compile a bare InputNode")
         if channel not in _CHANNEL_FAMILIES:
@@ -271,7 +330,7 @@ class CompiledDAG:
             else [output_node]
         )
         self._multi_output = isinstance(output_node, MultiOutputNode)
-        self._seq = itertools.count()
+        self._submitted = 0  # next execute() seq (replaces a bare count())
         self._ctx = worker_mod.get_global_context()
         self._stages: dict[int, dict] = {}  # node_id → stage spec
         self._input_targets: list[dict] = []
@@ -279,9 +338,37 @@ class CompiledDAG:
         self._out_channel = None  # first output channel (back-compat)
         self._all_shm_bases: list[str] = []
         self._group = None
+        self._group_name: str | None = None
         self._torn_down = False
         self._inflight: set[int] = set()
+        # -- self-healing state (costs nothing until a failure) ----------
+        self._supervise = bool(supervise)
+        self._max_recoveries = int(max_recoveries)
+        self._epoch = 0
+        self.recoveries = 0
+        self.replay_discards = 0
+        self.last_recovery: dict | None = None
+        # Driver retains each in-flight input until its out-edge results
+        # complete (or, with snapshot hooks, until the next committed
+        # snapshot) so a recovery can replay from the per-edge cursors.
+        self._retained: dict[int, Any] = {}
+        self._snapshots: dict[str, Any] | None = None
+        self._snapshot_base: int | None = None
+        self._stall_event = threading.Event()
+        self._last_probe_ts = 0.0
+        self._stall_cb = None
         self._compile()
+        if self._supervise:
+            from ray_tpu.util.collective import flight
+
+            # Hang-doctor → supervisor wiring: a watchdog stall on any of
+            # this DAG's channels (any epoch) wakes the blocked reader
+            # into an immediate liveness probe instead of waiting out the
+            # probe interval. The callback closes over the event, not the
+            # DAG, so the listener registry never pins a dropped graph.
+            evt = self._stall_event
+            self._stall_cb = lambda event: evt.set()
+            flight.register_stall_listener(self.dag_id, self._stall_cb)
         _LIVE_DAGS[self.dag_id] = self
 
     # -- graph lowering --------------------------------------------------
@@ -302,19 +389,52 @@ class CompiledDAG:
         )
         if not method_nodes:
             raise ValueError("DAG has no actor method nodes")
-        # Stage skeletons: slots for DAG-node args; constants stay the
-        # reference restriction (close over them in the actor).
+        self._method_nodes = method_nodes
         for node in method_nodes:
-            slots = []
-            for i, arg in enumerate(node.args):
-                if isinstance(arg, DAGNode):
-                    slots.append(f"a{i}")
-                else:
+            for arg in node.args:
+                if not isinstance(arg, DAGNode):
                     raise ValueError(
                         "compiled DAG args must be upstream nodes or the "
                         "InputNode (got a constant; close over it in the "
                         "actor instead)"
                     )
+        # Stable out-edge dst ids: allocated once so device tags stay
+        # identical across recovery re-lowers.
+        self._out_dst_ids = [next(_node_counter) for _ in self._out_nodes]
+        # Explicit compile-time placement (no swallowed probe): pins each
+        # actor's node, assigns device-plane ranks, raises on failure.
+        ordered_actors: list[str] = []
+        for node in method_nodes:
+            aid = node.actor._actor_id
+            if aid not in ordered_actors:
+                ordered_actors.append(aid)
+        self._actor_ids = ordered_actors
+        plan = placement.PlacementPlan.resolve(self._ctx, ordered_actors)
+        self._plan = plan
+        self._lower(plan)
+        self._register(
+            plan, need_group="device" in self._families, epoch=0,
+            start_seq=0,
+        )
+        self._open_driver_channels(plan, start_seq=0)
+
+    def _lower(self, plan: placement.PlacementPlan) -> None:
+        """Lower the graph onto a placement plan: stage specs, edge
+        families, channel names. Pure function of (graph, plan) — re-run
+        during recovery because a restarted actor may land on a new node
+        and change edge families."""
+        method_nodes = self._method_nodes
+        self._stages = {}
+        self._input_targets = []
+        self._all_shm_bases = []
+        self._out_channel = None
+        # Stage skeletons: slots for DAG-node args; constants stay the
+        # reference restriction (close over them in the actor).
+        for node in method_nodes:
+            slots = [
+                f"a{i}" for i, arg in enumerate(node.args)
+                if isinstance(arg, DAGNode)
+            ]
             self._stages[node.node_id] = {
                 "node": node.node_id,
                 "actor_id": node.actor._actor_id,
@@ -326,16 +446,6 @@ class CompiledDAG:
                 "is_output": False,
                 "depth": self.CHANNEL_DEPTH,
             }
-        # Explicit compile-time placement (no swallowed probe): pins each
-        # actor's node, assigns device-plane ranks, raises on failure.
-        ordered_actors: list[str] = []
-        for node in method_nodes:
-            aid = node.actor._actor_id
-            if aid not in ordered_actors:
-                ordered_actors.append(aid)
-        self._actor_ids = ordered_actors
-        plan = placement.PlacementPlan.resolve(self._ctx, ordered_actors)
-        self._plan = plan
         families: set[str] = set()
 
         # -- wire edges --------------------------------------------------
@@ -368,7 +478,8 @@ class CompiledDAG:
                     elif fam == "device":
                         edge["peer_rank"] = 0
                         target["channel"] = (
-                            f"dagch:e{arg.node_id}:{node.node_id}:{i}"
+                            f"dagch:p{self._epoch}:e{arg.node_id}:"
+                            f"{node.node_id}:{i}"
                         )
                     stage["in_edges"].append(edge)
                     self._input_targets.append(target)
@@ -415,7 +526,7 @@ class CompiledDAG:
             families.add(fam)
             out = {
                 "family": fam, "src": out_node.node_id,
-                "dst": next(_node_counter), "slot_id": 0,
+                "dst": self._out_dst_ids[k], "slot_id": 0,
             }
             if fam == "shm":
                 out["channel"] = f"dagch-{self.dag_id}-out-{k}"
@@ -426,7 +537,7 @@ class CompiledDAG:
             out_specs.append((aid, out))
             if self._out_channel is None:
                 self._out_channel = out.get("channel") or (
-                    f"dagch:e{out['src']}:{out['dst']}:0"
+                    f"dagch:p{self._epoch}:e{out['src']}:{out['dst']}:0"
                     if fam == "device" else None
                 )
         if (
@@ -437,35 +548,46 @@ class CompiledDAG:
                 "the socket fallback supports a single output edge; use "
                 "shm or device channels for MultiOutputNode graphs"
             )
-        self._register(plan, need_group="device" in families)
-        # -- driver-side channel objects ---------------------------------
+        self._out_specs = out_specs
+        self._families = families
+
+    def _open_driver_channels(self, plan: placement.PlacementPlan,
+                              start_seq: int) -> None:
+        """Build (or on recovery, re-build) the driver's ends of every
+        input and output edge at the current epoch. Existing readers are
+        refitted in place so their delivery state (buffered seqs, dedup
+        frontier) survives the epoch bump."""
         wire_cfg, ef = self._make_wire_codec()
         store = self._ctx.store
         for t in self._input_targets:
             if t["family"] == "shm":
                 t["chan"] = ShmChannel(
                     store, t["channel"], self.CHANNEL_DEPTH,
-                    group=self.dag_id,
+                    group=self.dag_id, epoch=self._epoch,
                 )
             elif t["family"] == "device":
                 t["chan"] = DeviceChannel(
                     self._group, plan.rank_of(t["actor_id"]),
                     src=t["src"], dst=t["dst"], slot=t["slot_id"],
-                    wire_cfg=wire_cfg, ef=ef,
+                    wire_cfg=wire_cfg, ef=ef, epoch=self._epoch,
                 )
-        for aid, out in out_specs:
+        refit = bool(self._out_readers)
+        for i, (aid, out) in enumerate(self._out_specs):
             chan = None
             if out["family"] == "shm":
                 chan = ShmChannel(
                     store, out["channel"], self.CHANNEL_DEPTH,
-                    group=self.dag_id,
+                    group=self.dag_id, epoch=self._epoch,
                 )
             elif out["family"] == "device":
                 chan = DeviceChannel(
                     self._group, plan.rank_of(aid), src=out["src"],
-                    dst=out["dst"], slot=out["slot_id"],
+                    dst=out["dst"], slot=out["slot_id"], epoch=self._epoch,
                 )
-            self._out_readers.append(_OutReader(self, aid, out, chan))
+            if refit:
+                self._out_readers[i].refit(out, chan, start_seq)
+            else:
+                self._out_readers.append(_OutReader(self, aid, out, chan))
 
     def _make_wire_codec(self):
         if not self._quantize_wire:
@@ -478,14 +600,27 @@ class CompiledDAG:
         cfg = CollectiveConfig(quantize_activations=self._quantize_wire)
         return cfg.activation_wire_config(), ErrorFeedback()
 
-    def _register(self, plan: placement.PlacementPlan,
-                  need_group: bool) -> None:
+    def _group_name_for(self, epoch: int) -> str:
+        """Per-epoch collective group name. Epoch 0 keeps the bare
+        dag_id (steady-state tags and tests unchanged); recovery epochs
+        get a fresh rendezvous namespace so a half-dead old group can
+        never collide with the re-opened one. All epochs share the
+        dag_id prefix, so the DAG's stall listener covers every epoch."""
+        return self.dag_id if epoch == 0 else f"{self.dag_id}:p{epoch}"
+
+    def _register(self, plan: placement.PlacementPlan, need_group: bool,
+                  epoch: int, start_seq: int) -> None:
         """Register stage bundles on every participating worker; when
         device edges exist, rendezvous the per-DAG collective group (the
         driver is rank 0). The register RPCs are issued CONCURRENTLY
         with the driver's own group init — each worker's handler blocks
         in the group rendezvous until all ranks (driver included) have
-        registered, so awaiting acks first would deadlock."""
+        registered, so awaiting acks first would deadlock.
+
+        On recovery re-registration the bundles carry the bumped channel
+        epoch and the replay base: every stage loop restarts its seq
+        counter at ``start_seq`` and stamps ``epoch`` into its frames."""
+        group_name = self._group_name_for(epoch)
         by_actor: dict[str, list] = {}
         for stage in self._stages.values():
             by_actor.setdefault(stage["actor_id"], []).append(stage)
@@ -499,9 +634,11 @@ class CompiledDAG:
                     "stages": by_actor[aid],
                     "depth": self.CHANNEL_DEPTH,
                     "wire_quant": self._quantize_wire,
+                    "epoch": epoch,
+                    "start_seq": start_seq,
                     "group": (
                         {
-                            "name": self.dag_id,
+                            "name": group_name,
                             "world_size": plan.world_size,
                             "rank": plan.rank_of(aid),
                         }
@@ -523,9 +660,10 @@ class CompiledDAG:
         fut = asyncio.run_coroutine_threadsafe(_register_all(), ctx.io.loop)
         try:
             collective.init_collective_group(
-                plan.world_size, 0, backend="ring", group_name=self.dag_id
+                plan.world_size, 0, backend="ring", group_name=group_name
             )
-            self._group = collective.get_group(self.dag_id)
+            self._group = collective.get_group(group_name)
+            self._group_name = group_name
             fut.result(timeout=180)
         except Exception:
             fut.cancel()
@@ -617,8 +755,20 @@ class CompiledDAG:
                 f"in flight (max {self.CHANNEL_DEPTH}); get() earlier "
                 "results before submitting more"
             )
-        seq = next(self._seq)
+        seq = self._submitted
+        self._submitted += 1
         self._inflight.add(seq)
+        if self._supervise:
+            # Retain the input until its results complete (or the next
+            # committed snapshot supersedes it): the retained dict IS the
+            # replay log a recovery re-feeds from.
+            self._retained[seq] = value
+        self._push_input(seq, value)
+        return DAGRef(self, seq)
+
+    def _push_input(self, seq: int, value: Any) -> None:
+        """Push one input seq into every input edge (shared by execute()
+        and the supervisor's replay pump)."""
         parts = total = raw = None
         for target in self._input_targets:
             fam = target["family"]
@@ -633,30 +783,97 @@ class CompiledDAG:
                     raw = serialization.join_parts(
                         serialization.serialize_parts(value)[0]
                     )
-                self._call_actor(target["actor_id"], "dag_push", {
+                resp = self._call_actor(target["actor_id"], "dag_push", {
                     "dag_id": self.dag_id, "node": target["node"],
                     "seq": seq, "slot": target["slot"], "value": raw,
+                    "epoch": self._epoch,
                 })
-        return DAGRef(self, seq)
+                if (resp or {}).get("status") == "stale_epoch":
+                    raise RuntimeError(
+                        f"{self.dag_id}: dag_push rejected — worker is at "
+                        f"a newer epoch than this driver (epoch "
+                        f"{self._epoch})"
+                    )
 
     def _pop(self, seq: int, timeout: float) -> Any:
         self._inflight.discard(seq)
         deadline = time.monotonic() + timeout
         values = []
-        for reader in self._out_readers:
-            try:
-                values.append(reader.read(seq, deadline))
-            except (TimeoutError, asyncio.TimeoutError):
-                self._raise_pop_timeout(seq, timeout)
+        for i in range(len(self._out_readers)):
+            while True:
+                try:
+                    values.append(
+                        self._out_readers[i].read(seq, deadline)
+                    )
+                    break
+                except exceptions.DAGActorDiedError as err:
+                    self._handle_death(err)
+                    # Recovered: fresh budget for the replayed stream.
+                    deadline = time.monotonic() + timeout
+                except (TimeoutError, asyncio.TimeoutError):
+                    err = self._probe_death(
+                        seq, self._out_readers[i]._out
+                    )
+                    if err is None:
+                        raise TimeoutError(
+                            f"dag output seq={seq} not ready in {timeout}s"
+                        ) from None
+                    self._handle_death(err)
+                    deadline = time.monotonic() + timeout
+        self._retire(seq)
         errors = [v for v in values if isinstance(v, exceptions.TaskError)]
         if errors:
             raise errors[0]
         return values if self._multi_output else values[0]
 
-    def _raise_pop_timeout(self, seq: int, timeout: float) -> None:
-        """A pop timeout on a static graph means either a dead stage or a
-        genuinely slow one — probe actor liveness so the caller gets a
-        typed death error instead of a bare timeout."""
+    def _retire(self, seq: int) -> None:
+        """Drop retained inputs no recovery could ever need to replay:
+        everything below the slowest reader's channel cursor has been
+        fully consumed (with snapshot hooks, the snapshot commit is the
+        floor instead — replay restarts from the committed state)."""
+        if not self._retained:
+            return
+        floor = min(r._next for r in self._out_readers)
+        if self._snapshot_base is not None:
+            floor = min(floor, self._snapshot_base)
+        for s in [s for s in self._retained if s < floor]:
+            del self._retained[s]
+
+    # -- supervised liveness probing -------------------------------------
+    def _maybe_probe(self, out: dict, frontier: int) -> None:
+        """Called by a blocked supervised reader between pop slices:
+        probe actor liveness when the watchdog flagged a stall on this
+        DAG's channels, or the probe interval elapsed. Raises a typed
+        DAGActorDiedError (caught by _pop's recovery loop) when an actor
+        is DEAD; a slow-but-alive graph just keeps waiting."""
+        now = time.monotonic()
+        stalled = self._stall_event.is_set()
+        if not stalled and now - self._last_probe_ts < self.PROBE_INTERVAL_S:
+            return
+        self._last_probe_ts = now
+        self._stall_event.clear()
+        err = self._probe_death(frontier, out)
+        if err is not None:
+            raise err
+
+    def _probe_death(self, frontier: int,
+                     out: dict | None = None) -> "exceptions.DAGActorDiedError | None":
+        """Probe every DAG actor's controller state; a DEAD one becomes a
+        typed death error carrying the edge evidence (channel name,
+        family, epoch, seq frontier) the supervisor and the hang report
+        line up on. Returns None when everyone is alive."""
+        fam = out.get("family") if out else None
+        channel = None
+        if out is not None:
+            if fam == "shm":
+                channel = out.get("channel")
+            elif fam == "device":
+                channel = (
+                    f"dagch:p{self._epoch}:e{out['src']}:{out['dst']}:"
+                    f"{out['slot_id']}"
+                )
+            else:
+                channel = "dag_pop"
         for aid in self._actor_ids:
             try:
                 info = self._ctx.io.run(
@@ -665,16 +882,96 @@ class CompiledDAG:
                     ),
                     timeout=15,
                 )
-            except Exception:  # rtlint: disable=swallowed-exception - controller unreachable: fall through to the plain timeout
+            except Exception:  # rtlint: disable=swallowed-exception - controller unreachable: treat as alive, keep waiting
                 continue
             if (info or {}).get("state") == "DEAD":
-                raise exceptions.DAGActorDiedError(
+                return exceptions.DAGActorDiedError(
                     self.dag_id, aid, self._plan.rank_of(aid),
                     detail=str((info or {}).get("death_cause") or ""),
+                    channel=channel, family=fam, epoch=self._epoch,
+                    seq=frontier,
                 )
-        raise TimeoutError(
-            f"dag output seq={seq} not ready in {timeout}s"
-        )
+        return None
+
+    def _handle_death(self, err: "exceptions.DAGActorDiedError") -> None:
+        """An actor died with executions in flight: recover in place
+        (supervised, budget left) or tear the graph down and re-raise —
+        a failed execute() must not strand ring slots or parked loops."""
+        if not self._supervise or self.recoveries >= self._max_recoveries:
+            self._fail_cleanup()
+            raise err
+        from ray_tpu.dag import supervisor
+
+        try:
+            supervisor.recover(self, err)
+        except Exception:
+            self._fail_cleanup()
+            raise
+        self.recoveries += 1
+
+    def _fail_cleanup(self) -> None:
+        """Failure-path teardown: release every ring slot, stop every
+        resident loop, drop retained inputs. The graph is unusable after
+        this — close() becomes a no-op."""
+        if self._torn_down:
+            return
+        self._torn_down = True
+        _LIVE_DAGS.pop(self.dag_id, None)
+        self._unregister_stall_listener()
+        self._inflight.clear()
+        self._retained.clear()
+        try:
+            self._ctx.io.run(self._teardown_async(), timeout=15)
+        except Exception:  # rtlint: disable=swallowed-exception - dead workers can't ack teardown; driver-side slot frees already ran
+            pass
+        self._destroy_group(sync=True)
+
+    # -- snapshot hooks ---------------------------------------------------
+    def snapshot(self, timeout: float = 60.0) -> int:
+        """Commit a stateful checkpoint: calls ``__dag_snapshot__`` on
+        every actor that defines it and retains the blobs driver-side.
+        All-or-nothing — on any failure the previous committed snapshot
+        (if any) stays in force. Requires a quiescent graph (no in-flight
+        executions), so the snapshot corresponds to an exact seq
+        frontier: on recovery, hooked actors are restored to this commit
+        and the driver replays every retained input from it. Returns the
+        snapshot base seq (the next seq to execute after restore)."""
+        if self._torn_down:
+            raise RuntimeError(f"{self.dag_id} is torn down")
+        if self._inflight:
+            raise RuntimeError(
+                f"{self.dag_id}: snapshot() requires a quiescent graph "
+                f"({len(self._inflight)} executions in flight — get() "
+                "them first)"
+            )
+        blobs: dict[str, Any] = {}
+        for aid in self._actor_ids:
+            resp = self._call_actor(
+                aid, "dag_snapshot", {"dag_id": self.dag_id},
+                timeout=timeout,
+            )
+            status = (resp or {}).get("status")
+            if status == "no_hook":
+                continue
+            if status != "ok":
+                raise RuntimeError(
+                    f"dag_snapshot failed on actor {aid}: {resp!r}"
+                )
+            blobs[aid] = resp["blob"]
+        self._snapshots = blobs
+        self._snapshot_base = self._submitted
+        # Inputs before the commit can never be replayed again.
+        for s in [s for s in self._retained if s < self._snapshot_base]:
+            del self._retained[s]
+        return self._snapshot_base
+
+    def _unregister_stall_listener(self) -> None:
+        if self._stall_cb is None:
+            return
+        from ray_tpu.util.collective import flight
+
+        flight.unregister_stall_listener(self._stall_cb)
+        self._stall_cb = None
 
     # -- teardown ---------------------------------------------------------
     def close(self, timeout: float = 30.0) -> None:
@@ -684,6 +981,8 @@ class CompiledDAG:
             return
         self._torn_down = True
         _LIVE_DAGS.pop(self.dag_id, None)
+        self._unregister_stall_listener()
+        self._retained.clear()
         # Drain admitted-but-unpopped seqs so no worker loop is wedged
         # mid-push when the teardown RPC lands.
         for seq in sorted(self._inflight):
@@ -715,13 +1014,14 @@ class CompiledDAG:
             # forget — worker-side teardown is idempotent.
             self._torn_down = True
             _LIVE_DAGS.pop(self.dag_id, None)
+            self._unregister_stall_listener()
             self._spawn_teardown()
             self._destroy_group(sync=False)
         else:
             self.close()
 
     async def _teardown_async(self) -> None:
-        for actor_id in self._actor_ids:
+        async def one(actor_id: str) -> None:
             try:
                 client = await self._ctx._actor_client(actor_id)
                 await client.call(
@@ -729,6 +1029,10 @@ class CompiledDAG:
                 )
             except Exception:  # rtlint: disable=swallowed-exception - actor may be dead; teardown is idempotent
                 pass
+
+        # Concurrent: one dead actor's timeout must not serialize the
+        # survivors' teardown behind it (failure-path latency).
+        await asyncio.gather(*[one(aid) for aid in self._actor_ids])
         # Driver-side backstop: every shm ring slot of this DAG (input,
         # inter-stage, and output rings) — a dead worker must not leak
         # its consumer-owned slots, and the driver-owned output ring is
@@ -745,15 +1049,16 @@ class CompiledDAG:
             return
         from ray_tpu.util.collective import collective
 
+        name = self._group_name or self.dag_id
         if sync:
             try:
-                collective.destroy_collective_group(self.dag_id)
+                collective.destroy_collective_group(name)
             except Exception:  # rtlint: disable=swallowed-exception - rendezvous keys die with the controller; the registry entry is what must go
-                collective._groups.pop(self.dag_id, None)
+                collective._groups.pop(name, None)
         else:
             # destroy() round-trips the controller KV via the io loop we
             # may be ON: drop the registry entry only.
-            collective._groups.pop(self.dag_id, None)
+            collective._groups.pop(name, None)
         self._group = None
 
     def _spawn_teardown(self) -> None:

@@ -70,12 +70,19 @@ class StageLoop(threading.Thread):
 
     def __init__(self, *, dag_id: str, stage: dict, store, group,
                  run_stage, deliver_local, send_socket, park_output,
-                 wire_cfg=None, ef=None):
+                 wire_cfg=None, ef=None, epoch: int = 0,
+                 start_seq: int = 0):
         super().__init__(
             daemon=True, name=f"rtdag-{dag_id}-n{stage['node']}"
         )
         self.dag_id = dag_id
         self.stage = stage
+        self.epoch = epoch
+        self.start_seq = start_seq
+        # High-water seq this loop has FULLY pushed downstream — the
+        # per-stage replay cursor the supervisor reads when deciding how
+        # far back a post-recovery re-register must rewind.
+        self.completed_seq = start_seq - 1
         self._stop = threading.Event()
         self._run_stage = run_stage
         self._deliver_local = deliver_local
@@ -89,13 +96,14 @@ class StageLoop(threading.Thread):
             fam = edge["family"]
             if fam == "shm":
                 chan = ShmChannel(
-                    store, edge["channel"], depth, group=dag_id
+                    store, edge["channel"], depth, group=dag_id,
+                    epoch=epoch,
                 )
             elif fam == "device":
                 chan = DeviceChannel(
                     group, edge["peer_rank"], src=edge["src"],
                     dst=edge["dst"], slot=edge["slot_id"],
-                    wire_cfg=wire_cfg, ef=ef,
+                    wire_cfg=wire_cfg, ef=ef, epoch=epoch,
                 )
             else:  # local / socket: fed via feed()
                 chan = self._buffers.setdefault(edge["slot"], SeqBuffer())
@@ -107,25 +115,28 @@ class StageLoop(threading.Thread):
             key = (edge["node"], edge["slot"])
             if fam == "shm":
                 self._down_chans[key] = ShmChannel(
-                    store, edge["channel"], depth, group=dag_id
+                    store, edge["channel"], depth, group=dag_id,
+                    epoch=epoch,
                 )
             elif fam == "device":
                 self._down_chans[key] = DeviceChannel(
                     group, edge["peer_rank"], src=edge["src"],
                     dst=edge["dst"], slot=edge["slot_id"],
-                    wire_cfg=wire_cfg, ef=ef,
+                    wire_cfg=wire_cfg, ef=ef, epoch=epoch,
                 )
         # Output edges to the driver (a stage may back several
         # MultiOutputNode members).
         self._out_chans: list[tuple[dict, object]] = []
         for out in stage.get("outs", ()):
             if out["family"] == "shm":
-                chan = ShmChannel(store, out["channel"], depth, group=dag_id)
+                chan = ShmChannel(
+                    store, out["channel"], depth, group=dag_id, epoch=epoch
+                )
             elif out["family"] == "device":
                 chan = DeviceChannel(
                     group, out["peer_rank"], src=out["src"],
                     dst=out["dst"], slot=out["slot_id"],
-                    wire_cfg=wire_cfg, ef=ef,
+                    wire_cfg=wire_cfg, ef=ef, epoch=epoch,
                 )
             else:  # socket: parked locally, pulled via dag_pop
                 chan = None
@@ -200,7 +211,11 @@ class StageLoop(threading.Thread):
     def run(self) -> None:
         stage = self.stage
         try:
-            for seq in itertools.count():
+            # A post-recovery loop starts at the replay base, not 0: the
+            # driver re-pushes every retained seq and each stage
+            # recomputes from there (duplicated outputs are deduplicated
+            # by the driver-side readers' delivery frontier).
+            for seq in itertools.count(self.start_seq):
                 if self.stopped():
                     return
                 args = []
@@ -231,6 +246,7 @@ class StageLoop(threading.Thread):
                         chan.push(seq, result, stop=self.stopped)
                     else:
                         chan.push_edge(result)
+                self.completed_seq = seq
         except ChannelClosedError:
             return
         except Exception:
@@ -249,6 +265,7 @@ class DagRuntime:
                  notify_loop):
         self._ctx = ctx
         self.dag_id = dag_id
+        self.epoch = payload.get("epoch", 0)
         self._stages = payload["stages"]
         self._notify_loop = notify_loop
         self._results: dict[int, object] = {}
@@ -283,6 +300,8 @@ class DagRuntime:
                 run_stage=run_stage, deliver_local=self._deliver_local,
                 send_socket=self._send_socket,
                 park_output=self._park_output, wire_cfg=wire_cfg, ef=ef,
+                epoch=self.epoch,
+                start_seq=payload.get("start_seq", 0),
             )
             for stage in self._stages
         ]
@@ -309,6 +328,7 @@ class DagRuntime:
             await client.call("dag_push", {
                 "dag_id": self.dag_id, "node": edge["node"],
                 "slot": edge["slot"], "seq": seq, "value": raw,
+                "epoch": self.epoch,
             })
 
         def _log_err(f):

@@ -1,0 +1,247 @@
+"""rtdag supervisor — driver-side crash recovery for compiled graphs.
+
+A supervised CompiledDAG (``experimental_compile(supervise=True)``) that
+sees a ``DAGActorDiedError`` — from a liveness probe the blocked reader
+ran between pop slices, or short-circuited by the comm watchdog's stall
+listener — calls :func:`recover` instead of surfacing the error. The
+sequence:
+
+1. **Diagnose** — probe every DAG actor's controller state; every DEAD
+   one is a victim (the triggering error names at least one).
+2. **Restart** — resurrect each victim through the controller's normal
+   lease path (``restart_actor``; mutation-token idempotent), then poll
+   with full-jitter backoff until ALIVE. The replacement may land on a
+   different node — the re-lower below re-derives edge families.
+3. **Quiesce** — stop every surviving stage loop (``dag_teardown``,
+   idempotent, best-effort to the dead) and drop the driver's old-epoch
+   collective group; sweep every old shm ring slot. Anything that slips
+   the sweep is fenced by the epoch header.
+4. **Re-open** — bump the channel epoch, re-resolve placement (ranks are
+   stable: same actor order), re-lower the graph, restore committed
+   ``__dag_snapshot__`` state to every hooked actor (survivors roll back
+   too — the graph restarts from ONE consistent cut), re-register every
+   stage at ``(epoch, start_seq)`` (per-epoch collective group name),
+   and re-open the driver's channel ends (readers refit in place).
+5. **Replay** — re-push every retained input from the replay base in
+   order, draining laggard readers so ring-depth backpressure can't
+   wedge a >depth replay. Consumers discard replayed seqs below their
+   old cursors, so ``execute()`` stays exactly-once end to end.
+
+Steady state costs nothing: no timer, no thread, no extra RPC — all of
+this is reached only from a failed pop.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu import exceptions
+from ray_tpu.dag import placement
+from ray_tpu.util.backoff import Backoff
+from ray_tpu.util.collective import flight
+
+# How long a recovery will wait for one victim to come back ALIVE
+# through the lease path before giving up (matches the controller's own
+# scheduling deadline).
+RECOVERY_TIMEOUT_S = 120.0
+
+# Generous per-seq ceiling for the replay pump: replayed frames flow
+# through already-warm stages, so this only bounds a pathological wedge.
+_REPLAY_DRAIN_TIMEOUT_S = 60.0
+
+
+def _actor_state(dag, actor_id: str) -> dict:
+    try:
+        return dag._ctx.io.run(
+            dag._ctx.controller.call(
+                "get_actor_info", {"actor_id": actor_id}, timeout=10
+            ),
+            timeout=15,
+        ) or {}
+    except Exception:  # rtlint: disable=swallowed-exception - controller hiccup: caller treats unknown as not-yet-alive
+        return {}
+
+
+def _find_victims(dag, err: exceptions.DAGActorDiedError) -> list[str]:
+    victims = []
+    for aid in dag._actor_ids:
+        if aid == err.actor_id:
+            victims.append(aid)
+            continue
+        if _actor_state(dag, aid).get("state") == "DEAD":
+            victims.append(aid)
+    return victims
+
+
+def _restart_victim(dag, actor_id: str, new_epoch: int) -> None:
+    """Resurrect one dead actor through the controller lease path and
+    wait for it to come back ALIVE. The mutation token makes a re-sent
+    restart (dropped reply, reconnect replay) a no-op instead of a
+    double-schedule."""
+    ctx = dag._ctx
+    resp = ctx.io.run(
+        ctx.controller.call("restart_actor", {
+            "actor_id": actor_id,
+            "mutation_token": f"dag-restart:{dag.dag_id}:{actor_id}:{new_epoch}",
+        }, timeout=30),
+        timeout=45,
+    )
+    if (resp or {}).get("status") != "ok":
+        raise exceptions.ActorDiedError(
+            f"{dag.dag_id}: controller refused to restart actor "
+            f"{actor_id}: {resp!r}"
+        )
+    # The old address is poison now; the resolver re-learns the new one.
+    ctx._actor_addr_cache.pop(actor_id, None)
+    deadline = time.monotonic() + RECOVERY_TIMEOUT_S
+    backoff = Backoff(initial_backoff_s=0.05, max_backoff_s=2.0)
+    while True:
+        state = _actor_state(dag, actor_id).get("state")
+        if state == "ALIVE":
+            ctx._actor_addr_cache.pop(actor_id, None)
+            return
+        if state == "DEAD":
+            raise exceptions.ActorDiedError(
+                f"{dag.dag_id}: actor {actor_id} died again while "
+                "restarting (lease path exhausted)"
+            )
+        if time.monotonic() > deadline:
+            raise exceptions.ActorDiedError(
+                f"{dag.dag_id}: actor {actor_id} not ALIVE within "
+                f"{RECOVERY_TIMEOUT_S}s of restart (state={state!r})"
+            )
+        time.sleep(backoff.next_delay(cap=deadline - time.monotonic()))
+
+
+def _quiesce(dag) -> None:
+    """Stop every surviving stage loop and sweep every old-epoch shm
+    ring slot. Idempotent and best-effort: dead actors can't ack, and a
+    frame that slips the sweep is fenced by its stale epoch header."""
+    ctx = dag._ctx
+
+    async def _teardown_all():
+        import asyncio
+
+        async def one(aid):
+            try:
+                client = await ctx._actor_client(aid)
+                await client.call(
+                    "dag_teardown", {"dag_id": dag.dag_id}, timeout=10
+                )
+            except Exception:  # rtlint: disable=swallowed-exception - victim can't ack its own teardown
+                pass
+
+        await asyncio.gather(*[one(aid) for aid in dag._actor_ids])
+
+    try:
+        ctx.io.run(_teardown_all(), timeout=30)
+    except Exception:  # rtlint: disable=swallowed-exception - quiesce is best-effort; epoch fencing covers stragglers
+        pass
+    for base in dag._all_shm_bases:
+        for i in range(dag.CHANNEL_DEPTH):
+            try:
+                ctx.store.delete(f"{base}-{i}")
+            except Exception:  # rtlint: disable=swallowed-exception - slot already freed
+                pass
+
+
+def _restore_snapshots(dag) -> None:
+    if not dag._snapshots:
+        return
+    for aid, blob in dag._snapshots.items():
+        resp = dag._call_actor(
+            aid, "dag_restore",
+            {"dag_id": dag.dag_id, "blob": blob}, timeout=60,
+        )
+        if (resp or {}).get("status") != "ok":
+            raise RuntimeError(
+                f"{dag.dag_id}: dag_restore failed on actor {aid}: {resp!r}"
+            )
+
+
+def _replay(dag, start_seq: int) -> None:
+    """Re-push every retained input from the replay base, in order.
+    When a replayed seq would outrun the slowest reader by a full ring
+    depth, drain that reader first — its frames are buffered (or
+    discarded as duplicates) driver-side, so backpressure never wedges
+    a longer-than-depth replay."""
+    for seq in sorted(s for s in dag._retained if s >= start_seq):
+        while dag._out_readers:
+            laggard = min(dag._out_readers, key=lambda r: r._next)
+            if seq - laggard._next < dag.CHANNEL_DEPTH:
+                break
+            laggard.drain_one(time.monotonic() + _REPLAY_DRAIN_TIMEOUT_S)
+        dag._push_input(seq, dag._retained[seq])
+
+
+def _doctor_ranks(dag) -> list[int]:
+    """Best-effort: what the hang doctor's merged report blames, for
+    cross-checking against the supervisor's own victim ranks."""
+    try:
+        from ray_tpu._private import hang_doctor
+        from ray_tpu.util import state
+
+        report = state.get_hang_report(fresh=False, stacks=False)
+        return sorted(hang_doctor.blamed_ranks(report))
+    except Exception:  # rtlint: disable=swallowed-exception - no report yet / controller gone: agreement is advisory
+        return []
+
+
+def recover(dag, err: exceptions.DAGActorDiedError) -> None:
+    """Restart victims, re-open every channel under a bumped epoch, and
+    replay the retained inputs. Raises (and the caller tears the graph
+    down) if any step fails — a half-recovered graph is worse than a
+    dead one."""
+    t0 = time.monotonic()
+    new_epoch = dag._epoch + 1
+    victims = _find_victims(dag, err)
+    with flight.site("dag"):
+        # Fixed-shape flight records: the new epoch rides the seq field,
+        # the triggering edge rides the tag.
+        flight.note(
+            dag.dag_id, "dag_recovery_start", tag=err.channel or "",
+            seq=new_epoch,
+        )
+    for aid in victims:
+        _restart_victim(dag, aid, new_epoch)
+    _quiesce(dag)
+    dag._destroy_group(sync=True)
+    dag._epoch = new_epoch
+    plan = placement.PlacementPlan.resolve(dag._ctx, dag._actor_ids)
+    old_ranks = {aid: dag._plan.rank_of(aid) for aid in dag._actor_ids}
+    for aid in dag._actor_ids:
+        if plan.rank_of(aid) != old_ranks[aid]:
+            raise RuntimeError(
+                f"{dag.dag_id}: rank drift on recovery for actor {aid} "
+                f"({old_ranks[aid]} -> {plan.rank_of(aid)})"
+            )
+    dag._plan = plan
+    dag._lower(plan)
+    _restore_snapshots(dag)
+    if dag._retained:
+        start_seq = min(dag._retained)
+    elif dag._snapshot_base is not None:
+        start_seq = dag._snapshot_base
+    else:
+        start_seq = dag._submitted
+    dag._register(
+        plan, need_group="device" in dag._families,
+        epoch=new_epoch, start_seq=start_seq,
+    )
+    dag._open_driver_channels(plan, start_seq)
+    _replay(dag, start_seq)
+    dag._stall_event.clear()
+    duration = time.monotonic() - t0
+    dag.last_recovery = {
+        "victims": victims,
+        "victim_ranks": sorted(plan.rank_of(a) for a in victims),
+        "doctor_ranks": _doctor_ranks(dag),
+        "epoch": new_epoch,
+        "start_seq": start_seq,
+        "duration_s": duration,
+    }
+    with flight.site("dag"):
+        flight.note(
+            dag.dag_id, "dag_recovery_done", tag=err.channel or "",
+            seq=new_epoch,
+        )

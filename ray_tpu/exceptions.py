@@ -58,24 +58,47 @@ class DAGActorDiedError(ActorDiedError):
     was in flight. Raised from DAGRef.get() instead of a bare timeout so
     callers can distinguish 'the graph is dead' from 'the graph is
     slow'; names the dead actor and its device-plane rank so the report
-    lines up with the hang doctor's suspect ranks."""
+    lines up with the hang doctor's suspect ranks, plus the edge it was
+    detected on — channel name, family, channel epoch, and the seq
+    frontier the consumer was blocked at — so the DAG supervisor and the
+    hang report agree on the blast radius."""
 
     def __init__(self, dag_id: str, actor_id: str, rank: int,
-                 detail: str = ""):
+                 detail: str = "", *, channel: str | None = None,
+                 family: str | None = None, epoch: int | None = None,
+                 seq: int | None = None):
         self.dag_id = dag_id
         self.actor_id = actor_id
         self.rank = rank
+        self.detail = detail
+        self.channel = channel
+        self.family = family
+        self.epoch = epoch
+        self.seq = seq
         message = (
             f"compiled DAG {dag_id}: actor {actor_id} (dag rank {rank}) "
             "died with executions in flight"
         )
+        if channel is not None:
+            message += (
+                f" [detected on {family or '?'} channel {channel}"
+                f" epoch={epoch} seq frontier={seq}]"
+            )
         if detail:
             message += f": {detail}"
         super().__init__(message)
 
     def __reduce__(self):
+        # 3rd element updates __dict__ on unpickle, so the edge evidence
+        # survives the wire without breaking older (dag_id, actor_id,
+        # rank) consumers.
         return (
-            DAGActorDiedError, (self.dag_id, self.actor_id, self.rank)
+            DAGActorDiedError,
+            (self.dag_id, self.actor_id, self.rank, self.detail),
+            {
+                "channel": self.channel, "family": self.family,
+                "epoch": self.epoch, "seq": self.seq,
+            },
         )
 
 

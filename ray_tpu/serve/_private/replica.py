@@ -93,6 +93,15 @@ class Replica:
         self._latency_hist = LatencyHistogram()
         self._streams: dict[str, tuple] = {}
         self._stream_counter = 0
+        # Per-incarnation stream-id fencing: replica_id is stable across
+        # restarts and the counter resets with the process, so without
+        # this token a caller holding "stream-<replica>-0" from a dead
+        # incarnation could alias a NEW stream of the restarted replica
+        # and silently read someone else's tokens. With it, stale ids
+        # miss the table and get the loud "unknown stream" terminal.
+        import uuid as _uuid
+
+        self._incarnation = _uuid.uuid4().hex[:6]
         # Shape keys served here (explicit request shape_keys); unioned
         # with the batching module's compiled buckets in
         # get_warm_shapes() for compile-cache-aware routing.
@@ -207,7 +216,10 @@ class Replica:
     def _open_stream(self, gen) -> str:
         from ray_tpu.dag.channels import LocalChannel
 
-        stream_id = f"stream-{self.replica_id}-{self._stream_counter}"
+        stream_id = (
+            f"stream-{self.replica_id}-{self._incarnation}"
+            f"-{self._stream_counter}"
+        )
         self._stream_counter += 1
         # The token stream rides an rtdag LocalChannel — the same-process
         # channel family of the compiled-dataflow plane (ISSUE 15); its

@@ -131,6 +131,7 @@ class BackendExecutor:
         train_loop_config: dict,
         latest_checkpoint: Optional[Checkpoint],
         dataset_shards_per_rank: list[dict] | Callable[[int], list[dict]],
+        attempt: int = 0,
     ) -> None:
         sc = self.scaling_config
         self.gang = self._form_gang()
@@ -154,6 +155,10 @@ class BackendExecutor:
                     "num_stages": int(sc.pipeline_stages),
                     "microbatches": int(sc.microbatches),
                     "virtual": int(getattr(sc, "virtual_stages", 1)),
+                    # Launch-attempt generation: the stage runner fences
+                    # its p2p wire tags per attempt, so a re-formed gang
+                    # never consumes a dead incarnation's frames.
+                    "attempt": int(attempt),
                 }
                 if int(getattr(sc, "pipeline_stages", 1)) > 1
                 else None

@@ -174,7 +174,13 @@ class PipelineStageRunner:
 
         self._apply = jax.jit(_apply, donate_argnums=(0, 1))
         self._param_shardings = param_shardings
-        self._step_counter = 0
+        # Step-tag namespace fencing across gang re-formations: each
+        # launch attempt starts its counter in its own disjoint range, so
+        # a frame a dying peer left in a mailbox can never pair with the
+        # re-formed gang's traffic (same fix as the rtdag channel epoch,
+        # expressed inside the existing ``s{N}.`` tag shape so the static
+        # commgraph skeleton is unchanged).
+        self._step_counter = int(pipe.get("attempt", 0)) * 1_000_000
 
         # Activation-wire codec (ISSUE 11): host-memory backends only —
         # the xla p2p plane moves device arrays and stays exact.
@@ -189,13 +195,19 @@ class PipelineStageRunner:
         # Neighbor rings as rtdag device channels (ISSUE 15): the 1F1B
         # activation wire is the same channel family a compiled DAG edge
         # uses — tagged mode, with the codec/EF state owned per edge.
+        # The gang-formation attempt is the rings' channel epoch: after a
+        # gang death + re-form, a frame a dying peer left in flight can
+        # never be mistaken for the new incarnation's traffic.
+        attempt = int(pipe.get("attempt", 0))
         self._prev_ring = DeviceChannel(
             self.group, (self.stage - 1) % self.num_stages,
             site="pipeline", wire_cfg=self._act_cfg, ef=self._act_ef,
+            epoch=attempt,
         )
         self._next_ring = DeviceChannel(
             self.group, (self.stage + 1) % self.num_stages,
             site="pipeline", wire_cfg=self._act_cfg, ef=self._act_ef,
+            epoch=attempt,
         )
 
     # -- back-compat single-chunk views -----------------------------------

@@ -203,6 +203,9 @@ class DataParallelTrainer:
                         lambda world_size: _split_datasets(
                             self.datasets, world_size, ingest=ingest
                         ),
+                        # Launch-attempt generation — fences the pipeline
+                        # p2p wire's tag namespace per gang incarnation.
+                        attempt=failures,
                     )
                 finally:
                     # Gang (re)formation is restart-resharding time whether

@@ -347,6 +347,7 @@ class FlightRecorder:
                 self._publish(event)
             except Exception:  # rtlint: disable=swallowed-exception - stall publication is best-effort; local ring + mark already hold the evidence
                 pass
+            _notify_stall_listeners(event)
         return fired
 
     def _ensure_watchdog(self) -> None:
@@ -368,6 +369,40 @@ class FlightRecorder:
                 self.check_once()
                 _export_inflight_gauge(self)
             except Exception:  # rtlint: disable=swallowed-exception - the watchdog must outlive transient metric/controller failures
+                pass
+
+
+# ---------------------------------------------------------------------------
+# in-process stall listeners (watchdog -> rtdag supervisor wiring)
+# ---------------------------------------------------------------------------
+# A listener is (group_prefix, callback): the watchdog invokes the
+# callback for every stall event whose group starts with the prefix. The
+# rtdag supervisor registers its dag_id here so a stall on any of the
+# graph's channels (any recovery epoch — per-epoch group names share the
+# dag_id prefix) wakes the blocked driver reader into an immediate
+# liveness probe instead of waiting out its probe interval. Callbacks
+# run on the watchdog thread and must not block.
+
+_stall_listeners: list[tuple[str, Callable[[dict], None]]] = []
+
+
+def register_stall_listener(prefix: str, cb: Callable[[dict], None]) -> None:
+    _stall_listeners.append((prefix, cb))
+
+
+def unregister_stall_listener(cb: Callable[[dict], None]) -> None:
+    _stall_listeners[:] = [
+        (p, c) for (p, c) in _stall_listeners if c is not cb
+    ]
+
+
+def _notify_stall_listeners(event: dict) -> None:
+    group = str(event.get("group") or "")
+    for prefix, cb in list(_stall_listeners):
+        if group.startswith(prefix):
+            try:
+                cb(event)
+            except Exception:  # rtlint: disable=swallowed-exception - a broken listener must not kill the watchdog
                 pass
 
 
