@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Run the serve-LLM suite, slow scenarios included (ISSUE 17).
+#
+# Tier-1 CI runs `pytest -m 'not slow'`, which covers the hash-ring
+# stability properties, slot/KV-pool unit behavior, the quantized KV
+# wire + epoch fencing, engine continuous batching (admission overlap,
+# deadline eviction, fast shed, fence dedup), multiplex pin-before-
+# evict, the kv-headroom autoscaling floor, and the in-cluster e2e
+# paths (unary/stream/batch, zero-controller-RPC steady state, batch-
+# full fast 503). This script is the nightly companion that also runs
+# the long windowed schedule (mid-stream decode replica kill with
+# exactly-once token delivery) plus the serve_llm release benchmark in
+# smoke mode (throughput + replica AND proxy kill under load + the
+# independent pool-scaling phase).
+# Usage: ci/run_serve_llm_bench.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== serve llm suite (tier-1 subset) =="
+python -m pytest tests/test_serve_llm.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== serve llm suite (slow scenarios) =="
+python -m pytest tests/test_serve_llm.py -q -m 'slow' \
+    -p no:cacheprovider "$@"
+
+echo "== serve llm release benchmark (smoke) =="
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" RAY_TPU_RELEASE_SMOKE=1 \
+    python release/benchmarks_serve_llm.py
+
+echo "serve llm suite: PASS"

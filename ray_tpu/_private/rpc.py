@@ -580,8 +580,11 @@ class _ClientCallMixin:
         self.auto_reconnect = auto_reconnect
         # Total call() invocations over this client's lifetime — the
         # rtdag zero-RPC-per-step acceptance gate reads the delta across
-        # a window of steady-state executes.
+        # a window of steady-state executes. The per-method split names
+        # whatever a nonzero delta was (steady-state probes report it so
+        # a stray background call is attributable, not just counted).
         self.calls_total = 0
+        self.calls_by_method: dict[str, int] = {}
         self.on_reconnect: Callable[[], Awaitable[None]] | None = None
         self._reconnect_lock: asyncio.Lock | None = None
         self._closed = False
@@ -625,6 +628,9 @@ class _ClientCallMixin:
         # (actor sequence numbers) release the next writer from it while
         # still awaiting this reply concurrently.
         self.calls_total += 1
+        self.calls_by_method[method] = (
+            self.calls_by_method.get(method, 0) + 1
+        )
         injector = chaos.get_injector()
         if injector.active:
             return await self._call_with_chaos(

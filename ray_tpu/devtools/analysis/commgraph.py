@@ -75,7 +75,7 @@ _COMM_PATHS = ("util/collective/",)
 # (ISSUE 13): the serve control plane hosts no collectives today, so the
 # scan doubles as a tripwire against one sneaking onto the request path.
 _SCAN_PATHS = ("util/collective/", "train/", "parallel/", "release/",
-               "bench", "serve/_private/", "dag/")
+               "bench", "serve/_private/", "serve/llm/", "dag/")
 
 _RANKISH = re.compile(r"rank|stage|process_index")
 

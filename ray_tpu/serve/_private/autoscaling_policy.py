@@ -21,6 +21,7 @@ def calculate_desired_num_replicas(
     current_replicas: int,
     queue_depth: float = 0.0,
     p99_ms: float | None = None,
+    kv_free_frac: float | None = None,
 ) -> int:
     # Demand counts queued-but-unstarted work too (ISSUE 13): a deployment
     # whose batching queues are backing up is under-provisioned even while
@@ -48,6 +49,17 @@ def calculate_desired_num_replicas(
     slo = getattr(config, "slo_p99_ms", None)
     if slo and p99_ms is not None and p99_ms > slo and current_replicas > 0:
         raw = max(raw, current_replicas + 1)
+    # Memory floor (ISSUE 17): a decode pool whose worst replica is out
+    # of KV-block headroom stalls admission regardless of ongoing
+    # counts — the serve-plane twin of the PR-5 HBM headroom guard.
+    headroom = getattr(config, "kv_headroom_min", None)
+    if (
+        headroom is not None
+        and kv_free_frac is not None
+        and kv_free_frac < headroom
+        and current_replicas > 0
+    ):
+        raw = max(raw, current_replicas + 1)
     return max(config.min_replicas, min(config.max_replicas, raw))
 
 
@@ -66,11 +78,13 @@ class AutoscalingState:
         now: float | None = None,
         queue_depth: float = 0.0,
         p99_ms: float | None = None,
+        kv_free_frac: float | None = None,
     ) -> int:
         now = time.monotonic() if now is None else now
         desired = calculate_desired_num_replicas(
             self.config, total_ongoing_requests, current_replicas,
             queue_depth=queue_depth, p99_ms=p99_ms,
+            kv_free_frac=kv_free_frac,
         )
         if desired == current_replicas:
             self._proposal = None

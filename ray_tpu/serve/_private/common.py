@@ -129,6 +129,12 @@ class AutoscalingConfig:
     # counts look healthy (queues hide behind batching).
     queue_weight: float = 1.0
     slo_p99_ms: Optional[float] = None
+    # Memory floor (ISSUE 17 tentpole d): when the fleet's minimum
+    # KV-block free fraction drops below this, force one replica of
+    # upscale pressure — the decode-pool analogue of the PR-5 HBM
+    # headroom signal (a full KV pool stalls admission long before
+    # ongoing counts look unhealthy).
+    kv_headroom_min: Optional[float] = None
 
 
 @dataclass
@@ -202,6 +208,9 @@ class RequestMetadata:
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
     method_name: str = "__call__"
     multiplexed_model_id: str = ""
+    # Hash-ring affinity key (ISSUE 17): a session's requests rendezvous-
+    # hash to the replica holding its KV blocks / conversation state.
+    session_id: str = ""
     http: bool = False
     # Remaining deadline budget at dispatch time (seconds, None=unbounded).
     # Relative on the wire; the replica re-anchors on its own clock.

@@ -692,6 +692,7 @@ class ServeController:
         ]
         total_ongoing = 0.0
         queue_depth = 0.0
+        kv_free_frac: float | None = None
         for rep in running:
             actor = self._actor_handles.get(rep.actor_name)
             if actor is None:
@@ -700,6 +701,15 @@ class ServeController:
                 load = ray_tpu.get(actor.get_load.remote(), timeout=5)
                 total_ongoing += load.get("ongoing", 0)
                 queue_depth += load.get("queue_depth", 0)
+                # Decode replicas report paged-KV headroom (ISSUE 17);
+                # the pool scales on its WORST replica — one full pool
+                # stalls that replica's admission even if siblings idle.
+                frac = load.get("kv_free_frac")
+                if frac is not None:
+                    kv_free_frac = (
+                        frac if kv_free_frac is None
+                        else min(kv_free_frac, frac)
+                    )
             except Exception:  # rtlint: disable=swallowed-exception - queue-depth probe failed; autoscale on what we have
                 pass
         current = self._autoscale_counts.get(
@@ -712,6 +722,7 @@ class ServeController:
             current,
             queue_depth=queue_depth,
             p99_ms=self._route_p99.get(qname),
+            kv_free_frac=kv_free_frac,
         )
         if decision != current:
             self._autoscale_counts[qname] = decision

@@ -150,7 +150,7 @@ class HTTPProxy(RoutingMixin):
             policy.get("max_queued_requests", -1),
         )
         if self._inflight.get(qualified, 0) >= limit:
-            return self._shed_response(qualified, "proxy")
+            return self._shed_response(qualified, "proxy", deadline=deadline)
         body: Any
         if request.method in ("POST", "PUT", "PATCH"):
             raw = await request.read()
@@ -160,6 +160,14 @@ class HTTPProxy(RoutingMixin):
                 body = raw
         else:
             body = dict(request.query)
+        # Session affinity (ISSUE 17): an X-RayTPU-Session header (or a
+        # "session_id" body field) becomes the handle's hash-ring key, so
+        # a session's requests land on the replica holding its KV blocks
+        # across every proxy in the pool (the ring is membership-keyed,
+        # not proxy-local state).
+        session_id = request.headers.get("X-RayTPU-Session", "")
+        if not session_id and isinstance(body, dict):
+            session_id = str(body.get("session_id", "") or "")
         self._num_requests += 1
         # Incoming trace context rides an X-RayTPU-Trace header
         # ("<trace_id>:<span_id>"); absent, the proxy starts a new trace.
@@ -186,10 +194,13 @@ class HTTPProxy(RoutingMixin):
                 with trace_scope:
                     result = await asyncio.to_thread(
                         self._call_deployment, app_name, dep_name, body,
-                        deadline,
+                        deadline, session_id,
                     )
-            except exceptions.RequestShedError:
-                return self._shed_response(qualified, "replica")
+            except exceptions.RequestShedError as exc:
+                return self._shed_response(
+                    qualified, "replica", deadline=deadline,
+                    retry_after_s=getattr(exc, "retry_after_s", None),
+                )
             except (exceptions.DeadlineExceededError, TimeoutError) as exc:
                 self._observe_route(
                     qualified, time.perf_counter() - req_t0, error=True,
@@ -202,7 +213,9 @@ class HTTPProxy(RoutingMixin):
                 if "no available replica" in str(exc):
                     # Backpressure/scale-to-zero exhausted the deadline:
                     # service unavailable, not an internal error.
-                    return self._shed_response(qualified, "proxy")
+                    return self._shed_response(
+                        qualified, "proxy", deadline=deadline
+                    )
                 self._observe_route(
                     qualified, time.perf_counter() - req_t0, error=True
                 )
@@ -289,8 +302,10 @@ class HTTPProxy(RoutingMixin):
         return response
 
     def _call_deployment(self, app_name: str, dep_name: str, body: Any,
-                         deadline: Deadline) -> Any:
+                         deadline: Deadline, session_id: str = "") -> Any:
         handle = self._handle_for(f"{app_name}_{dep_name}")
+        if session_id:
+            handle = handle.options(session_id=session_id)
         # Runs on a worker thread: the ambient deadline set here is what
         # handle.remote() picks up (and result() is bounded by it — no
         # more hardcoded 120s cap).
@@ -313,9 +328,15 @@ class HTTPProxy(RoutingMixin):
         policy["num_replicas"] = len(info.get("actor_names", ()))
         return policy
 
-    def _shed_response(self, qualified: str, where: str):
+    def _shed_response(self, qualified: str, where: str,
+                       deadline: Deadline | None = None,
+                       retry_after_s: float | None = None):
         """Fast 503 + Retry-After: the graceful-degradation contract —
-        callers back off instead of piling onto a saturated route."""
+        callers back off instead of piling onto a saturated route. The
+        Retry-After hint starts from the shedder's own estimate (the
+        decode engine projects when a slot frees) and is capped by the
+        request's remaining deadline budget — advising a client to retry
+        after its own deadline would guarantee a wasted request."""
         from aiohttp import web
 
         self._shed_count += 1
@@ -328,9 +349,13 @@ class HTTPProxy(RoutingMixin):
             metrics_mod.record_serve_request(qualified, 0.0, "503")
         except Exception:  # rtlint: disable=swallowed-exception - metric export must never fail a shed response
             pass
+        hint = retry_after_s if retry_after_s is not None else 1.0
+        if deadline is not None and not deadline.is_unbounded():
+            hint = min(hint, deadline.remaining())
+        hint = max(0.0, hint)
         return web.Response(
             status=503,
-            headers={"Retry-After": "1"},
+            headers={"Retry-After": f"{hint:.3f}"},
             text="overloaded: request shed by admission control",
         )
 

@@ -114,6 +114,13 @@ class Replica:
         else:
             self._callable = cls_or_fn
             self._is_function = True
+        # serve_llm integration (ISSUE 17): a callable hosting a decode
+        # engine gets its gauge identity stamped here (the engine can't
+        # know which replica hosts it at construction time).
+        engine = getattr(self._callable, "_engine", None)
+        if engine is not None and hasattr(engine, "replica_id"):
+            engine.deployment = deployment_name
+            engine.replica_id = replica_id
         if user_config is not None:
             self._apply_reconfigure(user_config)
 
@@ -193,7 +200,9 @@ class Replica:
                 # chunks via stream_next() (batched per RPC). The ongoing
                 # gauge stays raised until the stream finishes — a live
                 # token stream IS an ongoing request for autoscaling.
-                stream_id = self._open_stream(result)
+                stream_id = self._open_stream(
+                    result, model_id=meta.get("multiplexed_model_id", "")
+                )
                 self._ongoing += 1  # released by _finish_stream
                 if meta.get("shape_key"):
                     self._warm_shapes.add(meta["shape_key"])
@@ -213,7 +222,7 @@ class Replica:
     # -- streaming ------------------------------------------------------
     STREAM_IDLE_TTL_S = 120.0
 
-    def _open_stream(self, gen) -> str:
+    def _open_stream(self, gen, model_id: str = "") -> str:
         from ray_tpu.dag.channels import LocalChannel
 
         stream_id = (
@@ -226,8 +235,16 @@ class Replica:
         # bounded ring is the decode-loop backpressure.
         chan = LocalChannel(maxsize=256, group="serve", label=stream_id)
         task = asyncio.get_running_loop().create_task(self._pump(gen, chan))
+        # Pin the stream's multiplexed model (ISSUE 17 satellite 6): an
+        # LRU swap must not checkpoint-evict a model whose stream is
+        # still decoding — eviction defers until the last pin releases.
+        if model_id:
+            from ray_tpu.serve.multiplex import pin_model
+
+            pin_model(model_id)
         self._streams[stream_id] = {
             "chan": chan, "task": task, "last_access": time.monotonic(),
+            "model_id": model_id,
         }
         self._reap_idle_streams()
         return stream_id
@@ -237,6 +254,10 @@ class Replica:
         if entry is not None:
             entry["task"].cancel()
             entry["chan"].close()
+            if entry.get("model_id"):
+                from ray_tpu.serve.multiplex import unpin_model
+
+                unpin_model(entry["model_id"])
             self._ongoing -= 1
 
     def _reap_idle_streams(self) -> None:
@@ -359,6 +380,21 @@ class Replica:
             # latency.
             "rss_bytes": _peak_rss_bytes(),
         }
+        # Continuous-batching stats (ISSUE 17 satellite 2): the decode
+        # engine's per-iteration slot occupancy replaces the batch-
+        # boundary occupancy for deployments hosting one — the dashboard
+        # fields track the running batch, not the last flushed one.
+        stats_fn = getattr(self._callable, "serve_llm_stats", None)
+        if callable(stats_fn):
+            try:
+                llm_stats = stats_fn()
+                out["serve_llm"] = llm_stats
+                out["queue_depth"] += llm_stats.get("queue_depth", 0)
+                out["batch_occupancy"] = llm_stats.get(
+                    "slot_occupancy_frac"
+                )
+            except Exception:  # rtlint: disable=swallowed-exception - stats merge must never fail a metrics poll
+                pass
         # Push the occupancy gauges on the controller's metric-poll tick:
         # the poll cadence IS the gauge cadence, no extra timer needed.
         try:
@@ -389,14 +425,24 @@ class Replica:
 
     def get_load(self) -> dict:
         """Autoscaler input: in-flight requests plus queued-but-unstarted
-        batching depth (the part `ongoing` alone hides)."""
+        batching depth (the part `ongoing` alone hides). Decode-engine
+        replicas also report KV-pool headroom (ISSUE 17 tentpole d) —
+        `ongoing` already covers engine slots (each occupied slot IS an
+        in-flight request), so only the memory signal merges in."""
         from ray_tpu.serve import batching
 
-        return {
+        load = {
             "ongoing": self._ongoing,
             "queue_depth": batching.queue_stats()["queue_depth"],
             "draining": self._draining,
         }
+        load_fn = getattr(self._callable, "serve_llm_load", None)
+        if callable(load_fn):
+            try:
+                load["kv_free_frac"] = load_fn().get("kv_free_frac")
+            except Exception:  # rtlint: disable=swallowed-exception - load merge must never fail an autoscaler poll
+                pass
+        return load
 
     def get_warm_shapes(self) -> list:
         """Shape keys whose XLA programs this replica has already

@@ -1,14 +1,84 @@
-"""Shared ingress routing: long-poll-refreshed route table + handle cache.
+"""Shared ingress routing: long-poll-refreshed route table + handle cache,
+plus the replica-selection hash ring (ISSUE 17).
 
 One implementation of route matching and deployment-handle resolution for
 every proxy protocol (HTTP, gRPC) — reference proxy_router.py role. A
 future change to prefix-matching or the qualified-name encoding lands in
 both ingresses at once.
+
+``HashRing`` replaces power-of-two-choices replica selection: rendezvous
+(highest-random-weight) hashing keyed on the request's affinity key
+(session id > multiplexed model id > shape key > request id) with a
+bounded-load fallback. Keyed traffic (a session's decode stream, a
+multiplexed model's requests) sticks to one replica — so its KV blocks
+and LRU-loaded model stay hot — while replica add/remove only remaps the
+keys that must move (HRW's minimal-disruption property). Bounded load
+walks down the key's preference order past saturated replicas, so a hot
+session cannot melt one replica while others idle.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import hashlib
+from typing import Any, Iterable, Mapping, Optional
+
+
+class HashRing:
+    """Rendezvous-hash replica selector with bounded-load fallback.
+
+    Pure data structure (no locks, no RPC): callers pass the current
+    member list and per-member load on every pick, so the ring never
+    holds stale membership — Router._refresh already owns that state.
+    """
+
+    def __init__(self, members: Iterable[str] = ()):
+        self._members: tuple[str, ...] = tuple(sorted(members))
+
+    def update(self, members: Iterable[str]) -> None:
+        self._members = tuple(sorted(members))
+
+    @property
+    def members(self) -> tuple[str, ...]:
+        return self._members
+
+    @staticmethod
+    def _score(key: str, member: str) -> int:
+        # blake2b over "key|member": stable across processes and runs
+        # (unlike hash()), cheap, and uniformly distributed.
+        digest = hashlib.blake2b(
+            f"{key}|{member}".encode(), digest_size=8
+        ).digest()
+        return int.from_bytes(digest, "big")
+
+    def rank(self, key: str) -> list[str]:
+        """Members ordered by descending HRW score for ``key`` — the
+        key's full preference order. Removing a member leaves every
+        other member's relative order untouched, which is exactly the
+        ring-stability property the tests pin down."""
+        return sorted(
+            self._members, key=lambda m: self._score(key, m), reverse=True
+        )
+
+    def pick(
+        self,
+        key: str,
+        load: Optional[Mapping[str, int]] = None,
+        max_load: Optional[int] = None,
+    ) -> Optional[str]:
+        """The key's most-preferred member whose load is under
+        ``max_load``. Saturated members are skipped in preference order
+        (bounded-load fallback); if every member is saturated, fall back
+        to the least-loaded one so the caller can apply its own
+        backpressure rather than spin."""
+        order = self.rank(key)
+        if not order:
+            return None
+        if load is None or max_load is None:
+            return order[0]
+        for member in order:
+            if load.get(member, 0) < max_load:
+                return member
+        return min(order, key=lambda m: load.get(m, 0))
 
 
 class RoutingMixin:

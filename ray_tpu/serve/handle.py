@@ -1,13 +1,15 @@
 """DeploymentHandle + Router — client-side load-balanced calls.
 
 Role-equivalents of python/ray/serve/handle.py :: DeploymentHandle /
-DeploymentResponse and _private/router.py + replica_scheduler/
-pow_2_scheduler.py :: PowerOfTwoChoicesReplicaScheduler (SURVEY §2.6):
-the handle keeps a router that tracks the deployment's live replicas
-(refreshed from the controller), picks between two random replicas by
-queue length (locally-tracked ongoing counts + max_ongoing_requests
-backpressure), and returns futures (DeploymentResponse) that compose
-between deployments.
+DeploymentResponse and _private/router.py + replica_scheduler (SURVEY
+§2.6): the handle keeps a router that tracks the deployment's live
+replicas (refreshed from the controller), picks a replica by rendezvous-
+hashing the request's affinity key over the live set with bounded-load
+fallback (routing.HashRing, ISSUE 17 — replaces power-of-two-choices;
+session/model-keyed traffic sticks to the replica holding its KV blocks
+or LRU-loaded model, keyless traffic spreads uniformly by request id),
+and returns futures (DeploymentResponse) that compose between
+deployments.
 
 Reliability layer (ISSUE 13): every call carries a Deadline created at
 ingress; retries are budgeted by the deployment's RetryPolicy (full-jitter
@@ -21,9 +23,10 @@ from __future__ import annotations
 
 import collections
 import logging
-import random
+import math
 import threading
 import time
+import uuid
 from typing import Any, Optional
 
 import ray_tpu
@@ -34,6 +37,7 @@ from ray_tpu.serve._private.common import (
     RetryPolicy,
     current_deadline,
 )
+from ray_tpu.serve._private.routing import HashRing
 from ray_tpu.util import tracing
 from ray_tpu.util.metrics import (
     inc_serve_reliability,
@@ -239,8 +243,17 @@ class DeploymentResponse:
                     continue
                 self._finish_all(winner=None)
                 if kind == "RequestShedError":
+                    # The replica's Retry-After estimate rides the remote
+                    # message ("retry_after_s=X" — e.g. the decode
+                    # engine's slot-free projection); recover it so the
+                    # proxy's 503 hint reflects the shedder's estimate
+                    # instead of a flat 1s.
+                    import re as _re
+
+                    m = _re.search(r"retry_after_s=([0-9.]+)", str(exc))
                     raise exceptions.RequestShedError(
-                        f"replica of {self._deployment!r} shed the request"
+                        f"replica of {self._deployment!r} shed the request",
+                        retry_after_s=float(m.group(1)) if m else 1.0,
                     ) from exc
                 if kind == "DeadlineExceededError":
                     inc_serve_reliability(
@@ -546,7 +559,14 @@ class ResponseStream:
 
 
 class Router:
-    """Pow-2 replica choice with cached membership + local queue counts."""
+    """Hash-ring replica choice with cached membership + local queue
+    counts (rendezvous hashing, bounded-load fallback — ISSUE 17)."""
+
+    # Bounded-load factor: a key's preferred replica is skipped once its
+    # ongoing count exceeds BOUNDED_LOAD_FACTOR x the fleet average —
+    # classic consistent-hashing-with-bounded-loads, so one hot session
+    # cannot melt a single replica while the rest idle.
+    BOUNDED_LOAD_FACTOR = 1.25
 
     REFRESH_INTERVAL_S = 1.0
     # Hedge delay fallback until enough latency samples exist.
@@ -580,6 +600,9 @@ class Router:
         # shape keys, polled lazily once any caller routes by shape_key.
         self._warm: dict[str, set] = {}
         self._warm_ts = 0.0
+        # Affinity ring (ISSUE 17): rendezvous hashing over the live
+        # replica set; membership updates ride _refresh.
+        self._ring = HashRing()
 
     # -- policy ---------------------------------------------------------
     def policy(self) -> dict:
@@ -696,11 +719,19 @@ class Router:
 
     def choose_replica(self, shape_key: str | None = None,
                        deadline: Deadline | None = None,
-                       exclude: set | frozenset = frozenset()) -> str:
-        """Pick a replica and take an ongoing slot on it. The wait for
-        capacity/membership is bounded by the request's Deadline (the old
-        hardcoded 30s); ``exclude`` supports hedging and death retries."""
+                       exclude: set | frozenset = frozenset(),
+                       affinity_key: str | None = None) -> str:
+        """Pick a replica and take an ongoing slot on it. Selection is
+        rendezvous hashing on ``affinity_key`` (session id, model id,
+        shape key, or the request id for keyless spread) with bounded-
+        load fallback. The wait for capacity/membership is bounded by
+        the request's Deadline; ``exclude`` supports hedging and death
+        retries."""
         deadline = deadline or Deadline.after(self.request_timeout_s())
+        # Keyless requests spread uniformly: a one-shot random key gives
+        # HRW the same distribution as random choice (kept stable across
+        # this call's wait loop so bounded load doesn't thrash the pick).
+        key = affinity_key or shape_key or uuid.uuid4().hex
         while True:
             self._refresh()
             with self._lock:
@@ -728,12 +759,23 @@ class Router:
                 if warm_free:
                     candidates = warm_free
             if candidates:
-                if len(candidates) == 1:
-                    pick = candidates[0]
-                else:
-                    a, b = random.sample(candidates, 2)
-                    pick = a if self._ongoing.get(a, 0) <= self._ongoing.get(b, 0) else b
-                if self._ongoing.get(pick, 0) < self._max_ongoing:
+                with self._lock:
+                    ongoing = dict(self._ongoing)
+                # Bounded load: the key's preferred replica is skipped
+                # once it is BOUNDED_LOAD_FACTOR past the fleet average
+                # (and always at the hard max_ongoing cap).
+                total = sum(ongoing.get(c, 0) for c in candidates)
+                avg_bound = math.ceil(
+                    self.BOUNDED_LOAD_FACTOR
+                    * (total + 1) / max(1, len(candidates))
+                )
+                self._ring.update(candidates)
+                pick = self._ring.pick(
+                    key,
+                    load=ongoing,
+                    max_load=min(self._max_ongoing, max(1, avg_bound)),
+                )
+                if pick and ongoing.get(pick, 0) < self._max_ongoing:
                     with self._lock:
                         self._ongoing[pick] = self._ongoing.get(pick, 0) + 1
                     return pick
@@ -765,6 +807,7 @@ class DeploymentHandle:
         self._method_name = "__call__"
         self._model_id = ""
         self._shape_key = ""
+        self._session_id = ""
 
     def _get_router(self) -> Router:
         if self._router is None:
@@ -773,10 +816,13 @@ class DeploymentHandle:
 
     def options(self, *, method_name: str | None = None,
                 multiplexed_model_id: str | None = None,
-                shape_key: str | None = None) -> "DeploymentHandle":
+                shape_key: str | None = None,
+                session_id: str | None = None) -> "DeploymentHandle":
         """shape_key: opaque label of the request's compiled shape
         (sequence-length bucket, resolution, ...). Requests with the same
-        key stick to replicas that already compiled it (§3.4)."""
+        key stick to replicas that already compiled it (§3.4).
+        session_id: affinity key for the hash ring (ISSUE 17) — a
+        session's requests land on the replica holding its KV blocks."""
         clone = DeploymentHandle(self.deployment_name, self.app_name)
         # Share ONE router across option clones (materialize it now: a
         # None copied here would fork load counts and warm caches later).
@@ -784,6 +830,7 @@ class DeploymentHandle:
         clone._method_name = method_name or self._method_name
         clone._model_id = multiplexed_model_id or self._model_id
         clone._shape_key = shape_key or self._shape_key
+        clone._session_id = session_id or self._session_id
         return clone
 
     def __getattr__(self, name: str):
@@ -807,7 +854,9 @@ class DeploymentHandle:
         )
         policy = router.retry_policy()
         meta = RequestMetadata(
-            method_name=self._method_name, multiplexed_model_id=self._model_id
+            method_name=self._method_name,
+            multiplexed_model_id=self._model_id,
+            session_id=self._session_id,
         )
         # Compose: upstream DeploymentResponses pass as object refs so the
         # downstream replica reads the value without driver round-trips.
@@ -850,10 +899,17 @@ class DeploymentHandle:
                         attempt: int = 0) -> _Attempt:
         """One dispatch onto a chosen replica; takes (and on failure
         releases) the replica's ongoing slot."""
+        # Affinity precedence: explicit session > multiplexed model (the
+        # replica holding the LRU-loaded model) > compiled shape.
+        affinity = (
+            self._session_id or meta.multiplexed_model_id
+            or self._shape_key or None
+        )
         replica_name = router.choose_replica(
             shape_key=self._shape_key or None,
             deadline=deadline,
             exclude=exclude,
+            affinity_key=affinity,
         )
         try:
             replica = router._replica_handle(replica_name)
@@ -868,6 +924,7 @@ class DeploymentHandle:
                     "method_name": meta.method_name,
                     "multiplexed_model_id": meta.multiplexed_model_id,
                     "shape_key": self._shape_key,
+                    "session_id": meta.session_id,
                     # The remaining budget travels as a relative duration;
                     # the replica re-anchors it on its own clock.
                     "deadline_budget_s": deadline.budget(),
@@ -889,18 +946,19 @@ class DeploymentHandle:
     def __reduce__(self):
         return (_rebuild_handle, (self.deployment_name, self.app_name,
                                   self._method_name, self._model_id,
-                                  self._shape_key))
+                                  self._shape_key, self._session_id))
 
     def __repr__(self):
         return f"DeploymentHandle({self.app_name}/{self.deployment_name})"
 
 
 def _rebuild_handle(deployment, app_name, method_name, model_id,
-                    shape_key=""):
+                    shape_key="", session_id=""):
     handle = DeploymentHandle(deployment, app_name)
     handle._method_name = method_name
     handle._model_id = model_id
     handle._shape_key = shape_key
+    handle._session_id = session_id
     return handle
 
 

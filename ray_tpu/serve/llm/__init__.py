@@ -1,0 +1,45 @@
+"""ray_tpu.serve.llm — throughput-first LLM serving on the rtdag plane.
+
+ISSUE 17's serving layer: continuous batching (a resident decode loop
+over rtdag channels that admits new sequences into the running batch
+every iteration), disaggregated prefill/decode replica pools with KV
+blocks crossing pools on the PR-7 block-scaled quantized wire, hash-ring
+session affinity across the multi-proxy pool, model multiplexing, and
+closed-loop autoscaling off SLO histograms + KV-pool (HBM) headroom.
+
+Public surface::
+
+    from ray_tpu.serve import llm
+
+    app = llm.build_llm_app(llm.LLMConfig(max_slots=64))
+    serve.run(app, route_prefix="/llm")
+"""
+
+from ray_tpu.serve.llm.batch import SequenceState, SlotBatch
+from ray_tpu.serve.llm.config import LLMConfig
+from ray_tpu.serve.llm.deployments import (
+    LLMDecode,
+    LLMPrefill,
+    build_llm_app,
+)
+from ray_tpu.serve.llm.engine import DecodeEngine
+from ray_tpu.serve.llm.kv import KVBlockPool
+from ray_tpu.serve.llm.wire import (
+    KVDeviceWire,
+    decode_kv_blocks,
+    encode_kv_blocks,
+)
+
+__all__ = [
+    "LLMConfig",
+    "SlotBatch",
+    "SequenceState",
+    "KVBlockPool",
+    "DecodeEngine",
+    "KVDeviceWire",
+    "encode_kv_blocks",
+    "decode_kv_blocks",
+    "LLMPrefill",
+    "LLMDecode",
+    "build_llm_app",
+]

@@ -1,0 +1,355 @@
+"""DecodeEngine — the resident continuous-batching decode loop.
+
+The serve-plane analogue of the rtdag executor's ``StageLoop`` (PR 15):
+one resident loop per decode replica, riding the rtdag channel family —
+admission is a bounded ``LocalChannel``, per-sequence token streams are
+``LocalChannel``s, and the prefill KV handoff arrives over the inline or
+device wire (wire.py). Every iteration:
+
+1. admit newly-arrived sequences into free slots (continuous batching —
+   no batch boundaries; `serve/batching.py` waits for a flush, this
+   admits mid-flight),
+2. page their prefill KV into the paged block pool,
+3. evict deadline-expired sequences,
+4. run ONE decode step over the active slots at the covering padded
+   bucket shape (bounded recompilation),
+5. append/stream tokens and evict completed sequences (their slots are
+   free for step 1 of the *next* iteration),
+6. export per-iteration slot-occupancy + KV-block gauges (satellite 2).
+
+Steady state is pure in-process work — channel ops, pool arithmetic,
+the model step. Zero controller RPCs per iteration, which the release
+bench gates exactly like ``compiled_dag_overhead`` does for rtdag.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+import uuid
+
+from ray_tpu import exceptions
+from ray_tpu._private import chaos
+from ray_tpu.dag.channels import LocalChannel
+from ray_tpu.serve.llm.batch import SequenceState, SlotBatch
+from ray_tpu.serve.llm.config import LLMConfig
+from ray_tpu.serve.llm.kv import KVBlockPool
+
+logger = logging.getLogger(__name__)
+
+
+class DecodeEngine:
+    """Slot-based continuous batching over rtdag channels. Single-owner:
+    all state is touched only from the hosting replica's event loop."""
+
+    # Idle admission wait when the batch is empty (engine parked).
+    IDLE_POLL_S = 0.1
+
+    def __init__(self, config: LLMConfig, model, *, deployment: str = "",
+                 replica_id: str = ""):
+        self.cfg = config
+        self.model = model
+        self.deployment = deployment
+        self.replica_id = replica_id
+        self._batch = SlotBatch(config.max_slots, config.slot_buckets)
+        self._kv = KVBlockPool(
+            config.num_kv_blocks, config.block_tokens, config.kv_dim,
+            deployment=deployment, replica_id=replica_id,
+        )
+        self._admit_chan = LocalChannel(
+            maxsize=max(1, config.max_queued_seqs),
+            group="serve_llm", label=f"admit-{replica_id}",
+        )
+        # Sequences whose KV couldn't be paged in yet (pool pressure).
+        self._deferred: list[SequenceState] = []
+        # Engine fence (PR-16 epoch analogue for token streams): every
+        # emitted token carries (fence, index). A client resuming a
+        # stream after a replica death sees a NEW fence from the retry
+        # replica and dedups by index — tokens are delivered exactly
+        # once even when decode replays from scratch.
+        self.fence = uuid.uuid4().hex[:8]
+        self._task: asyncio.Task | None = None
+        self._stopped = False
+        # Stats.
+        self.iterations = 0
+        self.admitted = 0
+        self.completed = 0
+        self.shed = 0
+        self.expired = 0
+        self._last_bucket = 0
+        self._occupancy_ewma = 0.0
+        self._iter_rate = 0.0  # iterations/s EWMA
+        self._last_iter_t = 0.0
+
+    # -- lifecycle ------------------------------------------------------
+    def ensure_started(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+
+    # -- admission ------------------------------------------------------
+    def retry_after_estimate(self) -> float:
+        """Seconds until a slot plausibly frees: the closest-to-done
+        active sequence's remaining tokens at the observed iteration
+        rate. This seeds the shed response's Retry-After hint (capped by
+        the caller's remaining deadline budget at the proxy)."""
+        active = self._batch.active()
+        if not active or self._iter_rate <= 0:
+            return 0.05
+        remaining = min(
+            s.max_tokens - len(s.generated) for _, s in active
+        )
+        return max(0.01, remaining / self._iter_rate)
+
+    async def submit(self, seq: SequenceState) -> SequenceState:
+        """Admit a sequence into the engine, shedding fast when the
+        running batch AND the admission queue are full — the router's
+        retry/backoff (or the proxy's 503 + Retry-After) handles it."""
+        self.ensure_started()
+        backlog = (
+            self._admit_chan.qsize() + len(self._deferred)
+        )
+        if (
+            self._batch.free_count() == 0
+            and backlog >= self.cfg.max_queued_seqs
+        ):
+            self.shed += 1
+            est = self.retry_after_estimate()
+            raise exceptions.RequestShedError(
+                f"decode batch full ({self._batch.occupancy()} slots, "
+                f"{backlog} queued); retry_after_s={est:.3f}",
+                retry_after_s=est,
+            )
+        if seq.out_chan is None:
+            seq.future = asyncio.get_running_loop().create_future()
+        seq.admitted_at = time.monotonic()
+        await self._admit_chan.put(seq)
+        return seq
+
+    # -- the resident loop ----------------------------------------------
+    async def _loop(self) -> None:
+        logger.info(
+            "decode engine %s: resident loop up (slots=%d buckets=%s "
+            "kv_blocks=%d fence=%s)", self.replica_id, self.cfg.max_slots,
+            list(self._batch.buckets), self.cfg.num_kv_blocks, self.fence,
+        )
+        try:
+            while not self._stopped:
+                await self._iterate()
+        except asyncio.CancelledError:
+            pass
+        except Exception as exc:
+            # A decode-loop crash must not strand submitters on futures
+            # that will never resolve: fail every in-flight sequence
+            # loudly, then let the next submit() restart the loop.
+            logger.exception(
+                "decode engine %s: loop crashed", self.replica_id
+            )
+            for idx, seq in self._batch.active():
+                self._batch.evict(idx)
+                self._release(seq)
+                await self._finish_error(seq, exc)
+            for seq in self._deferred:
+                await self._finish_error(seq, exc)
+            self._deferred = []
+
+    async def _iterate(self) -> None:
+        # 1. page in deferred sequences first (they arrived earlier and
+        # eviction may have freed the pool since last iteration).
+        if self._deferred:
+            still: list[SequenceState] = []
+            for seq in self._deferred:
+                if not self._try_page_in(seq):
+                    still.append(seq)
+            self._deferred = still
+        # 2. admit arrivals into free slots. When the batch is live, wait
+        # at most admit_poll_s (admission latency is one iteration); when
+        # the engine is idle, park on the channel instead of spinning.
+        free = self._batch.free_count()
+        if free > 0:
+            busy = self._batch.occupancy() > 0 or self._deferred
+            arrivals = await self._admit_chan.pop_batch(
+                free, self.cfg.admit_poll_s if busy else self.IDLE_POLL_S
+            )
+            for seq in arrivals:
+                if not self._try_page_in(seq):
+                    self._deferred.append(seq)
+        # 3. deadline eviction — queued or running, an expired sequence
+        # wastes a slot on an answer nobody is waiting for.
+        for idx, seq in self._batch.active():
+            if seq.deadline.expired():
+                self._batch.evict(idx)
+                self._release(seq)
+                self.expired += 1
+                await self._finish_error(
+                    seq, exceptions.DeadlineExceededError(
+                        "sequence deadline expired mid-decode"
+                    ),
+                )
+        self._deferred = [
+            s for s in self._deferred
+            if not (s.deadline.expired() and self._expire_deferred(s))
+        ]
+        active = self._batch.active()
+        if not active:
+            return
+        # Chaos hook (ISSUE 13 schedule): an armed mid-decode kill takes
+        # the replica down between iterations — the handle's death retry
+        # re-prefills on a sibling and the stream fence dedups tokens.
+        try:
+            chaos.failpoint("serve.llm.decode_iter")
+        except chaos.ChaosFault:
+            os._exit(1)
+        # 4. one decode step over the active slots at the covering
+        # padded bucket (bounded recompilation), KV pages gathered from
+        # the paged pool.
+        bucket = self._batch.bucket_for(len(active))
+        if bucket != self._last_bucket:
+            from ray_tpu.serve import batching
+
+            batching.note_warm_shape(f"llm:{bucket}")
+            self._last_bucket = bucket
+        seqs = [s for _, s in active]
+        kv_pages = [self._kv.read(s.kv_blocks) for s in seqs]
+        tokens = self.model.decode_step(seqs, kv_pages, bucket)
+        # 5. append/stream tokens; evict completed sequences.
+        for (idx, seq), tok in zip(active, tokens):
+            seq.generated.append(int(tok))
+            if seq.out_chan is not None:
+                await seq.out_chan.put({
+                    "i": len(seq.generated) - 1, "t": int(tok),
+                    "fence": self.fence,
+                })
+            if seq.done():
+                self._batch.evict(idx)
+                self._release(seq)
+                self.completed += 1
+                await self._finish_ok(seq)
+        # 6. per-iteration bookkeeping + gauges (satellite 2).
+        self.iterations += 1
+        now = time.monotonic()
+        if self._last_iter_t:
+            dt = max(1e-6, now - self._last_iter_t)
+            self._iter_rate = 0.9 * self._iter_rate + 0.1 / dt
+        self._last_iter_t = now
+        occ = len(active)
+        self._occupancy_ewma = 0.9 * self._occupancy_ewma + 0.1 * occ
+        self._export_gauges(occ, bucket)
+        await asyncio.sleep(0)
+
+    # -- sequence completion --------------------------------------------
+    def _try_page_in(self, seq: SequenceState) -> bool:
+        if self._batch.free_count() == 0:
+            return False
+        n = self._kv.blocks_needed(len(seq.prompt_tokens))
+        ids = self._kv.alloc(n)
+        if ids is None:
+            return False
+        if seq.kv_data is not None:
+            self._kv.write(ids, seq.kv_data)
+            seq.kv_data = None
+        seq.kv_blocks = ids
+        self._batch.admit(seq)
+        self.admitted += 1
+        if seq.model_id:
+            from ray_tpu.serve import multiplex
+
+            multiplex.pin_model(seq.model_id)
+        return True
+
+    def _release(self, seq: SequenceState) -> None:
+        if seq.kv_blocks:
+            self._kv.release(seq.kv_blocks)
+            seq.kv_blocks = []
+        if seq.model_id:
+            from ray_tpu.serve import multiplex
+
+            multiplex.unpin_model(seq.model_id)
+
+    def _expire_deferred(self, seq: SequenceState) -> bool:
+        self.expired += 1
+        task = asyncio.get_running_loop().create_task(
+            self._finish_error(seq, exceptions.DeadlineExceededError(
+                "sequence deadline expired before a KV page freed"
+            ))
+        )
+        # Keep a strong ref until it runs (create_task result unused
+        # otherwise gets GC'd mid-flight).
+        task.add_done_callback(lambda _t: None)
+        return True
+
+    async def _finish_ok(self, seq: SequenceState) -> None:
+        if seq.out_chan is not None:
+            await seq.out_chan.put({
+                "done": True, "n": len(seq.generated), "fence": self.fence,
+            })
+        elif seq.future is not None and not seq.future.done():
+            seq.future.set_result({
+                "request_id": seq.request_id,
+                "tokens": list(seq.generated),
+                "fence": self.fence,
+            })
+
+    async def _finish_error(self, seq: SequenceState, exc: Exception) -> None:
+        if seq.out_chan is not None:
+            await seq.out_chan.put({
+                "error": f"{type(exc).__name__}: {exc}",
+                "fence": self.fence,
+            })
+        elif seq.future is not None and not seq.future.done():
+            seq.future.set_exception(exc)
+
+    # -- observability --------------------------------------------------
+    def _export_gauges(self, occupancy: int, bucket: int) -> None:
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+
+            metrics_mod.set_serve_replica_gauge(
+                "slot_occupancy", self.deployment, self.replica_id,
+                occupancy,
+            )
+            self._kv.export_gauges()
+        except Exception:  # rtlint: disable=swallowed-exception - metric export must never stall the decode loop
+            pass
+
+    def queue_depth(self) -> int:
+        return self._admit_chan.qsize() + len(self._deferred)
+
+    def stats(self) -> dict:
+        """Per-iteration view for replica.get_metrics(): slot occupancy
+        replaces the batch-boundary occupancy the PR-8 gauge read."""
+        occ = self._batch.occupancy()
+        bucket = self._batch.bucket_for(occ) if occ else 0
+        return {
+            "slot_occupancy": occ,
+            "slot_occupancy_frac": (occ / bucket) if bucket else 0.0,
+            "avg_slot_occupancy": round(self._occupancy_ewma, 3),
+            "decode_bucket": bucket,
+            "iterations": self.iterations,
+            "iter_rate_s": round(self._iter_rate, 3),
+            "admitted": self.admitted,
+            "completed": self.completed,
+            "shed": self.shed,
+            "expired": self.expired,
+            "queue_depth": self.queue_depth(),
+            "kv_blocks_used": self._kv.used(),
+            "kv_blocks_free": self._kv.free(),
+            "kv_free_frac": round(self._kv.free_frac(), 4),
+            "fence": self.fence,
+        }
+
+    def load(self) -> dict:
+        """Autoscaler inputs (tentpole d): ongoing slots + queued
+        sequences, and the KV-pool free fraction — the decode twin's
+        HBM-headroom signal (PR-5's oom-risk analogue)."""
+        return {
+            "ongoing": self._batch.occupancy(),
+            "queue_depth": self.queue_depth(),
+            "kv_free_frac": self._kv.free_frac(),
+        }

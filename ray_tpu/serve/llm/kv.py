@@ -1,0 +1,84 @@
+"""Paged KV-block pool — one per decode replica (ISSUE 17 tentpole b).
+
+A fixed arena of fixed-size KV blocks (vLLM's PagedAttention layout in
+miniature): sequences allocate whole blocks at admission and free them
+at eviction, so fragmentation is impossible by construction and "HBM
+headroom" is a single number — the free-block fraction — which feeds
+both the `rt_serve_kv_blocks_{used,free}` gauges (satellite 2) and the
+autoscaler's kv_headroom_min input (tentpole d).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class KVBlockPool:
+    """Fixed arena of ``num_blocks`` blocks of ``block_tokens * kv_dim``
+    float32 each. Not thread-safe: the decode engine is the only caller
+    and runs on one event loop."""
+
+    def __init__(self, num_blocks: int, block_tokens: int, kv_dim: int,
+                 *, deployment: str = "", replica_id: str = ""):
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        self.kv_dim = int(kv_dim)
+        self.block_elems = self.block_tokens * self.kv_dim
+        self._arena = np.zeros(
+            (self.num_blocks, self.block_elems), dtype=np.float32
+        )
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._deployment = deployment
+        self._replica_id = replica_id
+
+    # -- accounting -----------------------------------------------------
+    def used(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def free(self) -> int:
+        return len(self._free)
+
+    def free_frac(self) -> float:
+        return len(self._free) / max(1, self.num_blocks)
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return max(1, -(-int(n_tokens) // self.block_tokens))
+
+    # -- alloc/free -----------------------------------------------------
+    def alloc(self, n_blocks: int) -> Optional[List[int]]:
+        """n block ids, or None when the pool can't cover the request —
+        the engine defers the sequence rather than partially allocating."""
+        if n_blocks > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n_blocks)]
+        return ids
+
+    def release(self, block_ids: List[int]) -> None:
+        for bid in block_ids:
+            self._arena[bid].fill(0.0)
+            self._free.append(bid)
+
+    # -- data -----------------------------------------------------------
+    def write(self, block_ids: List[int], kv: np.ndarray) -> None:
+        """Page a sequence's prefill KV ((n_tokens, kv_dim) float32) into
+        its allocated blocks, zero-padding the tail block."""
+        flat = np.asarray(kv, dtype=np.float32).reshape(-1)
+        for i, bid in enumerate(block_ids):
+            chunk = flat[i * self.block_elems:(i + 1) * self.block_elems]
+            self._arena[bid, : chunk.size] = chunk
+            if chunk.size < self.block_elems:
+                self._arena[bid, chunk.size:] = 0.0
+
+    def read(self, block_ids: List[int]) -> np.ndarray:
+        """The sequence's KV pages, stacked (n_blocks, block_elems)."""
+        return self._arena[np.asarray(block_ids, dtype=np.intp)]
+
+    # -- observability (satellite 2) ------------------------------------
+    def export_gauges(self) -> None:
+        from ray_tpu.util.metrics import set_serve_kv_blocks
+
+        set_serve_kv_blocks(
+            self._deployment, self._replica_id, self.used(), self.free()
+        )

@@ -1,0 +1,108 @@
+"""KV-block wire: prefill → decode handoff payloads (tentpole b).
+
+Two transports share one codec:
+
+* **inline** — the KV payload rides the prefill RPC reply (the decode
+  replica calls the prefill pool through a DeploymentHandle and the
+  encoded blocks come back in the result). Always available; this is
+  what the release bench runs.
+* **device** — ``KVDeviceWire``: the payload moves worker→worker over
+  the collective p2p ring (the PR-15 device-channel plane), tagged
+  ``kvblk:p{epoch}:e{src}:{dst}:{seq}`` with all-integer holes so the
+  static commgraph extractor folds every KV wire to one certified
+  skeleton, and the epoch hole fences pre-crash frames out of re-opened
+  wires exactly like rtdag's ``dagch:`` tags (PR-16): a frame sent
+  before a recovery epoch bump lands in a mailbox no post-recovery pop
+  ever reads.
+
+Payloads are block-scale quantized with the PR-7 codec when the config
+carries a wire quantize mode; ``kv_wire_quantize=None`` is the exact-
+wire fallback knob. Error feedback stays off — a KV handoff is one-shot,
+residuals would never be consumed again.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.util.collective import flight
+
+# Self-describing payload markers (same idiom as the pipeline activation
+# wire's "__act" envelope, so mixed exact/quantized wires share one
+# decode path).
+_KV_EXACT = "__kv_exact"
+_KV_Q = "__kv_q"
+
+
+def encode_kv_blocks(kv: np.ndarray, wire_cfg=None) -> tuple:
+    """(marker, shape, payload): exact float32 bytes, or the PR-7
+    block-scaled encoding when ``wire_cfg`` requests quantization."""
+    kv = np.ascontiguousarray(kv, dtype=np.float32)
+    if wire_cfg is None or not getattr(wire_cfg, "quantize", None):
+        return (_KV_EXACT, kv.shape, kv)
+    from ray_tpu.util.collective.quantization import encode
+
+    return (_KV_Q, kv.shape, encode(kv.reshape(-1), wire_cfg))
+
+
+def decode_kv_blocks(payload: tuple) -> np.ndarray:
+    marker, shape, data = payload
+    if marker == _KV_EXACT:
+        return np.asarray(data, dtype=np.float32).reshape(shape)
+    if marker == _KV_Q:
+        from ray_tpu.util.collective.quantization import decode
+
+        return decode(data).reshape(shape).astype(np.float32)
+    raise ValueError(f"unknown KV wire marker: {marker!r}")
+
+
+def wire_error(original: np.ndarray, payload: tuple) -> float:
+    """Mean |roundtrip - original| — the KV wire fidelity stat the decode
+    engine reports (quantized wires must stay near-exact; the exact wire
+    must be exactly zero)."""
+    back = decode_kv_blocks(payload)
+    return float(np.mean(np.abs(back - np.asarray(original, np.float32))))
+
+
+class KVDeviceWire:
+    """One prefill→decode edge on the collective p2p plane.
+
+    ``src``/``dst`` are the wire's rank endpoints inside the group,
+    ``epoch`` is the channel epoch (bumped by the supervisor on replica
+    recovery — see ``bump_epoch``), and ``seq`` is the per-wire handoff
+    ordinal. The tag skeleton has all-integer holes, so commgraph folds
+    every call site to ``kvblk:p{}:e{}:{}:{}`` and certifies the push
+    against the pop like any rtdag device edge.
+    """
+
+    def __init__(self, group, peer: int, *, src: int = 0, dst: int = 1,
+                 epoch: int = 0, wire_cfg=None):
+        self._group = group
+        self._peer = peer
+        self._src = src
+        self._dst = dst
+        self._wire_cfg = wire_cfg
+        self.epoch = epoch
+
+    def bump_epoch(self) -> None:
+        """Fence the wire after a peer recovery: frames tagged with the
+        old epoch become unreadable by construction, so a replayed
+        handoff is delivered exactly once (PR-16 semantics)."""
+        self.epoch += 1
+
+    def push(self, seq: int, kv: np.ndarray) -> None:
+        payload = encode_kv_blocks(kv, self._wire_cfg)
+        with flight.site("serve_llm"):
+            self._group.send(
+                payload, self._peer,
+                tag=f"kvblk:p{self.epoch}:e{self._src}:{self._dst}:{seq}",
+            )
+
+    def pop(self, seq: int, *, timeout: float = 60.0) -> np.ndarray:
+        with flight.site("serve_llm"):
+            payload = self._group.recv(
+                self._peer,
+                tag=f"kvblk:p{self.epoch}:e{self._src}:{self._dst}:{seq}",
+                timeout=timeout,
+            )
+        return decode_kv_blocks(payload)

@@ -29,6 +29,99 @@ def get_multiplexed_model_id() -> str:
 # checkpoint its loaded models before the process exits (ISSUE 13).
 _ALL_CACHES: list = []
 
+# Active-use pins (ISSUE 17 satellite 6): a model serving a live token
+# stream or holding decode-engine slots must not be LRU-evicted mid-
+# stream — its KV state and checkpoint would race the stream's writes.
+# Pinned models survive the eviction scan; the eviction they dodged is
+# recorded and replayed when the last pin releases, so the cache still
+# converges to its LRU bound.
+_PINS: dict[str, int] = {}
+_DEFERRED: list = []  # (cache, max_models) pairs still over budget
+
+
+def pin_model(model_id: str) -> None:
+    """Mark a multiplexed model as in active use (stream open / engine
+    slots resident). Idempotent across concurrent streams — each pin
+    needs a matching :func:`unpin_model`."""
+    if model_id:
+        _PINS[model_id] = _PINS.get(model_id, 0) + 1
+
+
+def unpin_model(model_id: str) -> None:
+    """Release one pin; when the last pin on the last over-budget model
+    drops, any eviction deferred by :func:`pin_model` runs (checkpoint-
+    then-unload, same as a live eviction)."""
+    if not model_id:
+        return
+    remaining = _PINS.get(model_id, 0) - 1
+    if remaining > 0:
+        _PINS[model_id] = remaining
+        return
+    _PINS.pop(model_id, None)
+    if _DEFERRED:
+        _schedule_deferred_evictions()
+
+
+def pinned_models() -> dict[str, int]:
+    """Snapshot of model_id -> pin count (test/debug surface)."""
+    return dict(_PINS)
+
+
+async def _checkpoint_evict(cache, max_models: int,
+                            protect: frozenset = frozenset()) -> None:
+    """Evict LRU-first down to ``max_models``, skipping pinned models
+    and ``protect`` (the model being loaded right now — it is about to
+    be handed to the caller). Eviction is checkpoint-then-unload: the
+    model's state is durable before its memory is released. If pins
+    keep the cache over budget, the remainder is deferred to the next
+    unpin."""
+    import logging
+
+    for model_id in list(cache.keys()):
+        if len(cache) <= max_models:
+            break
+        if _PINS.get(model_id) or model_id in protect:
+            continue
+        model = cache.pop(model_id)
+        for hook_name in ("checkpoint", "__serve_checkpoint__"):
+            hook = getattr(model, hook_name, None)
+            if hook is not None:
+                try:
+                    result = hook()
+                    if inspect.iscoroutine(result):
+                        await result
+                except Exception as exc:
+                    logging.getLogger(__name__).warning(
+                        "checkpoint of evicted model %r failed: %s",
+                        model_id, exc,
+                    )
+                break
+        unload = getattr(model, "unload", None) or getattr(
+            model, "__serve_unload__", None
+        )
+        if unload is not None:
+            result = unload()
+            if inspect.iscoroutine(result):
+                await result
+    if len(cache) > max_models:
+        entry = (cache, max_models)
+        if entry not in [(c, m) for c, m in _DEFERRED]:
+            _DEFERRED.append(entry)
+
+
+async def _drain_deferred_evictions() -> None:
+    pending, _DEFERRED[:] = list(_DEFERRED), []
+    for cache, max_models in pending:
+        await _checkpoint_evict(cache, max_models)
+
+
+def _schedule_deferred_evictions() -> None:
+    try:
+        asyncio.get_running_loop().create_task(_drain_deferred_evictions())
+    except RuntimeError:
+        # No running loop (sync unpin path, e.g. tests): drain inline.
+        asyncio.run(_drain_deferred_evictions())
+
 
 async def checkpoint_loaded_models() -> int:
     """Call ``checkpoint``/``__serve_checkpoint__`` on every model loaded
@@ -84,15 +177,10 @@ def multiplexed(
                 if inspect.iscoroutine(model):
                     model = await model
                 cache[model_id] = model
-                while len(cache) > max_num_models_per_replica:
-                    _, evicted = cache.popitem(last=False)
-                    unload = getattr(evicted, "unload", None) or getattr(
-                        evicted, "__serve_unload__", None
-                    )
-                    if unload is not None:
-                        result = unload()
-                        if inspect.iscoroutine(result):
-                            await result
+                await _checkpoint_evict(
+                    cache, max_num_models_per_replica,
+                    protect=frozenset((model_id,)),
+                )
                 return model
 
         return wrapper

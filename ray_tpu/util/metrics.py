@@ -59,9 +59,18 @@ def _flush_at_exit() -> None:
         )
 
 
+# Uplink RPCs issued by flush() since process start — observability
+# for steady-state RPC accounting (one kv_multi_put per flush interval
+# regardless of traffic; serve_llm's `steady_rpc_probe` attributes
+# background uplinks by RPC method name when isolating request-path
+# controller calls).
+flush_rpcs_total = 0
+
+
 def flush() -> None:
     """Push pending metric points to the controller KV — the whole tick
     rides ONE kv_multi_put RPC, not one kv_put per series."""
+    global flush_rpcs_total
     with _local_lock:
         points = dict(_pending)
         _pending.clear()
@@ -75,6 +84,7 @@ def flush() -> None:
         {"key": key, "value": json.dumps(point).encode()}
         for key, point in points.items()
     ]
+    flush_rpcs_total += 1
     ctx.io.run(
         ctx.controller.call(
             "kv_multi_put",
@@ -347,6 +357,17 @@ def set_serve_replica_gauge(
     gauge.set(
         float(value), tags={"deployment": deployment, "replica": replica_id}
     )
+
+
+def set_serve_kv_blocks(
+    deployment: str, replica_id: str, used: int, free: int
+) -> None:
+    """rt_serve_kv_blocks_used / rt_serve_kv_blocks_free {deployment,
+    replica}: the decode replica's paged-KV pool headroom (ISSUE 17
+    satellite 2) — the memory signal behind the serve_llm autoscaler's
+    kv_headroom_min floor."""
+    set_serve_replica_gauge("kv_blocks_used", deployment, replica_id, used)
+    set_serve_replica_gauge("kv_blocks_free", deployment, replica_id, free)
 
 
 # ---------------------------------------------------------------------------

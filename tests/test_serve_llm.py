@@ -1,0 +1,695 @@
+"""serve/llm tests (ISSUE 17) — continuous batching, disaggregated
+prefill/decode, affinity routing, KV-headroom autoscaling.
+
+Layers:
+  * pure: HashRing rendezvous stability under membership churn
+    (satellite 1), SlotBatch/KVBlockPool mechanics, KV wire codec +
+    device-wire epoch fencing, autoscaling kv_headroom_min floor,
+  * asyncio: DecodeEngine continuous admission / deadline eviction /
+    fast shed with Retry-After, multiplex pin-defers-eviction
+    regression (satellite 6),
+  * e2e: disaggregated app through a real controller + replicas
+    (deterministic tokens, streaming, zero-controller-RPC steady state,
+    batch-full fast 503 + Retry-After ≤ remaining budget),
+  * slow: mid-stream decode-replica kill → exactly-once tokens via the
+    engine fence (satellite 3), run via ci/run_serve_llm_bench.sh.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu._private import chaos as chaos_core
+from ray_tpu.serve import multiplex
+from ray_tpu.serve._private.common import AutoscalingConfig, Deadline
+from ray_tpu.serve._private.routing import HashRing
+from ray_tpu.serve.llm import (
+    DecodeEngine,
+    KVBlockPool,
+    KVDeviceWire,
+    LLMConfig,
+    SequenceState,
+    SlotBatch,
+    build_llm_app,
+    decode_kv_blocks,
+    encode_kv_blocks,
+)
+from ray_tpu.serve.llm.deployments import ToyLM, _digest, tokenize
+from ray_tpu.serve.llm.wire import wire_error
+
+
+@pytest.fixture(autouse=True)
+def _clean_multiplex_pins():
+    """Pin state is process-global; every test starts and ends clean."""
+    multiplex._PINS.clear()
+    multiplex._DEFERRED.clear()
+    yield
+    multiplex._PINS.clear()
+    multiplex._DEFERRED.clear()
+
+
+def _expected_tokens(prompt, n, model_id="", vocab=32000):
+    toks = tokenize(prompt)
+    return [
+        _digest(model_id, tuple(toks), i) % vocab for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: consistent-hash ring (rendezvous) stability
+# ---------------------------------------------------------------------------
+
+def test_hash_ring_deterministic_and_spread():
+    members = [f"replica-{i}" for i in range(5)]
+    ring = HashRing(members)
+    keys = [f"session-{i}" for i in range(500)]
+    first = {k: ring.pick(k) for k in keys}
+    # Deterministic: same key -> same member, every time.
+    assert all(ring.pick(k) == first[k] for k in keys)
+    # Spread: every member owns a non-trivial share (rendezvous hashing
+    # is near-uniform; 500 keys over 5 members ~ 100 each).
+    counts = {m: 0 for m in members}
+    for k in keys:
+        counts[first[k]] += 1
+    assert all(c > 40 for c in counts.values()), counts
+
+
+def test_hash_ring_stability_on_add_remove():
+    members = [f"replica-{i}" for i in range(5)]
+    ring = HashRing(members)
+    keys = [f"session-{i}" for i in range(600)]
+    before = {k: ring.pick(k) for k in keys}
+
+    # Add a member: only ~1/(n+1) of keys may move, all of them TO the
+    # new member (the rendezvous property serve relies on: scaling up
+    # doesn't reshuffle existing sessions between old replicas).
+    ring.update(members + ["replica-5"])
+    moved = 0
+    for k in keys:
+        now = ring.pick(k)
+        if now != before[k]:
+            assert now == "replica-5", "moved key landed on an OLD member"
+            moved += 1
+    assert 0 < moved < len(keys) * 0.35, moved
+
+    # Remove a member: only keys it owned remap; everyone else's
+    # session stays put (KV-affinity survives a downscale).
+    ring.update(members[1:])
+    for k in keys:
+        if before[k] != "replica-0":
+            assert ring.pick(k) == before[k]
+
+
+def test_hash_ring_bounded_load_fallback():
+    ring = HashRing(["a", "b", "c"])
+    key = "hot-session"
+    favorite = ring.pick(key)
+    others = [m for m in ring.members if m != favorite]
+    # Favorite saturated: the pick walks down the preference order.
+    load = {favorite: 10}
+    spill = ring.pick(key, load=load, max_load=10)
+    assert spill in others
+    assert spill == ring.rank(key)[1]  # next preference, not random
+    # Everyone saturated: least-loaded wins rather than failing.
+    load = {"a": 7, "b": 5, "c": 9}
+    assert ring.pick(key, load=load, max_load=3) == "b"
+    # Empty ring.
+    assert HashRing().pick(key) is None
+
+
+# ---------------------------------------------------------------------------
+# tentpole a: slot batch + paged KV pool mechanics
+# ---------------------------------------------------------------------------
+
+def test_slot_batch_admit_evict_buckets():
+    batch = SlotBatch(8, buckets=(2, 4, 8))
+    assert batch.free_count() == 8
+    seqs = [
+        SequenceState(request_id=f"r{i}", prompt_tokens=[1], max_tokens=1)
+        for i in range(3)
+    ]
+    idxs = [batch.admit(s) for s in seqs]
+    assert batch.occupancy() == 3
+    assert batch.bucket_for(3) == 4 and batch.bucket_for(1) == 2
+    assert batch.bucket_for(5) == 8
+    # Evict the middle slot; the freed slot is reused by the next admit
+    # (continuous batching: completion frees capacity mid-flight).
+    batch.evict(idxs[1])
+    assert batch.occupancy() == 2
+    again = SequenceState(request_id="r9", prompt_tokens=[1], max_tokens=1)
+    assert batch.admit(again) == idxs[1]
+    # active() is slot-ordered (stable padded layout).
+    assert [i for i, _ in batch.active()] == sorted(idxs)
+
+
+def test_kv_block_pool_roundtrip_and_all_or_nothing():
+    pool = KVBlockPool(4, block_tokens=4, kv_dim=2)  # 8 elems per block
+    kv = np.arange(10 * 2, dtype=np.float32).reshape(10, 2)  # 20 elems
+    n = pool.blocks_needed(10)
+    assert n == 3
+    ids = pool.alloc(n)
+    pool.write(ids, kv)
+    pages = pool.read(ids)
+    assert pages.shape == (3, 8)
+    np.testing.assert_array_equal(pages.reshape(-1)[:20], kv.reshape(-1))
+    assert float(pages.reshape(-1)[20:].sum()) == 0.0  # tail zero-pad
+    assert pool.used() == 3 and pool.free() == 1
+    # All-or-nothing: 2 blocks requested, 1 free -> None, nothing leaks.
+    assert pool.alloc(2) is None
+    assert pool.free() == 1
+    pool.release(ids)
+    assert pool.free() == 4 and pool.free_frac() == 1.0
+    assert float(pool.read(ids).sum()) == 0.0  # released blocks scrubbed
+
+
+# ---------------------------------------------------------------------------
+# tentpole b: KV wire codec + device-wire epoch fencing
+# ---------------------------------------------------------------------------
+
+def test_kv_wire_exact_and_quantized():
+    cfg = LLMConfig(kv_wire_quantize="int8", kv_wire_block=32)
+    kv = ToyLM(cfg).prefill(tokenize("the quick brown fox"), "m1")
+    exact = encode_kv_blocks(kv, None)
+    np.testing.assert_array_equal(decode_kv_blocks(exact), kv)
+    assert wire_error(kv, exact) == 0.0
+    quant = encode_kv_blocks(kv, cfg.wire_config())
+    # Block-scaled int8 on smooth [-1, 1] KV: small but non-zero error.
+    err = wire_error(kv, quant)
+    assert 0.0 < err < 0.02
+    with pytest.raises(ValueError):
+        decode_kv_blocks(("__bogus", kv.shape, kv))
+
+
+class _MailboxGroup:
+    """Fake p2p group: tag-addressed one-shot mailboxes, like the real
+    collective transport's tagged send/recv."""
+
+    def __init__(self):
+        self.box = {}
+
+    def send(self, payload, peer, *, tag):
+        self.box[tag] = payload
+
+    def recv(self, peer, *, tag, timeout=None):
+        if tag not in self.box:
+            raise TimeoutError(f"no frame for tag {tag!r}")
+        return self.box.pop(tag)
+
+
+def test_kv_device_wire_epoch_fencing():
+    group = _MailboxGroup()
+    cfg = LLMConfig(kv_wire_quantize=None)
+    tx = KVDeviceWire(group, peer=1, src=0, dst=1, wire_cfg=cfg.wire_config())
+    rx = KVDeviceWire(group, peer=0, src=0, dst=1)
+    kv = ToyLM(cfg).prefill(tokenize("fence me"), "")
+    tx.push(7, kv)
+    assert "kvblk:p0:e0:1:7" in group.box  # the certified tag skeleton
+    np.testing.assert_array_equal(rx.pop(7), kv)
+    # Pre-crash frame + epoch bump on the receiver: the stale frame is
+    # unreadable by construction (PR-16 exactly-once semantics) and the
+    # replayed handoff on the new epoch is the one delivered.
+    tx.push(8, kv)
+    rx.bump_epoch()
+    with pytest.raises(TimeoutError):
+        rx.pop(8, timeout=0.01)
+    tx.bump_epoch()
+    tx.push(8, kv * 2.0)
+    np.testing.assert_array_equal(rx.pop(8), kv * 2.0)
+    assert "kvblk:p0:e0:1:8" in group.box  # the fenced frame rots unread
+
+
+# ---------------------------------------------------------------------------
+# tentpole a: the decode engine (pure asyncio, no cluster)
+# ---------------------------------------------------------------------------
+
+def _make_seq(cfg, model, prompt, max_tokens, *, model_id="",
+              deadline=None, request_id=None):
+    toks = tokenize(prompt)
+    return SequenceState(
+        request_id=request_id or prompt,
+        prompt_tokens=toks,
+        max_tokens=max_tokens,
+        model_id=model_id,
+        kv_data=model.prefill(toks, model_id),
+        deadline=deadline or Deadline.never(),
+    )
+
+
+def test_engine_continuous_admission():
+    """Sequences submitted mid-decode join the running batch at the next
+    iteration — no batch boundary. If admission waited for the first
+    wave to drain (batching.py semantics) the loop would need ~2x the
+    iterations; continuous batching overlaps the waves."""
+    cfg = LLMConfig(max_slots=8, num_kv_blocks=128, slot_buckets=(4, 8))
+
+    async def main():
+        model = ToyLM(cfg)
+        eng = DecodeEngine(cfg, model)
+        wave1 = [_make_seq(cfg, model, f"w1-{i}", 12) for i in range(3)]
+        for s in wave1:
+            await eng.submit(s)
+        # Let the first wave get a few iterations in, then pile on.
+        while eng.iterations < 3:
+            await asyncio.sleep(0.005)
+        wave2 = [_make_seq(cfg, model, f"w2-{i}", 12) for i in range(3)]
+        for s in wave2:
+            await eng.submit(s)
+        results = await asyncio.gather(
+            *(s.future for s in wave1 + wave2)
+        )
+        eng.stop()
+        return eng, results
+
+    eng, results = asyncio.run(main())
+    assert eng.admitted == 6 and eng.completed == 6
+    for seq, res in zip(["w1-0", "w1-1", "w1-2", "w2-0", "w2-1", "w2-2"],
+                        results):
+        assert res["tokens"] == _expected_tokens(seq, 12)
+    # Overlapped waves: well under the ~24 iterations serial execution
+    # would need (wave2 rode wave1's in-flight iterations).
+    assert eng.iterations < 20, eng.iterations
+
+
+def test_engine_deadline_eviction_and_kv_release():
+    cfg = LLMConfig(max_slots=4, num_kv_blocks=32)
+
+    async def main():
+        model = ToyLM(cfg)
+        eng = DecodeEngine(cfg, model)
+        doomed = _make_seq(cfg, model, "doomed", 10_000,
+                           deadline=Deadline.after(0.05))
+        fine = _make_seq(cfg, model, "fine", 5)
+        await eng.submit(doomed)
+        await eng.submit(fine)
+        ok = await fine.future
+        with pytest.raises(exceptions.DeadlineExceededError):
+            await asyncio.wait_for(doomed.future, timeout=5.0)
+        eng.stop()
+        return eng, ok
+
+    eng, ok = asyncio.run(main())
+    assert ok["tokens"] == _expected_tokens("fine", 5)
+    assert eng.expired == 1
+    # The evicted sequence's KV pages went back to the pool.
+    assert eng.stats()["kv_blocks_used"] == 0
+
+
+def test_engine_sheds_fast_when_full_with_retry_after():
+    """Batch full + admission queue full -> immediate RequestShedError
+    carrying a slot-free projection, both as an attribute and embedded
+    in the message (the handle recovers it across the actor wire)."""
+    cfg = LLMConfig(max_slots=2, num_kv_blocks=64, max_queued_seqs=2)
+
+    async def main():
+        model = ToyLM(cfg)
+        eng = DecodeEngine(cfg, model)
+        hogs = [_make_seq(cfg, model, f"hog-{i}", 100_000)
+                for i in range(2)]
+        for s in hogs:
+            await eng.submit(s)
+        while eng.stats()["slot_occupancy"] < 2:
+            await asyncio.sleep(0.005)
+        for i in range(2):  # fill the admission queue
+            await eng.submit(_make_seq(cfg, model, f"q-{i}", 100_000))
+        t0 = time.monotonic()
+        with pytest.raises(exceptions.RequestShedError) as exc_info:
+            await eng.submit(_make_seq(cfg, model, "straw", 4))
+        elapsed = time.monotonic() - t0
+        eng.stop()
+        return exc_info.value, elapsed, eng
+
+    exc, elapsed, eng = asyncio.run(main())
+    assert elapsed < 0.5  # fast shed, not a queue-to-death timeout
+    assert exc.retry_after_s > 0
+    assert f"retry_after_s={exc.retry_after_s:.3f}" in str(exc)
+    assert eng.shed == 1
+
+
+def test_engine_fence_dedup_across_replay():
+    """Replayed decode on a fresh engine (new fence) reproduces byte-
+    identical tokens; a client deduping by index sees each token exactly
+    once even when it consumed a partial stream before the crash."""
+    cfg = LLMConfig(max_slots=4, num_kv_blocks=32)
+
+    async def run_stream(eng, model, n_tokens):
+        from ray_tpu.dag.channels import LocalChannel
+
+        seq = _make_seq(cfg, model, "replay me", n_tokens)
+        seq.out_chan = LocalChannel(maxsize=n_tokens + 8, group="serve_llm",
+                                    label="t-replay")
+        await eng.submit(seq)
+        events = []
+        while True:
+            got = await seq.out_chan.pop_batch(64, 2.0)
+            assert got, "stream stalled"
+            for ev in got:
+                if ev.get("done"):
+                    return events
+                events.append(ev)
+
+    async def main():
+        model = ToyLM(cfg)
+        eng1 = DecodeEngine(cfg, model)
+        eng2 = DecodeEngine(cfg, model)  # the "restarted replica"
+        first = await run_stream(eng1, model, 10)
+        second = await run_stream(eng2, model, 10)
+        eng1.stop()
+        eng2.stop()
+        return eng1, eng2, first, second
+
+    eng1, eng2, first, second = asyncio.run(main())
+    assert eng1.fence != eng2.fence
+    # Client crashed after consuming 4 tokens of the first attempt,
+    # then replayed: dedup by index reconstructs the exact sequence.
+    seen = {}
+    for ev in first[:4] + second:
+        seen.setdefault(ev["i"], set()).add(ev["t"])
+    assert sorted(seen) == list(range(10))
+    assert all(len(v) == 1 for v in seen.values())  # byte-identical replay
+    assert [next(iter(seen[i])) for i in range(10)] == _expected_tokens(
+        "replay me", 10
+    )
+
+
+# ---------------------------------------------------------------------------
+# satellite 6: multiplex pin defers checkpoint-evict until streams drain
+# ---------------------------------------------------------------------------
+
+def test_multiplex_pin_defers_eviction_until_unpin():
+    events = []
+
+    class Model:
+        def __init__(self, mid):
+            self.mid = mid
+
+        def checkpoint(self):
+            events.append(("checkpoint", self.mid))
+
+        def unload(self):
+            events.append(("unload", self.mid))
+
+    class Host:
+        @multiplex.multiplexed(max_num_models_per_replica=2)
+        async def load(self, mid):
+            return Model(mid)
+
+    async def main():
+        host = Host()
+        await host.load("m1")
+        await host.load("m2")
+        # Both models are mid-stream: pins defer any eviction.
+        multiplex.pin_model("m1")
+        multiplex.pin_model("m2")
+        await host.load("m3")  # over budget, but every victim is pinned
+        assert events == []  # REGRESSION: no evict while streams live
+        assert multiplex.pinned_models() == {"m1": 1, "m2": 1}
+        # Stream on m1 drains: the deferred eviction fires, checkpoint
+        # strictly before unload, and only the now-unpinned LRU goes.
+        multiplex.unpin_model("m1")
+        for _ in range(5):
+            await asyncio.sleep(0)
+        assert events == [("checkpoint", "m1"), ("unload", "m1")]
+        assert "m2" in multiplex.pinned_models()
+        # m2 still pinned and loaded: a fresh load must hit the cache
+        # (same object identity), not reload.
+        m2a = await host.load("m2")
+        m2b = await host.load("m2")
+        assert m2a is m2b
+        multiplex.unpin_model("m2")
+        for _ in range(5):
+            await asyncio.sleep(0)
+        # Within budget now (m2, m3): nothing else evicts.
+        assert events == [("checkpoint", "m1"), ("unload", "m1")]
+
+    asyncio.run(main())
+
+
+def test_multiplex_double_pin_needs_double_unpin():
+    multiplex.pin_model("m")
+    multiplex.pin_model("m")
+    multiplex.unpin_model("m")
+    assert multiplex.pinned_models() == {"m": 1}
+    multiplex.unpin_model("m")
+    assert multiplex.pinned_models() == {}
+
+
+# ---------------------------------------------------------------------------
+# tentpole d: KV-headroom autoscaling floor (pure policy math)
+# ---------------------------------------------------------------------------
+
+def test_autoscaling_kv_headroom_floor():
+    from ray_tpu.serve._private.autoscaling_policy import (
+        calculate_desired_num_replicas,
+    )
+
+    # 6 ongoing / 3 replicas at target 2.0: the request signal alone is
+    # perfectly balanced — any movement below comes from the KV floor.
+    cfg = AutoscalingConfig(
+        min_replicas=1, max_replicas=8, target_ongoing_requests=2.0,
+        kv_headroom_min=0.2,
+    )
+    # Ongoing load looks healthy, but the worst replica's KV pool is
+    # nearly full: force one replica of upscale pressure.
+    assert calculate_desired_num_replicas(
+        cfg, 6.0, 3, kv_free_frac=0.05
+    ) == 4
+    # Healthy headroom: no pressure.
+    assert calculate_desired_num_replicas(
+        cfg, 6.0, 3, kv_free_frac=0.8
+    ) == 3
+    # No headroom signal (non-LLM deployment): ignored.
+    assert calculate_desired_num_replicas(cfg, 6.0, 3) == 3
+    # Unconfigured floor: signal ignored.
+    plain = AutoscalingConfig(
+        min_replicas=1, max_replicas=8, target_ongoing_requests=2.0
+    )
+    assert calculate_desired_num_replicas(
+        plain, 6.0, 3, kv_free_frac=0.01
+    ) == 3
+    # max_replicas still clamps.
+    capped = AutoscalingConfig(
+        min_replicas=1, max_replicas=3, target_ongoing_requests=2.0,
+        kv_headroom_min=0.2,
+    )
+    assert calculate_desired_num_replicas(
+        capped, 6.0, 3, kv_free_frac=0.0
+    ) == 3
+
+
+# ---------------------------------------------------------------------------
+# e2e: the disaggregated app against a real cluster
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_instance(ray_start_shared):
+    from ray_tpu import serve
+
+    yield
+    if ray_tpu.is_initialized():  # the kill test recycles the cluster
+        serve.shutdown()
+
+
+def test_llm_app_end_to_end(serve_instance):
+    from ray_tpu import serve
+
+    app = build_llm_app(
+        {"max_slots": 16, "num_kv_blocks": 256},
+        prefill_replicas=1, decode_replicas=1,
+    )
+    handle = serve.run(app, name="llm", route_prefix="/llm")
+    # Unary: deterministic toy tokens.
+    out = handle.options(method_name="generate").remote(
+        {"prompt": "hello tpu", "max_tokens": 6}
+    ).result(timeout=60)
+    assert out["tokens"] == _expected_tokens("hello tpu", 6)
+    # Batched admission (the bench path): one prefill RPC, one wave.
+    res = handle.options(method_name="generate_batch").remote(
+        {"prompts": [f"p {i}" for i in range(8)], "max_tokens": 4}
+    ).result(timeout=60)
+    assert len(res["results"]) == 8
+    for i, r in enumerate(res["results"]):
+        assert r["tokens"] == _expected_tokens(f"p {i}", 4)
+        assert r["fence"] == res["fence"]
+    # Multiplexed model: different model id -> different tokens.
+    alt = handle.options(method_name="generate").remote(
+        {"prompt": "hello tpu", "max_tokens": 6, "model": "lora-7"}
+    ).result(timeout=60)
+    assert alt["tokens"] == _expected_tokens("hello tpu", 6, model_id="lora-7")
+    assert alt["tokens"] != out["tokens"]
+    # Both pools deployed + engine stats exposed through the replica.
+    status = serve.status()["llm"]["deployments"]
+    assert set(status) == {"llm_prefill", "llm_decode"}
+    stats = handle.options(method_name="serve_llm_stats").remote().result(timeout=30)
+    assert stats["completed"] >= 10
+    assert stats["kv_blocks_used"] == 0  # everything released
+    assert stats["fence"]
+
+
+def test_llm_streaming_through_handle(serve_instance):
+    from ray_tpu import serve
+
+    handle = serve.get_deployment_handle("llm_decode", "llm")
+    stream = handle.options(method_name="generate").remote(
+        {"prompt": "stream these", "max_tokens": 9, "stream": True}
+    ).result(timeout=60)
+    assert isinstance(stream, serve.ResponseStream)
+    events = list(stream)
+    assert [e["i"] for e in events] == list(range(9))
+    assert [e["t"] for e in events] == _expected_tokens("stream these", 9)
+    assert len({e["fence"] for e in events}) == 1
+
+
+def test_llm_steady_state_zero_controller_rpcs(serve_instance):
+    """The compiled_dag_overhead gate, serve-side: with traffic flowing,
+    a window of decode iterations issues ZERO controller RPCs from the
+    decode replica — steady state is channel ops + pool arithmetic."""
+    from ray_tpu import serve
+
+    handle = serve.get_deployment_handle("llm_decode", "llm")
+    bg = handle.options(method_name="generate_batch").remote(
+        {"prompts": [f"load {i}" for i in range(16)], "max_tokens": 600}
+    )
+    probe = handle.options(method_name="steady_rpc_probe").remote().result(timeout=60)
+    assert probe["iterations"] >= 100, probe
+    assert probe["controller_rpcs"] == 0, probe
+    res = bg.result(timeout=120)
+    assert len(res["results"]) == 16
+
+
+def test_llm_batch_full_fast_503_retry_after(serve_instance):
+    """Satellite 3: admission/deadline interaction. A saturated decode
+    pool (slots AND queue full) sheds over HTTP with an immediate 503
+    whose Retry-After is the engine's slot-free projection capped by the
+    request's remaining deadline budget."""
+    import httpx
+
+    from ray_tpu import serve
+
+    serve.start(http_port=8179)
+    app = build_llm_app(
+        {
+            "max_slots": 1, "max_queued_seqs": 1, "num_kv_blocks": 64,
+            "decode_flops": 1_000_000,
+        },
+        request_timeout_s=30.0,
+    )
+    handle = serve.run(app, name="llmfull", route_prefix="/llmfull",
+                       http_port=8179)
+    decode = serve.get_deployment_handle("llm_decode", "llmfull")
+    # Occupy the only slot, then the only queue seat (neither awaited).
+    hogs = [
+        decode.options(method_name="generate").remote(
+            {"prompt": f"hog {i}", "max_tokens": 20_000}
+        )
+        for i in range(2)
+    ]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = decode.options(method_name="serve_llm_stats").remote().result(timeout=30)
+        if st["slot_occupancy"] >= 1 and st["queue_depth"] >= 1:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail(f"never saturated: {st}")
+    budget = 5.0
+    t0 = time.monotonic()
+    resp = httpx.post(
+        "http://127.0.0.1:8179/llmfull",
+        json={"prompt": "straw", "max_tokens": 4},
+        headers={"X-RayTPU-Deadline": str(budget)},
+        timeout=30,
+    )
+    elapsed = time.monotonic() - t0
+    assert resp.status_code == 503
+    assert elapsed < 3.0, "shed must be fast, not a queue-to-death wait"
+    hint = float(resp.headers["Retry-After"])
+    # The engine's projection for a 20k-token hog is minutes; the hint
+    # must have been capped by the request's own remaining budget.
+    assert 0.0 < hint <= budget
+    del hogs  # left to deadline-evict; serve.shutdown reaps the rest
+
+
+# ---------------------------------------------------------------------------
+# slow: mid-stream decode-replica kill -> exactly-once tokens (satellite 3)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_llm_decode_replica_kill_exactly_once(monkeypatch, tmp_path):
+    """Arm a windowed kill inside the decode loop: the replica dies
+    mid-stream holding live slots. The client replays the stream on a
+    surviving replica and dedups by (fence, index): every token index
+    arrives exactly once, byte-identical to the deterministic model's
+    output — zero lost, zero duplicated."""
+    from ray_tpu import serve
+    from ray_tpu.util.chaos import FaultSchedule, read_event_log
+
+    log_dir = str(tmp_path / "chaos-log")
+    schedule = FaultSchedule(
+        seed=17,
+        fail_points={
+            "serve.llm.decode_iter": {
+                "count": 1, "start_s": 25.0, "duration_s": 3.0,
+            },
+        },
+    )
+    monkeypatch.setenv("RAY_TPU_chaos", schedule.to_json())
+    monkeypatch.setenv("RAY_TPU_chaos_log_dir", log_dir)
+    chaos_core.reset()
+    if ray_tpu.is_initialized():
+        # Whole-file run: the module-scoped shared cluster is still up
+        # (its fixture finalizes only after this, the last test). The
+        # fail points arm at init, so this test needs its own cluster.
+        serve.shutdown()
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=16)
+    try:
+        serve.start()
+        app = build_llm_app(
+            {"max_slots": 8, "num_kv_blocks": 256, "decode_flops": 250_000},
+            decode_replicas=2,
+            request_timeout_s=120.0,
+        )
+        handle = serve.run(app, name="llmchaos", route_prefix="/llmchaos")
+        warm = handle.options(method_name="generate").remote(
+            {"prompt": "warm", "max_tokens": 2}
+        ).result(timeout=60)
+        assert warm["tokens"] == _expected_tokens("warm", 2)
+        # Sleep to the window edge, then stream through the crash.
+        opened = schedule.epoch + 25.0
+        if (wait := opened - time.time()) > 0:
+            time.sleep(wait)
+        n_tokens = 40
+        seen: dict = {}
+        fences = set()
+        for attempt in range(12):
+            try:
+                stream = handle.options(method_name="generate").remote(
+                    {"prompt": "sole survivor", "max_tokens": n_tokens,
+                     "stream": True}
+                ).result(timeout=90)
+                for ev in stream:
+                    fences.add(ev["fence"])
+                    seen.setdefault(ev["i"], set()).add(ev["t"])
+                break
+            except Exception:
+                time.sleep(1.0)  # replica died mid-stream: replay
+        else:
+            pytest.fail("stream never completed through the kill window")
+        assert sorted(seen) == list(range(n_tokens))
+        assert all(len(v) == 1 for v in seen.values())  # exactly-once
+        assert [next(iter(seen[i])) for i in range(n_tokens)] == (
+            _expected_tokens("sole survivor", n_tokens)
+        )
+    finally:
+        ray_tpu.shutdown()
+        chaos_core.reset()
+    kills = [
+        e for e in read_event_log(log_dir)
+        if e.get("point") == "failpoint"
+        and e.get("method") == "serve.llm.decode_iter"
+    ]
+    assert kills, "the decode-iteration fail point never fired"
