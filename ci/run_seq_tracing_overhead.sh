@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Serve-LLM observability gate (ISSUE 19): proves the token-level
+# observability plane — per-sequence trace continuity through the
+# channel families, the exact-sum token ledger, TTFT/TPOT histograms,
+# and the Perfetto sequence export — costs <=2% decode throughput when
+# fully sampled and stays control-plane silent.
+#
+# Three layers:
+#   1. tests/test_seq_observability.py — ctx wire roundtrip, sampling
+#      determinism, ledger exact-sum + replay dedup vs a fenced fake
+#      mailbox, engine timeline/kv export, the diagnose SLO + KV-trend
+#      rules, the Perfetto builder, and the end-to-end single-trace-id
+#      tests (proxy -> prefill -> KV wire -> decode -> every token);
+#   2. tests/test_observability.py — includes the dag-side join test
+#      (channel trace ids landing in flight records at site="dag");
+#   3. the serve_llm_observability release entry under --smoke: paired
+#      off/on decode windows gate sampled overhead <=2%, and the
+#      steady_rpc_probe re-run with tracing+sampling enabled gates
+#      decode_controller_rpcs==0; appends release_history.jsonl.
+#
+# The full-size measurement (24 paired windows) is the release suite
+# proper:
+#   python release/run_all.py --only serve_llm_observability
+# Usage: ci/run_seq_tracing_overhead.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== serve-LLM sequence observability (pytest) =="
+python -m pytest tests/test_seq_observability.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== dataflow trace joins (pytest) =="
+python -m pytest tests/test_observability.py -q -m 'not slow' \
+    -p no:cacheprovider "$@"
+
+echo "== sampled observability overhead (release floors, --smoke) =="
+python release/run_all.py --smoke --only serve_llm_observability
+
+echo "serve-LLM observability overhead: PASS"

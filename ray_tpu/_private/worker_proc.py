@@ -1362,6 +1362,13 @@ class WorkerRuntime:
             # a stale driver) must not feed a re-opened graph.
             return {"status": "stale_epoch", "epoch": runtime.epoch}
         value = serialization.deserialize(payload["value"], zero_copy=False)
+        trace = payload.get("trace")
+        if trace is not None:
+            # Re-wrap the sidecar trace context so the stage loop's
+            # buffered-edge pop recovers it like a local edge's envelope.
+            from ray_tpu.dag.channels import _TR_WIRE
+
+            value = (_TR_WIRE, trace, value)
         try:
             runtime.feed(payload["node"], payload["slot"],
                          payload["seq"], value)

@@ -355,6 +355,16 @@ CKPT_BOUND_FRAC = 0.10
 GOODPUT_WARN_FRACTION = 0.90
 SERVE_P99_SLO_MS = 250.0
 CPU_SATURATED_PCT = 90.0
+# Token-level serving SLOs (ISSUE 19): TTFT is request-latency-shaped
+# (queue + prefill + KV transfer + first decode step); TPOT is one
+# decode iteration.
+SERVE_TTFT_SLO_MS = 500.0
+SERVE_TPOT_SLO_MS = 100.0
+# KV-headroom exhaustion trend: projection horizon and the free-frac
+# floor under which the projection counts as exhaustion (same shape as
+# the node agent's oom_risk projection).
+KV_TREND_HORIZON_S = 60.0
+KV_EXHAUSTION_FRAC = 0.05
 
 
 def _finding(severity: str, score: float, kind: str, message: str,
@@ -531,6 +541,71 @@ def diagnose(snapshot: dict) -> list[dict]:
                 "warn", 35 + errors, "serve_errors",
                 f"serve {route}: {errors:.0f} failed requests",
                 {"route": route, **latest},
+            ))
+
+    # -- token-level serving SLOs (ISSUE 19) ----------------------------
+    serve_llm = snapshot.get("serve_llm") or {}
+    seq_count = int(serve_llm.get("count") or 0)
+    if seq_count:
+        ttft_p99_ms = 1e3 * _num(serve_llm.get("ttft_p99_s"))
+        tpot_p99_ms = 1e3 * _num(serve_llm.get("tpot_p99_s"))
+        if ttft_p99_ms >= SERVE_TTFT_SLO_MS:
+            findings.append(_finding(
+                "warn", 42 + ttft_p99_ms / 10.0, "serve_ttft_slo",
+                f"serve llm: TTFT p99 {ttft_p99_ms:.0f}ms over the "
+                f"{SERVE_TTFT_SLO_MS:.0f}ms SLO across {seq_count} "
+                "sequence(s) — check queue wait vs prefill in "
+                "`ray_tpu timeline --seq <id>`",
+                {"ttft_p99_ms": ttft_p99_ms, "sequences": seq_count,
+                 "by_outcome": serve_llm.get("by_outcome", {})},
+            ))
+        if tpot_p99_ms >= SERVE_TPOT_SLO_MS:
+            findings.append(_finding(
+                "warn", 41 + tpot_p99_ms / 10.0, "serve_tpot_slo",
+                f"serve llm: inter-token p99 {tpot_p99_ms:.0f}ms over "
+                f"the {SERVE_TPOT_SLO_MS:.0f}ms SLO — the decode step "
+                "is slow or the batch is oversubscribed",
+                {"tpot_p99_ms": tpot_p99_ms, "sequences": seq_count},
+            ))
+        ledger = serve_llm.get("ledger") or {}
+        issued = int(ledger.get("issued") or 0)
+        wasted = (
+            int(ledger.get("evicted") or 0)
+            + int(ledger.get("replay_discarded") or 0)
+        )
+        if issued and wasted / issued >= 0.10:
+            findings.append(_finding(
+                "warn", 38 + 100.0 * wasted / issued, "token_goodput",
+                f"serve llm: {wasted / issued:.0%} of {issued} issued "
+                "token(s) were wasted (evicted or replay-discarded) — "
+                "decode work that never reached a client",
+                {"ledger": ledger},
+            ))
+    # KV-headroom exhaustion trend: least-squares over the (ts,
+    # free_frac) history the decode engines export, projected
+    # KV_TREND_HORIZON_S forward — the paged-pool analogue of the node
+    # agent's oom_risk warner (telemetry.project_rss does the fit).
+    kv_history = serve_llm.get("kv_history") or []
+    if len(kv_history) >= 3:
+        from ray_tpu._private.telemetry import project_rss
+
+        projected = project_rss(kv_history, KV_TREND_HORIZON_S)
+        current = _num(kv_history[-1][1])
+        if (
+            projected is not None
+            and projected <= KV_EXHAUSTION_FRAC < current
+        ):
+            findings.append(_finding(
+                "warn", 55 + 100 * (current - projected),
+                "kv_headroom_trend",
+                f"serve llm: KV free fraction {current:.0%} trending to "
+                f"{max(projected, 0.0):.0%} within "
+                f"{KV_TREND_HORIZON_S:.0f}s — the paged pool is heading "
+                "for exhaustion (scale decode or shed earlier)",
+                {"kv_free_frac": current,
+                 "projected_free_frac": projected,
+                 "horizon_s": KV_TREND_HORIZON_S,
+                 "points": len(kv_history)},
             ))
 
     # -- node-level hot spots (even without a training run) -------------

@@ -94,6 +94,13 @@ def _consume_view(store, name: str, view):
 
 SEQ_HEADER = struct.Struct("<QQ")  # (epoch, seq)
 
+# ISSUE 19: an optional trace-context segment rides the frame header
+# right after (epoch, seq) — one length byte, then ``length`` bytes of
+# ``tracing.pack_ctx`` payload (25 bytes for a sampled context, 0 when
+# tracing is off). The disabled path costs exactly one b"\x00" byte per
+# frame; no import of the tracing module happens on it.
+_NO_TRACE = b"\x00"
+
 # Distinguishes "slot not written yet" from any legitimate payload value
 # (None included) on the non-blocking read path.
 NOT_READY = object()
@@ -127,26 +134,33 @@ def _note_stale_frame(name: str, got_epoch: int, epoch: int,
 
 
 def try_write_seq(store, name: str, seq: int, parts, total: int,
-                  epoch: int = 0) -> bool:
+                  epoch: int = 0, trace: bytes = b"") -> bool:
     """One seq-framed write attempt; False while the ring slot is still
-    occupied by an unconsumed earlier seq."""
+    occupied by an unconsumed earlier seq. ``trace`` is an optional
+    pre-packed trace-context segment (``tracing.pack_ctx``) that rides
+    the header beside (epoch, seq)."""
+    header = SEQ_HEADER.pack(epoch, seq)
+    seg = bytes([len(trace)]) + trace if trace else _NO_TRACE
     return try_write(
-        store, name, [SEQ_HEADER.pack(epoch, seq), *parts],
-        total + SEQ_HEADER.size,
+        store, name, [header, seg, *parts],
+        total + SEQ_HEADER.size + len(seg),
     )
 
 
-def read_seq_consume(store, name: str, seq: int, epoch: int = 0):
+def read_seq_consume(store, name: str, seq: int, epoch: int = 0,
+                     trace_out: list | None = None):
     """Non-blocking epoch+seq-framed read. Returns NOT_READY when the
     slot is absent, still holds an older seq, or holds a stale-epoch
     frame (which is consumed and discarded loudly — the slot frees so
     the post-recovery producer can claim it); otherwise consumes the
     slot and returns its value (zero-copy above the threshold, like
-    read_consume)."""
+    read_consume). When the frame header carries a trace segment and the
+    caller passed ``trace_out``, the raw segment bytes are appended to
+    it (the caller unpacks — this module stays tracing-agnostic)."""
     view = store.get(name, timeout_ms=0)
     if view is None:
         return NOT_READY
-    if view.nbytes < SEQ_HEADER.size:
+    if view.nbytes < SEQ_HEADER.size + 1:
         _free_slot(store, name)
         raise RuntimeError(f"channel slot {name}: truncated seq header")
     got_epoch, got = SEQ_HEADER.unpack(view[: SEQ_HEADER.size])
@@ -170,4 +184,8 @@ def read_seq_consume(store, name: str, seq: int, epoch: int = 0):
         raise RuntimeError(
             f"channel slot {name}: seq desync (holds {got}, expected {seq})"
         )
-    return _consume_view(store, name, view[SEQ_HEADER.size:])
+    trace_len = view[SEQ_HEADER.size]
+    body = SEQ_HEADER.size + 1 + trace_len
+    if trace_len and trace_out is not None:
+        trace_out.append(bytes(view[SEQ_HEADER.size + 1: body]))
+    return _consume_view(store, name, view[body:])

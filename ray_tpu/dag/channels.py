@@ -37,12 +37,41 @@ import numpy as np
 
 from ray_tpu._private import chaos, serialization
 from ray_tpu.dag import channel as shm
+from ray_tpu.util import tracing
 from ray_tpu.util.collective import flight
 
 # Wire marker for codec-compressed device payloads — same self-describing
 # envelope the pipeline activation wire uses, so mixed exact/quantized
 # edges share one decode path.
 _ACT_WIRE = "__act"
+
+# Wire marker for trace-carrying payloads (ISSUE 19): device/local
+# channel frames have no header to extend, so a sampled trace context
+# rides a compact ``(marker, ctx, payload)`` envelope instead. Only
+# written when a context is actually flowing — the untraced payload
+# shape is byte-identical to PR 15.
+_TR_WIRE = "__tr"
+
+
+def _resolve_ctx(trace):
+    """The context a push should propagate: the explicit one the caller
+    threaded through (a popped upstream context), else the ambient span
+    (``tracing.inject()`` — None when tracing is disabled, which keeps
+    the disabled path at one attribute read)."""
+    return trace if trace is not None else tracing.inject()
+
+
+def _push_span(ctx, *, channel: str, family: str, seq, nbytes: int):
+    """Open the ``channel.push`` span whose OWN context rides the wire —
+    the consumer's ``channel.pop`` parents on it, so the hop is causally
+    linked producer → frame → consumer."""
+    if ctx is None:
+        return None, None
+    span = tracing.begin(
+        "channel.push", parent=ctx, channel=channel, family=family,
+        seq=seq, nbytes=nbytes,
+    )
+    return span, {"trace_id": span.trace_id, "span_id": span.span_id}
 
 
 class ChannelClosedError(RuntimeError):
@@ -63,17 +92,31 @@ class ShmChannel:
         self._group = group
         self._site = site
         self.epoch = epoch
+        # Trace context of the most recent pop (single-consumer rings:
+        # each channel end is owned by exactly one loop thread, so a
+        # side-channel attribute needs no lock and keeps pop's return
+        # shape stable).
+        self.last_trace: dict | None = None
 
-    def push(self, seq: int, value, timeout: float = 120.0, stop=None) -> None:
+    def push(self, seq: int, value, timeout: float = 120.0, stop=None,
+             trace: dict | None = None) -> None:
         parts, total, _ = serialization.serialize_parts(value)
-        self.push_parts(seq, parts, total, timeout=timeout, stop=stop)
+        self.push_parts(seq, parts, total, timeout=timeout, stop=stop,
+                        trace=trace)
 
     def push_parts(self, seq: int, parts, total: int,
-                   timeout: float = 120.0, stop=None) -> None:
+                   timeout: float = 120.0, stop=None,
+                   trace: dict | None = None) -> None:
+        ctx = _resolve_ctx(trace)
+        span, wire_ctx = _push_span(
+            ctx, channel=self.base, family="shm", seq=seq, nbytes=total,
+        )
+        wire = tracing.pack_ctx(wire_ctx) if wire_ctx else b""
         name = shm.slot_name(self.base, seq, self.depth)
         deadline = time.monotonic() + timeout
         while not shm.try_write_seq(
-            self._store, name, seq, parts, total, epoch=self.epoch
+            self._store, name, seq, parts, total, epoch=self.epoch,
+            trace=wire,
         ):
             if stop is not None and stop():
                 raise ChannelClosedError(f"{self.base}: channel closed")
@@ -82,21 +125,45 @@ class ShmChannel:
                     f"channel slot {name} still unread after {timeout}s"
                 )
             time.sleep(0.002)
-        with flight.site(self._site):
+        with flight.site(self._site), flight.trace(
+            ctx["trace_id"] if ctx else None
+        ):
             flight.note(self._group, "chan_push", tag=self.base, nbytes=total)
+        if span is not None:
+            tracing.finish(span)
 
     def pop(self, seq: int, timeout: float | None = None, stop=None):
         name = shm.slot_name(self.base, seq, self.depth)
         deadline = None if timeout is None else time.monotonic() + timeout
         started = time.monotonic()
         delay = 0.002
+        trace_out: list = []
         while True:
             value = shm.read_seq_consume(
-                self._store, name, seq, epoch=self.epoch
+                self._store, name, seq, epoch=self.epoch,
+                trace_out=trace_out,
             )
             if value is not shm.NOT_READY:
-                with flight.site(self._site):
+                ctx = (
+                    tracing.unpack_ctx(trace_out[0]) if trace_out else None
+                )
+                self.last_trace = ctx
+                with flight.site(self._site), flight.trace(
+                    ctx["trace_id"] if ctx else None
+                ):
                     flight.note(self._group, "chan_pop", tag=self.base)
+                if ctx is not None:
+                    # The pop span covers the wait-for-frame window and
+                    # parents on the producer's channel.push context
+                    # that rode the frame header.
+                    wait_s = time.monotonic() - started
+                    end_ns = time.time_ns()
+                    tracing.emit(
+                        "channel.pop", ctx,
+                        start_ns=end_ns - int(wait_s * 1e9),
+                        end_ns=end_ns, channel=self.base, family="shm",
+                        seq=seq,
+                    )
                 return value
             if stop is not None and stop():
                 raise ChannelClosedError(f"{self.base}: channel closed")
@@ -148,6 +215,7 @@ class DeviceChannel:
         self._wire_cfg = wire_cfg
         self._ef = ef
         self.epoch = epoch
+        self.last_trace: dict | None = None
 
     # -- tagged mode (pipeline wire) ------------------------------------
     def push(self, value, *, tag: str, ef_site=None) -> None:
@@ -168,13 +236,28 @@ class DeviceChannel:
     # post-recovery pop ever reads, so stale device frames are fenced by
     # construction. All holes are integers, so the commgraph extractor
     # still folds every DAG wire to one certified skeleton.
-    def push_edge(self, value) -> None:
+    def push_edge(self, value, trace: dict | None = None) -> None:
+        tag = f"dagch:p{self.epoch}:e{self._src}:{self._dst}:{self._slot}"
         payload = self._encode(value, (self._src, self._dst, self._slot))
-        with flight.site(self._site):
+        ctx = _resolve_ctx(trace)
+        span, wire_ctx = _push_span(
+            ctx, channel=tag, family="device", seq=None, nbytes=0,
+        )
+        if wire_ctx is not None:
+            # The device wire has no frame header to extend — the
+            # context rides a compact envelope around the payload.
+            payload = (_TR_WIRE, wire_ctx, payload)
+        with flight.site(self._site), flight.trace(
+            ctx["trace_id"] if ctx else None
+        ):
+            # Tag f-string inlined at the call: the commgraph extractor
+            # reads tag= literals at send/recv sites to certify the wire.
             self._group.send(
                 payload, self._peer,
                 tag=f"dagch:p{self.epoch}:e{self._src}:{self._dst}:{self._slot}",
             )
+        if span is not None:
+            tracing.finish(span)
 
     def pop_edge(self, *, timeout: float = 60.0, like=None):
         # Chaos latency point: a windowed schedule makes the whole device
@@ -183,12 +266,31 @@ class DeviceChannel:
         extra = chaos.latency_delay("dag.device.pop")
         if extra > 0:
             time.sleep(extra)
+        started = time.monotonic()
         with flight.site(self._site):
             out = self._group.recv(
                 self._peer,
                 tag=f"dagch:p{self.epoch}:e{self._src}:{self._dst}:{self._slot}",
                 timeout=timeout, like=like,
             )
+        if (
+            isinstance(out, tuple) and len(out) == 3 and out[0] == _TR_WIRE
+        ):
+            _, ctx, out = out
+            self.last_trace = ctx
+            wait_s = time.monotonic() - started
+            end_ns = time.time_ns()
+            tracing.emit(
+                "channel.pop", ctx,
+                start_ns=end_ns - int(wait_s * 1e9), end_ns=end_ns,
+                channel=(
+                    f"dagch:p{self.epoch}:"
+                    f"e{self._src}:{self._dst}:{self._slot}"
+                ),
+                family="device",
+            )
+        else:
+            self.last_trace = None
         return self._decode(out)
 
     # -- codec ----------------------------------------------------------
@@ -227,13 +329,18 @@ class LocalChannel:
         self._group = group
         self._label = label
         self._closed = False
+        self.last_trace: dict | None = None
         # Lifecycle-only flight notes: per-item records would rotate
         # genuinely stalled ops out of the bounded flight ring.
         flight.note(self._group, "chan_open", tag=label)
 
-    async def put(self, item) -> None:
+    async def put(self, item, trace: dict | None = None) -> None:
         if self._closed:
             raise ChannelClosedError(f"{self._label}: channel closed")
+        if trace is not None:
+            # Same compact envelope as the device wire: the consumer's
+            # pop_batch unwraps and surfaces the context on last_trace.
+            item = (_TR_WIRE, trace, item)
         await self._q.put(item)
 
     def qsize(self) -> int:
@@ -254,7 +361,16 @@ class LocalChannel:
                 items.append(self._q.get_nowait())
             except asyncio.QueueEmpty:
                 break
-        return items
+        unwrapped: list = []
+        for item in items:
+            if (
+                isinstance(item, tuple) and len(item) == 3
+                and item[0] == _TR_WIRE
+            ):
+                self.last_trace = item[1]
+                item = item[2]
+            unwrapped.append(item)
+        return unwrapped
 
     def close(self) -> None:
         if not self._closed:

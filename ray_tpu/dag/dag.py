@@ -43,6 +43,7 @@ from ray_tpu import exceptions
 from ray_tpu._private import serialization, worker as worker_mod
 from ray_tpu.dag import placement
 from ray_tpu.dag.channels import DeviceChannel, ShmChannel
+from ray_tpu.util import tracing
 
 _node_counter = itertools.count()
 
@@ -761,33 +762,44 @@ class CompiledDAG:
         if self._supervise:
             # Retain the input until its results complete (or the next
             # committed snapshot supersedes it): the retained dict IS the
-            # replay log a recovery re-feeds from.
-            self._retained[seq] = value
+            # replay log a recovery re-feeds from. The submit-time trace
+            # context rides along so a post-crash replay re-pushes each
+            # frame under its ORIGINAL trace id, not the supervisor's.
+            self._retained[seq] = (value, tracing.inject())
         self._push_input(seq, value)
         return DAGRef(self, seq)
 
-    def _push_input(self, seq: int, value: Any) -> None:
+    def _push_input(self, seq: int, value: Any,
+                    trace: dict | None = None) -> None:
         """Push one input seq into every input edge (shared by execute()
-        and the supervisor's replay pump)."""
+        and the supervisor's replay pump). ``trace`` overrides the
+        ambient trace context — the replay pump passes the retained
+        submit-time context so replayed frames keep their trace ids."""
+        ctx = trace if trace is not None else tracing.inject()
         parts = total = raw = None
         for target in self._input_targets:
             fam = target["family"]
             if fam == "shm":
                 if parts is None:
                     parts, total, _ = serialization.serialize_parts(value)
-                target["chan"].push_parts(seq, parts, total)
+                target["chan"].push_parts(seq, parts, total, trace=ctx)
             elif fam == "device":
-                target["chan"].push_edge(value)
+                target["chan"].push_edge(value, trace=ctx)
             else:  # socket fallback: one RPC per push
                 if raw is None:
                     raw = serialization.join_parts(
                         serialization.serialize_parts(value)[0]
                     )
-                resp = self._call_actor(target["actor_id"], "dag_push", {
+                payload = {
                     "dag_id": self.dag_id, "node": target["node"],
                     "seq": seq, "slot": target["slot"], "value": raw,
                     "epoch": self._epoch,
-                })
+                }
+                if ctx is not None:
+                    payload["trace"] = ctx
+                resp = self._call_actor(
+                    target["actor_id"], "dag_push", payload
+                )
                 if (resp or {}).get("status") == "stale_epoch":
                     raise RuntimeError(
                         f"{self.dag_id}: dag_push rejected — worker is at "

@@ -26,10 +26,12 @@ import traceback
 from ray_tpu import exceptions
 from ray_tpu._private import serialization
 from ray_tpu.dag.channels import (
+    _TR_WIRE,
     ChannelClosedError,
     DeviceChannel,
     ShmChannel,
 )
+from ray_tpu.util import tracing
 
 # Worker-side device-pop retry slice: long enough to stay cheap, short
 # enough that stop() is honored promptly and a timed-out slice stays
@@ -167,19 +169,31 @@ class StageLoop(threading.Thread):
 
     # -- per-edge ops ----------------------------------------------------
     def _pop_input(self, fam: str, chan, seq: int):
+        """One input value + the trace context that rode its frame (the
+        channel's ``last_trace`` for shm/device edges, the ``_TR_WIRE``
+        envelope for buffered local/socket edges; None untraced)."""
         if fam == "shm":
-            return chan.pop(seq, timeout=None, stop=self.stopped)
+            value = chan.pop(seq, timeout=None, stop=self.stopped)
+            return value, chan.last_trace
         if fam == "device":
             while True:
                 if self.stopped():
                     raise ChannelClosedError("stage loop stopped")
                 try:
-                    return chan.pop_edge(timeout=_POP_SLICE_S)
+                    value = chan.pop_edge(timeout=_POP_SLICE_S)
+                    return value, chan.last_trace
                 except _TIMEOUTS:
                     continue
-        return chan.pop(seq, stop=self.stopped)  # SeqBuffer
+        value = chan.pop(seq, stop=self.stopped)  # SeqBuffer
+        if (
+            isinstance(value, tuple) and len(value) == 3
+            and value[0] == _TR_WIRE
+        ):
+            return value[2], value[1]
+        return value, None
 
-    def _push_downstream(self, edge, seq: int, result, cache: dict) -> None:
+    def _push_downstream(self, edge, seq: int, result, cache: dict,
+                         trace: dict | None = None) -> None:
         fam = edge["family"]
         if fam == "local":
             # Same-actor edge: deliver a private copy in-process (the
@@ -188,7 +202,7 @@ class StageLoop(threading.Thread):
                 parts, total, _ = serialization.serialize_parts(result)
                 cache["raw"] = serialization.join_parts(parts)
             self._deliver_local(
-                edge["node"], edge["slot"], seq, cache["raw"]
+                edge["node"], edge["slot"], seq, cache["raw"], trace
             )
         elif fam == "shm":
             if "parts" not in cache:
@@ -197,15 +211,18 @@ class StageLoop(threading.Thread):
                 )
             chan = self._down_chans[(edge["node"], edge["slot"])]
             chan.push_parts(
-                seq, cache["parts"], cache["total"], stop=self.stopped
+                seq, cache["parts"], cache["total"], stop=self.stopped,
+                trace=trace,
             )
         elif fam == "device":
-            self._down_chans[(edge["node"], edge["slot"])].push_edge(result)
+            self._down_chans[(edge["node"], edge["slot"])].push_edge(
+                result, trace=trace
+            )
         else:  # socket
             if "raw" not in cache:
                 parts, total, _ = serialization.serialize_parts(result)
                 cache["raw"] = serialization.join_parts(parts)
-            self._send_socket(edge, seq, cache["raw"])
+            self._send_socket(edge, seq, cache["raw"], trace)
 
     # -- main loop -------------------------------------------------------
     def run(self) -> None:
@@ -220,13 +237,26 @@ class StageLoop(threading.Thread):
                     return
                 args = []
                 err = None
+                in_ctx = None
                 for slot, fam, chan in self._in_pops:
-                    value = self._pop_input(fam, chan, seq)
+                    value, ctx = self._pop_input(fam, chan, seq)
+                    if in_ctx is None and ctx is not None:
+                        in_ctx = ctx
                     if err is None and isinstance(
                         value, exceptions.TaskError
                     ):
                         err = value
                     args.append(value)
+                # A traced input makes the whole stage invocation part of
+                # that trace: the stage span parents on the frame context
+                # and its OWN context flows into every downstream push,
+                # so cross-stage hops chain push → pop → stage → push.
+                stage_span = None
+                if in_ctx is not None and tracing.enabled():
+                    stage_span = tracing.begin(
+                        f"dag.stage {stage['method']}", parent=in_ctx,
+                        dag_id=self.dag_id, node=stage["node"], seq=seq,
+                    )
                 if err is not None:
                     result = err  # skip compute, forward the failure
                 else:
@@ -236,16 +266,27 @@ class StageLoop(threading.Thread):
                         result = exceptions.TaskError(
                             stage["method"], traceback.format_exc()
                         )
+                        if stage_span is not None:
+                            stage_span.set_error(result.__class__.__name__)
+                out_ctx = (
+                    {"trace_id": stage_span.trace_id,
+                     "span_id": stage_span.span_id}
+                    if stage_span is not None else in_ctx
+                )
                 cache: dict = {}
                 for edge in stage.get("downstream", ()):
-                    self._push_downstream(edge, seq, result, cache)
+                    self._push_downstream(edge, seq, result, cache, out_ctx)
                 for out, chan in self._out_chans:
                     if chan is None:
                         self._park_output(seq, result)
                     elif out["family"] == "shm":
-                        chan.push(seq, result, stop=self.stopped)
+                        chan.push(
+                            seq, result, stop=self.stopped, trace=out_ctx
+                        )
                     else:
-                        chan.push_edge(result)
+                        chan.push_edge(result, trace=out_ctx)
+                if stage_span is not None:
+                    tracing.finish(stage_span)
                 self.completed_seq = seq
         except ChannelClosedError:
             return
@@ -317,19 +358,28 @@ class DagRuntime:
         raise KeyError(f"dag {self.dag_id}: stage {node} not on this worker")
 
     # -- StageLoop callbacks ---------------------------------------------
-    def _deliver_local(self, node: int, slot: str, seq: int, raw) -> None:
-        self.feed(
-            node, slot, seq, serialization.deserialize(raw, zero_copy=False)
-        )
+    def _deliver_local(self, node: int, slot: str, seq: int, raw,
+                       trace: dict | None = None) -> None:
+        value = serialization.deserialize(raw, zero_copy=False)
+        if trace is not None:
+            value = (_TR_WIRE, trace, value)
+        self.feed(node, slot, seq, value)
 
-    def _send_socket(self, edge: dict, seq: int, raw) -> None:
+    def _send_socket(self, edge: dict, seq: int, raw,
+                     trace: dict | None = None) -> None:
+        payload = {
+            "dag_id": self.dag_id, "node": edge["node"],
+            "slot": edge["slot"], "seq": seq, "value": raw,
+            "epoch": self.epoch,
+        }
+        if trace is not None:
+            # Sidecar field, not an envelope: the receiver re-wraps after
+            # deserializing so the value bytes stay format-stable.
+            payload["trace"] = trace
+
         async def _push():
             client = await self._ctx._actor_client(edge["actor_id"])
-            await client.call("dag_push", {
-                "dag_id": self.dag_id, "node": edge["node"],
-                "slot": edge["slot"], "seq": seq, "value": raw,
-                "epoch": self.epoch,
-            })
+            await client.call("dag_push", payload)
 
         def _log_err(f):
             try:

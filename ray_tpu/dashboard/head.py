@@ -41,6 +41,7 @@ _INDEX_HTML = """<!doctype html>
  <a href="#" onclick="view='tasks';refresh();return false">tasks</a>
  <a href="#" onclick="view='jobs';refresh();return false">jobs</a>
  <a href="#" onclick="view='serveView';refresh();return false">serve</a>
+ <a href="#" onclick="view='sequences';refresh();return false">sequences</a>
  <a href="#" onclick="view='workers';refresh();return false">workers</a>
  <a href="#" onclick="view='resources';refresh();return false">resources</a>
  <a href="#" onclick="view='workload';refresh();return false">workload</a>
@@ -126,6 +127,31 @@ async function serveView() {
       Object.keys(deps).map(d => [esc(d), esc(JSON.stringify(deps[d].status ?? deps[d])),
         esc(deps[d].running_replicas ?? '')]));
   }
+  return html;
+}
+async function sequences() {
+  const s = await fetch('/api/sequences').then(r => r.json());
+  const ms = v => (typeof v === 'number' ? (1000 * v).toFixed(1) : '');
+  let html = '<h2>Served sequences</h2><div class="muted">' +
+    `sampled terminal records ${esc(s.count ?? 0)} · ` +
+    `TTFT p50/p99 ${esc(ms(s.ttft_p50_s))}/${esc(ms(s.ttft_p99_s))} ms · ` +
+    `TPOT p50/p99 ${esc(ms(s.tpot_p50_s))}/${esc(ms(s.tpot_p99_s))} ms</div>`;
+  const led = s.ledger ?? {};
+  html += '<h2>Token ledger</h2>' + table(['class', 'tokens'],
+    ['issued', 'productive', 'shed', 'evicted', 'replay_discarded']
+      .map(k => [esc(k), esc(led[k] ?? 0)]));
+  const rows = s.sequences ?? [];
+  if (!rows.length) return html + '<div class="muted">no sampled sequences yet ' +
+    '(enable tracing + LLMConfig.seq_trace_sample)</div>';
+  html += '<h2>Recent sequences</h2>' + table(
+    ['request', 'outcome', 'cause', 'tokens', 'queue ms', 'prefill ms',
+     'kv ms', 'TTFT ms', 'TPOT p99 ms', 'trace'],
+    rows.slice().reverse().map(r =>
+      [`<code>${esc((r.request_id ?? '').slice(0, 18))}</code>`,
+       esc(r.outcome ?? ''), esc(r.cause ?? ''), esc(r.tokens ?? 0),
+       esc(ms(r.queue_wait_s)), esc(ms(r.prefill_s)), esc(ms(r.kv_transfer_s)),
+       esc(ms(r.ttft_s)), esc(ms(r.tpot_p99_s)),
+       `<code>${esc((r.trace_id ?? '').slice(0, 12))}</code>`]));
   return html;
 }
 function fmtBytes(b) {
@@ -264,8 +290,8 @@ async function autoscaler() {
   return html;
 }
 async function refresh() {
-  const render = {overview, tasks, jobs, serveView, workers, resources,
-                  workload, logs, events, autoscaler}[view];
+  const render = {overview, tasks, jobs, serveView, sequences, workers,
+                  resources, workload, logs, events, autoscaler}[view];
   try { document.getElementById('content').innerHTML = await render(); }
   catch (err) { document.getElementById('content').innerHTML = 'error: ' + esc(err); }
 }
@@ -323,6 +349,7 @@ class DashboardHead:
         app.router.add_get("/api/commflight", self._commflight)
         app.router.add_post("/api/profile", self._profile)
         app.router.add_get("/api/serve", self._serve_state)
+        app.router.add_get("/api/sequences", self._sequences)
         app.router.add_get("/api/workers", self._workers)
         app.router.add_get("/api/grafana_dashboard", self._grafana)
         app.router.add_get("/api/autoscaler", self._autoscaler)
@@ -569,6 +596,24 @@ class DashboardHead:
 
         return web.json_response(
             await asyncio.to_thread(status), dumps=_dumps
+        )
+
+    async def _sequences(self, request):
+        """Token-level serving view (ISSUE 19): sampled per-sequence
+        timelines + the exact-sum token ledger from the session dir."""
+        from aiohttp import web
+
+        try:
+            limit = int(request.query.get("limit", "200"))
+        except ValueError:
+            return web.Response(
+                status=400, text="?limit= must be an integer"
+            )
+        return web.json_response(
+            await asyncio.to_thread(
+                state_mod.summarize_sequences, self.session_dir, limit
+            ),
+            dumps=_dumps,
         )
 
     async def _workers(self, request):

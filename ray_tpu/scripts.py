@@ -318,10 +318,31 @@ def cmd_stacks(args) -> None:
 
 
 def cmd_timeline(args) -> None:
-    _connect(args)
-    import ray_tpu
+    if getattr(args, "seq", None):
+        # Single-sequence view (ISSUE 19): every span sharing the
+        # sequence's trace id + one instant per emitted token. Reads
+        # session files directly — works offline against a finished
+        # session via RAYTPU_SESSION_DIR, no cluster connection needed.
+        from ray_tpu.util import state as state_mod
+        from ray_tpu.util.timeline import build_sequence_trace
 
-    trace = ray_tpu.timeline()
+        session_dir = state_mod._session_dir()
+        if not session_dir:
+            _connect(args)
+            session_dir = state_mod._session_dir()
+        if not session_dir:
+            raise SystemExit("timeline --seq: no session directory "
+                             "(set RAYTPU_SESSION_DIR or run inside a "
+                             "cluster)")
+        try:
+            trace = build_sequence_trace(session_dir, args.seq)
+        except KeyError as exc:
+            raise SystemExit(str(exc))
+    else:
+        _connect(args)
+        import ray_tpu
+
+        trace = ray_tpu.timeline()
     out = args.out or args.output
     from ray_tpu._private.atomic_io import atomic_write_json
 
@@ -461,6 +482,9 @@ def main(argv=None) -> None:
     p.add_argument("--output", default="timeline.json")
     p.add_argument("--out", default=None,
                    help="alias for --output (ray_tpu timeline --out trace.json)")
+    p.add_argument("--seq", default=None,
+                   help="request id: export ONE served sequence's trace "
+                        "(spans sharing its trace id + per-token instants)")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_timeline)
 

@@ -41,6 +41,27 @@ class SequenceState:
     out_chan: Any = None
     admitted_at: float = 0.0
 
+    # -- observability (ISSUE 19) ---------------------------------------
+    # Trace context captured at request entry (rides every token event
+    # and the terminal timeline record); ``sampled`` is the
+    # deterministic seq_trace_sample decision, stable across replays.
+    trace_ctx: Any = None
+    sampled: bool = False
+    # Tokens the client already holds from a pre-death replica (fence
+    # dedup drops their replays) — the ledger charges exactly this many
+    # to replay_discarded instead of double-counting them productive.
+    resume_from: int = 0
+    # Monotonic timestamps of the sequence's lifecycle: request entry,
+    # slot admission, first token; ``token_times`` collects every
+    # emission for inter-token percentiles.
+    enqueued_at: float = 0.0
+    slot_admitted_at: float = 0.0
+    first_token_at: float = 0.0
+    token_times: List[float] = field(default_factory=list)
+    # Upstream phase durations measured by the decode deployment.
+    prefill_s: float = 0.0
+    kv_transfer_s: float = 0.0
+
     def done(self) -> bool:
         return len(self.generated) >= self.max_tokens
 

@@ -58,6 +58,15 @@ class LLMConfig:
     prefill_flops: int = 0
     decode_flops: int = 0
 
+    # -- sequence observability (ISSUE 19) ------------------------------
+    # Fraction of sequences that get full trace continuity (spans +
+    # per-sequence timeline records). The decision is a deterministic
+    # hash of request_id, so a replayed sequence keeps its sampling fate
+    # (and its trace id) across replica deaths. 0.0 disables the traced
+    # path entirely; the token ledger and TTFT/TPOT histograms are
+    # always on (they are O(1) arithmetic per token).
+    seq_trace_sample: float = 1.0
+
     # -- multiplexing ---------------------------------------------------
     max_models_per_replica: int = 3
 

@@ -33,10 +33,12 @@ import numpy as np
 
 from ray_tpu._private import chaos
 from ray_tpu.serve._private.common import Deadline, current_deadline
+from ray_tpu.serve.llm import observability as seq_obs
 from ray_tpu.serve.llm.batch import SequenceState
 from ray_tpu.serve.llm.config import LLMConfig
 from ray_tpu.serve.llm.engine import DecodeEngine
 from ray_tpu.serve.llm.wire import decode_kv_blocks, encode_kv_blocks
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -180,26 +182,39 @@ class LLMDecode:
 
     async def _prefill_seqs(self, prompts: list, model_id: str) -> list:
         payload = {"prompts": prompts, "model": model_id}
-        if self._prefill is None:
-            out = await self._local_prefill.prefill(payload)
-        else:
-            # One RPC per admission batch, not per sequence; to_thread
-            # keeps the blocking handle call off the decode loop, and
-            # copies the ambient deadline contextvar with it.
-            out = await asyncio.to_thread(self._run_prefill, payload)
+        # The serve.prefill span makes the prompt-pass hop a visible
+        # phase of the request's trace (the ambient serve.replica span
+        # parents it; the handle call's own submit/execute spans chain
+        # underneath). span() is a no-op yield when tracing is off.
+        with tracing.span(
+            "serve.prefill", prompts=len(prompts),
+            inline=self._prefill is None,
+        ):
+            if self._prefill is None:
+                out = await self._local_prefill.prefill(payload)
+            else:
+                # One RPC per admission batch, not per sequence;
+                # to_thread keeps the blocking handle call off the
+                # decode loop, and copies the ambient deadline
+                # contextvar with it.
+                out = await asyncio.to_thread(self._run_prefill, payload)
         return out["seqs"]
 
     def _make_seq(self, entry: dict, body: dict, model_id: str,
-                  deadline: Deadline) -> SequenceState:
+                  deadline: Deadline, *, enqueued_at: float = 0.0,
+                  prefill_s: float = 0.0) -> SequenceState:
         import uuid
 
+        t0 = time.monotonic()
         kv = decode_kv_blocks(entry["kv"])
+        kv_transfer_s = time.monotonic() - t0
         err = abs(float(np.mean(np.abs(kv))) - entry.get("sig", 0.0))
         self._kv_wire_err = 0.9 * self._kv_wire_err + 0.1 * err
-        return SequenceState(
-            request_id=str(
-                body.get("request_id", "") or uuid.uuid4().hex[:12]
-            ),
+        request_id = str(
+            body.get("request_id", "") or uuid.uuid4().hex[:12]
+        )
+        seq = SequenceState(
+            request_id=request_id,
             prompt_tokens=entry["tokens"],
             max_tokens=int(
                 body.get("max_tokens", self.cfg.max_tokens_default)
@@ -209,6 +224,33 @@ class LLMDecode:
             kv_data=kv,
             deadline=deadline,
         )
+        seq.enqueued_at = enqueued_at
+        seq.prefill_s = prefill_s
+        seq.kv_transfer_s = kv_transfer_s
+        # Client hint after a replica-death retry: how many tokens it
+        # already delivered under the previous fence. The ledger
+        # charges exactly that many replays to replay_discarded.
+        seq.resume_from = int(body.get("resume_from", 0) or 0)
+        # Deterministic sampling keeps a replayed request's tracing
+        # fate (and trace id, carried in the retried request's ambient
+        # context) stable across replicas.
+        seq.sampled = tracing.enabled() and seq_obs.sampled(
+            request_id, self.cfg.seq_trace_sample
+        )
+        if seq.sampled:
+            seq.trace_ctx = tracing.inject()
+            if seq.trace_ctx and kv_transfer_s > 0:
+                # Backdated span for the KV decode hop (inline wire):
+                # the sampling decision needs request_id, which is only
+                # known after the decode ran.
+                end_ns = time.time_ns()
+                tracing.emit(
+                    "serve.kv_transfer", seq.trace_ctx,
+                    start_ns=end_ns - int(kv_transfer_s * 1e9),
+                    end_ns=end_ns, request_id=request_id,
+                    quantized=entry["kv"][0] != "__kv_exact",
+                )
+        return seq
 
     # -- request surface ------------------------------------------------
     async def generate(self, body: Any = None):
@@ -216,6 +258,7 @@ class LLMDecode:
         ``{"i", "t", "fence"}`` token events (the replica wraps it in an
         rtdag LocalChannel stream); otherwise awaits completion."""
         body = body if isinstance(body, dict) else {"prompt": body or ""}
+        t0 = time.monotonic()
         deadline = current_deadline() or Deadline.never()
         model_id = str(body.get("model", "") or "")
         if model_id:
@@ -223,7 +266,11 @@ class LLMDecode:
         entries = await self._prefill_seqs(
             [body.get("prompt", "")], model_id
         )
-        seq = self._make_seq(entries[0], body, model_id, deadline)
+        prefill_s = time.monotonic() - t0
+        seq = self._make_seq(
+            entries[0], body, model_id, deadline,
+            enqueued_at=t0, prefill_s=prefill_s,
+        )
         if body.get("stream"):
             from ray_tpu.dag.channels import LocalChannel
 
@@ -256,14 +303,20 @@ class LLMDecode:
         RPC and one admission wave for N sequences, completion gathered
         per-sequence as slots finish."""
         body = body if isinstance(body, dict) else {}
+        t0 = time.monotonic()
         deadline = current_deadline() or Deadline.never()
         model_id = str(body.get("model", "") or "")
         if model_id:
             await self._load_model(model_id)
         prompts = list(body.get("prompts", ()))
         entries = await self._prefill_seqs(prompts, model_id)
+        prefill_s = time.monotonic() - t0
         seqs = [
-            self._make_seq(e, body, model_id, deadline) for e in entries
+            self._make_seq(
+                e, body, model_id, deadline,
+                enqueued_at=t0, prefill_s=prefill_s,
+            )
+            for e in entries
         ]
         for seq in seqs:
             await self._engine.submit(seq)

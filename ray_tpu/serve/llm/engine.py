@@ -33,9 +33,11 @@ import uuid
 from ray_tpu import exceptions
 from ray_tpu._private import chaos
 from ray_tpu.dag.channels import LocalChannel
+from ray_tpu.serve.llm import observability as seq_obs
 from ray_tpu.serve.llm.batch import SequenceState, SlotBatch
 from ray_tpu.serve.llm.config import LLMConfig
 from ray_tpu.serve.llm.kv import KVBlockPool
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -82,6 +84,10 @@ class DecodeEngine:
         self._occupancy_ewma = 0.0
         self._iter_rate = 0.0  # iterations/s EWMA
         self._last_iter_t = 0.0
+        # Token goodput ledger (ISSUE 19): always on — O(1) integer
+        # arithmetic per token, classification once per terminal seq.
+        self.ledger = seq_obs.TokenLedger()
+        self._last_kv_note_t = 0.0
 
     # -- lifecycle ------------------------------------------------------
     def ensure_started(self) -> None:
@@ -120,6 +126,9 @@ class DecodeEngine:
             and backlog >= self.cfg.max_queued_seqs
         ):
             self.shed += 1
+            self.ledger.seqs_shed += 1
+            self._seq_record(seq, outcome="shed", cause="admission_shed",
+                             split={})
             est = self.retry_after_estimate()
             raise exceptions.RequestShedError(
                 f"decode batch full ({self._batch.occupancy()} slots, "
@@ -129,6 +138,11 @@ class DecodeEngine:
         if seq.out_chan is None:
             seq.future = asyncio.get_running_loop().create_future()
         seq.admitted_at = time.monotonic()
+        if not seq.enqueued_at:
+            # Raw engine submissions (tests, custom deployments) that
+            # skipped the deployment's entry stamp still get a queue
+            # baseline.
+            seq.enqueued_at = seq.admitted_at
         await self._admit_chan.put(seq)
         return seq
 
@@ -154,8 +168,10 @@ class DecodeEngine:
             for idx, seq in self._batch.active():
                 self._batch.evict(idx)
                 self._release(seq)
+                self._finish_ledger(seq, "shed", "engine_crash")
                 await self._finish_error(seq, exc)
             for seq in self._deferred:
+                self._finish_ledger(seq, "shed", "engine_crash")
                 await self._finish_error(seq, exc)
             self._deferred = []
 
@@ -187,6 +203,7 @@ class DecodeEngine:
                 self._batch.evict(idx)
                 self._release(seq)
                 self.expired += 1
+                self._finish_ledger(seq, "evicted", "deadline")
                 await self._finish_error(
                     seq, exceptions.DeadlineExceededError(
                         "sequence deadline expired mid-decode"
@@ -217,20 +234,56 @@ class DecodeEngine:
             self._last_bucket = bucket
         seqs = [s for _, s in active]
         kv_pages = [self._kv.read(s.kv_blocks) for s in seqs]
+        # decode.iter span (ISSUE 19): parented on the first sampled
+        # active sequence's trace, so the iteration that produced a
+        # token shows up inside that sequence's trace tree. Unsampled
+        # iterations pay one generator-free any() scan.
+        iter_span = None
+        if tracing.enabled():
+            parent = next(
+                (s.trace_ctx for s in seqs if s.sampled and s.trace_ctx),
+                None,
+            )
+            if parent is not None:
+                iter_span = tracing.begin(
+                    "decode.iter", parent=parent, replica=self.replica_id,
+                    slots=len(active), bucket=bucket,
+                )
         tokens = self.model.decode_step(seqs, kv_pages, bucket)
         # 5. append/stream tokens; evict completed sequences.
+        self.ledger.issue(len(active))
+        now_t = time.monotonic()
         for (idx, seq), tok in zip(active, tokens):
             seq.generated.append(int(tok))
+            prev_t = seq.token_times[-1] if seq.token_times else 0.0
+            seq.token_times.append(now_t)
+            if len(seq.generated) == 1:
+                seq.first_token_at = now_t
+                self._observe_token("ttft", now_t - seq.enqueued_at)
+            elif prev_t:
+                self._observe_token("tpot", now_t - prev_t)
             if seq.out_chan is not None:
-                await seq.out_chan.put({
+                event = {
                     "i": len(seq.generated) - 1, "t": int(tok),
                     "fence": self.fence,
-                })
+                }
+                if seq.sampled and seq.trace_ctx:
+                    # The trace id follows every token to the client:
+                    # visible in the event AND riding the LocalChannel
+                    # envelope for the stream reader's last_trace.
+                    event["tr"] = seq.trace_ctx["trace_id"]
+                await seq.out_chan.put(
+                    event,
+                    trace=seq.trace_ctx if seq.sampled else None,
+                )
             if seq.done():
                 self._batch.evict(idx)
                 self._release(seq)
                 self.completed += 1
+                self._finish_ledger(seq, "productive", "completed")
                 await self._finish_ok(seq)
+        if iter_span is not None:
+            tracing.finish(iter_span)
         # 6. per-iteration bookkeeping + gauges (satellite 2).
         self.iterations += 1
         now = time.monotonic()
@@ -255,6 +308,7 @@ class DecodeEngine:
             self._kv.write(ids, seq.kv_data)
             seq.kv_data = None
         seq.kv_blocks = ids
+        seq.slot_admitted_at = time.monotonic()
         self._batch.admit(seq)
         self.admitted += 1
         if seq.model_id:
@@ -274,6 +328,7 @@ class DecodeEngine:
 
     def _expire_deferred(self, seq: SequenceState) -> bool:
         self.expired += 1
+        self._finish_ledger(seq, "evicted", "kv_wait_deadline")
         task = asyncio.get_running_loop().create_task(
             self._finish_error(seq, exceptions.DeadlineExceededError(
                 "sequence deadline expired before a KV page freed"
@@ -306,6 +361,50 @@ class DecodeEngine:
             seq.future.set_exception(exc)
 
     # -- observability --------------------------------------------------
+    def _finish_ledger(self, seq: SequenceState, outcome: str,
+                       cause: str) -> None:
+        """Terminal accounting for one sequence: partition its tokens
+        in the ledger, mirror the split into the Prometheus token
+        counters, and (for sampled sequences) write the per-sequence
+        timeline record."""
+        split = self.ledger.classify(seq, outcome)
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+
+            metrics_mod.inc_serve_tokens(
+                outcome, split["tokens"], self.deployment
+            )
+            metrics_mod.inc_serve_tokens(
+                "replay_discarded", split["replay_discarded"],
+                self.deployment,
+            )
+        except Exception:  # rtlint: disable=swallowed-exception - metric export must never stall the decode loop
+            pass
+        self._seq_record(seq, outcome=outcome, cause=cause, split=split)
+
+    def _seq_record(self, seq: SequenceState, *, outcome: str, cause: str,
+                    split: dict) -> None:
+        if not seq.sampled:
+            return
+        try:
+            seq_obs.record(seq_obs.seq_record(
+                seq, outcome=outcome, cause=cause, split=split,
+                deployment=self.deployment, replica_id=self.replica_id,
+                fence=self.fence,
+            ))
+        except Exception:  # rtlint: disable=swallowed-exception - timeline export must never stall the decode loop
+            pass
+
+    def _observe_token(self, kind: str, seconds: float) -> None:
+        try:
+            from ray_tpu.util import metrics as metrics_mod
+
+            metrics_mod.record_serve_token_latency(
+                kind, seconds, self.deployment
+            )
+        except Exception:  # rtlint: disable=swallowed-exception - metric export must never stall the decode loop
+            pass
+
     def _export_gauges(self, occupancy: int, bucket: int) -> None:
         try:
             from ray_tpu.util import metrics as metrics_mod
@@ -314,9 +413,29 @@ class DecodeEngine:
                 "slot_occupancy", self.deployment, self.replica_id,
                 occupancy,
             )
+            metrics_mod.inc_serve_tokens(
+                "issued", occupancy, self.deployment
+            )
             self._kv.export_gauges()
         except Exception:  # rtlint: disable=swallowed-exception - metric export must never stall the decode loop
             pass
+        now = time.monotonic()
+        if now - self._last_kv_note_t >= 0.5:
+            # KV-headroom history rides the sequence timeline files —
+            # the series the diagnose rule fits its exhaustion trend to
+            # (the PR-5 oom_risk shape, least-squares over (ts, free)).
+            self._last_kv_note_t = now
+            try:
+                seq_obs.record({
+                    "kind": "kv", "ts": time.time(),
+                    "deployment": self.deployment,
+                    "replica": self.replica_id,
+                    "kv_free_frac": round(self._kv.free_frac(), 4),
+                    "kv_blocks_used": self._kv.used(),
+                    "kv_blocks_free": self._kv.free(),
+                })
+            except Exception:  # rtlint: disable=swallowed-exception - timeline export must never stall the decode loop
+                pass
 
     def queue_depth(self) -> int:
         return self._admit_chan.qsize() + len(self._deferred)
@@ -342,6 +461,7 @@ class DecodeEngine:
             "kv_blocks_free": self._kv.free(),
             "kv_free_frac": round(self._kv.free_frac(), 4),
             "fence": self.fence,
+            "token_ledger": self.ledger.snapshot(),
         }
 
     def load(self) -> dict:

@@ -1,0 +1,259 @@
+"""Token-level serve-LLM observability (ISSUE 19).
+
+Three cooperating pieces, all owned by the decode replica's event loop:
+
+* **TokenLedger** — the PR-8 goodput discipline applied to tokens:
+  every token the decode step issues is eventually classified into
+  exactly one of ``productive`` / ``shed`` / ``evicted`` /
+  ``replay_discarded`` when its sequence reaches a terminal state, so
+  ``issued == classified + in_flight`` holds at every instant and
+  ``issued == sum(classes)`` holds once the engine drains. A replayed
+  sequence (client resumed after a replica death, ``resume_from`` > 0)
+  charges its first ``resume_from`` tokens to ``replay_discarded`` —
+  the client's fence dedup drops those on the floor, so counting them
+  productive would double-count delivered work.
+
+* **Per-sequence timelines** — one JSONL record per terminal sequence
+  (``sequences-<pid>.jsonl`` beside the span files under
+  ``<session>/tracing/``): queue/admission wait, prefill time,
+  KV-transfer time, TTFT, inter-token p50/p99, the terminal cause, and
+  the trace id that followed the sequence through the channel plane.
+  The same files carry periodic ``kv`` records (KV-pool headroom over
+  time) — the history the diagnose rule fits a least-squares trend to,
+  exactly like the node agent's oom_risk projection.
+
+* **Sampling** — ``LLMConfig.seq_trace_sample`` gates the traced path.
+  The decision is a deterministic hash of request_id (NOT a PRNG), so
+  a replayed sequence keeps its sampling fate — and therefore its
+  trace id — across replica deaths. The unsampled/disabled path does
+  no span work and writes no timeline records; the ledger and the
+  TTFT/TPOT histograms stay on either way (O(1) arithmetic per token,
+  gated by the release overhead bench at <=2%).
+"""
+
+from __future__ import annotations
+
+import atexit
+import glob
+import hashlib
+import json
+import os
+import threading
+import time
+
+# Terminal ledger classes, in the order summaries render them.
+TOKEN_CLASSES = ("productive", "shed", "evicted", "replay_discarded")
+
+
+def sampled(request_id: str, sample: float) -> bool:
+    """Deterministic per-sequence sampling decision: a blake2b hash of
+    the request id against the configured fraction. Stable across
+    processes and replays (no PRNG state)."""
+    if sample >= 1.0:
+        return True
+    if sample <= 0.0:
+        return False
+    h = hashlib.blake2b(request_id.encode(), digest_size=4).digest()
+    return int.from_bytes(h, "big") / 0xFFFFFFFF < sample
+
+
+class TokenLedger:
+    """Exact-sum token accounting: ``issued`` counts every token the
+    decode step emits; terminal classification partitions them."""
+
+    __slots__ = (
+        "issued", "productive", "shed", "evicted", "replay_discarded",
+        "seqs_shed",
+    )
+
+    def __init__(self):
+        self.issued = 0
+        self.productive = 0
+        self.shed = 0
+        self.evicted = 0
+        self.replay_discarded = 0
+        # Sequences shed at admission never issue a token; counted
+        # separately so sheds stay visible even though their token
+        # contribution is structurally zero.
+        self.seqs_shed = 0
+
+    def issue(self, n: int = 1) -> None:
+        self.issued += n
+
+    def classify(self, seq, outcome: str) -> dict:
+        """Charge a terminal sequence's tokens: the first
+        ``resume_from`` of a replayed sequence to ``replay_discarded``
+        (the client's fence dedup already has them), the rest to
+        ``outcome``. Returns the per-class split for the timeline
+        record."""
+        n = len(seq.generated)
+        replayed = min(max(int(getattr(seq, "resume_from", 0)), 0), n)
+        fresh = n - replayed
+        self.replay_discarded += replayed
+        setattr(self, outcome, getattr(self, outcome) + fresh)
+        return {"class": outcome, "tokens": fresh,
+                "replay_discarded": replayed}
+
+    def in_flight(self) -> int:
+        return self.issued - (
+            self.productive + self.shed + self.evicted
+            + self.replay_discarded
+        )
+
+    def snapshot(self) -> dict:
+        return {
+            "issued": self.issued,
+            "productive": self.productive,
+            "shed": self.shed,
+            "evicted": self.evicted,
+            "replay_discarded": self.replay_discarded,
+            "in_flight": self.in_flight(),
+            "seqs_shed": self.seqs_shed,
+        }
+
+
+# -- sequence timeline exporter ---------------------------------------------
+# Same buffered-JSONL discipline as tracing.py's span exporter (append to
+# a thread-safe list, one batched write per flush), shared directory, so
+# ``ray_tpu timeline --seq`` and the dashboard read spans and sequence
+# records from one place.
+
+_lock = threading.Lock()
+_buffer: list[dict] = []
+_flusher_started = False
+# Age-based drain, same cadence discipline as the span flusher: a
+# decode replica writes ONE terminal record per sequence, so waiting
+# for a 256-record batch would strand records in memory for minutes.
+_FLUSH_AGE_S = 0.5
+
+
+def _export_path() -> str | None:
+    from ray_tpu.util import tracing
+
+    base = tracing._export_dir()
+    if base is None:
+        return None
+    os.makedirs(base, exist_ok=True)
+    return os.path.join(base, f"sequences-{os.getpid()}.jsonl")
+
+
+def _flush_loop() -> None:
+    while True:
+        time.sleep(_FLUSH_AGE_S)
+        try:
+            flush()
+        except Exception:  # rtlint: disable=swallowed-exception - keep the daemon alive through transient write failures
+            pass
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    with _lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+    threading.Thread(
+        target=_flush_loop, name="raytpu-seq-flusher", daemon=True
+    ).start()
+    atexit.register(flush)
+
+
+def record(rec: dict) -> None:
+    """Buffer one timeline record (``kind`` in {"seq", "kv"})."""
+    with _lock:
+        _buffer.append(rec)
+        should_flush = len(_buffer) >= 256
+    if not _flusher_started:
+        _ensure_flusher()
+    if should_flush:
+        flush()
+
+
+def flush() -> None:
+    with _lock:
+        batch, _buffer[:] = _buffer[:], ()
+    if not batch:
+        return
+    path = _export_path()
+    if path is None:
+        return
+    lines = "".join(
+        json.dumps(rec, separators=(",", ":")) + "\n" for rec in batch
+    )
+    with open(path, "a") as fh:
+        fh.write(lines)
+
+
+def read_sequences(session_dir: str) -> list[dict]:
+    """Every sequence/kv timeline record exported under a session
+    (tests, ``state.summarize_sequences``, the dashboard route)."""
+    flush()
+    out: list[dict] = []
+    for path in sorted(
+        glob.glob(os.path.join(session_dir, "tracing",
+                               "sequences-*.jsonl"))
+    ):
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+        except OSError:
+            continue
+    return out
+
+
+def percentile(values, frac: float) -> float:
+    """Nearest-rank percentile over a small list (inter-token gaps —
+    bounded by max_tokens, so sorting per terminal sequence is cheap)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(frac * len(ordered)))
+    return float(ordered[idx])
+
+
+def seq_record(seq, *, outcome: str, cause: str, split: dict,
+               deployment: str, replica_id: str, fence: str) -> dict:
+    """Build the terminal timeline record for one sequence. Times are
+    relative spans in seconds (monotonic-clock differences), plus one
+    wall-clock ``ts`` so cross-process records order coherently."""
+    import time
+
+    gaps = [
+        b - a for a, b in zip(seq.token_times, seq.token_times[1:])
+    ]
+    ttft = (
+        seq.first_token_at - seq.enqueued_at
+        if seq.first_token_at and seq.enqueued_at else 0.0
+    )
+    queue_wait = (
+        seq.slot_admitted_at - seq.enqueued_at
+        if seq.slot_admitted_at and seq.enqueued_at else 0.0
+    )
+    return {
+        "kind": "seq",
+        "ts": time.time(),
+        "request_id": seq.request_id,
+        "trace_id": (seq.trace_ctx or {}).get("trace_id", ""),
+        "deployment": deployment,
+        "replica": replica_id,
+        "fence": fence,
+        "outcome": outcome,
+        "cause": cause,
+        "tokens": len(seq.generated),
+        "replay_discarded": split.get("replay_discarded", 0),
+        "queue_wait_s": round(queue_wait, 6),
+        "prefill_s": round(seq.prefill_s, 6),
+        "kv_transfer_s": round(seq.kv_transfer_s, 6),
+        "ttft_s": round(ttft, 6),
+        "tpot_p50_s": round(percentile(gaps, 0.50), 6),
+        "tpot_p99_s": round(percentile(gaps, 0.99), 6),
+        # Relative token emission times (vs enqueue) for the Perfetto
+        # export's instant events; capped so a long generation can't
+        # bloat the record.
+        "token_rel_s": [
+            round(t - seq.enqueued_at, 6) for t in seq.token_times[:512]
+        ] if seq.enqueued_at else [],
+    }

@@ -23,9 +23,18 @@ residuals would never be consumed again.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
+from ray_tpu.util import tracing
 from ray_tpu.util.collective import flight
+
+# Trace-carrying envelope marker, shared shape with the rtdag device
+# wire's (ISSUE 19): a sampled trace context rides
+# ``(marker, ctx, payload)`` — the untraced payload is byte-identical
+# to the PR-17 wire.
+_TR_WIRE = "__tr"
 
 # Self-describing payload markers (same idiom as the pipeline activation
 # wire's "__act" envelope, so mixed exact/quantized wires share one
@@ -83,6 +92,8 @@ class KVDeviceWire:
         self._dst = dst
         self._wire_cfg = wire_cfg
         self.epoch = epoch
+        # Trace context of the most recent pop (single-consumer wire).
+        self.last_trace: dict | None = None
 
     def bump_epoch(self) -> None:
         """Fence the wire after a peer recovery: frames tagged with the
@@ -90,19 +101,52 @@ class KVDeviceWire:
         handoff is delivered exactly once (PR-16 semantics)."""
         self.epoch += 1
 
-    def push(self, seq: int, kv: np.ndarray) -> None:
+    def push(self, seq: int, kv: np.ndarray,
+             trace: dict | None = None) -> None:
+        tag = f"kvblk:p{self.epoch}:e{self._src}:{self._dst}:{seq}"
         payload = encode_kv_blocks(kv, self._wire_cfg)
-        with flight.site("serve_llm"):
-            self._group.send(
-                payload, self._peer,
-                tag=f"kvblk:p{self.epoch}:e{self._src}:{self._dst}:{seq}",
+        ctx = trace if trace is not None else tracing.inject()
+        span = None
+        if ctx is not None:
+            span = tracing.begin(
+                "channel.push", parent=ctx, channel=tag,
+                family="kv_wire", seq=seq, nbytes=int(kv.nbytes),
             )
+            # The producer-side span's OWN context rides the wire so
+            # the consumer's channel.pop parents on it (same causal
+            # chain as the rtdag device channel).
+            payload = (
+                _TR_WIRE,
+                {"trace_id": span.trace_id, "span_id": span.span_id},
+                payload,
+            )
+        with flight.site("serve_llm"), flight.trace(
+            ctx["trace_id"] if ctx else None
+        ):
+            self._group.send(payload, self._peer, tag=tag)
+        if span is not None:
+            tracing.finish(span)
 
     def pop(self, seq: int, *, timeout: float = 60.0) -> np.ndarray:
+        tag = f"kvblk:p{self.epoch}:e{self._src}:{self._dst}:{seq}"
+        started = time.monotonic()
         with flight.site("serve_llm"):
             payload = self._group.recv(
-                self._peer,
-                tag=f"kvblk:p{self.epoch}:e{self._src}:{self._dst}:{seq}",
-                timeout=timeout,
+                self._peer, tag=tag, timeout=timeout,
             )
+        if (
+            isinstance(payload, tuple) and len(payload) == 3
+            and payload[0] == _TR_WIRE
+        ):
+            _, ctx, payload = payload
+            self.last_trace = ctx
+            wait_s = time.monotonic() - started
+            end_ns = time.time_ns()
+            tracing.emit(
+                "channel.pop", ctx,
+                start_ns=end_ns - int(wait_s * 1e9), end_ns=end_ns,
+                channel=tag, family="kv_wire", seq=seq,
+            )
+        else:
+            self.last_trace = None
         return decode_kv_blocks(payload)

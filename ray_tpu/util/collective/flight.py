@@ -103,6 +103,35 @@ def _current_site() -> Optional[str]:
     return getattr(_site_tls, "value", None)
 
 
+_trace_tls = threading.local()
+
+
+class trace:
+    """Context manager stamping records created on this thread with a
+    trace id (ISSUE 19): channel push/pop call sites wrap their flight-
+    recorded ops so ``dag``/``serve_llm`` ring entries join the span
+    store on ``trace_id`` exactly like the collective sites do — the
+    group-internal p2p records a DeviceChannel send/recv creates pick
+    the ambient id up without the wire layer knowing about tracing."""
+
+    def __init__(self, trace_id: Optional[str]):
+        self.trace_id = trace_id
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_trace_tls, "value", None)
+        _trace_tls.value = self.trace_id
+        return self
+
+    def __exit__(self, *exc):
+        _trace_tls.value = self._prev
+        return False
+
+
+def _current_trace() -> Optional[str]:
+    return getattr(_trace_tls, "value", None)
+
+
 class CommRecord:
     """One fixed-shape ring entry. Mutated in place as the op advances
     (the inflight map and the ring share the object, so a snapshot sees
@@ -131,7 +160,7 @@ class CommRecord:
         self.t_enqueued = now
         self.t_launched = 0.0
         self.t_completed = 0.0
-        self.trace_id = None
+        self.trace_id = _current_trace()
         self.site = _current_site()
         self.stalled = False
 

@@ -359,6 +359,60 @@ def set_serve_replica_gauge(
     )
 
 
+_serve_token_hists: dict[str, Histogram] = {}
+_serve_token_counter: Counter | None = None
+
+# Token-level SLO bounds (ISSUE 19): TTFT spans queue wait + prefill +
+# KV transfer + the first decode iteration (request-latency-shaped);
+# TPOT is one decode iteration (orders of magnitude tighter).
+SERVE_TTFT_BOUNDARIES = SERVE_LATENCY_BOUNDARIES
+SERVE_TPOT_BOUNDARIES = (
+    0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0,
+)
+
+
+def record_serve_token_latency(
+    kind: str, seconds: float, deployment: str
+) -> None:
+    """rt_serve_ttft_s / rt_serve_tpot_s {deployment}: time-to-first-
+    token and time-per-output-token of the continuous-batching decode
+    path (ISSUE 19 token-level SLO)."""
+    hist = _serve_token_hists.get(kind)
+    if hist is None:
+        hist = _serve_token_hists[kind] = Histogram(
+            f"rt_serve_{kind}_s",
+            description=(
+                "Time to first token (seconds)" if kind == "ttft"
+                else "Time per output token (seconds)"
+            ),
+            boundaries=(
+                SERVE_TTFT_BOUNDARIES if kind == "ttft"
+                else SERVE_TPOT_BOUNDARIES
+            ),
+            tag_keys=("deployment",),
+        )
+    hist.observe(float(seconds), tags={"deployment": deployment})
+
+
+def inc_serve_tokens(cls: str, n: int, deployment: str) -> None:
+    """rt_serve_tokens_total{class,deployment}: the token goodput ledger
+    (ISSUE 19) — ``issued`` plus its exact partition into productive /
+    shed / evicted / replay_discarded as sequences reach a terminal
+    state."""
+    global _serve_token_counter
+    if n <= 0:
+        return
+    if _serve_token_counter is None:
+        _serve_token_counter = Counter(
+            "rt_serve_tokens_total",
+            description="Decode tokens by ledger class",
+            tag_keys=("class", "deployment"),
+        )
+    _serve_token_counter.inc(
+        n, tags={"class": cls, "deployment": deployment}
+    )
+
+
 def set_serve_kv_blocks(
     deployment: str, replica_id: str, used: int, free: int
 ) -> None:

@@ -453,6 +453,75 @@ def summarize_goodput() -> dict:
     return {"runs": runs}
 
 
+def summarize_sequences(session_dir: str | None = None,
+                        limit: int = 200) -> dict:
+    """Token-level serving observability rollup (ISSUE 19).
+
+    Reads the per-sequence timeline records the decode engines exported
+    beside the span files (``<session>/tracing/sequences-*.jsonl``) and
+    returns::
+
+        {"count": N, "by_outcome": {outcome: n},
+         "ttft_p50_s": .., "ttft_p99_s": ..,
+         "tpot_p50_s": .., "tpot_p99_s": ..,
+         "ledger": {issued, productive, shed, evicted,
+                    replay_discarded},
+         "kv_history": [(ts, kv_free_frac), ...],   # trend input
+         "sequences": [... newest ``limit`` seq records ...]}
+
+    Empty structure — never an exception — on a fresh cluster or with
+    sequence sampling off."""
+    empty = {
+        "count": 0, "by_outcome": {}, "ttft_p50_s": 0.0,
+        "ttft_p99_s": 0.0, "tpot_p50_s": 0.0, "tpot_p99_s": 0.0,
+        "ledger": {}, "kv_history": [], "sequences": [],
+    }
+    session_dir = session_dir or _session_dir()
+    if not session_dir:
+        return empty
+    try:
+        from ray_tpu.serve.llm import observability as seq_obs
+
+        records = seq_obs.read_sequences(session_dir)
+    except Exception:
+        return empty
+    seqs = [r for r in records if r.get("kind") == "seq"]
+    kv = [r for r in records if r.get("kind") == "kv"]
+    by_outcome: dict[str, int] = {}
+    ttfts: list[float] = []
+    tpots: list[float] = []
+    ledger = {
+        "productive": 0, "shed": 0, "evicted": 0, "replay_discarded": 0,
+    }
+    for rec in seqs:
+        outcome = str(rec.get("outcome", ""))
+        by_outcome[outcome] = by_outcome.get(outcome, 0) + 1
+        if rec.get("tokens"):
+            ttfts.append(float(rec.get("ttft_s", 0.0)))
+            tpots.append(float(rec.get("tpot_p50_s", 0.0)))
+        if outcome in ledger:
+            ledger[outcome] += int(rec.get("tokens", 0))
+        ledger["replay_discarded"] += int(rec.get("replay_discarded", 0))
+    ledger["issued"] = sum(ledger.values())
+    ttfts.sort()
+    tpots.sort()
+    seqs.sort(key=lambda r: r.get("ts", 0.0))
+    return {
+        "count": len(seqs),
+        "by_outcome": by_outcome,
+        "ttft_p50_s": _percentile(ttfts, 0.50),
+        "ttft_p99_s": _percentile(ttfts, 0.99),
+        "tpot_p50_s": _percentile(tpots, 0.50),
+        "tpot_p99_s": _percentile(tpots, 0.99),
+        "ledger": ledger,
+        "kv_history": [
+            (float(r.get("ts", 0.0)), float(r.get("kv_free_frac", 0.0)))
+            for r in kv
+        ],
+        "sequences": seqs[-limit:],
+    }
+
+
 def collect_diagnose_snapshot(session_dir: str | None = None) -> dict:
     """Assemble the cross-subsystem snapshot that feeds
     ``ray_tpu._private.workload.diagnose`` (and the `ray_tpu diagnose`
@@ -469,7 +538,12 @@ def collect_diagnose_snapshot(session_dir: str | None = None) -> dict:
         "workload": {"series": {}},
         "rank_records": {},
         "commflight": {},
+        "serve_llm": {},
     }
+    try:
+        snapshot["serve_llm"] = summarize_sequences(session_dir)
+    except Exception:  # rtlint: disable=swallowed-exception - summaries are independent; a failed one keeps its default
+        pass
     try:
         snapshot["latency"] = summarize_latency(session_dir)
     except Exception:  # rtlint: disable=swallowed-exception - summaries are independent; a failed one keeps its default

@@ -188,3 +188,62 @@ def build_chrome_trace(
         except Exception:  # rtlint: disable=swallowed-exception - counter events are optional enrichment
             pass
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def build_sequence_trace(session_dir: str, request_id: str) -> dict:
+    """Perfetto view of ONE served sequence (ISSUE 19,
+    ``ray_tpu timeline --seq <id>``): every span that shares the
+    sequence's trace id — proxy request, replica handling, prefill, KV
+    transfer/wire hops, channel push/pop, decode iterations — plus an
+    instant event per emitted token, so TTFT and inter-token gaps are
+    readable off the ruler.
+
+    Raises KeyError when no terminal timeline record exists for
+    ``request_id`` (not served, not sampled, or sampling disabled)."""
+    from ray_tpu.serve.llm import observability as seq_obs
+
+    seq_rec = None
+    for rec in seq_obs.read_sequences(session_dir):
+        if rec.get("kind") == "seq" and rec.get("request_id") == request_id:
+            seq_rec = rec  # keep the LAST record (replays re-export)
+    if seq_rec is None:
+        raise KeyError(
+            f"no sequence timeline record for request_id={request_id!r} "
+            "(was the sequence sampled? see LLMConfig.seq_trace_sample)"
+        )
+    trace_id = seq_rec.get("trace_id") or ""
+    spans = [
+        s for s in tracing.read_spans(session_dir)
+        if trace_id and s.get("trace_id") == trace_id
+    ]
+    events = _span_events(spans)
+    # Token instants ride the ingress track (the earliest span's pid,
+    # else a synthetic one): ts anchors on the trace's first span so
+    # the relative emission offsets land on the same axis.
+    starts = [s.get("start_ns") or 0 for s in spans if s.get("start_ns")]
+    rels = seq_rec.get("token_rel_s") or []
+    if starts:
+        anchor_us = min(starts) / 1e3
+    elif rels:
+        # No spans (tracing off, sampled timeline only): reconstruct
+        # the enqueue wall time from the terminal record's timestamp.
+        anchor_us = (float(seq_rec.get("ts", 0.0)) - rels[-1]) * 1e6
+    else:
+        anchor_us = 0.0
+    pid = spans[0].get("pid", 0) if spans else 0
+    for i, rel_s in enumerate(rels):
+        events.append({
+            "name": f"token[{i}]",
+            "cat": "token",
+            "ph": "i",
+            "s": "p",
+            "ts": anchor_us + rel_s * 1e6,
+            "pid": pid,
+            "tid": 0,
+            "args": {"request_id": request_id, "index": i},
+        })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {"sequence": seq_rec},
+    }

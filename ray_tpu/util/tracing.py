@@ -391,6 +391,51 @@ def context_of(record: Span | None) -> dict | None:
     return {"trace_id": record.trace_id, "span_id": record.span_id}
 
 
+# -- compact wire context (ISSUE 19) ----------------------------------------
+# The rtdag channel plane moves payloads with no RPC frame to ride, so the
+# trace context crosses processes as a fixed 25-byte binary segment:
+# 16-byte trace_id + 8-byte span_id + 1 flags byte (bit 0 = sampled).
+# Hex round-trips exactly (ids are generated as 32/16 hex chars above).
+
+CTX_WIRE_SIZE = 25
+_FLAG_SAMPLED = 0x01
+
+
+def pack_ctx(ctx: dict | tuple | None) -> bytes:
+    """Encode an injected context for a channel frame header. Returns
+    b"" for None (the disabled path writes zero extra bytes beyond the
+    1-byte length that frames always carry)."""
+    if ctx is None:
+        return b""
+    if isinstance(ctx, dict):
+        trace_id, span_id = ctx["trace_id"], ctx["span_id"]
+    else:
+        trace_id, span_id = ctx
+    try:
+        return (
+            bytes.fromhex(trace_id)
+            + bytes.fromhex(span_id)
+            + bytes([_FLAG_SAMPLED])
+        )
+    except ValueError:
+        # Foreign-format ids (an OTLP bridge injecting its own): drop
+        # rather than corrupt the frame.
+        return b""
+
+
+def unpack_ctx(buf) -> dict | None:
+    """Decode a pack_ctx segment back to an injectable dict (None for
+    empty/short segments)."""
+    if not buf or len(buf) < CTX_WIRE_SIZE:
+        return None
+    b = bytes(buf[:CTX_WIRE_SIZE])
+    return {
+        "trace_id": b[:16].hex(),
+        "span_id": b[16:24].hex(),
+        "sampled": bool(b[24] & _FLAG_SAMPLED),
+    }
+
+
 def read_spans(session_dir: str) -> list[dict]:
     """All spans exported under a session (tests + dashboard route)."""
     flush()  # surface this process's buffered spans first

@@ -504,3 +504,78 @@ def test_collective_spans_join_flight_records(traced_cluster):
     }
     rec_keys = {(r["trace_id"], r["seq"]) for r in records}
     assert span_keys == rec_keys
+
+
+def test_dag_channel_trace_joins_flight_records(traced_cluster):
+    """ISSUE 19 satellite: compiled-dag channel hops carry the driver's
+    trace id end to end — the site="dag" flight records are stamped with
+    it, and the exported channel.push/channel.pop/dag.stage spans form
+    one causally-linked trace across processes."""
+    from ray_tpu.dag import InputNode
+    from ray_tpu.util import tracing
+    from ray_tpu.util.collective import flight
+
+    @ray_tpu.remote
+    class Hop:
+        def add(self, x):
+            return x + 1
+
+    a, b = Hop.remote(), Hop.remote()
+    with InputNode() as inp:
+        out = b.add.bind(a.add.bind(inp))
+    dag = out.experimental_compile()
+    try:
+        with tracing.span("dag.ingress") as root:
+            assert dag.execute(40).get(timeout=60) == 42
+            root_trace = root.trace_id
+
+        # Driver-side flight records (input push, output pop) join the
+        # trace on trace_id — the dark-plane half of the PR-14 ring.
+        recs = [
+            r for r in flight.snapshot(512)
+            if r.get("site") == "dag" and r.get("trace_id") == root_trace
+        ]
+        kinds = {r["kind"] for r in recs}
+        assert "chan_push" in kinds, recs
+        assert "chan_pop" in kinds, recs
+
+        # Exported spans: the frame context crossed both workers.
+        deadline = time.monotonic() + 30
+        by_name = {}
+        while time.monotonic() < deadline:
+            by_name = {}
+            for s in tracing.read_spans(traced_cluster):
+                if s["trace_id"] == root_trace:
+                    by_name.setdefault(s["name"], []).append(s)
+            if (len(by_name.get("dag.stage add", [])) >= 2
+                    and len(by_name.get("channel.push", [])) >= 2
+                    and by_name.get("channel.pop")):
+                break
+            time.sleep(0.2)
+        stages = by_name.get("dag.stage add", [])
+        assert len(stages) >= 2, sorted(by_name)
+        assert {s["pid"] for s in stages} != {root.to_json()["pid"]}
+        # Causal chain: every channel.pop parents on a channel.push
+        # whose context rode the frame.
+        push_ids = {s["span_id"] for s in by_name.get("channel.push", [])}
+        pops = by_name.get("channel.pop", [])
+        assert pops and all(s["parent_id"] in push_ids for s in pops)
+    finally:
+        dag.close()
+
+
+def test_flight_note_stamps_site_and_trace():
+    """The serve_llm site + explicit trace ids land on instantaneous
+    ring records (the KV wire's join key into the flight ring)."""
+    from ray_tpu.util.collective import flight
+
+    tid = "12" * 16
+    with flight.site("serve_llm"), flight.trace(tid):
+        flight.note("g", "chan_push", tag="unit", nbytes=3)
+    rec = next(
+        r for r in reversed(flight.snapshot(64))
+        if r["kind"] == "chan_push" and r["tag"] == "unit"
+    )
+    assert rec["site"] == "serve_llm"
+    assert rec["trace_id"] == tid
+    assert rec["bytes"] == 3

@@ -1,0 +1,583 @@
+"""Trace continuity + token-level serve-LLM SLO observability (ISSUE 19).
+
+Layers:
+  * pure: 25-byte wire context roundtrip, deterministic per-request
+    sampling, TokenLedger exact-sum accounting with replay dedup,
+    KV device-wire trace preservation across epoch fencing (satellite
+    2), diagnose rules for TTFT/TPOT SLO breach + KV-headroom trend
+    (satellite 3), per-sequence Perfetto export on synthetic files,
+  * asyncio: DecodeEngine ledger classification with ``resume_from``
+    replays, per-sequence timeline + kv-headroom records landing in the
+    session's tracing dir,
+  * e2e (satellite 4): one trace id proxy -> prefill -> decode -> every
+    token through a real cluster, joined to the ``--seq`` export.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import global_config
+from ray_tpu.serve.llm import (
+    DecodeEngine,
+    KVDeviceWire,
+    LLMConfig,
+    SequenceState,
+)
+from ray_tpu.serve.llm import observability as seq_obs
+from ray_tpu.serve.llm.deployments import ToyLM, tokenize
+from ray_tpu.util import tracing
+
+TRACE_ID = "ab" * 16  # 32 hex chars, the generated-id shape
+SPAN_ID = "cd" * 8
+
+
+# ---------------------------------------------------------------------------
+# pure: the 25-byte channel-frame trace segment
+# ---------------------------------------------------------------------------
+
+def test_ctx_wire_roundtrip():
+    ctx = {"trace_id": TRACE_ID, "span_id": SPAN_ID}
+    buf = tracing.pack_ctx(ctx)
+    assert len(buf) == tracing.CTX_WIRE_SIZE
+    back = tracing.unpack_ctx(buf)
+    assert back["trace_id"] == TRACE_ID
+    assert back["span_id"] == SPAN_ID
+    assert back["sampled"] is True
+    # Tuple form (hot paths avoid the dict build).
+    assert tracing.pack_ctx((TRACE_ID, SPAN_ID)) == buf
+    # Disabled path: zero bytes on the wire, None back out.
+    assert tracing.pack_ctx(None) == b""
+    assert tracing.unpack_ctx(b"") is None
+    assert tracing.unpack_ctx(buf[:10]) is None
+    # Foreign-format ids must not corrupt the frame: dropped, not raised.
+    assert tracing.pack_ctx({"trace_id": "zz", "span_id": "qq"}) == b""
+
+
+def test_seq_sampling_deterministic():
+    # Edges are exact.
+    assert seq_obs.sampled("anything", 1.0) is True
+    assert seq_obs.sampled("anything", 0.0) is False
+    # Stable: the same request id gets the same fate every call — a
+    # replayed sequence keeps its sampling decision (and trace id).
+    ids = [f"req-{i}" for i in range(2000)]
+    first = {r: seq_obs.sampled(r, 0.25) for r in ids}
+    assert all(seq_obs.sampled(r, 0.25) == first[r] for r in ids)
+    # The hash is near-uniform: ~25% of ids sample in.
+    hit = sum(first.values())
+    assert 350 < hit < 650, hit
+
+
+# ---------------------------------------------------------------------------
+# pure: token ledger exact-sum + replay dedup
+# ---------------------------------------------------------------------------
+
+def _seq(request_id, n_tokens, resume_from=0):
+    s = SequenceState(request_id=request_id, prompt_tokens=[1, 2],
+                      max_tokens=n_tokens)
+    s.generated = list(range(n_tokens))
+    s.resume_from = resume_from
+    return s
+
+
+def test_token_ledger_exact_sum_and_replay_dedup():
+    ledger = seq_obs.TokenLedger()
+    ledger.issue(10)
+    split = ledger.classify(_seq("a", 10), "productive")
+    assert split == {"class": "productive", "tokens": 10,
+                     "replay_discarded": 0}
+    # Replayed sequence: the client already holds the first 4 tokens
+    # (fence dedup drops their replays) — they must NOT double-count.
+    ledger.issue(10)
+    split = ledger.classify(_seq("b", 10, resume_from=4), "productive")
+    assert split["tokens"] == 6 and split["replay_discarded"] == 4
+    # Eviction after replay: the fresh remainder charges to evicted.
+    ledger.issue(5)
+    split = ledger.classify(_seq("c", 5, resume_from=2), "evicted")
+    assert split == {"class": "evicted", "tokens": 3,
+                     "replay_discarded": 2}
+    # resume_from beyond the generation clamps (a replay that died
+    # before reaching the client's resume point).
+    ledger.issue(3)
+    split = ledger.classify(_seq("d", 3, resume_from=99), "shed")
+    assert split["tokens"] == 0 and split["replay_discarded"] == 3
+    snap = ledger.snapshot()
+    assert snap["issued"] == 28
+    assert snap["issued"] == (
+        snap["productive"] + snap["shed"] + snap["evicted"]
+        + snap["replay_discarded"]
+    )
+    assert snap["replay_discarded"] == 9
+    assert snap["in_flight"] == 0
+    # Mid-flight: issued tokens not yet classified are visible.
+    ledger.issue(7)
+    assert ledger.in_flight() == 7
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: KV device wire keeps the original trace id across a
+# fenced replay (PR-16 epoch semantics)
+# ---------------------------------------------------------------------------
+
+class _MailboxGroup:
+    """Fake p2p group: tag-addressed one-shot mailboxes (the
+    test_serve_llm idiom for the collective transport)."""
+
+    def __init__(self):
+        self.box = {}
+
+    def send(self, payload, peer, *, tag):
+        self.box[tag] = payload
+
+    def recv(self, peer, *, tag, timeout=None):
+        if tag not in self.box:
+            raise TimeoutError(f"no frame for tag {tag!r}")
+        return self.box.pop(tag)
+
+
+def test_kv_wire_trace_survives_epoch_fenced_replay():
+    group = _MailboxGroup()
+    cfg = LLMConfig(kv_wire_quantize=None)
+    tx = KVDeviceWire(group, peer=1, src=0, dst=1,
+                      wire_cfg=cfg.wire_config())
+    rx = KVDeviceWire(group, peer=0, src=0, dst=1)
+    kv = np.arange(32, dtype=np.float32).reshape(4, 8)
+    ctx = {"trace_id": TRACE_ID, "span_id": SPAN_ID}
+
+    tx.push(3, kv, trace=ctx)
+    np.testing.assert_array_equal(rx.pop(3), kv)
+    # The consumer sees the producer's trace: same trace id (the span
+    # id is the push span's own — the causal parent for channel.pop).
+    assert rx.last_trace["trace_id"] == TRACE_ID
+
+    # Pre-crash frame + epoch bump: the stale frame is unreadable, and
+    # the replayed handoff — pushed with the ORIGINAL context, because
+    # sampling is a deterministic hash of request_id — delivers the
+    # original trace id exactly once.
+    tx.push(4, kv, trace=ctx)
+    rx.bump_epoch()
+    with pytest.raises(TimeoutError):
+        rx.pop(4, timeout=0.01)
+    tx.bump_epoch()
+    tx.push(4, kv * 2.0, trace=ctx)
+    np.testing.assert_array_equal(rx.pop(4), kv * 2.0)
+    assert rx.last_trace["trace_id"] == TRACE_ID
+    assert "kvblk:p0:e0:1:4" in group.box  # fenced frame rots unread
+
+    # Unsampled handoff: bare payload, last_trace cleared.
+    tx.push(5, kv)
+    np.testing.assert_array_equal(rx.pop(5), kv)
+    assert rx.last_trace is None
+
+
+# ---------------------------------------------------------------------------
+# asyncio: engine ledger classification with replays (satellite 2) and
+# the per-sequence timeline + kv-headroom export
+# ---------------------------------------------------------------------------
+
+def _make_seq(cfg, model, prompt, max_tokens, *, request_id=None,
+              resume_from=0):
+    from ray_tpu.serve._private.common import Deadline
+
+    toks = tokenize(prompt)
+    s = SequenceState(
+        request_id=request_id or prompt,
+        prompt_tokens=toks,
+        max_tokens=max_tokens,
+        kv_data=model.prefill(toks, ""),
+        deadline=Deadline.never(),
+    )
+    s.resume_from = resume_from
+    return s
+
+
+def test_engine_ledger_replay_discarded_exact_sum():
+    """A replayed sequence (resume_from > 0) re-decodes every token, but
+    the ledger charges the client-held prefix to replay_discarded — the
+    classes still sum exactly to issued once the engine drains."""
+    cfg = LLMConfig(max_slots=4, num_kv_blocks=64)
+
+    async def main():
+        model = ToyLM(cfg)
+        eng = DecodeEngine(cfg, model)
+        fresh = _make_seq(cfg, model, "fresh", 8)
+        replay = _make_seq(cfg, model, "replayed", 10, resume_from=4)
+        await eng.submit(fresh)
+        await eng.submit(replay)
+        await asyncio.gather(fresh.future, replay.future)
+        eng.stop()
+        return eng
+
+    eng = asyncio.run(main())
+    snap = eng.ledger.snapshot()
+    # Every issued token is classified; nothing in flight after drain.
+    assert snap["in_flight"] == 0
+    assert snap["issued"] == 18
+    assert snap["issued"] == (
+        snap["productive"] + snap["shed"] + snap["evicted"]
+        + snap["replay_discarded"]
+    )
+    assert snap["replay_discarded"] == 4
+    assert snap["productive"] == 14
+    assert eng.stats()["token_ledger"]["issued"] == 18
+
+
+@pytest.fixture()
+def seq_export_dir(tmp_path):
+    """Route span + sequence exports to a throwaway dir, restoring the
+    process-global tracing state afterwards (tracing._dir and the
+    enabled flag leak across test files otherwise)."""
+    old_dir = tracing._dir
+    old_enabled = global_config().tracing_enabled
+    tracing.configure(str(tmp_path))
+    global_config().tracing_enabled = True
+    yield str(tmp_path)
+    seq_obs.flush()
+    tracing.flush()
+    tracing._dir = old_dir
+    global_config().tracing_enabled = old_enabled
+
+
+def test_engine_exports_sequence_timeline_and_kv_history(seq_export_dir):
+    """Sampled sequences leave terminal timeline records + periodic
+    kv-headroom records in the session tracing dir, and decode.iter
+    spans parent on the sequence's trace."""
+    from ray_tpu.util import state as state_mod
+
+    cfg = LLMConfig(max_slots=4, num_kv_blocks=64)
+    ctx = {"trace_id": TRACE_ID, "span_id": SPAN_ID}
+
+    async def main():
+        model = ToyLM(cfg)
+        eng = DecodeEngine(cfg, model, deployment="llm_decode",
+                           replica_id="r0")
+        seq = _make_seq(cfg, model, "timed", 6, request_id="seq-timed")
+        seq.sampled = True
+        seq.trace_ctx = ctx
+        await eng.submit(seq)
+        await seq.future
+        eng.stop()
+        return eng
+
+    eng = asyncio.run(main())
+    records = seq_obs.read_sequences(seq_export_dir)
+    seqs = [r for r in records if r.get("kind") == "seq"]
+    assert len(seqs) == 1
+    rec = seqs[0]
+    assert rec["request_id"] == "seq-timed"
+    assert rec["trace_id"] == TRACE_ID
+    assert rec["outcome"] == "productive" and rec["cause"] == "completed"
+    assert rec["tokens"] == 6 and rec["replay_discarded"] == 0
+    assert rec["fence"] == eng.fence
+    assert rec["ttft_s"] > 0 and rec["tpot_p99_s"] >= 0
+    assert len(rec["token_rel_s"]) == 6
+    assert rec["token_rel_s"] == sorted(rec["token_rel_s"])
+    # KV-headroom history (the diagnose trend input) rides the same
+    # files; the first iteration always writes one.
+    kv = [r for r in records if r.get("kind") == "kv"]
+    assert kv and 0.0 <= kv[0]["kv_free_frac"] <= 1.0
+    # decode.iter spans joined the sequence's trace.
+    iters = [s for s in tracing.read_spans(seq_export_dir)
+             if s["name"] == "decode.iter"]
+    assert len(iters) == 6
+    assert all(s["trace_id"] == TRACE_ID for s in iters)
+    assert all(s["parent_id"] == SPAN_ID for s in iters)
+    # The rollup sums the ledger from records: issued == sum(classes).
+    summary = state_mod.summarize_sequences(seq_export_dir)
+    assert summary["count"] == 1
+    assert summary["by_outcome"] == {"productive": 1}
+    led = summary["ledger"]
+    assert led["issued"] == led["productive"] + led["shed"] + \
+        led["evicted"] + led["replay_discarded"] == 6
+    assert summary["kv_history"]
+    assert summary["ttft_p99_s"] > 0
+
+
+def test_engine_unsampled_writes_no_timeline(seq_export_dir):
+    """The unsampled path is free of timeline records — the gate the
+    overhead bench relies on."""
+    cfg = LLMConfig(max_slots=2, num_kv_blocks=32)
+
+    async def main():
+        model = ToyLM(cfg)
+        eng = DecodeEngine(cfg, model)
+        seq = _make_seq(cfg, model, "dark", 4)
+        assert seq.sampled is False
+        await eng.submit(seq)
+        await seq.future
+        eng.stop()
+
+    asyncio.run(main())
+    records = seq_obs.read_sequences(seq_export_dir)
+    assert [r for r in records if r.get("kind") == "seq"] == []
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: diagnose findings for SLO breach + KV-headroom trend
+# ---------------------------------------------------------------------------
+
+def _snapshot(**over):
+    snap = {
+        "latency": {},
+        "comm": {},
+        "resources": {"nodes": {}},
+        "goodput": {"runs": {}},
+        "workload": {"series": {}},
+        "rank_records": {},
+    }
+    snap.update(over)
+    return snap
+
+
+def test_diagnose_serve_llm_slo_and_kv_trend():
+    from ray_tpu._private.workload import diagnose
+
+    t0 = 1000.0
+    serve_llm = {
+        "count": 8,
+        "ttft_p99_s": 0.9,    # over the 500ms SLO
+        "tpot_p99_s": 0.25,   # over the 100ms SLO
+        "by_outcome": {"productive": 6, "evicted": 2},
+        "ledger": {"issued": 100, "productive": 80, "shed": 0,
+                   "evicted": 15, "replay_discarded": 5},
+        # 0.5 -> 0.2 free over 10s: least-squares projects exhaustion
+        # well inside the 60s horizon while current is still healthy.
+        "kv_history": [[t0, 0.5], [t0 + 5, 0.35], [t0 + 10, 0.2]],
+    }
+    findings = diagnose(_snapshot(serve_llm=serve_llm))
+    kinds = {f["kind"] for f in findings}
+    assert {"serve_ttft_slo", "serve_tpot_slo", "token_goodput",
+            "kv_headroom_trend"} <= kinds
+    ttft = next(f for f in findings if f["kind"] == "serve_ttft_slo")
+    assert ttft["severity"] == "warn"
+    assert "ray_tpu timeline --seq" in ttft["message"]
+    trend = next(f for f in findings if f["kind"] == "kv_headroom_trend")
+    assert trend["data"]["projected_free_frac"] <= 0.05
+    assert trend["data"]["kv_free_frac"] == pytest.approx(0.2)
+
+
+def test_diagnose_serve_llm_healthy_is_quiet():
+    from ray_tpu._private.workload import diagnose
+
+    t0 = 1000.0
+    serve_llm = {
+        "count": 8,
+        "ttft_p99_s": 0.05,
+        "tpot_p99_s": 0.01,
+        "by_outcome": {"productive": 8},
+        "ledger": {"issued": 100, "productive": 98, "shed": 0,
+                   "evicted": 1, "replay_discarded": 1},
+        # Flat headroom: no trend.
+        "kv_history": [[t0, 0.6], [t0 + 5, 0.6], [t0 + 10, 0.6]],
+    }
+    findings = diagnose(_snapshot(serve_llm=serve_llm))
+    kinds = {f["kind"] for f in findings}
+    assert not kinds & {"serve_ttft_slo", "serve_tpot_slo",
+                        "token_goodput", "kv_headroom_trend"}
+    # No sequences at all: the rules stay silent too (fresh cluster).
+    findings = diagnose(_snapshot(serve_llm={"count": 0}))
+    assert not {f["kind"] for f in findings} & {
+        "serve_ttft_slo", "serve_tpot_slo"}
+
+
+# ---------------------------------------------------------------------------
+# the per-sequence Perfetto export (synthetic files; the e2e test below
+# exercises it against a real cluster)
+# ---------------------------------------------------------------------------
+
+def test_build_sequence_trace_from_synthetic_session(tmp_path):
+    from ray_tpu.util.timeline import build_sequence_trace
+
+    tdir = tmp_path / "tracing"
+    tdir.mkdir()
+    base_ns = 1_700_000_000 * 10**9
+    spans = [
+        {"name": "serve.request /llm", "trace_id": TRACE_ID,
+         "span_id": "a" * 16, "parent_id": None,
+         "start_ns": base_ns, "end_ns": base_ns + 50_000_000,
+         "status": "ok", "pid": 1, "attributes": {}},
+        {"name": "decode.iter", "trace_id": TRACE_ID,
+         "span_id": "b" * 16, "parent_id": "a" * 16,
+         "start_ns": base_ns + 10_000_000,
+         "end_ns": base_ns + 20_000_000,
+         "status": "ok", "pid": 2, "attributes": {"slots": 1}},
+        # A different trace must NOT leak into the view.
+        {"name": "decode.iter", "trace_id": "ef" * 16,
+         "span_id": "c" * 16, "parent_id": None,
+         "start_ns": base_ns, "end_ns": base_ns + 1000,
+         "status": "ok", "pid": 2, "attributes": {}},
+    ]
+    with open(tdir / "spans-1.jsonl", "w") as fh:
+        for s in spans:
+            fh.write(json.dumps(s) + "\n")
+    seq_rec = {"kind": "seq", "ts": base_ns / 1e9 + 0.05,
+               "request_id": "r1", "trace_id": TRACE_ID,
+               "outcome": "productive", "cause": "completed",
+               "tokens": 3, "replay_discarded": 0,
+               "ttft_s": 0.012, "tpot_p50_s": 0.004,
+               "tpot_p99_s": 0.008,
+               "token_rel_s": [0.012, 0.016, 0.024]}
+    with open(tdir / "sequences-1.jsonl", "w") as fh:
+        fh.write(json.dumps(seq_rec) + "\n")
+
+    trace = build_sequence_trace(str(tmp_path), "r1")
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"serve.request /llm", "decode.iter"}
+    # Causal ordering: the child decode.iter starts inside its parent.
+    req = next(e for e in xs if e["name"].startswith("serve.request"))
+    it = next(e for e in xs if e["name"] == "decode.iter")
+    assert it["args"]["parent_id"] == req["args"]["span_id"]
+    assert req["ts"] <= it["ts"] <= req["ts"] + req["dur"]
+    # One instant per emitted token, anchored on the first span.
+    tokens = [e for e in events if e.get("cat") == "token"]
+    assert len(tokens) == 3 and all(e["ph"] == "i" for e in tokens)
+    ts = [e["ts"] for e in tokens]
+    assert ts == sorted(ts) and ts[0] >= req["ts"]
+    assert trace["metadata"]["sequence"]["request_id"] == "r1"
+    json.dumps(trace)  # what the CLI writes to --out
+    # Unknown / unsampled request ids raise with the sampling hint.
+    with pytest.raises(KeyError, match="seq_trace_sample"):
+        build_sequence_trace(str(tmp_path), "nope")
+
+
+# ---------------------------------------------------------------------------
+# e2e (satellite 4): one trace id proxy -> prefill -> decode -> tokens
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def traced_cluster():
+    assert not ray_tpu.is_initialized()
+    os.environ["RAY_TPU_tracing_enabled"] = "1"
+    global_config().tracing_enabled = True
+    ray_tpu.init(num_cpus=8)
+    from ray_tpu._private import worker as worker_mod
+
+    yield worker_mod._local_cluster.session_dir
+    from ray_tpu import serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_tracing_enabled", None)
+    global_config().tracing_enabled = False
+
+
+def _expected_tokens(prompt, n, model_id="", vocab=32000):
+    from ray_tpu.serve.llm.deployments import _digest
+
+    toks = tokenize(prompt)
+    return [_digest(model_id, tuple(toks), i) % vocab for i in range(n)]
+
+
+def test_llm_trace_continuity_end_to_end(traced_cluster):
+    """The ingress trace id survives proxy -> prefill -> KV transfer ->
+    decode iterations -> the terminal timeline record, and the --seq
+    Perfetto export renders the whole causally-linked chain."""
+    import httpx
+
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import build_llm_app
+    from ray_tpu.util.timeline import build_sequence_trace
+
+    serve.start(http_port=8186)
+    app = build_llm_app({"max_slots": 8, "num_kv_blocks": 128})
+    serve.run(app, name="llmtr", route_prefix="/llmtr", http_port=8186)
+    trace_id = "beef" * 8
+    parent_span = "cafe" * 4
+    resp = httpx.post(
+        "http://127.0.0.1:8186/llmtr",
+        json={"prompt": "trace me", "max_tokens": 5,
+              "request_id": "seqtrace1"},
+        headers={"X-RayTPU-Trace": f"{trace_id}:{parent_span}"},
+        timeout=60,
+    )
+    assert resp.status_code == 200, resp.text
+    assert resp.json()["tokens"] == _expected_tokens("trace me", 5)
+
+    # One trace, across processes: the proxy span, the decode replica's
+    # prefill + KV transfer, and every decode iteration share the
+    # header's trace id.
+    wanted = {"serve.request /llmtr", "serve.prefill",
+              "serve.kv_transfer", "decode.iter"}
+    deadline = time.monotonic() + 30
+    by_name = {}
+    while time.monotonic() < deadline:
+        spans = [s for s in tracing.read_spans(traced_cluster)
+                 if s["trace_id"] == trace_id]
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        if wanted <= set(by_name) and len(by_name["decode.iter"]) >= 5:
+            break
+        time.sleep(0.2)
+    assert wanted <= set(by_name), sorted(by_name)
+    req = by_name["serve.request /llmtr"][0]
+    assert req["parent_id"] == parent_span
+    assert len(by_name["decode.iter"]) == 5  # one span per token
+
+    # The terminal timeline record joins on the same trace id.
+    deadline = time.monotonic() + 30
+    rec = None
+    while time.monotonic() < deadline and rec is None:
+        rec = next(
+            (r for r in seq_obs.read_sequences(traced_cluster)
+             if r.get("kind") == "seq"
+             and r.get("request_id") == "seqtrace1"),
+            None,
+        )
+        time.sleep(0.2)
+    assert rec is not None, "terminal sequence record never exported"
+    assert rec["trace_id"] == trace_id
+    assert rec["outcome"] == "productive" and rec["tokens"] == 5
+
+    # The --seq export: a valid, causally-ordered Perfetto view.
+    trace = build_sequence_trace(traced_cluster, "seqtrace1")
+    events = trace["traceEvents"]
+    xs = {e["name"] for e in events if e["ph"] == "X"}
+    assert wanted <= xs
+    by_span = {e["args"]["span_id"]: e for e in events
+               if e["ph"] == "X"}
+    for ev in by_span.values():
+        parent = by_span.get(ev["args"].get("parent_id"))
+        if parent is not None:
+            # Cross-process clocks: allow a small skew.
+            assert ev["ts"] >= parent["ts"] - 5_000, (ev, parent)
+    tokens = [e for e in events if e.get("cat") == "token"]
+    assert len(tokens) == 5
+    assert [e["ts"] for e in tokens] == sorted(e["ts"] for e in tokens)
+    json.dumps(trace)
+
+
+def test_llm_stream_tokens_carry_trace_id(traced_cluster):
+    """Every streamed token event carries the sequence's trace id (the
+    `tr` field riding beside the PR-17 fence), and the terminal record
+    joins on it."""
+    from ray_tpu import serve
+
+    handle = serve.get_deployment_handle("llm_decode", "llmtr")
+    with tracing.span("client.stream") as root:
+        stream = handle.options(method_name="generate").remote(
+            {"prompt": "stream trace", "max_tokens": 7, "stream": True,
+             "request_id": "seqtrace2"}
+        ).result(timeout=60)
+        events = list(stream)
+    assert [e["i"] for e in events] == list(range(7))
+    trs = {e.get("tr") for e in events}
+    assert trs == {root.trace_id}, trs
+    deadline = time.monotonic() + 30
+    rec = None
+    while time.monotonic() < deadline and rec is None:
+        rec = next(
+            (r for r in seq_obs.read_sequences(traced_cluster)
+             if r.get("kind") == "seq"
+             and r.get("request_id") == "seqtrace2"),
+            None,
+        )
+        time.sleep(0.2)
+    assert rec is not None
+    assert rec["trace_id"] == root.trace_id
