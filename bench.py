@@ -68,6 +68,51 @@ def _emit(tokens_per_s: float, params: int, detail: dict) -> None:
     )
 
 
+def _phase_breakdown(loss_f, optimizer, params, opt_state, batch,
+                     reps: int = 3):
+    """Out-of-band fwd/bwd/opt split (ISSUE 20 satellite): times each
+    sub-phase with its own jit AFTER the headline window closes, so the
+    measured metric is untouched. Mirrors the trainer's vjp-through-jit
+    split (train/jax_utils.py). Returns per-step ``{"fwd_s", "bwd_s",
+    "opt_s"}`` or None when the split path fails."""
+    import jax
+    import optax
+
+    try:
+        fwd_fn = jax.jit(lambda p, b: jax.vjp(loss_f, p, b))
+        bwd_fn = jax.jit(lambda vjp_fn, ct: vjp_fn(ct)[0])
+
+        def _opt(p, o, g):
+            updates, new_o = optimizer.update(g, o, p)
+            return optax.apply_updates(p, updates), new_o
+
+        opt_fn = jax.jit(_opt)
+        loss, vjp_fn = fwd_fn(params, batch)
+        grads = bwd_fn(vjp_fn, jax.numpy.ones_like(loss))
+        jax.block_until_ready(opt_fn(params, opt_state, grads))
+        fwd = bwd = opt = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            loss, vjp_fn = fwd_fn(params, batch)
+            jax.block_until_ready(loss)
+            t1 = time.perf_counter()
+            grads = bwd_fn(vjp_fn, jax.numpy.ones_like(loss))
+            jax.block_until_ready(grads)
+            t2 = time.perf_counter()
+            jax.block_until_ready(opt_fn(params, opt_state, grads))
+            t3 = time.perf_counter()
+            fwd += t1 - t0
+            bwd += t2 - t1
+            opt += t3 - t2
+        return {
+            "fwd_s": round(fwd / reps, 6),
+            "bwd_s": round(bwd / reps, 6),
+            "opt_s": round(opt / reps, 6),
+        }
+    except Exception:  # rtlint: disable=swallowed-exception - phase split is best-effort garnish; the headline MFU numbers stand without it
+        return None
+
+
 def sharded_main(mode: str) -> None:
     """--sharding matrix entry: train the bench transformer through the
     GSPMD path under ONE strategy and report the same schema."""
@@ -146,6 +191,11 @@ def sharded_main(mode: str) -> None:
             "loss": loss_value,
             "factorization": setup.factorization,
         }
+        phases = _phase_breakdown(
+            batch_loss, optimizer, params, opt_state, tokens_sh
+        )
+        if phases:
+            extra["phases"] = phases
     _emit(
         tokens_per_s, p,
         {"sharding": mode, "devices": n_dev, **extra},
@@ -217,6 +267,12 @@ def _bench_pp(config, optimizer, tokens, steps, init_params,
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     mb = inputs.shape[0] // microbatches
 
+    # Per-phase accumulator (ISSUE 20 satellite): the staged loop already
+    # runs fwd/bwd/opt as separate jits, so attribution is direct timing
+    # around serial sections — no extra syncs beyond the data
+    # dependencies the schedule enforces anyway.
+    phase_acc = {"fwd": 0.0, "bwd": 0.0, "opt": 0.0}
+
     def one_step():
         g_acc = [None] * num_chunks
         losses = []
@@ -230,22 +286,33 @@ def _bench_pp(config, optimizer, tokens, steps, init_params,
             x = inputs[m * mb:(m + 1) * mb]
             y = targets[m * mb:(m + 1) * mb]
             acts, a = [], x
+            t0 = time.perf_counter()
             for i in range(num_chunks - 1):
                 acts.append(a)
                 a = fwds[i](chunks[i], a)
+            jax.block_until_ready(a)
+            t1 = time.perf_counter()
             loss, (g_last, da) = grad_last(chunks[-1], a, y)
             acc(num_chunks - 1, g_last)
             for i in reversed(range(1, num_chunks - 1)):
                 gp, da = bwds[i](chunks[i], acts[i], da)
                 acc(i, gp)
             acc(0, bwds[0](chunks[0], acts[0], da))
+            jax.block_until_ready(g_acc[0])
+            t2 = time.perf_counter()
+            phase_acc["fwd"] += t1 - t0
+            phase_acc["bwd"] += t2 - t1
             losses.append(loss)
+        t3 = time.perf_counter()
         for i in range(num_chunks):
             g = jax.tree.map(lambda v: v / microbatches, g_acc[i])
             chunks[i], opt_states[i] = apply(chunks[i], opt_states[i], g)
+        jax.block_until_ready(chunks)
+        phase_acc["opt"] += time.perf_counter() - t3
         return float(jnp.mean(jnp.stack(losses)))
 
     first_loss = one_step()  # warmup/compile
+    phase_acc.update(fwd=0.0, bwd=0.0, opt=0.0)  # drop the compile step
     start = time.perf_counter()
     for _ in range(steps):
         loss_value = one_step()
@@ -261,14 +328,19 @@ def _bench_pp(config, optimizer, tokens, steps, init_params,
         int(jnp.size(l)) for s in chunks for l in jax.tree.leaves(s)
     )
     tokens_per_s = inputs.shape[0] * inputs.shape[1] * steps / elapsed
+    bubble = bubble_fraction(num_stages, microbatches, virtual)
     return tokens_per_s, p, {
         "loss": loss_value,
         "factorization": {"dp": 1, "fsdp": 1, "tp": 1, "pp": num_stages},
         "microbatches": microbatches,
         "virtual_stages": virtual,
-        "schedule_bubble_fraction": round(
-            bubble_fraction(num_stages, microbatches, virtual), 4
-        ),
+        "schedule_bubble_fraction": round(bubble, 4),
+        "phases": {
+            "fwd_s": round(phase_acc["fwd"] / steps, 6),
+            "bwd_s": round(phase_acc["bwd"] / steps, 6),
+            "opt_s": round(phase_acc["opt"] / steps, 6),
+            "pp_bubble_frac": round(bubble, 4),
+        },
     }
 
 
@@ -457,6 +529,10 @@ def overlap_main(mode: str) -> None:
                     "schedule_bubble_fraction": round(
                         bubble_fraction(2, 8, 2), 4
                     ),
+                    "phases": {
+                        "comm_exposed_s": round(exposed, 6),
+                        "collective_s": round(coll_s, 6),
+                    },
                 },
             }
         )
@@ -545,6 +621,14 @@ def main() -> None:
     peak = next((v for k, v in peaks.items() if device_kind.startswith(k)), None)
     mfu = round(achieved_flops / peak, 4) if peak else None
 
+    # fwd/bwd/opt split measured AFTER the headline window (own jits),
+    # so the tokens/s number above is exactly what it always was.
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    phases = _phase_breakdown(
+        lambda prm, b: loss_fn(prm, b[0], b[1], config),
+        optimizer, params, opt_state, (inputs, targets),
+    )
+
     print(
         json.dumps(
             {
@@ -559,6 +643,7 @@ def main() -> None:
                     "achieved_tflops": round(achieved_flops / 1e12, 2),
                     "mfu": mfu,
                     "loss": loss_value,
+                    **({"phases": phases} if phases else {}),
                 },
             }
         )

@@ -225,6 +225,15 @@ class Controller:
         self._hang_reports: collections.deque = collections.deque(maxlen=8)
         self._hang_harvest_task: asyncio.Task | None = None
         self._last_hang_harvest = 0.0
+        # Cluster step profiler (ISSUE 20): completed capture records
+        # (small dicts pointing at session-dir artifacts) + the single
+        # in-flight capture task. Auto-captures (straggler / comm-stall
+        # triggered) are cooldown-guarded here — the controller is the
+        # authority, whatever the trigger side rate-limits.
+        self._profiles: collections.deque = collections.deque(maxlen=32)
+        self._profile_task: asyncio.Task | None = None
+        self._last_auto_profile = 0.0
+        self._profile_seq = itertools.count()
         # Idempotency-token reply cache for mutation RPCs: a client that
         # retried after a dropped/duplicated reply (or a controller
         # restart) gets the ORIGINAL reply back instead of re-applying
@@ -1793,6 +1802,10 @@ class Controller:
             self._hang_harvest_task = spawn_task(
                 self._harvest_hang_evidence()
             )
+        # A persistent comm stall is also a profiling trigger (ISSUE 20):
+        # the hang report names WHO is stuck, the auto-capture names WHAT
+        # the stuck rank is doing. Cooldown-guarded inside.
+        self._maybe_auto_profile_capture(reason="comm_stall")
         return {"status": "ok"}
 
     async def _harvest_hang_evidence(self) -> dict:
@@ -1886,6 +1899,259 @@ class Controller:
             "inflight": inflight,
             "hang_reports": len(self._hang_reports),
         }
+
+    # ------------------------------------------------------------------
+    # cluster step profiler (ISSUE 20)
+    # ------------------------------------------------------------------
+    def _maybe_auto_profile_capture(
+        self, reason: str, ranks: list | None = None, steps: int | None = None
+    ) -> bool:
+        """Debounced auto-capture entry: one capture at a time, one per
+        RAY_TPU_PROFILE_AUTO_COOLDOWN_S, nothing when auto is off."""
+        from ray_tpu._private import profiler as profiler_mod
+
+        if not profiler_mod.knob_bool("AUTO", True):
+            return False
+        if self._profile_task is not None and not self._profile_task.done():
+            return False
+        now = time.monotonic()
+        cooldown = profiler_mod.knob_float("AUTO_COOLDOWN_S", 300.0)
+        if self._last_auto_profile and now - self._last_auto_profile < cooldown:
+            return False
+        self._last_auto_profile = now
+        capture_id = f"prof-{next(self._profile_seq):04d}-{reason}"
+        self._active_capture_id = capture_id
+        self._profile_task = spawn_task(
+            self._run_profile_capture(
+                capture_id,
+                steps or profiler_mod.knob_int("AUTO_STEPS", 3),
+                ranks,
+                reason,
+            )
+        )
+        return True
+
+    async def rpc_profile_capture(self, conn, payload) -> dict:
+        """Start one coordinated step-aligned capture (the `ray_tpu
+        profile` CLI and the straggler/comm-stall auto-triggers). Returns
+        the capture id immediately; poll ``profile_status`` for the
+        record (captures span N live train steps — longer than an RPC
+        deadline should be)."""
+        payload = payload or {}
+        reason = str(payload.get("reason") or "manual")
+        steps = max(1, int(payload.get("steps") or 3))
+        ranks = payload.get("ranks")
+        if ranks is not None:
+            ranks = [int(r) for r in ranks]
+        if reason != "manual":
+            started = self._maybe_auto_profile_capture(
+                reason=reason, ranks=ranks, steps=steps
+            )
+            if not started:
+                return {"status": "skipped", "code": "cooldown_or_busy"}
+            return {
+                "status": "ok",
+                "capture_id": getattr(self, "_active_capture_id", None),
+            }
+        if self._profile_task is not None and not self._profile_task.done():
+            return {
+                "status": "error",
+                "code": "busy",
+                "error": "a capture is already running",
+            }
+        capture_id = f"prof-{next(self._profile_seq):04d}-manual"
+        self._active_capture_id = capture_id
+        self._profile_task = spawn_task(
+            self._run_profile_capture(capture_id, steps, ranks, reason)
+        )
+        return {"status": "ok", "capture_id": capture_id}
+
+    async def rpc_profile_status(self, conn, payload) -> dict:
+        """One capture's record (or its in-flight state) by capture id;
+        no id → the most recent record."""
+        capture_id = (payload or {}).get("capture_id")
+        for rec in reversed(self._profiles):
+            if capture_id in (None, rec.get("capture_id")):
+                return {"status": "ok", "state": "done", "record": rec}
+        if self._profile_task is not None and not self._profile_task.done():
+            return {"status": "ok", "state": "running", "record": None}
+        return {"status": "ok", "state": "unknown", "record": None}
+
+    async def rpc_profile_list(self, conn, payload) -> dict:
+        """Completed capture records, oldest first (``ray_tpu diagnose``
+        and the dashboard /api/profiles read this)."""
+        return {"status": "ok", "profiles": list(self._profiles)}
+
+    async def _profile_fanout(
+        self, action: str, targets: dict | None, args: dict | None = None
+    ) -> dict:
+        """One profiler action across node agents in parallel.
+        ``targets``: {node_id: [worker_ids]} to address specific workers,
+        None for the all-workers status sweep. Returns {worker_id:
+        result} merged across nodes."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        if targets is not None:
+            alive = [n for n in alive if n.node_id in targets]
+
+        async def _one(node):
+            try:
+                client = await self._node_client(node)
+                payload = {"action": action, "args": args or {}}
+                if targets is not None:
+                    payload["workers"] = list(targets.get(node.node_id) or [])
+                return await client.call("profile_gang", payload, timeout=30.0)
+            except Exception as exc:  # rtlint: disable=swallowed-exception - an unreachable node yields a partial capture, not a failed one
+                return {"status": "error", "error": str(exc)}
+
+        merged: dict[str, dict] = {}
+        for node, res in zip(
+            alive, await asyncio.gather(*(_one(n) for n in alive))
+        ):
+            for wid, wres in (res.get("workers") or {}).items():
+                if isinstance(wres, dict):
+                    wres.setdefault("node_id", node.node_id)
+                    merged[wid] = wres
+        return merged
+
+    async def _run_profile_capture(
+        self,
+        capture_id: str,
+        steps: int,
+        ranks: list | None,
+        reason: str,
+    ) -> dict:
+        """The coordinated capture: discover train ranks + their current
+        steps, arm every selected rank at the same upcoming step
+        boundary, wait the capture out, collect, merge into ONE Perfetto
+        trace + merged folded stacks, record + publish."""
+        from ray_tpu._private import profile_merge, profiler as profiler_mod
+        from ray_tpu._private.atomic_io import atomic_write_json
+
+        rec: dict = {
+            "capture_id": capture_id,
+            "ts": time.time(),
+            "reason": reason,
+            "steps": steps,
+            "requested_ranks": ranks,
+        }
+        try:
+            statuses = await self._profile_fanout("status", None)
+            train = {
+                wid: st
+                for wid, st in statuses.items()
+                if st.get("status") == "ok" and st.get("rank") is not None
+            }
+            if ranks is not None:
+                train = {
+                    wid: st
+                    for wid, st in train.items()
+                    if int(st["rank"]) in ranks
+                }
+            if not train:
+                rec.update(status="error", code="no_train_workers")
+                self._profiles.append(rec)
+                await self.publish("profile", rec)
+                return rec
+            # The SAME upcoming boundary for every rank: past the fastest
+            # rank's current step, plus slack for the arm RPC to land.
+            known = [
+                int(st["step"]) for st in train.values()
+                if st.get("step") is not None
+            ]
+            start_step = (max(known) + 2) if known else 0
+            max_s = profiler_mod.knob_float("MAX_S", 60.0)
+            targets: dict[str, list[str]] = {}
+            for wid, st in train.items():
+                targets.setdefault(st.get("node_id") or "", []).append(wid)
+            armed = await self._profile_fanout(
+                "arm",
+                targets,
+                {
+                    "capture_id": capture_id,
+                    "start_step": start_step,
+                    "steps": steps,
+                    "max_s": max_s,
+                    "session_dir": self.session_dir,
+                },
+            )
+            arm_errors = {
+                wid: res for wid, res in armed.items()
+                if res.get("status") != "ok"
+            }
+            deadline = time.monotonic() + max_s + 15.0
+            pending = set(wid for wid in train if wid not in arm_errors)
+            while pending and time.monotonic() < deadline:
+                await asyncio.sleep(0.25)
+                polled = await self._profile_fanout("status", targets)
+                pending = {
+                    wid for wid in pending
+                    if polled.get(wid, {}).get("state")
+                    in ("armed", "capturing")
+                }
+            if pending:
+                # Deadline elapsed with ranks still armed/capturing (step
+                # stream stalled?): force-stop them so collect returns a
+                # (partial) capture instead of `not_done`.
+                stuck = {
+                    nid: [w for w in wids if w in pending]
+                    for nid, wids in targets.items()
+                    if any(w in pending for w in wids)
+                }
+                await self._profile_fanout("abort", stuck)
+            collected = await self._profile_fanout("collect", targets)
+            captures = [
+                res for res in collected.values()
+                if res.get("status") == "ok"
+            ]
+            out_dir = os.path.join(self.session_dir, "profiles", capture_id)
+            trace = profile_merge.merge_captures(
+                captures,
+                capture_id,
+                meta={"reason": reason, "start_step": start_step},
+            )
+            folded = profile_merge.merge_folded(captures)
+            trace_path = os.path.join(out_dir, "merged_trace.json")
+            folded_path = os.path.join(out_dir, "merged_folded.json")
+            await asyncio.to_thread(atomic_write_json, trace_path, trace)
+            await asyncio.to_thread(atomic_write_json, folded_path, folded)
+            hot = {}
+            for cap in captures:
+                if cap.get("rank") is None:
+                    continue
+                phase, frac = profile_merge.hot_phase(
+                    cap.get("phase_totals") or {}
+                )
+                if phase is not None:
+                    hot[str(cap["rank"])] = {
+                        "phase": phase, "frac": round(frac, 4)
+                    }
+            rec.update(
+                status="ok" if captures and not arm_errors else "partial",
+                ranks=trace["metadata"]["ranks"],
+                start_step=start_step,
+                path=trace_path,
+                folded_path=folded_path,
+                hot_phases=hot,
+                workers=len(captures),
+                arm_errors={
+                    wid: res.get("code") or res.get("error")
+                    for wid, res in arm_errors.items()
+                } or None,
+                trace_ids=trace["metadata"]["trace_ids"],
+            )
+            if not captures:
+                rec["status"] = "error"
+                rec["code"] = "no_captures"
+        except Exception as exc:
+            print(
+                f"[controller] profile capture {capture_id} failed: {exc}",
+                file=sys.stderr, flush=True,
+            )
+            rec.update(status="error", code="exception", error=str(exc))
+        self._profiles.append(rec)
+        self.stats_counters["profile_captures"] += 1
+        await self.publish("profile", rec)
+        return rec
 
     async def rpc_controller_stats(self, conn, payload) -> dict:
         """Control-plane internals for the scale suite and /metrics: queue

@@ -37,6 +37,10 @@ _CHANNEL_FILES = {
     # Comm watchdog suspected a stalled collective/p2p channel (ISSUE 14);
     # the controller follows up with a cluster-wide evidence harvest.
     "comm_stall": "comm_stall",
+    # Step-profiler capture records (ISSUE 20): one per completed
+    # (or failed) coordinated capture — manual CLI, straggler-triggered,
+    # or comm-stall-triggered.
+    "profile": "profile",
 }
 
 

@@ -1473,6 +1473,34 @@ class NodeAgent:
             },
         )
 
+    async def rpc_profile_gang(self, conn, payload) -> dict:
+        """Step-profiler fan-out (ISSUE 20, the comm_evidence shape):
+        apply one profiler action — arm / status / collect / abort — to
+        this node's workers in parallel. ``workers`` limits the fan-out
+        to named worker ids (the controller targets the armed ranks);
+        absent, every local worker is asked (the status sweep that
+        discovers which workers ARE train ranks)."""
+        req = dict((payload or {}).get("args") or {})
+        req["action"] = (payload or {}).get("action")
+        worker_ids = (payload or {}).get("workers")
+        if worker_ids is None:
+            worker_ids = list(self.workers)
+        else:
+            worker_ids = [w for w in worker_ids if w in self.workers]
+        results = await asyncio.gather(
+            *(
+                self._forward_to_worker(wid, "profiler", req)
+                for wid in worker_ids
+            ),
+            return_exceptions=True,
+        )
+        workers = {}
+        for wid, res in zip(worker_ids, results):
+            if isinstance(res, BaseException):
+                res = {"status": "error", "error": str(res)}
+            workers[wid] = res
+        return {"status": "ok", "node_id": self.node_id, "workers": workers}
+
     async def rpc_stack_trace_worker(self, conn, payload) -> dict:
         """Live thread stacks of a worker (dashboard 'Stack Trace' role)."""
         return await self._forward_to_worker(

@@ -1005,39 +1005,82 @@ class WorkerRuntime:
                            for c, o in engine.owners.items()}}
 
     async def rpc_profiler(self, conn, payload) -> dict:
-        """XLA/TPU profiler capture (SURVEY §5.1 TPU-equiv): start/stop a
-        jax.profiler trace on this worker; the trace lands in a
-        TensorBoard/Perfetto-readable directory under the session dir."""
+        """Profiler control surface (ISSUE 20).
+
+        Manual actions (the original SURVEY §5.1 hook, hardened):
+        ``start``/``stop`` drive a raw jax.profiler trace into a
+        session-dir directory. Errors are TYPED (``code`` field):
+        double-start → ``already_started``, stop-without-start →
+        ``not_started``, a live coordinated capture → ``plane_active``.
+        Output dirs are GC'd on every start (session-scoped TTL,
+        RAY_TPU_PROFILE_DIR_TTL_S — they used to accumulate forever).
+
+        Coordinated actions (the cluster step profiler):
+        ``arm``/``status``/``collect``/``abort`` delegate to this
+        worker's :class:`~ray_tpu._private.profiler.ProfilePlane` —
+        step-boundary-aligned capture of device trace + host sampling
+        profiler + annotation slices, harvested by the controller."""
+        from ray_tpu._private import profiler as profiler_mod
+
         action = payload.get("action")
+        plane = profiler_mod.get_plane()
+        if action == "arm":
+            plane.set_meta(worker_id=self.ctx.worker_id)
+            return await asyncio.to_thread(plane.arm, payload)
+        if action == "status":
+            return plane.status()
+        if action == "collect":
+            return plane.collect()
+        if action == "abort":
+            return await asyncio.to_thread(plane.abort)
         try:
             import jax
         except Exception as exc:  # pragma: no cover - jax is baked in
             return {"status": "error", "error": f"jax unavailable: {exc}"}
         if action == "start":
             if getattr(self, "_profiling_dir", None):
-                return {"status": "error", "error": "profiler already running"}
+                return {
+                    "status": "error",
+                    "code": "already_started",
+                    "error": "profiler already running",
+                }
+            if plane.state in ("armed", "capturing"):
+                return {
+                    "status": "error",
+                    "code": "plane_active",
+                    "error": "a coordinated capture owns the profiler",
+                }
+            base = os.path.join(
+                os.environ.get("RAYTPU_SESSION_DIR", "/tmp"), "profiles"
+            )
+            await asyncio.to_thread(profiler_mod.gc_profile_dirs, base)
             log_dir = payload.get("log_dir") or os.path.join(
-                os.environ.get("RAYTPU_SESSION_DIR", "/tmp"),
-                "profiles",
-                f"worker-{self.ctx.worker_id[-12:]}",
+                base, f"worker-{self.ctx.worker_id[-12:]}"
             )
             os.makedirs(log_dir, exist_ok=True)
             try:
                 jax.profiler.start_trace(log_dir)
             except Exception as exc:
-                return {"status": "error", "error": str(exc)}
+                return {"status": "error", "code": "start_failed",
+                        "error": str(exc)}
             self._profiling_dir = log_dir
             return {"status": "ok", "log_dir": log_dir}
         if action == "stop":
             if not getattr(self, "_profiling_dir", None):
-                return {"status": "error", "error": "profiler not running"}
+                return {
+                    "status": "error",
+                    "code": "not_started",
+                    "error": "profiler not running",
+                }
             log_dir, self._profiling_dir = self._profiling_dir, None
             try:
                 jax.profiler.stop_trace()
             except Exception as exc:
-                return {"status": "error", "error": str(exc)}
+                return {"status": "error", "code": "stop_failed",
+                        "error": str(exc)}
             return {"status": "ok", "log_dir": log_dir}
-        return {"status": "error", "error": f"unknown action {action!r}"}
+        return {"status": "error", "code": "unknown_action",
+                "error": f"unknown action {action!r}"}
 
     async def rpc_push_task(self, conn, spec) -> dict:
         if spec.get("cross_language"):

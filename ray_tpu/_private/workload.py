@@ -41,6 +41,17 @@ STEP_PHASES = (
     "comm_exposed_s",
 )
 
+# Sub-phase split of ``compute_s`` (ISSUE 20): ranks running with step
+# annotations report how compute divides into forward, backward, and
+# optimizer time. These are *additive detail* under compute_s — they
+# never enter the wall-partition identity above, and ranks that cannot
+# split (fused GSPMD single-program path) simply omit them.
+SUB_PHASES = (
+    "fwd_s",
+    "bwd_s",
+    "opt_s",
+)
+
 # Peak bf16 FLOP/s per chip kind — must match release/bench_mfu.py
 # (bench.py), which is the acceptance reference: in-framework MFU and
 # the out-of-band benchmark must agree within 2% on the same run.
@@ -129,7 +140,7 @@ class StepStatsAggregator:
             self.clamped_negative += 1
             wall = 0.0
         phases: dict[str, float] = {}
-        for phase in STEP_PHASES:
+        for phase in STEP_PHASES + SUB_PHASES:
             v = _num(rec.get(phase))
             if v < 0:
                 self.clamped_negative += 1
@@ -150,7 +161,7 @@ class StepStatsAggregator:
                 "ts": 0.0,
                 "tokens": 0.0,
                 "flops": 0.0,
-                **{p: 0.0 for p in STEP_PHASES},
+                **{p: 0.0 for p in STEP_PHASES + SUB_PHASES},
             }
             self.steps_ingested += 1
             while len(self._by_step) > self.window:
@@ -159,7 +170,7 @@ class StepStatsAggregator:
         entry["ts"] = max(entry["ts"], _num(rec.get("ts")))
         entry["tokens"] += _num(rec.get("tokens"))
         entry["flops"] += _num(rec.get("flops"))
-        for phase in STEP_PHASES:
+        for phase in STEP_PHASES + SUB_PHASES:
             entry[phase] += phases[phase]
         self.records_ingested += 1
         return True
@@ -182,6 +193,15 @@ class StepStatsAggregator:
             phase_fracs[phase.replace("_s", "_frac")] = (
                 total / rank_wall_total if rank_wall_total > 0 else 0.0
             )
+        # Sub-phase fracs (compute split) only when at least one rank
+        # reported a split — an all-zero "fwd_frac: 0.0" would read as
+        # "forward is free" rather than "no data".
+        for phase in SUB_PHASES:
+            total = sum(e.get(phase, 0.0) for e in steps)
+            if total > 0 and rank_wall_total > 0:
+                phase_fracs[phase.replace("_s", "_frac")] = (
+                    total / rank_wall_total
+                )
         peak_total = sum(self._rank_peak.values()) or None
         mfu = None
         if peak_total and gang_wall > 0:
@@ -489,6 +509,46 @@ def diagnose(snapshot: dict) -> list[dict]:
                 f"{s['flagged_steps']}/{s['window_steps']} recent steps"
                 + cause,
                 {"experiment": exp, **s, "node_latest": latest},
+            ))
+
+    # -- straggler hot phase (ISSUE 20 auto-profiling) ------------------
+    # When an auto-capture ran against flagged rank(s), name the phase
+    # that dominated the slow rank's step — the difference between "rank
+    # 3 is slow" and "rank 3 spends 62% of its step blocked in
+    # collectives; look at its NIC".
+    auto_profile = next(
+        (
+            rec for rec in reversed(snapshot.get("profiles") or [])
+            if isinstance(rec, dict)
+            and rec.get("reason") != "manual"
+            and rec.get("hot_phases")
+        ),
+        None,
+    )
+    if auto_profile is not None:
+        for rank_key, hot in sorted(
+            (auto_profile.get("hot_phases") or {}).items(),
+            key=lambda kv: str(kv[0]),
+        ):
+            if not isinstance(hot, dict) or not hot.get("phase"):
+                continue
+            frac = _num(hot.get("frac"))
+            findings.append(_finding(
+                "crit", 120 + 100 * frac, "straggler_hot_phase",
+                f"rank {rank_key}: auto-profile "
+                f"{auto_profile.get('capture_id', '?')} "
+                f"({auto_profile.get('reason', '?')}) attributes "
+                f"{frac:.0%} of attributed step time to "
+                f"'{hot['phase']}' — merged trace at "
+                f"{auto_profile.get('path') or '<unavailable>'}",
+                {
+                    "rank": rank_key,
+                    "phase": hot["phase"],
+                    "frac": frac,
+                    "capture_id": auto_profile.get("capture_id"),
+                    "reason": auto_profile.get("reason"),
+                    "path": auto_profile.get("path"),
+                },
             ))
 
     # -- goodput --------------------------------------------------------

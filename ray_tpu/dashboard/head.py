@@ -348,6 +348,10 @@ class DashboardHead:
         app.router.add_get("/api/stacks", self._stacks)
         app.router.add_get("/api/commflight", self._commflight)
         app.router.add_post("/api/profile", self._profile)
+        app.router.add_get("/api/profiles", self._profiles)
+        app.router.add_get(
+            "/api/profiles/{capture_id}/flamegraph", self._flamegraph
+        )
         app.router.add_get("/api/serve", self._serve_state)
         app.router.add_get("/api/sequences", self._sequences)
         app.router.add_get("/api/workers", self._workers)
@@ -722,6 +726,53 @@ class DashboardHead:
                     "log_dir": payload.get("log_dir"),
                 },
             )
+        )
+
+    async def _profiles(self, request):
+        """GET — coordinated capture records (ISSUE 20): the controller's
+        rolling ledger of manual and auto-triggered step captures, newest
+        last, each carrying artifact paths + per-rank hot phases."""
+        from aiohttp import web
+
+        return web.json_response(
+            {"profiles": await asyncio.to_thread(state_mod.list_profiles)},
+            dumps=_dumps,
+        )
+
+    async def _flamegraph(self, request):
+        """GET /api/profiles/{capture_id}/flamegraph — the capture's
+        merged folded host stacks as a d3-flamegraph-style nested
+        {name, value, children} tree. 404 JSON body when the capture or
+        its folded artifact is unknown (same contract as _timeseries)."""
+        from aiohttp import web
+
+        from ray_tpu._private import profile_merge
+
+        capture_id = request.match_info["capture_id"]
+        # Resist path traversal: capture ids are flat tokens minted by the
+        # controller, never paths.
+        if not self.session_dir or "/" in capture_id or ".." in capture_id:
+            return web.json_response(
+                {"error": f"unknown capture_id {capture_id!r}"}, status=404
+            )
+        path = os.path.join(
+            self.session_dir, "profiles", capture_id, "merged_folded.json"
+        )
+
+        def read():
+            try:
+                with open(path) as fh:
+                    return json.load(fh)
+            except (OSError, ValueError):
+                return None
+
+        folded = await asyncio.to_thread(read)
+        if not isinstance(folded, dict):
+            return web.json_response(
+                {"error": f"unknown capture_id {capture_id!r}"}, status=404
+            )
+        return web.json_response(
+            profile_merge.flamegraph_tree(folded), dumps=_dumps
         )
 
 

@@ -352,6 +352,59 @@ def cmd_timeline(args) -> None:
           f"or chrome://tracing)")
 
 
+def cmd_profile(args) -> None:
+    """`ray_tpu profile --steps N [--ranks 0,3]` — coordinated
+    step-aligned capture (ISSUE 20): every selected rank arms at the
+    same upcoming step boundary, captures N steps of device trace +
+    host samples, and the controller merges everything into ONE
+    Perfetto trace joined to the run's trace ids."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    ranks = None
+    if args.ranks:
+        try:
+            ranks = [int(r) for r in args.ranks.split(",") if r.strip()]
+        except ValueError:
+            raise SystemExit(f"--ranks must be comma-separated ints, "
+                             f"got {args.ranks!r}")
+    rec = state.capture_profile(
+        steps=args.steps, ranks=ranks, timeout_s=args.timeout,
+    )
+    if args.out and rec.get("path"):
+        import shutil
+
+        try:
+            shutil.copyfile(rec["path"], args.out)
+            rec = dict(rec, copied_to=args.out)
+        except OSError as exc:
+            rec = dict(rec, copy_error=str(exc))
+    if args.json:
+        print(json.dumps(rec, indent=2, default=str))
+        return
+    status = rec.get("status", "error")
+    if status in ("ok", "partial"):
+        print(f"capture {rec.get('capture_id')}: {status} — "
+              f"{len(rec.get('ranks') or [])} rank(s), "
+              f"steps {rec.get('start_step')}+{rec.get('steps')}")
+        if rec.get("path"):
+            print(f"  merged trace : {rec['path']} "
+                  "(load in ui.perfetto.dev)")
+        if rec.get("folded_path"):
+            print(f"  folded stacks: {rec['folded_path']}")
+        if rec.get("copied_to"):
+            print(f"  copied to    : {rec['copied_to']}")
+        for rank, hot in sorted((rec.get("hot_phases") or {}).items(),
+                                key=lambda kv: str(kv[0])):
+            if isinstance(hot, dict) and hot.get("phase"):
+                print(f"  rank {rank}: hot phase '{hot['phase']}' "
+                      f"({float(hot.get('frac') or 0.0):.0%})")
+    else:
+        raise SystemExit(
+            f"capture failed: {rec.get('code') or rec.get('error') or rec}"
+        )
+
+
 def cmd_microbenchmark(args) -> None:
     from ray_tpu._private.ray_perf import main as perf_main
 
@@ -487,6 +540,23 @@ def main(argv=None) -> None:
                         "(spans sharing its trace id + per-token instants)")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser(
+        "profile",
+        help="coordinated step-aligned profile capture across the gang "
+             "(merged Perfetto trace + folded host stacks)",
+    )
+    p.add_argument("--steps", type=int, default=3,
+                   help="number of training steps to capture (default 3)")
+    p.add_argument("--ranks", default=None,
+                   help="comma-separated world ranks (default: all)")
+    p.add_argument("--out", default=None,
+                   help="also copy the merged trace to this path")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="seconds to wait for the capture (default 300)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("microbenchmark")
     p.set_defaults(fn=cmd_microbenchmark)

@@ -125,6 +125,13 @@ class _Session:
                 "step_stats": step_stats,
             }
         )
+        # Re-stamp the step clock AFTER the hand-off: the wait above is
+        # the driver's rendezvous (every rank resumes on the same round
+        # edge), and letting it bleed into the next record's wall makes
+        # all ranks' walls equal the gang round period — hiding exactly
+        # the per-rank dispersion the straggler detector keys on.
+        if self._recorder is not None:
+            self._recorder.mark_resume()
 
     # -- called from the actor (poll) -----------------------------------
     def next_result(self, timeout: float = 0.0) -> dict | None:

@@ -284,15 +284,21 @@ class PipelineStageRunner:
                     )
                 if vs == last_vs:
                     # Last virtual stage has no downstream cotangent to
-                    # wait on: loss + grads in one fused value_and_grad.
-                    loss, (dp, da) = self._last_grad(
-                        self._chunk_params[c], a_in, micro
-                    )
+                    # wait on: loss + grads in one fused value_and_grad —
+                    # unsplittable, so the slice is attributed to bwd
+                    # (backward dominates it).
+                    with step_stats.step_annotation("bwd", phase="bwd"):
+                        loss, (dp, da) = self._last_grad(
+                            self._chunk_params[c], a_in, micro
+                        )
+                        jax.block_until_ready(dp)  # rtlint: disable=host-sync-in-step - attribution boundary; the grads feed the send/accumulate next anyway
                     losses.append(loss)
                     stash[(m, c)] = (dp, da)
                 else:
                     stash[(m, c)] = a_in
-                    y = self._fwd[c](self._chunk_params[c], a_in)
+                    with step_stats.step_annotation("fwd", phase="fwd"):
+                        y = self._fwd[c](self._chunk_params[c], a_in)
+                        jax.block_until_ready(y)  # rtlint: disable=host-sync-in-step - attribution boundary; _send materializes y on host next anyway
                     self._send(
                         y,
                         self._next_ring,
@@ -308,9 +314,11 @@ class PipelineStageRunner:
                         f"{step_tag}b{m}v{vs}",
                         self.activation_like(micro),
                     )
-                    dp, da = self._bwd[c](
-                        self._chunk_params[c], stash.pop((m, c)), ct
-                    )
+                    with step_stats.step_annotation("bwd", phase="bwd"):
+                        dp, da = self._bwd[c](
+                            self._chunk_params[c], stash.pop((m, c)), ct
+                        )
+                        jax.block_until_ready(dp)  # rtlint: disable=host-sync-in-step - attribution boundary; the grads feed the send/accumulate next anyway
                 if vs > 0:
                     self._send(
                         da,
@@ -323,13 +331,15 @@ class PipelineStageRunner:
                     if grads_acc[c] is None
                     else jax.tree.map(jax.numpy.add, grads_acc[c], dp)
                 )
-        for c in range(self.virtual):
-            grads = jax.tree.map(
-                lambda g: g / self.microbatches, grads_acc[c]
-            )
-            self._chunk_params[c], self._opt_states[c] = self._apply(
-                self._chunk_params[c], self._opt_states[c], grads
-            )
+        with step_stats.step_annotation("opt", phase="opt"):
+            for c in range(self.virtual):
+                grads = jax.tree.map(
+                    lambda g: g / self.microbatches, grads_acc[c]
+                )
+                self._chunk_params[c], self._opt_states[c] = self._apply(
+                    self._chunk_params[c], self._opt_states[c], grads
+                )
+            jax.block_until_ready(self._chunk_params)  # rtlint: disable=host-sync-in-step - attribution boundary; next step's forwards consume the params anyway
         if self.stage == self.num_stages - 1:
             local = float(np.mean([np.asarray(l) for l in losses]))  # rtlint: disable=host-sync-in-step - loss leaves the device to ride the broadcast wire
         else:

@@ -25,10 +25,14 @@ Driver half:
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import sys
 import threading
 import time
 from typing import Any
+
+from ray_tpu._private import profiler as profiler_mod
 
 logger = logging.getLogger(__name__)
 
@@ -74,6 +78,53 @@ def record_phase(phase: str, seconds: float) -> None:
         return
     with _phase_lock:
         _phase_acc[phase] = _phase_acc.get(phase, 0.0) + float(seconds)
+    # Profile capture (ISSUE 20): phase totals during the capture window
+    # feed the hot-phase attribution. One module-bool check when idle.
+    profiler_mod.note_phase(phase, seconds)
+
+
+_annotation_cls: Any = None
+
+
+def _trace_annotation_cls() -> Any:
+    """``jax.profiler.TraceAnnotation`` when the process already imported
+    jax (never force a jax init for telemetry), else None. Cached after
+    the first successful probe."""
+    global _annotation_cls
+    if _annotation_cls is None:
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return None
+        try:
+            _annotation_cls = jax.profiler.TraceAnnotation
+        except Exception:  # rtlint: disable=swallowed-exception - ancient jax without profiler: annotations degrade to timers
+            return None
+    return _annotation_cls
+
+
+@contextlib.contextmanager
+def step_annotation(name: str, phase: str | None = None):
+    """Named sub-step scope (ISSUE 20): times the block, opens a
+    ``jax.profiler.TraceAnnotation`` so the device trace carries the same
+    name, attributes the wall time to a StepStats ``phase`` (fwd/bwd/opt)
+    when asked, and — only while a capture is live — buffers the slice
+    for the merged Perfetto trace. Idle cost is one timer read pair plus
+    a no-op TraceAnnotation."""
+    cls = _trace_annotation_cls()
+    ann = cls(name) if cls is not None else None
+    wall0 = time.time()
+    t0 = time.perf_counter()
+    if ann is not None:
+        ann.__enter__()
+    try:
+        yield
+    finally:
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        dt = time.perf_counter() - t0
+        if phase is not None:
+            record_phase(phase, dt)
+        profiler_mod.note_annotation(name, wall0, dt)
 
 
 def _drain_phases() -> dict[str, float]:
@@ -108,6 +159,11 @@ class StepRecorder:
         self._last_wait = 0.0
         self._device_kind: str | None = None
         self._devices = 1
+        # The capture plane learns this worker's identity here so a
+        # controller-armed profile can align on the step stream.
+        profiler_mod.get_plane().set_meta(
+            rank=ctx.world_rank, node_id=ctx.node_id
+        )
 
     def _data_wait_total(self) -> float:
         total = 0.0
@@ -140,6 +196,21 @@ class StepRecorder:
         compute = max(
             0.0, wall - data_wait - comm_blocking - checkpoint - pp_bubble
         )
+        # Sub-step attribution (ISSUE 20): step_annotation() scopes split
+        # the compute remainder into fwd/bwd/opt. The split is clamped so
+        # fwd+bwd+opt never exceeds compute (annotation walls can overlap
+        # phases already subtracted above); compute itself is UNCHANGED —
+        # the split refines it, never redefines it.
+        fwd = phases.get("fwd", 0.0)
+        bwd = phases.get("bwd", 0.0)
+        opt = phases.get("opt", 0.0)
+        sub = fwd + bwd + opt
+        if sub > compute > 0.0:
+            scale = compute / sub
+            fwd, bwd, opt = fwd * scale, bwd * scale, opt * scale
+        elif sub > 0.0 and compute <= 0.0:
+            fwd = bwd = opt = 0.0
+            sub = 0.0
         if self._device_kind is None:
             self._device_kind, self._devices = _device_info()
         self.step += 1
@@ -156,6 +227,14 @@ class StepRecorder:
             "pp_bubble_s": pp_bubble,
             "comm_exposed_s": comm_exposed,
         }
+        if sub > 0.0:
+            rec["fwd_s"] = fwd
+            rec["bwd_s"] = bwd
+            rec["opt_s"] = opt
+        # Step boundary for the capture plane: this report ends step
+        # `self.step` — an armed capture starts/stops exactly here, so
+        # every selected rank cuts on the same global step edge.
+        profiler_mod.on_step_boundary(self.step)
         tokens = metrics.get("tokens")
         if isinstance(tokens, (int, float)) and not isinstance(tokens, bool):
             rec["tokens"] = float(tokens)
@@ -166,6 +245,19 @@ class StepRecorder:
             rec["device_kind"] = self._device_kind
             rec["devices"] = self._devices
         return rec
+
+    def mark_resume(self) -> None:
+        """Exclude the driver's report rendezvous from the next wall.
+
+        ``train.report()`` blocks until the trainer's poll loop consumes
+        the previous result, so every rank resumes on the same round
+        edge — gated by the slowest rank. Without this re-stamp that
+        block lands in the NEXT step's wall and every rank's wall
+        converges to the gang round period, which blinds the MAD
+        straggler scan (a dragged rank reads as a uniform gang).
+        ``session.report`` calls this after the hand-off so walls
+        measure the rank's own step, not the driver's backpressure."""
+        self._last = time.perf_counter()
 
 
 async def _swallow(coro) -> None:
@@ -199,6 +291,10 @@ class FlightRecorder:
         self._summary: dict | None = None
         self._last_summary = 0.0
         self.stragglers: list[dict] = []
+        # Auto-profiling (ISSUE 20): ranks flagged straggler on
+        # consecutive summary cuts debounce-trigger a bounded capture.
+        self._straggler_streak: dict[int, int] = {}
+        self._last_auto_req = 0.0
 
     # -- goodput wall-clock buckets -------------------------------------
     def note_restart(self, seconds: float) -> None:
@@ -269,11 +365,64 @@ class FlightRecorder:
         self.stragglers = self.agg.straggler_report(k=self._mad_k())
         if self.stragglers:
             summary["stragglers"] = [s["rank"] for s in self.stragglers]
+        self._maybe_auto_profile()
         self._queue(
             f"train/{self.experiment}",
             {"ts": time.time(), **summary},
         )
         return summary
+
+    def _maybe_auto_profile(self) -> None:
+        """Debounce straggler flags into ONE profile_capture request.
+
+        A rank must stay flagged for RAY_TPU_PROFILE_AUTO_CONSECUTIVE
+        summary cuts (MAD blips don't profile); the driver then
+        fire-and-forgets one controller RPC. The controller is the
+        authority on cooldown/concurrency — this side only rate-limits
+        its own requests so a persistent straggler doesn't spam."""
+        if not self.stragglers:
+            self._straggler_streak.clear()
+            return
+        if not profiler_mod.knob_bool("AUTO", True):
+            return
+        flagged = {
+            int(s["rank"]) for s in self.stragglers if "rank" in s
+        }
+        for rank in list(self._straggler_streak):
+            if rank not in flagged:
+                del self._straggler_streak[rank]
+        need = profiler_mod.knob_int("AUTO_CONSECUTIVE", 2)
+        ready = []
+        for rank in sorted(flagged):
+            streak = self._straggler_streak.get(rank, 0) + 1
+            self._straggler_streak[rank] = streak
+            if streak >= need:
+                ready.append(rank)
+        if not ready:
+            return
+        now = time.monotonic()
+        cooldown = profiler_mod.knob_float("AUTO_COOLDOWN_S", 300.0)
+        if self._last_auto_req and now - self._last_auto_req < cooldown:
+            return
+        self._last_auto_req = now
+        for rank in ready:
+            self._straggler_streak[rank] = 0
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            ctx = worker_mod.get_global_context()
+            call = ctx.controller.call(
+                "profile_capture",
+                {
+                    "steps": profiler_mod.knob_int("AUTO_STEPS", 3),
+                    "ranks": ready,
+                    "reason": "straggler",
+                },
+                timeout=10.0,
+            )
+            ctx.io.spawn(_swallow(call))
+        except Exception:
+            logger.debug("auto-profile trigger failed", exc_info=True)
 
     @staticmethod
     def _mad_k() -> float:

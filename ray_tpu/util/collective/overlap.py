@@ -99,7 +99,15 @@ class SyncHandle:
                 backend=getattr(g, "backend_name", ""),
             )
         try:
-            results = [f.result() for f in self.futures]
+            # Per-bucket waits carry step_annotation scopes (ISSUE 20):
+            # a straggling bucket shows up on the merged trace as ONE
+            # named slice (fence.b<i>) instead of an opaque fence blob.
+            # Accounting is untouched — comm_exposed still measures the
+            # whole fence below.
+            results = []
+            for i, fut in enumerate(self.futures):
+                with step_stats.step_annotation(f"fence.b{i}"):
+                    results.append(fut.result())
         except BaseException:
             if rec is not None:
                 flight.completed(rec, ok=False)

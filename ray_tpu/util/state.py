@@ -305,6 +305,65 @@ def collect_cluster_stacks() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Cluster step profiler (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def capture_profile(
+    steps: int = 3,
+    ranks: list | None = None,
+    reason: str = "manual",
+    wait: bool = True,
+    timeout_s: float = 300.0,
+) -> dict:
+    """Run one coordinated, step-aligned profile capture across the
+    train gang (the `ray_tpu profile` CLI). Arms every selected rank at
+    the same upcoming step boundary, captures ``steps`` steps of device
+    trace + host sampling profiler + annotation slices, and merges the
+    pile into ONE Perfetto trace under the session dir.
+
+    ``wait=True`` polls the controller until the capture record lands
+    (captures span live train steps, so this outlives a single RPC
+    deadline by design); ``wait=False`` returns the capture id
+    immediately."""
+    started = _call(
+        "profile_capture",
+        {"steps": int(steps), "ranks": ranks, "reason": reason},
+    )
+    if not isinstance(started, dict) or started.get("status") != "ok":
+        return started if isinstance(started, dict) else {"status": "error"}
+    capture_id = started.get("capture_id")
+    if not wait:
+        return started
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_s
+    while _time.monotonic() < deadline:
+        out = _call("profile_status", {"capture_id": capture_id})
+        if isinstance(out, dict) and out.get("state") == "done":
+            return out.get("record") or {}
+        _time.sleep(0.5)
+    return {
+        "status": "error",
+        "code": "timeout",
+        "capture_id": capture_id,
+        "error": f"capture did not finish within {timeout_s}s",
+    }
+
+
+def list_profiles() -> list[dict]:
+    """Completed capture records (manual + auto), oldest first. Empty
+    list — never an exception — on a fresh or absent cluster."""
+    try:
+        out = _call("profile_list")
+    except Exception:
+        return []
+    if not isinstance(out, dict):
+        return []
+    return [r for r in out.get("profiles", []) if isinstance(r, dict)]
+
+
+# ---------------------------------------------------------------------------
 # Resource telemetry (ISSUE 5): the controller's tiered time-series store
 # answers "what is the cluster eating" the way summarize_latency answers
 # "where does task time go".
@@ -539,7 +598,12 @@ def collect_diagnose_snapshot(session_dir: str | None = None) -> dict:
         "rank_records": {},
         "commflight": {},
         "serve_llm": {},
+        "profiles": [],
     }
+    try:
+        snapshot["profiles"] = list_profiles()
+    except Exception:  # rtlint: disable=swallowed-exception - summaries are independent; a failed one keeps its default
+        pass
     try:
         snapshot["serve_llm"] = summarize_sequences(session_dir)
     except Exception:  # rtlint: disable=swallowed-exception - summaries are independent; a failed one keeps its default

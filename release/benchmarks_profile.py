@@ -1,0 +1,290 @@
+"""Cluster step profiler gates (ISSUE 20).
+
+Four phases, one JSON verdict line:
+
+  1. capture_overhead — paired off/on windows of a CPU-bound annotated
+     step loop in ONE process: each pair times a window with no capture,
+     then the same window under a live host-only capture (host sampler
+     at the default 50 Hz + annotation buffering). Pairing inside one
+     process cancels machine drift the way the tracing A/B does; the
+     gate is the median paired ratio.
+  2. idle_overhead — the `step_annotation()` scope cost with NO session
+     and NO capture (one timer pair + one TraceAnnotation + two module
+     bool checks), measured over many iterations and scaled by the
+     annotations-per-step the trainers actually emit (fwd/bwd/opt = 3)
+     against phase 1's measured off-window step time — the deterministic
+     what-a-real-step-pays form, not a noisy wall A/B.
+  3. straggler — a REAL 4-worker train gang where a chaos latency point
+     drags exactly ONE rank's grad_sync by 150 ms/step. The MAD
+     detector must flag it, the driver must debounce-trigger an
+     auto-capture scoped to that rank, and the capture's hot-phase
+     attribution must name the dragged collective on the right rank.
+  4. uniform — the SAME drag on EVERY rank (slow but healthy): the
+     relative detector must stay silent — zero captures fire.
+
+Gates (release_tests.yaml): idle_overhead<=0.01, capture_overhead<=0.05,
+named_rank_correct==1, false_positives==0.
+
+Prints ONE JSON line, e.g.:
+  {"idle_overhead": 0.0004, "capture_overhead": 0.011,
+   "auto_captures": 2, "named_rank_correct": 1, "false_positives": 0,
+   "hot_phase": "collective", ...}
+
+RAY_TPU_RELEASE_SMOKE=1 shrinks the loops so the suite fits CI.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from bench_env import force_cpu, smoke, smoke_scale
+
+force_cpu()
+
+import os
+import statistics
+import tempfile
+import time
+
+SMOKE = smoke()
+
+ANNOTATIONS_PER_STEP = 3  # fwd / bwd / opt, what the trainers emit
+IDLE_ITERS = smoke_scale(100_000, 20_000)
+WINDOW_STEPS = smoke_scale(200, 60)
+WINDOW_PAIRS = smoke_scale(8, 4)
+TRAIN_STEPS = smoke_scale(120, 50)
+UNIFORM_STEPS = smoke_scale(60, 25)
+STRAGGLER_MS = 150.0
+
+# Auto-profiling tuned for a bench-sized run: trigger on the first
+# flagged cut, short cooldown, 2-step captures — same knobs the e2e
+# tests pin.
+PROFILE_ENV = {
+    "RAY_TPU_PROFILE_MAX_S": "30",
+    "RAY_TPU_PROFILE_AUTO_STEPS": "2",
+    "RAY_TPU_PROFILE_AUTO_COOLDOWN_S": "2",
+    "RAY_TPU_PROFILE_AUTO_CONSECUTIVE": "1",
+}
+
+
+def _set_env(extra):
+    env = dict(PROFILE_ENV)
+    env.update(extra)
+    for key, value in env.items():
+        os.environ[key] = value
+    return env
+
+
+def _clear_env(env):
+    for key in env:
+        os.environ.pop(key, None)
+
+
+# -- phases 1+2: overhead (single process, no cluster) --------------------
+def _phase_overhead() -> dict:
+    import numpy as np
+
+    from ray_tpu._private import profiler
+    from ray_tpu.train._internal import step_stats
+
+    rng = np.random.default_rng(20)
+    # Sized for a few-ms step: the gates compare against what a REAL
+    # train step pays, and a sub-ms toy step would let the fixed
+    # per-annotation cost (~2 µs) read as a huge relative overhead.
+    a = rng.standard_normal((448, 448)).astype(np.float32)
+
+    def step():
+        with step_stats.step_annotation("fwd", phase="fwd"):
+            x = a @ a
+        with step_stats.step_annotation("bwd", phase="bwd"):
+            x = (x @ a) @ a
+        with step_stats.step_annotation("opt", phase="opt"):
+            x = x + a
+        return x
+
+    def window(n: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            step()
+        return (time.perf_counter() - t0) / n
+
+    window(max(10, WINDOW_STEPS // 10))  # warmup
+    plane = profiler.ProfilePlane()
+    plane.set_meta(rank=0, worker_id="bench")
+    out_dir = tempfile.mkdtemp(prefix="raytpu-profbench-")
+    off, on = [], []
+    for pair in range(WINDOW_PAIRS):
+        off.append(window(WINDOW_STEPS))
+        armed = plane.arm({
+            "capture_id": f"bench-{pair}",
+            "start_step": None,  # no step stream: capture starts now
+            "steps": 1,
+            "max_s": 120,
+            "host": True,   # the 50 Hz sampler IS the cost under test
+            "device": False,
+            "session_dir": out_dir,
+        })
+        assert armed["status"] == "ok", armed
+        on.append(window(WINDOW_STEPS))
+        plane.abort()
+        collected = plane.collect()
+        assert collected["status"] == "ok", collected
+    off_med = statistics.median(off)
+    on_med = statistics.median(on)
+    capture_overhead = max(0.0, (on_med - off_med) / off_med)
+
+    # Idle scope cost: no capture armed, no active session — the cost
+    # every un-profiled train step pays for carrying the annotations.
+    t0 = time.perf_counter()
+    for _ in range(IDLE_ITERS):
+        with step_stats.step_annotation("fwd", phase="fwd"):
+            pass
+    per_annotation_s = (time.perf_counter() - t0) / IDLE_ITERS
+    idle_overhead = per_annotation_s * ANNOTATIONS_PER_STEP / off_med
+    return {
+        "step_ms_off": round(off_med * 1e3, 4),
+        "step_ms_captured": round(on_med * 1e3, 4),
+        "capture_overhead": round(capture_overhead, 6),
+        "per_annotation_us": round(per_annotation_s * 1e6, 3),
+        "idle_overhead": round(idle_overhead, 6),
+    }
+
+
+# -- phases 3+4: auto-capture chaos acceptance ----------------------------
+def _annotated_loop(config):
+    """Train loop with the trainer's fwd/bwd/opt annotation shape; the
+    chaos latency point stands in for a dragged collective on whatever
+    rank(s) the schedule targets."""
+    import time
+
+    from ray_tpu import train
+    from ray_tpu._private import chaos as chaos_mod
+    from ray_tpu.train._internal import step_stats as ss
+
+    rank = train.get_context().get_world_rank()
+    for step in range(config["steps"]):
+        with ss.step_annotation("fwd", phase="fwd"):
+            time.sleep(0.004)
+        with ss.step_annotation("bwd", phase="bwd"):
+            time.sleep(0.008)
+        with ss.step_annotation("grad_sync", phase="collective"):
+            delay = chaos_mod.latency_delay(
+                f"train.step.rank{rank}"
+            ) + chaos_mod.latency_delay("train.step.uniform")
+            time.sleep(0.002 + delay)
+        train.report({"step": step, "tokens": 100.0})
+
+
+def _fit(name: str, steps: int) -> None:
+    from ray_tpu.train import (
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    trainer = JaxTrainer(
+        _annotated_loop,
+        train_loop_config={"steps": steps},
+        scaling_config=ScalingConfig(num_workers=4),
+        run_config=RunConfig(
+            name=name,
+            storage_path=tempfile.mkdtemp(prefix="raytpu-profbench-"),
+        ),
+    )
+    result = trainer.fit()
+    if result.error is not None:
+        raise result.error
+
+
+def _phase_straggler() -> dict:
+    import ray_tpu
+    from ray_tpu._private import chaos as chaos_core
+    from ray_tpu.util import state
+
+    env = _set_env({
+        "RAY_TPU_chaos": json.dumps({
+            "seed": 20,
+            # Exactly ONE rank's grad_sync drags every step.
+            "latency_points": {"train.step.rank3": STRAGGLER_MS},
+        }),
+    })
+    chaos_core.reset()
+    ray_tpu.init(num_cpus=8)
+    try:
+        _fit("profbench-straggler", TRAIN_STEPS)
+        deadline = time.time() + 45.0
+        done = []
+        while not done and time.time() < deadline:
+            done = [
+                p for p in state.list_profiles()
+                if p.get("reason") == "straggler"
+                and p.get("status") in ("ok", "partial")
+            ]
+            if not done:
+                time.sleep(0.5)
+        autos = [
+            p for p in state.list_profiles()
+            if p.get("reason") == "straggler"
+        ]
+        mistargeted = [
+            p for p in autos if p.get("requested_ranks") != [3]
+        ]
+        hot = (done[-1].get("hot_phases") or {}).get("3") if done else None
+        named = bool(
+            done
+            and not mistargeted
+            and isinstance(hot, dict)
+            and hot.get("phase") == "collective"
+        )
+        return {
+            "auto_captures": len(autos),
+            "completed_captures": len(done),
+            "named_rank_correct": int(named),
+            "hot_phase": hot.get("phase") if isinstance(hot, dict) else None,
+            "hot_phase_frac": (
+                hot.get("frac") if isinstance(hot, dict) else None
+            ),
+        }
+    finally:
+        ray_tpu.shutdown()
+        _clear_env(env)
+        os.environ.pop("RAY_TPU_chaos", None)
+        chaos_core.reset()
+
+
+def _phase_uniform() -> dict:
+    import ray_tpu
+    from ray_tpu._private import chaos as chaos_core
+    from ray_tpu.util import state
+
+    env = _set_env({
+        "RAY_TPU_chaos": json.dumps({
+            "seed": 21,
+            # The SAME drag on every rank: slow but healthy.
+            "latency_points": {"train.step.uniform": STRAGGLER_MS},
+        }),
+    })
+    chaos_core.reset()
+    ray_tpu.init(num_cpus=8)
+    try:
+        _fit("profbench-uniform", UNIFORM_STEPS)
+        time.sleep(2.0)  # grace for any in-flight (wrong) trigger to land
+        return {"false_positives": len(state.list_profiles())}
+    finally:
+        ray_tpu.shutdown()
+        _clear_env(env)
+        os.environ.pop("RAY_TPU_chaos", None)
+        chaos_core.reset()
+
+
+def main() -> int:
+    result = {"benchmark": "step_profiler", "smoke": int(SMOKE)}
+    result.update(_phase_overhead())
+    result.update(_phase_straggler())
+    result.update(_phase_uniform())
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

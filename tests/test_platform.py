@@ -286,6 +286,87 @@ def test_worker_stack_trace(ray_start_shared):
     assert missing["status"] == "error"
 
 
+# ---------- reporter: per-worker profiler trigger ----------
+
+def test_dashboard_profile_endpoints(ray_start_shared):
+    """POST /api/profile (manual per-worker XLA trace) had no coverage
+    before ISSUE 20 hardened it: unknown workers and double start/stop
+    now return typed errors instead of crashing the worker."""
+    import httpx
+
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    class ProfileProbe:
+        def whoami(self):
+            return ray_tpu.get_runtime_context()["worker_id"]
+
+    actor = ProfileProbe.remote()
+    worker_id = ray_tpu.get(actor.whoami.remote(), timeout=60)
+
+    start_dashboard(port=8267)
+    base = "http://127.0.0.1:8267"
+
+    unknown = httpx.post(
+        base + "/api/profile",
+        json={"worker_id": "nope", "action": "start"},
+        timeout=30,
+    ).json()
+    assert unknown["status"] == "error"
+    assert unknown["error"] == "unknown worker"
+
+    started = httpx.post(
+        base + "/api/profile",
+        json={"worker_id": worker_id, "action": "start"},
+        timeout=60,
+    ).json()
+    assert started["status"] == "ok", started
+    assert started["log_dir"]
+
+    dup = httpx.post(
+        base + "/api/profile",
+        json={"worker_id": worker_id, "action": "start"},
+        timeout=60,
+    ).json()
+    assert dup["status"] == "error"
+    assert dup["code"] == "already_started"
+
+    stopped = httpx.post(
+        base + "/api/profile",
+        json={"worker_id": worker_id, "action": "stop"},
+        timeout=60,
+    ).json()
+    assert stopped["status"] == "ok", stopped
+    assert stopped["log_dir"] == started["log_dir"]
+
+    again = httpx.post(
+        base + "/api/profile",
+        json={"worker_id": worker_id, "action": "stop"},
+        timeout=60,
+    ).json()
+    assert again["status"] == "error"
+    assert again["code"] == "not_started"
+
+    bogus = httpx.post(
+        base + "/api/profile",
+        json={"worker_id": worker_id, "action": "dance"},
+        timeout=60,
+    ).json()
+    assert bogus["status"] == "error"
+    assert bogus["code"] == "unknown_action"
+
+    # Coordinated-capture ledger (ISSUE 20): empty but well-shaped on a
+    # cluster that never profiled, and the flamegraph route 404s on
+    # unknown (or traversal-shaped) capture ids.
+    profiles = httpx.get(base + "/api/profiles", timeout=30).json()
+    assert profiles == {"profiles": []}
+    missing = httpx.get(
+        base + "/api/profiles/prof-9999-manual/flamegraph", timeout=30
+    )
+    assert missing.status_code == 404
+    assert "unknown capture_id" in missing.json()["error"]
+
+
 # ---------- sanitizers (§5.2) ----------
 
 @pytest.mark.skipif(

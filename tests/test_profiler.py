@@ -1,0 +1,749 @@
+"""Cluster step profiler (ISSUE 20).
+
+Four layers, cheapest first:
+
+* host-sampler units — folded-stack sampling is crash-proof against
+  threads exiting mid-capture and tids with no live Thread object
+  (the pid-reuse eviction discipline), and profile-dir GC honors TTL;
+* capture-plane units — two planes armed at the same future step
+  boundary cut on identical step edges, typed errors on double-arm /
+  collect-before-done, and the watchdog timer guarantees an armed
+  plane can never leak;
+* merge + attribution units — merged Perfetto output and folded
+  flamegraphs are byte-deterministic, hot-phase picks comm_exposed over
+  collective, the StepStats fwd/bwd/opt split refines compute without
+  changing it, and the aggregator + diagnose surface the split and the
+  ``straggler_hot_phase`` finding;
+* chaos e2e — `ray_tpu profile` against a live 2-worker gang produces
+  ONE merged trace with both ranks' step-aligned annotation tracks; an
+  injected per-rank chaos latency point auto-triggers a capture naming
+  the slow rank's hot phase, and the uniform-slow twin stays silent.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import profile_merge, profiler, workload
+from ray_tpu.train._internal import step_stats
+
+
+@pytest.fixture(autouse=True)
+def _reset_plane_globals():
+    """Unit tests drive standalone ProfilePlane instances; the module
+    fast-flags they flip must never leak across tests."""
+    yield
+    profiler._boundary_armed = False
+    profiler._capturing = False
+
+
+# ---------------------------------------------------------------------------
+# host sampler: robustness contract
+# ---------------------------------------------------------------------------
+
+def test_host_sampler_folds_stacks_and_reports_counts():
+    s = profiler.HostSampler(hz=200)
+    s.start()
+    time.sleep(0.2)
+    out = s.stop()
+    assert out["samples"] > 5
+    assert out["hz"] == 200
+    # MainThread is running this test: it must appear in the folds, and
+    # every key is `thread;frame;frame...` collapsed-stack shaped.
+    assert any(k.startswith("MainThread;") for k in out["folded"])
+    assert all(";" in k for k in out["folded"])
+
+
+def test_host_sampler_survives_threads_exiting_mid_capture():
+    """Satellite acceptance: a thread that exits while the sampler is
+    live must never crash the worker — its samples just stop."""
+    stop = threading.Event()
+
+    def victim():
+        while not stop.is_set():
+            time.sleep(0.001)
+
+    threads = [
+        threading.Thread(target=victim, name=f"victim-{i}", daemon=True)
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    s = profiler.HostSampler(hz=500)
+    s.start()
+    time.sleep(0.1)
+    stop.set()  # all victims exit mid-capture
+    for t in threads:
+        t.join(timeout=5)
+    time.sleep(0.1)  # sampler keeps running over the corpses
+    out = s.stop()
+    assert out["samples"] > 10
+    assert any("victim-" in k for k in out["folded"])
+
+
+def test_host_sampler_evicts_tids_without_live_thread_objects(monkeypatch):
+    """A tid present in sys._current_frames but absent from
+    threading.enumerate() (exited or reused by a foreign native thread)
+    is skipped, never walked with a stale identity."""
+    done = threading.Event()
+    ghost = threading.Thread(
+        target=done.wait, args=(5.0,), name="ghost-thread"
+    )
+    ghost.start()
+    try:
+        s = profiler.HostSampler(hz=50)
+        real_enumerate = threading.enumerate
+        monkeypatch.setattr(
+            threading, "enumerate",
+            lambda: [t for t in real_enumerate() if t.name != "ghost-thread"],
+        )
+        s.sample_once()
+        assert not any(k.startswith("ghost-thread") for k in s._folded)
+        assert s._samples == 1
+    finally:
+        done.set()
+        ghost.join()
+
+
+def test_gc_profile_dirs_removes_only_expired_entries(tmp_path):
+    old = tmp_path / "prof-0001-manual"
+    fresh = tmp_path / "prof-0002-manual"
+    old.mkdir()
+    fresh.mkdir()
+    stale_ts = time.time() - 7200
+    os.utime(old, (stale_ts, stale_ts))
+    removed = profiler.gc_profile_dirs(str(tmp_path), ttl_s=3600)
+    assert removed == 1
+    assert not old.exists() and fresh.exists()
+    # Missing base: silent no-op, never an exception.
+    assert profiler.gc_profile_dirs(str(tmp_path / "nope")) == 0
+
+
+def test_profile_knobs_parse_and_default(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_PROFILE_HOST_HZ", "25.5")
+    monkeypatch.setenv("RAY_TPU_PROFILE_AUTO", "off")
+    monkeypatch.setenv("RAY_TPU_PROFILE_AUTO_STEPS", "garbage")
+    assert profiler.knob_float("HOST_HZ", 50.0) == 25.5
+    assert profiler.knob_bool("AUTO", True) is False
+    assert profiler.knob_int("AUTO_STEPS", 3) == 3  # bad value -> default
+    assert profiler.knob_float("MAX_S", 60.0) == 60.0  # unset -> default
+
+
+# ---------------------------------------------------------------------------
+# capture plane: alignment + typed lifecycle
+# ---------------------------------------------------------------------------
+
+def _arm(plane, tmp_path, capture_id="cap", start_step=5, steps=2,
+         max_s=30, host=False):
+    return plane.arm({
+        "capture_id": capture_id,
+        "start_step": start_step,
+        "steps": steps,
+        "max_s": max_s,
+        "host": host,
+        "device": False,
+        "session_dir": str(tmp_path),
+    })
+
+
+def test_two_planes_cut_on_identical_step_edges(tmp_path):
+    """The tentpole alignment invariant: two ranks armed with the same
+    start_step capture exactly the same steps, whatever steps their
+    reports were on when the arm RPC landed."""
+    planes = []
+    for rank in range(2):
+        p = profiler.ProfilePlane()
+        p.set_meta(rank=rank, node_id=f"n{rank}", worker_id=f"w{rank}")
+        assert _arm(p, tmp_path)["status"] == "ok"
+        planes.append(p)
+    # Rank 1's step stream runs ahead of rank 0's by the time arming
+    # lands: both must still open the capture at the step-5 edge.
+    for step in range(2, 8):
+        planes[0].on_step_boundary(step)
+    for step in range(3, 8):
+        planes[1].on_step_boundary(step)
+    bounds = []
+    for p in planes:
+        res = p.collect()
+        assert res["status"] == "ok"
+        assert res["aborted"] is False
+        bounds.append([b["step"] for b in res["boundaries"]])
+    assert bounds[0] == bounds[1] == [4, 5, 6]
+    # collect() reset the plane: a fresh arm is legal immediately.
+    assert planes[0].state == "idle"
+
+
+def test_plane_typed_errors_and_abort(tmp_path):
+    p = profiler.ProfilePlane()
+    p.set_meta(rank=0)
+    assert p.collect()["code"] == "no_capture"
+    assert _arm(p, tmp_path)["status"] == "ok"
+    dup = _arm(p, tmp_path, capture_id="dup")
+    assert dup["status"] == "error" and dup["code"] == "already_active"
+    assert p.collect()["code"] == "not_done"
+    assert p.abort()["status"] == "ok"
+    res = p.collect()
+    assert res["status"] == "ok" and res["aborted"] is True
+    assert p.status()["state"] == "idle"
+
+
+def test_plane_armed_timer_never_leaks(tmp_path, monkeypatch):
+    """An armed plane whose step stream never reaches start_step (dead
+    loop, non-train worker mis-targeted) must force-finish on its own
+    timer — the controller's collect then sees a typed empty capture
+    instead of a plane wedged armed forever."""
+    monkeypatch.setattr(profiler, "_TIMER_GRACE_S", 0.05)
+    p = profiler.ProfilePlane()
+    p.set_meta(rank=0)
+    assert _arm(p, tmp_path, start_step=10_000, max_s=0.1)["status"] == "ok"
+    deadline = time.time() + 5.0
+    while p.status()["state"] != "done" and time.time() < deadline:
+        time.sleep(0.02)
+    res = p.collect()
+    assert res["status"] == "ok"
+    assert res["timed_out"] is True
+    assert res["boundaries"] == []
+
+
+def test_plane_without_step_stream_starts_immediately(tmp_path):
+    p = profiler.ProfilePlane()
+    p.set_meta(rank=None, worker_id="w-aux")
+    res = p.arm({"capture_id": "c", "start_step": None, "steps": 1,
+                 "max_s": 30, "host": False, "device": False,
+                 "session_dir": str(tmp_path)})
+    assert res["status"] == "ok"
+    assert p.status()["state"] == "capturing"
+    p.note_annotation("aux_work", time.time(), 0.01)
+    p.abort()
+    collected = p.collect()
+    assert [a["name"] for a in collected["annotations"]] == ["aux_work"]
+
+
+# ---------------------------------------------------------------------------
+# merge: determinism + step joins + hot phase
+# ---------------------------------------------------------------------------
+
+def _capture(rank, t0=1000.0, *, trace_id=None, folded=None, phases=None):
+    bounds = []
+    for i, step in enumerate((4, 5, 6)):
+        mark = {"step": step, "ts": t0 + 0.1 * i}
+        if trace_id:
+            mark["trace_id"] = trace_id
+            mark["span_id"] = f"{rank}{i}"
+        bounds.append(mark)
+    return {
+        "capture_id": "cap",
+        "rank": rank,
+        "worker_id": f"worker-{rank}",
+        "node_id": "n0",
+        "aborted": False,
+        "timed_out": False,
+        "boundaries": bounds,
+        "annotations": [
+            {"name": "bwd", "ts": t0 + 0.15, "dur_s": 0.04},
+            {"name": "fwd", "ts": t0 + 0.11, "dur_s": 0.02},
+        ],
+        "phase_totals": dict(phases or {"fwd": 0.02, "bwd": 0.04}),
+        "host": {"folded": dict(folded or {}), "samples": 7, "dropped": 0},
+        "device_trace_dir": f"/sess/profiles/cap/rank{rank}-device",
+    }
+
+
+def test_merge_captures_builds_one_step_joined_trace():
+    caps = [_capture(1, trace_id="tid-b"), _capture(0, trace_id="tid-a")]
+    out = profile_merge.merge_captures(caps, "cap", meta={"reason": "manual"})
+    md = out["metadata"]
+    assert md["ranks"] == [0, 1]
+    assert md["trace_ids"] == ["tid-a", "tid-b"]
+    assert md["reason"] == "manual"
+    assert md["device_trace_dirs"]["0"].endswith("rank0-device")
+    assert md["host_samples"] == {"0": 7, "1": 7}
+    step_slices = [e for e in out["traceEvents"] if e.get("cat") == "step"]
+    # Both ranks: a slice per captured step, pid = rank, args join back
+    # to the capture and the per-step trace ids.
+    assert {(e["pid"], e["args"]["step"]) for e in step_slices} == {
+        (0, 5), (0, 6), (1, 5), (1, 6),
+    }
+    assert all(e["args"]["capture_id"] == "cap" for e in step_slices)
+    assert {e["args"]["trace_id"] for e in step_slices} == {"tid-a", "tid-b"}
+    # Annotations land on tid 1 and inherit the containing step.
+    anns = [e for e in out["traceEvents"] if e.get("cat") == "phase"]
+    assert {e["name"] for e in anns} == {"fwd", "bwd"}
+    # Both annotations sit inside step 6's window (t0+0.1 .. t0+0.2).
+    assert all(e["tid"] == 1 and e["args"]["step"] == 6 for e in anns)
+
+
+def test_merge_is_deterministic_across_input_order():
+    a = [_capture(0, folded={"MainThread;f (x.py:1)": 3}), _capture(1)]
+    b = [_capture(1), _capture(0, folded={"MainThread;f (x.py:1)": 3})]
+    assert json.dumps(profile_merge.merge_captures(a, "cap")) == \
+        json.dumps(profile_merge.merge_captures(b, "cap"))
+    assert json.dumps(profile_merge.merge_folded(a)) == \
+        json.dumps(profile_merge.merge_folded(b))
+
+
+def test_merge_folded_prefixes_ranks_and_tree_is_stable():
+    caps = [
+        _capture(0, folded={"MainThread;step (t.py:9);fwd (t.py:2)": 5,
+                            "MainThread;step (t.py:9)": 2}),
+        _capture(1, folded={"MainThread;step (t.py:9)": 4}),
+    ]
+    folded = profile_merge.merge_folded(caps)
+    assert folded == {
+        "rank0;MainThread;step (t.py:9)": 2,
+        "rank0;MainThread;step (t.py:9);fwd (t.py:2)": 5,
+        "rank1;MainThread;step (t.py:9)": 4,
+    }
+    text = profile_merge.folded_text(folded)
+    assert "rank0;MainThread;step (t.py:9);fwd (t.py:2) 5\n" in text
+    tree = profile_merge.flamegraph_tree(folded)
+    assert tree["name"] == "all" and tree["value"] == 11
+    assert [c["name"] for c in tree["children"]] == ["rank0", "rank1"]
+    rank0 = tree["children"][0]
+    assert rank0["value"] == 7
+    # value rolls up: the shared prefix frame counts both stacks.
+    assert rank0["children"][0]["children"][0]["value"] == 7
+
+
+def test_hot_phase_prefers_exposed_comm_and_breaks_ties_by_name():
+    # Overlap accounting: `collective` is total op time (background
+    # threads included); only `comm_exposed` stole step wall clock.
+    phase, frac = profile_merge.hot_phase(
+        {"collective": 9.0, "comm_exposed": 0.4, "fwd": 0.6}
+    )
+    assert phase == "fwd"
+    assert frac == pytest.approx(0.6)
+    assert profile_merge.hot_phase({"collective": 2.0, "fwd": 1.0}) == \
+        ("collective", pytest.approx(2 / 3))
+    assert profile_merge.hot_phase({"bwd": 1.0, "fwd": 1.0})[0] == "bwd"
+    assert profile_merge.hot_phase({}) == (None, 0.0)
+    assert profile_merge.hot_phase({"fwd": 0.0}) == (None, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# StepStats split: fwd/bwd/opt refines compute, never redefines it
+# ---------------------------------------------------------------------------
+
+class _Ctx:
+    world_rank = 0
+    node_id = "node-test"
+    dataset_shards: dict = {}
+
+
+@pytest.fixture()
+def recorder():
+    step_stats.activate()
+    try:
+        yield step_stats.StepRecorder(_Ctx())
+    finally:
+        step_stats.deactivate()
+
+
+def test_split_sums_to_recorded_phases_and_compute_is_unchanged(recorder):
+    recorder.on_report({})
+    step_stats.record_phase("fwd", 0.010)
+    step_stats.record_phase("bwd", 0.020)
+    step_stats.record_phase("opt", 0.005)
+    time.sleep(0.08)
+    rec = recorder.on_report({})
+    # compute_s is the same remainder formula as before the split...
+    assert rec["compute_s"] == pytest.approx(rec["wall_s"], rel=0.05)
+    # ...and the split reproduces the annotated values exactly when they
+    # fit inside compute.
+    assert rec["fwd_s"] == pytest.approx(0.010)
+    assert rec["bwd_s"] == pytest.approx(0.020)
+    assert rec["opt_s"] == pytest.approx(0.005)
+    assert rec["fwd_s"] + rec["bwd_s"] + rec["opt_s"] <= rec["compute_s"]
+
+
+def test_split_clamps_to_compute_preserving_ratios(recorder):
+    recorder.on_report({})
+    # Annotated phase walls larger than the step (overlapping scopes,
+    # clock weirdness): scaled down so the split sums to compute.
+    step_stats.record_phase("fwd", 10.0)
+    step_stats.record_phase("bwd", 30.0)
+    time.sleep(0.04)
+    rec = recorder.on_report({})
+    total = rec["fwd_s"] + rec["bwd_s"] + rec["opt_s"]
+    assert total == pytest.approx(rec["compute_s"], rel=1e-6)
+    assert rec["bwd_s"] == pytest.approx(3 * rec["fwd_s"], rel=1e-6)
+
+
+def test_no_annotations_means_no_split_keys(recorder):
+    recorder.on_report({})
+    time.sleep(0.01)
+    rec = recorder.on_report({})
+    assert "fwd_s" not in rec and "bwd_s" not in rec and "opt_s" not in rec
+
+
+def test_step_annotation_times_and_attributes(recorder):
+    recorder.on_report({})
+    with step_stats.step_annotation("bwd", phase="bwd"):
+        time.sleep(0.02)
+    with step_stats.step_annotation("grad_sync"):  # no phase: timer only
+        time.sleep(0.001)
+    rec = recorder.on_report({})
+    assert rec["bwd_s"] >= 0.015
+    assert "fwd_s" in rec  # split keys ride together once any sub fired
+
+
+# ---------------------------------------------------------------------------
+# aggregator + diagnose: the split travels to gang summaries and findings
+# ---------------------------------------------------------------------------
+
+def _step_rec(step, rank, wall, **extra):
+    rec = {
+        "step": step, "ts": 1000.0 + step, "rank": rank, "wall_s": wall,
+        "data_wait_s": 0.0, "compute_s": wall, "collective_s": 0.0,
+        "checkpoint_s": 0.0,
+    }
+    rec.update(extra)
+    return rec
+
+
+def test_aggregator_ingests_sub_phases_additively():
+    agg = workload.StepStatsAggregator()
+    for step in range(10):
+        for rank in range(2):
+            agg.add(_step_rec(step, rank, 1.0, fwd_s=0.3, bwd_s=0.5,
+                              opt_s=0.2))
+    s = agg.summary()
+    assert s["fwd_frac"] == pytest.approx(0.3)
+    assert s["bwd_frac"] == pytest.approx(0.5)
+    assert s["opt_frac"] == pytest.approx(0.2)
+    # STEP_PHASES fracs unchanged by the refinement.
+    assert s["compute_frac"] == pytest.approx(1.0)
+
+
+def test_aggregator_omits_sub_fracs_when_no_rank_splits():
+    agg = workload.StepStatsAggregator()
+    for step in range(10):
+        agg.add(_step_rec(step, 0, 1.0))
+    s = agg.summary()
+    assert "fwd_frac" not in s and "bwd_frac" not in s and "opt_frac" not in s
+
+
+def _diag_snapshot(profiles):
+    return {
+        "latency": {}, "comm": {}, "resources": {"nodes": {}},
+        "goodput": {"runs": {}}, "workload": {"series": {}},
+        "rank_records": {}, "commflight": {}, "serve_llm": {},
+        "profiles": profiles,
+    }
+
+
+def test_diagnose_names_straggler_hot_phase_from_auto_capture():
+    profiles = [
+        {"capture_id": "prof-0001-manual", "reason": "manual",
+         "hot_phases": {"0": {"phase": "fwd", "frac": 0.9}}},
+        {"capture_id": "prof-0002-straggler", "reason": "straggler",
+         "status": "ok", "path": "/sess/profiles/p2/merged_trace.json",
+         "hot_phases": {"3": {"phase": "collective", "frac": 0.62}}},
+    ]
+    findings = workload.diagnose(_diag_snapshot(profiles))
+    hot = [f for f in findings if f["kind"] == "straggler_hot_phase"]
+    assert len(hot) == 1
+    f = hot[0]
+    assert f["severity"] == "crit"
+    assert "rank 3" in f["message"]
+    assert "'collective'" in f["message"]
+    assert "62%" in f["message"]
+    assert "merged_trace.json" in f["message"]
+    assert f["data"]["capture_id"] == "prof-0002-straggler"
+
+
+def test_diagnose_ignores_manual_captures():
+    profiles = [
+        {"capture_id": "prof-0001-manual", "reason": "manual",
+         "hot_phases": {"0": {"phase": "fwd", "frac": 0.9}}},
+    ]
+    findings = workload.diagnose(_diag_snapshot(profiles))
+    assert not [f for f in findings if f["kind"] == "straggler_hot_phase"]
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: coordinated capture, auto-trigger, false-positive twin
+# ---------------------------------------------------------------------------
+
+def _poll(fn, timeout=30.0, period=0.25):
+    deadline = time.time() + timeout
+    value = fn()
+    while not value and time.time() < deadline:
+        time.sleep(period)
+        value = fn()
+    return value
+
+
+def _profiler_cluster(extra_env):
+    from ray_tpu._private import chaos as chaos_core
+
+    assert not ray_tpu.is_initialized()
+    env = {
+        "RAY_TPU_PROFILE_MAX_S": "30",
+        "RAY_TPU_PROFILE_AUTO_STEPS": "2",
+        "RAY_TPU_PROFILE_AUTO_COOLDOWN_S": "2",
+        "RAY_TPU_PROFILE_AUTO_CONSECUTIVE": "1",
+    }
+    env.update(extra_env)
+    for key, value in env.items():
+        os.environ[key] = value
+    chaos_core.reset()
+    ray_tpu.init(num_cpus=8)
+    return env
+
+
+def _teardown_profiler_cluster(env):
+    from ray_tpu._private import chaos as chaos_core
+
+    ray_tpu.shutdown()
+    for key in env:
+        os.environ.pop(key, None)
+    chaos_core.reset()
+
+
+def _annotated_loop(config):
+    """Train loop with the same fwd/bwd/opt annotation scopes the GSPMD
+    trainer emits, plus a chaos latency point standing in for a slow
+    collective on whatever rank the schedule targets."""
+    import time
+
+    from ray_tpu import train
+    from ray_tpu._private import chaos as chaos_mod
+    from ray_tpu.train._internal import step_stats as ss
+
+    rank = train.get_context().get_world_rank()
+    for step in range(config["steps"]):
+        with ss.step_annotation("fwd", phase="fwd"):
+            time.sleep(0.002)
+        # bwd is the hot phase by a wide margin so one descheduled
+        # sleep inside a short capture window can't flip the ranking.
+        with ss.step_annotation("bwd", phase="bwd"):
+            time.sleep(0.012)
+        with ss.step_annotation("grad_sync", phase="collective"):
+            delay = chaos_mod.latency_delay(
+                f"train.step.rank{rank}"
+            ) + chaos_mod.latency_delay("train.step.uniform")
+            time.sleep(0.002 + delay)
+        train.report({"step": step, "tokens": 100.0})
+
+
+def _fit_in_background(tmp_path, name, steps, num_workers):
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    trainer = JaxTrainer(
+        _annotated_loop,
+        train_loop_config={"steps": steps},
+        scaling_config=ScalingConfig(num_workers=num_workers),
+        run_config=RunConfig(name=name, storage_path=str(tmp_path)),
+    )
+    out: dict = {}
+
+    def run():
+        out["result"] = trainer.fit()
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    return thread, out
+
+
+@pytest.fixture()
+def quiet_cluster():
+    env = _profiler_cluster({"RAY_TPU_PROFILE_AUTO": "0"})
+    try:
+        yield
+    finally:
+        _teardown_profiler_cluster(env)
+
+
+@pytest.fixture()
+def straggler_cluster():
+    env = _profiler_cluster({
+        "RAY_TPU_chaos": json.dumps({
+            "seed": 20,
+            # Exactly ONE rank's grad_sync drags 150ms every step.
+            "latency_points": {"train.step.rank3": 150.0},
+        }),
+    })
+    try:
+        yield
+    finally:
+        _teardown_profiler_cluster(env)
+
+
+@pytest.fixture()
+def uniform_slow_cluster():
+    env = _profiler_cluster({
+        "RAY_TPU_chaos": json.dumps({
+            "seed": 21,
+            # The SAME latency on every rank: slow but healthy.
+            "latency_points": {"train.step.uniform": 150.0},
+        }),
+    })
+    try:
+        yield
+    finally:
+        _teardown_profiler_cluster(env)
+
+
+@pytest.mark.slow
+def test_e2e_cli_profile_merges_two_step_aligned_ranks(
+    quiet_cluster, tmp_path
+):
+    """Acceptance: `ray_tpu profile --steps N` against a live 2-worker
+    gang yields ONE merged Perfetto file whose two rank track groups
+    carry step-aligned step slices and fwd/bwd/opt annotation tracks."""
+    import io
+    import unittest.mock
+    from contextlib import redirect_stdout
+
+    from ray_tpu import scripts
+    from ray_tpu.util import state
+
+    thread, out = _fit_in_background(
+        tmp_path, "profe2e", steps=250, num_workers=2
+    )
+    try:
+        assert _poll(
+            lambda: "train/profe2e" in state.summarize_workload()["series"],
+            timeout=60,
+        ), "train series never landed"
+
+        copy_path = tmp_path / "copied_trace.json"
+        buf = io.StringIO()
+        with unittest.mock.patch.object(scripts, "_connect"):
+            with redirect_stdout(buf):
+                scripts.main([
+                    "profile", "--steps", "2", "--json",
+                    "--out", str(copy_path),
+                ])
+        rec = json.loads(buf.getvalue())
+        assert rec["status"] == "ok", rec
+        assert rec["ranks"] == [0, 1]
+        assert rec["reason"] == "manual"
+        assert rec["capture_id"].endswith("-manual")
+
+        with open(rec["path"]) as fh:
+            trace = json.load(fh)
+        md = trace["metadata"]
+        assert md["ranks"] == [0, 1]
+        assert "trace_ids" in md
+        # Step-aligned: both pids (= ranks) captured the SAME steps.
+        steps_by_rank: dict = {}
+        for ev in trace["traceEvents"]:
+            if ev.get("cat") == "step":
+                steps_by_rank.setdefault(ev["pid"], set()).add(
+                    ev["args"]["step"]
+                )
+        assert set(steps_by_rank) == {0, 1}
+        assert steps_by_rank[0] == steps_by_rank[1]
+        assert len(steps_by_rank[0]) == 2
+        assert all(s >= rec["start_step"] for s in steps_by_rank[0])
+        # Both ranks carry the fwd/bwd/opt annotation track.
+        ann_by_rank: dict = {}
+        for ev in trace["traceEvents"]:
+            if ev.get("cat") == "phase":
+                ann_by_rank.setdefault(ev["pid"], set()).add(ev["name"])
+        for rank in (0, 1):
+            assert {"fwd", "bwd", "grad_sync"} <= ann_by_rank[rank]
+        # Hot-phase attribution fired for both ranks (bwd dominates the
+        # synthetic step) and the folded host stacks merged.
+        assert rec["hot_phases"]["0"]["phase"] == "bwd"
+        assert rec["hot_phases"]["1"]["phase"] == "bwd"
+        assert os.path.exists(rec["folded_path"])
+        assert copy_path.exists()
+        # `--out` copy is byte-identical to the session artifact.
+        assert copy_path.read_bytes() == open(rec["path"], "rb").read()
+
+        # The capture record is in the controller ledger + the exported
+        # profile event channel.
+        profiles = state.list_profiles()
+        assert any(
+            p["capture_id"] == rec["capture_id"] for p in profiles
+        )
+    finally:
+        thread.join(timeout=120)
+    assert out["result"].error is None
+
+
+@pytest.mark.slow
+def test_e2e_straggler_chaos_auto_triggers_capture_naming_rank(
+    straggler_cluster, tmp_path
+):
+    """Acceptance: a chaos latency point on ONE rank's grad_sync makes
+    the MAD detector flag it, the driver debounce-triggers a capture of
+    that rank, and diagnose names the rank AND its hot phase."""
+    from ray_tpu.util import state
+
+    thread, out = _fit_in_background(
+        tmp_path, "straggle", steps=45, num_workers=4
+    )
+    try:
+        autos = _poll(
+            lambda: [
+                p for p in state.list_profiles()
+                if p.get("reason") == "straggler"
+            ],
+            timeout=90,
+        )
+        assert autos, "straggler auto-capture never fired"
+    finally:
+        thread.join(timeout=180)
+    assert out["result"].error is None
+
+    autos = [
+        p for p in state.list_profiles() if p.get("reason") == "straggler"
+    ]
+    # Zero mis-targeted captures: every auto capture named rank 3 only.
+    assert all(p.get("requested_ranks") == [3] for p in autos), autos
+    done = [p for p in autos if p.get("status") in ("ok", "partial")]
+    assert done, autos
+    cap = done[-1]
+    assert cap["ranks"] == [3]
+    assert os.path.exists(cap["path"])
+    # The slow rank's hot phase is the dragged grad_sync collective.
+    assert cap["hot_phases"]["3"]["phase"] == "collective"
+    assert cap["hot_phases"]["3"]["frac"] > 0.5
+
+    snapshot = state.collect_diagnose_snapshot()
+    findings = workload.diagnose(snapshot)
+    hot = [f for f in findings if f["kind"] == "straggler_hot_phase"]
+    assert hot, [f["kind"] for f in findings]
+    assert any(
+        f["data"]["rank"] == "3" and f["data"]["phase"] == "collective"
+        for f in hot
+    )
+
+    # The capture landed on the exported profile event channel too.
+    from ray_tpu._private.event_export import read_events
+    from ray_tpu.util import state as state_mod
+
+    session_dir = state_mod._session_dir()
+    events = read_events(session_dir, "profile")
+    assert any(
+        e["data"].get("capture_id") == cap["capture_id"] for e in events
+    )
+
+
+@pytest.mark.slow
+def test_e2e_uniform_slow_cluster_never_auto_captures(
+    uniform_slow_cluster, tmp_path
+):
+    """The false-positive twin: the SAME 150ms drag on every rank is a
+    slow-but-healthy gang — the MAD detector stays quiet and zero
+    captures fire."""
+    from ray_tpu.util import state
+
+    thread, out = _fit_in_background(
+        tmp_path, "uniform", steps=20, num_workers=4
+    )
+    thread.join(timeout=180)
+    assert out["result"].error is None
+    time.sleep(2.0)  # grace for any in-flight (wrong) trigger to land
+    assert state.list_profiles() == []
+    summary = state.summarize_workload()["series"].get("train/uniform")
+    if summary:
+        assert "stragglers" not in (summary.get("latest") or {})
